@@ -1,0 +1,61 @@
+"""Model library — the TPU-native twin of reference src/modeling.py.
+
+Every public class of the reference model library (modeling.py:188-1327) has a
+counterpart here. Differences are deliberate TPU-first design, not omissions:
+
+  - Modules are pure flax.linen; loss computation lives in
+    :mod:`bert_pytorch_tpu.models.losses` (functional JAX style) rather than
+    inside ``forward`` branches keyed on whether labels were passed.
+  - The encoder is a single ``nn.scan`` over layers (one trace, one compile,
+    stacked [L, ...] params) with optional rematerialization — replacing the
+    reference's Python layer loop + √N-chunked ``checkpointed_forward``
+    (modeling.py:495-536).
+  - Parameters carry logical axis names consumed by
+    :mod:`bert_pytorch_tpu.parallel` for pjit sharding.
+"""
+
+from bert_pytorch_tpu.models.bert import (
+    BertEmbeddings,
+    BertEncoder,
+    BertForMaskedLM,
+    BertForMultipleChoice,
+    BertForNextSentencePrediction,
+    BertForPreTraining,
+    BertForQuestionAnswering,
+    BertForSequenceClassification,
+    BertForTokenClassification,
+    BertLayer,
+    BertModel,
+    BertPooler,
+    LayerNorm,
+    LinearActivation,
+)
+from bert_pytorch_tpu.models.losses import (
+    masked_lm_loss,
+    next_sentence_loss,
+    pretraining_loss,
+    span_loss,
+    token_classification_loss,
+)
+
+__all__ = [
+    "BertEmbeddings",
+    "BertEncoder",
+    "BertForMaskedLM",
+    "BertForMultipleChoice",
+    "BertForNextSentencePrediction",
+    "BertForPreTraining",
+    "BertForQuestionAnswering",
+    "BertForSequenceClassification",
+    "BertForTokenClassification",
+    "BertLayer",
+    "BertModel",
+    "BertPooler",
+    "LayerNorm",
+    "LinearActivation",
+    "masked_lm_loss",
+    "next_sentence_loss",
+    "pretraining_loss",
+    "span_loss",
+    "token_classification_loss",
+]

@@ -1,0 +1,95 @@
+"""Loss functions for the model family — the functional JAX counterpart of the
+reference's in-module loss branches.
+
+Parity targets:
+  - ``BertPretrainingCriterion`` (run_pretraining.py:58-72): masked-LM CE with
+    ignore_index −1 plus NSP CE, summed.
+  - SQuAD span loss (run_squad.py:1085-1092): positions clamped to sequence
+    length, (start CE + end CE) / 2.
+  - Token classification CE with ignore_index −100 for special tokens
+    (ner_dataset.py:13-44, modeling.py:1200-1271).
+
+All cross-entropies are computed in fp32 regardless of logit dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def _xent_ignore(logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int):
+    """Mean CE over positions where label != ignore_index (torch CE semantics:
+    mean over non-ignored elements; 0 if none)."""
+    logits = logits.astype(jnp.float32)
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    per_pos = optax.softmax_cross_entropy_with_integer_labels(logits, safe_labels)
+    per_pos = jnp.where(valid, per_pos, 0.0)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    return jnp.sum(per_pos) / count
+
+
+def masked_lm_loss(prediction_logits, masked_lm_labels, ignore_index: int = -1):
+    """CE over [B, S, V] logits with ignore_index (run_pretraining.py:64-69)."""
+    vocab = prediction_logits.shape[-1]
+    return _xent_ignore(
+        prediction_logits.reshape(-1, vocab),
+        masked_lm_labels.reshape(-1),
+        ignore_index,
+    )
+
+
+def next_sentence_loss(seq_relationship_logits, next_sentence_labels):
+    """CE over [B, 2] NSP logits (run_pretraining.py:70-71)."""
+    return _xent_ignore(
+        seq_relationship_logits.reshape(-1, 2),
+        next_sentence_labels.reshape(-1),
+        ignore_index=-1,
+    )
+
+
+def pretraining_loss(
+    prediction_logits,
+    seq_relationship_logits,
+    masked_lm_labels,
+    next_sentence_labels=None,
+):
+    """MLM + NSP total (run_pretraining.py:58-72); MLM-only when NSP is off."""
+    loss = masked_lm_loss(prediction_logits, masked_lm_labels)
+    if seq_relationship_logits is not None and next_sentence_labels is not None:
+        loss = loss + next_sentence_loss(seq_relationship_logits, next_sentence_labels)
+    return loss
+
+
+def span_loss(start_logits, end_logits, start_positions, end_positions):
+    """SQuAD loss: clamp positions into [0, S], CE on start and end, averaged
+    (run_squad.py:1085-1092 — clamped index == ignored index S)."""
+    seq_len = start_logits.shape[-1]
+    start_positions = jnp.clip(start_positions, 0, seq_len)
+    end_positions = jnp.clip(end_positions, 0, seq_len)
+    # The reference sets ignored_index = seq_len and clamps into it; emulate by
+    # padding logits with one extra (ignored) class.
+    pad = jnp.full(start_logits.shape[:-1] + (1,), -10000.0, start_logits.dtype)
+    start_l = jnp.concatenate([start_logits, pad], axis=-1).astype(jnp.float32)
+    end_l = jnp.concatenate([end_logits, pad], axis=-1).astype(jnp.float32)
+    s = _xent_ignore(start_l, start_positions, ignore_index=seq_len)
+    e = _xent_ignore(end_l, end_positions, ignore_index=seq_len)
+    return (s + e) / 2.0
+
+
+def token_classification_loss(logits, labels, ignore_index: int = -100):
+    """Per-token CE skipping special-token labels (run_ner.py via
+    modeling.py:1200-1271)."""
+    num_labels = logits.shape[-1]
+    return _xent_ignore(
+        logits.reshape(-1, num_labels), labels.reshape(-1), ignore_index
+    )
+
+
+def mlm_accuracy(prediction_logits, masked_lm_labels, ignore_index: int = -1):
+    """Fraction of masked positions predicted correctly (for eval logging)."""
+    preds = jnp.argmax(prediction_logits, axis=-1)
+    valid = masked_lm_labels != ignore_index
+    correct = jnp.logical_and(preds == masked_lm_labels, valid)
+    return jnp.sum(correct) / jnp.maximum(jnp.sum(valid), 1)

@@ -1,0 +1,26 @@
+"""Fused-op library: the TPU-native equivalents of the reference's Apex CUDA
+kernels (SURVEY.md §2.3).
+
+Every op has an XLA reference implementation (the default — XLA already fuses
+elementwise chains into the surrounding matmuls on TPU) and, where profitable,
+a Pallas kernel selected with ``backend='pallas'``. This mirrors the
+reference's pattern of a fused CUDA path with an unfused Python fallback
+(src/modeling.py:299-336).
+"""
+
+from bert_pytorch_tpu.ops.activations import ACT2FN, bias_gelu, bias_tanh, gelu, swish
+from bert_pytorch_tpu.ops.layernorm import layer_norm
+from bert_pytorch_tpu.ops.attention import dot_product_attention
+from bert_pytorch_tpu.ops.grad_utils import global_norm, clip_by_global_norm
+
+__all__ = [
+    "ACT2FN",
+    "gelu",
+    "bias_gelu",
+    "bias_tanh",
+    "swish",
+    "layer_norm",
+    "dot_product_attention",
+    "global_norm",
+    "clip_by_global_norm",
+]

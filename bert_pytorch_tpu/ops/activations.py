@@ -1,0 +1,45 @@
+"""Activation functions.
+
+Parity with reference src/modeling.py:118-139 (``gelu``/``bias_gelu``/
+``bias_tanh``/``swish`` + ``ACT2FN``). The reference ships jit-scripted fused
+bias+activation CUDA paths; on TPU, XLA fuses the bias add and the activation
+into the producing matmul automatically, so these stay plain jnp expressions.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import nn as jnn
+
+
+def gelu(x):
+    """Exact (erf) GELU — the reference's formulation (modeling.py:118-124)."""
+    return x * 0.5 * (1.0 + jax.lax.erf(x / jnp.sqrt(2.0).astype(x.dtype)))
+
+
+def bias_gelu(bias, y):
+    """Fused bias + GELU (reference modeling.py:126-130)."""
+    return gelu(y + bias)
+
+
+def bias_tanh(bias, y):
+    """Fused bias + tanh (reference modeling.py:132-134)."""
+    return jnp.tanh(y + bias)
+
+
+def swish(x):
+    """x * sigmoid(x) (reference modeling.py:136-137)."""
+    return x * jnn.sigmoid(x)
+
+
+def relu(x):
+    return jnn.relu(x)
+
+
+ACT2FN = {
+    "gelu": gelu,
+    "bias_gelu": bias_gelu,
+    "bias_tanh": bias_tanh,
+    "relu": relu,
+    "swish": swish,
+    "tanh": jnp.tanh,
+}

@@ -1,0 +1,62 @@
+"""Multi-head dot-product attention core.
+
+TPU-native replacement for the reference's unfused score/softmax/context chain
+(src/modeling.py:403-429 ``BertSelfAttention``): batched einsums land on the
+MXU, the softmax runs in fp32 for bf16 safety, and the additive mask uses the
+reference's ``(1 - mask) * -10000`` bias convention (modeling.py:862-870).
+
+``backend='pallas'`` routes to a fused flash-style kernel for long sequences;
+at BERT's seq<=512 the XLA path is already MXU-bound, so it is the default.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def make_attention_bias(input_mask: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    """[B, S] {0,1} mask -> [B, 1, 1, S] additive bias, (1-m) * -10000.
+
+    Parity with reference modeling.py:862-870 (``extended_attention_mask``).
+    """
+    bias = (1.0 - input_mask.astype(jnp.float32)) * -10000.0
+    return bias[:, None, None, :].astype(dtype)
+
+
+def dot_product_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: jnp.ndarray | None = None,
+    dropout_rng=None,
+    dropout_rate: float = 0.0,
+    deterministic: bool = True,
+    backend: str = "xla",
+) -> jnp.ndarray:
+    """Attention over [B, S, H, D] query/key/value tensors.
+
+    Returns [B, S, H, D]. Scores are scaled by 1/sqrt(D) and softmaxed in
+    fp32 (modeling.py:403-429's score path, bf16-safe).
+    """
+    if backend == "pallas" and (deterministic or dropout_rate == 0.0):
+        # The fused kernel does not implement attention dropout; when dropout
+        # is active we fall back to the XLA path (same fused-or-fallback
+        # policy as reference modeling.py:327-335).
+        from bert_pytorch_tpu.ops.pallas.attention import flash_attention
+
+        return flash_attention(q, k, v, bias=bias)
+
+    depth = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(depth).astype(q.dtype)
+    # [B, H, Sq, Sk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    scores = scores.astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = probs.astype(q.dtype)
+    if not deterministic and dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep.astype(probs.dtype) / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,34 @@
+"""Gradient-norm utilities — TPU-native equivalent of the reference's
+``amp_C.multi_tensor_l2norm`` / ``multi_tensor_scale`` fused global-norm
+clipping (src/optimization.py:27-33, run_squad.py:703-725 ``GradientClipper``).
+
+On TPU a global norm is one fused XLA reduction tree over the gradient pytree —
+there is no multi-tensor-apply problem to solve; XLA flattens the per-leaf
+square-sums into a handful of kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def global_norm(tree) -> jnp.ndarray:
+    """L2 norm over every leaf of a pytree, accumulated in fp32."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
+    )
+
+
+def clip_by_global_norm(tree, max_norm: float):
+    """Scale the pytree so its global norm is at most ``max_norm``.
+
+    Matches ``GradientClipper`` semantics (run_squad.py:703-725): a no-op scale
+    when already within bounds.
+    """
+    norm = global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda g: (g * scale).astype(g.dtype), tree), norm

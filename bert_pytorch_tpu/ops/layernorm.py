@@ -1,0 +1,39 @@
+"""LayerNorm — TPU-native equivalent of Apex ``FusedLayerNormAffineFunction``
+(reference src/modeling.py:299-336).
+
+The default backend is plain XLA: mean/variance reductions and the affine
+transform fuse into one kernel on TPU, with statistics computed in fp32
+regardless of the activation dtype (the bf16-safe policy replacing the
+reference's fp16 AMP handling). A Pallas kernel backend is provided behind the
+same function, mirroring the reference's fused-with-fallback structure
+(modeling.py:327-335).
+"""
+
+from __future__ import annotations
+
+import jax.lax
+import jax.numpy as jnp
+
+
+def layer_norm(
+    x: jnp.ndarray,
+    scale: jnp.ndarray,
+    bias: jnp.ndarray,
+    eps: float = 1e-12,
+    backend: str = "xla",
+) -> jnp.ndarray:
+    """Normalize the last axis of ``x`` and apply the affine transform.
+
+    Statistics are computed in fp32; the result is cast back to ``x.dtype``.
+    """
+    if backend == "pallas":
+        from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas
+
+        return layer_norm_pallas(x, scale, bias, eps)
+
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    normed = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    out = normed * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(x.dtype)

@@ -1,0 +1,17 @@
+"""Pallas TPU kernels — the hand-written fused paths behind
+:mod:`bert_pytorch_tpu.ops`.
+
+These are the TPU-native counterparts of the reference's Apex CUDA kernels
+(SURVEY.md §2.3): fused LayerNorm (``FusedLayerNormAffineFunction``,
+modeling.py:299-336) and fused attention. Each is selected with
+``backend='pallas'`` on the corresponding :mod:`bert_pytorch_tpu.ops`
+function; the XLA path remains the default and the numerical reference.
+
+On CPU (tests, smoke runs) the kernels run in Pallas interpret mode
+automatically.
+"""
+
+from bert_pytorch_tpu.ops.pallas.layernorm import layer_norm_pallas
+from bert_pytorch_tpu.ops.pallas.attention import flash_attention
+
+__all__ = ["layer_norm_pallas", "flash_attention"]

@@ -1,0 +1,150 @@
+"""Flash-style fused attention Pallas kernel.
+
+The fused-attention path of the framework (SURVEY.md §7 stage 8): scores,
+masking, online softmax, and the value contraction happen in one kernel, so
+the [B, H, S, S] score matrix never touches HBM. At BERT's seq<=512 the XLA
+path is already MXU-bound, so this kernel's payoff is long-context headroom
+(it is the single-chip building block under ring attention in
+bert_pytorch_tpu/parallel/ring.py).
+
+Forward is a Pallas kernel that also emits the log-sum-exp residual; the
+backward recomputes probabilities from (q, k, bias, lse) with XLA einsums —
+O(S²) memory in the backward only, an explicit v1 trade documented here.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bert_pytorch_tpu.ops.pallas.common import interpret_mode, pick_block
+
+_NEG_INF = -1e30
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref, *, block_k, scale):
+    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, S, D]; bias_ref: [1, 1, S]
+    q = q_ref[0].astype(jnp.float32) * scale
+    seq_k = k_ref.shape[1]
+    block_q, depth = q.shape
+    num_kb = seq_k // block_k
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        b = bias_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        s = s + b[None, :]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, depth), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    out_ref[0] = (acc / l[:, None]).astype(out_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _flash_forward(q3, k3, v3, bias3, scale):
+    """q3/k3/v3: [BH, S, D]; bias3: [BH, 1, S] additive key bias."""
+    bh, seq, depth = q3.shape
+    block_q = pick_block(seq, (256, 128, 64, 32, 16, 8))
+    block_k = pick_block(seq, (256, 128, 64, 32, 16, 8))
+    grid = (bh, seq // block_q)
+    out, lse = pl.pallas_call(
+        partial(_flash_fwd_kernel, block_k=block_k, scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq, depth), q3.dtype),
+            jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
+        ],
+        interpret=interpret_mode(),
+    )(q3, k3, v3, bias3)
+    return out, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _flash(q3, k3, v3, bias3, scale):
+    out, _ = _flash_forward(q3, k3, v3, bias3, scale)
+    return out
+
+
+def _flash_fwd(q3, k3, v3, bias3, scale):
+    out, lse = _flash_forward(q3, k3, v3, bias3, scale)
+    return out, (q3, k3, v3, bias3, out, lse)
+
+
+def _flash_bwd(scale, residuals, g):
+    q3, k3, v3, bias3, out, lse = residuals
+    q = q3.astype(jnp.float32) * scale
+    k = k3.astype(jnp.float32)
+    v = v3.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    o32 = out.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", q, k) + bias3.astype(jnp.float32)
+    p = jnp.exp(s - lse[:, 0, :, None])  # [BH, Sq, Sk]
+    dv = jnp.einsum("bqk,bqd->bkd", p, g32)
+    dp = jnp.einsum("bqd,bkd->bqk", g32, v)
+    delta = jnp.sum(g32 * o32, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, k) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, q)
+    dbias = jnp.sum(ds, axis=1, keepdims=True)  # [BH, 1, Sk]
+    return (
+        dq.astype(q3.dtype),
+        dk.astype(k3.dtype),
+        dv.astype(v3.dtype),
+        dbias.astype(bias3.dtype),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, bias=None):
+    """Fused attention over [B, S, H, D] tensors.
+
+    ``bias`` is the [B, 1, 1, S] additive mask from
+    :func:`bert_pytorch_tpu.ops.attention.make_attention_bias` (key-only bias;
+    a full [B, H, Sq, Sk] bias is not supported by this kernel). Attention
+    dropout is not applied here — callers fall back to the XLA path when
+    dropout is active (see ops/attention.py).
+    """
+    batch, seq, heads, depth = q.shape
+    scale = 1.0 / float(depth) ** 0.5
+
+    def to3(t):
+        return t.transpose(0, 2, 1, 3).reshape(batch * heads, seq, depth)
+
+    if bias is None:
+        bias3 = jnp.zeros((batch * heads, 1, seq), jnp.float32)
+    else:
+        key_bias = bias.reshape(batch, -1)[:, -seq:]  # [B, S]
+        bias3 = jnp.repeat(key_bias.astype(jnp.float32), heads, axis=0)[:, None, :]
+    out3 = _flash(to3(q), to3(k), to3(v), bias3, scale)
+    return out3.reshape(batch, heads, seq, depth).transpose(0, 2, 1, 3)

@@ -1,0 +1,19 @@
+"""Shared helpers for Pallas kernels."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_mode() -> bool:
+    """Run kernels in interpreter mode on non-TPU backends so the same code
+    paths are testable on the virtual CPU mesh (SURVEY.md §4's Gloo analog)."""
+    return jax.default_backend() != "tpu"
+
+
+def pick_block(size: int, candidates=(512, 256, 128, 64, 32, 16, 8)) -> int:
+    """Largest hardware-friendly block that divides ``size``."""
+    for c in candidates:
+        if size % c == 0 and c <= size:
+            return c
+    return size

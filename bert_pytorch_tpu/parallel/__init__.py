@@ -1,0 +1,43 @@
+"""Parallelism layer: device mesh, sharding rules, collectives, launcher.
+
+The TPU-native replacement for the reference's NCCL/DDP/torchrun stack
+(SURVEY.md §2.2/§5.8): instead of wrapping the model in DDP and letting NCCL
+allreduce gradients (run_pretraining.py:185,270), we lay the pod out as a
+`jax.sharding.Mesh` with axes ``('data', 'fsdp', 'seq', 'model')``, annotate
+parameters/activations with logical axis names, and let XLA insert the
+collectives (psum / all-gather / reduce-scatter) over ICI.
+
+Strategies (rule sets):
+  - ``dp``    — pure data parallelism: params replicated, batch sharded.
+                The reference's only strategy (DDP), here with zero
+                allreduce code — XLA emits the gradient psum.
+  - ``fsdp``  — params sharded over the fsdp axis (ZeRO-3 analog); XLA
+                all-gathers weights per layer and reduce-scatters grads.
+  - ``tp``    — Megatron-style tensor parallelism over the model axis
+                (heads/mlp/vocab sharded).
+  - ``sp``    — sequence/context parallelism over the seq axis for
+                long-context (ring attention lives in ops/pallas).
+These compose: a mesh may use several axes at once.
+"""
+
+from bert_pytorch_tpu.parallel.mesh import (
+    MeshConfig,
+    create_mesh,
+    logical_axis_rules,
+)
+from bert_pytorch_tpu.parallel.sharding import (
+    batch_sharding,
+    mesh_sharding,
+    params_shardings,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "logical_axis_rules",
+    "batch_sharding",
+    "mesh_sharding",
+    "params_shardings",
+    "shard_params",
+]

@@ -1,0 +1,80 @@
+"""Multi-host launcher — the TPU-native replacement for torchrun + the
+SLURM/Cobalt ssh fan-out scripts (reference scripts/run_pretraining.sbatch:49-94,
+run_pretraining.cobalt:46-91).
+
+On a TPU pod there is one process per host; `jax.distributed.initialize`
+performs the rendezvous (the c10d analog of sbatch:64-70), after which
+`jax.devices()` spans the whole pod and a single SPMD program runs everywhere.
+Coordinator discovery mirrors the reference's node-file inference: explicit
+flags > environment (SLURM/COBALT nodefiles) > single-host default.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+import jax
+
+_INITIALIZED = False
+
+
+def infer_coordinator(port: int = 9731) -> Optional[str]:
+    """Infer the coordinator address the way the reference's sbatch infers the
+    master node from $SLURM_NODELIST / $COBALT_NODEFILE (sbatch:49-62)."""
+    nodelist = os.environ.get("SLURM_NODELIST")
+    if nodelist:
+        out = subprocess.run(
+            ["scontrol", "show", "hostnames", nodelist],
+            capture_output=True,
+            text=True,
+            check=False,
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return f"{out.stdout.splitlines()[0].strip()}:{port}"
+    nodefile = os.environ.get("COBALT_NODEFILE")
+    if nodefile and os.path.exists(nodefile):
+        with open(nodefile) as f:
+            first = f.readline().strip()
+        if first:
+            return f"{first}:{port}"
+    return None
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the pod-wide rendezvous. Safe to call on single-host runs (no-op
+    when no multi-host environment is detected).
+
+    On Cloud TPU VMs `jax.distributed.initialize()` auto-discovers everything;
+    the explicit arguments cover SLURM-style clusters (the reference's target,
+    sbatch:64-70).
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    explicit = coordinator_address or num_processes or process_id is not None
+    auto_env = any(
+        v in os.environ
+        for v in ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    slurm = "SLURM_NODELIST" in os.environ and int(os.environ.get("SLURM_NNODES", "1")) > 1
+    if not (explicit or auto_env or slurm):
+        return  # single host, single process: nothing to rendezvous
+    kwargs = {}
+    if coordinator_address or slurm:
+        kwargs["coordinator_address"] = coordinator_address or infer_coordinator()
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    elif slurm:
+        kwargs["num_processes"] = int(os.environ["SLURM_NNODES"])
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    elif slurm:
+        kwargs["process_id"] = int(os.environ.get("SLURM_NODEID", "0"))
+    jax.distributed.initialize(**kwargs)
+    _INITIALIZED = True

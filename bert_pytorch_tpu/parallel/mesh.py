@@ -1,0 +1,133 @@
+"""Device mesh construction and logical-axis rules.
+
+Replaces the reference's process-group bootstrap (run_pretraining.py:183-185
+``init_process_group('nccl')`` + torchrun rendezvous, sbatch:64-92). On TPU a
+"process group" is a `jax.sharding.Mesh` over `jax.devices()`; multi-host
+initialization is `jax.distributed.initialize` (see
+bert_pytorch_tpu/parallel/launcher.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("data", "fsdp", "seq", "model")
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Sizes of each mesh axis; -1 on ``data`` means 'all remaining devices'.
+
+    The product must equal the device count. The default is the reference's
+    capability: pure data parallelism over every chip (§2.2).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    seq: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        fixed = self.fsdp * self.seq * self.model
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*seq*model={fixed}"
+                )
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh {data}x{self.fsdp}x{self.seq}x{self.model} != {n_devices} devices"
+            )
+        return (data, self.fsdp, self.seq, self.model)
+
+
+def create_mesh(
+    mesh_config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the ('data', 'fsdp', 'seq', 'model') mesh.
+
+    Device order comes from `jax.devices()`, which JAX already returns in
+    ICI-topology order — nearest-neighbor axes (model/seq) get the fastest
+    links, matching the scaling-book layout recipe.
+    """
+    mesh_config = mesh_config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = mesh_config.resolve(len(devices))
+    device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, MESH_AXES)
+
+
+# Logical axis name -> mesh axis (or None = replicated), per strategy.
+# Model code only knows logical names (bert.py); changing strategy never
+# touches model code — this table is the entire parallelism configuration.
+_BASE_RULES = [
+    ("batch", ("data", "fsdp")),  # batch shards over data (and fsdp if used)
+    ("seq_act", "seq"),  # activation sequence axis (context parallelism)
+    ("pos", None),
+    ("types", None),
+    ("classes", None),
+    ("layers", None),  # scan axis: never sharded (pipeline would map this)
+]
+
+_STRATEGY_RULES = {
+    # sequence/context parallelism: params replicated like dp; the activation
+    # sequence axis ('seq_act', in _BASE_RULES) shards over the seq mesh axis.
+    "sp": [
+        ("embed", None),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ],
+    "dp": [
+        ("embed", None),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ],
+    "fsdp": [
+        ("embed", "fsdp"),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ],
+    "tp": [
+        ("embed", None),
+        ("embed_out", "model"),
+        ("vocab", "model"),
+        ("heads", "model"),
+        ("kv", None),
+        ("mlp", "model"),
+    ],
+    # tp + fsdp composed: sharded params gather over fsdp, split over model.
+    "tp_fsdp": [
+        ("embed", "fsdp"),
+        ("embed_out", "model"),
+        ("vocab", "model"),
+        ("heads", "model"),
+        ("kv", None),
+        ("mlp", "model"),
+    ],
+}
+
+
+def logical_axis_rules(strategy: str = "dp") -> list[tuple]:
+    """Rule list for ``nn.logical_to_mesh_sharding``."""
+    if strategy not in _STRATEGY_RULES:
+        raise ValueError(
+            f"unknown strategy '{strategy}'; options: {sorted(_STRATEGY_RULES)}"
+        )
+    return _BASE_RULES + _STRATEGY_RULES[strategy]

@@ -1,0 +1,17 @@
+"""Cross-cutting utilities: dist helpers, logging, checkpointing."""
+
+from bert_pytorch_tpu.utils.dist import (
+    barrier,
+    get_rank,
+    get_world_size,
+    is_main_process,
+    format_step,
+)
+
+__all__ = [
+    "barrier",
+    "get_rank",
+    "get_world_size",
+    "is_main_process",
+    "format_step",
+]

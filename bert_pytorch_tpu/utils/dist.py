@@ -1,0 +1,59 @@
+"""Distributed helpers — parity with reference src/utils.py:22-74.
+
+Rank/world-size map to JAX process index/count; the reference's
+``dist.barrier()`` (utils.py:49-51) has no direct analog in JAX's SPMD model —
+host synchronization happens implicitly at blocking device ops — so
+``barrier()`` here performs a tiny cross-process psum, which is both a real
+barrier and cheap.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_rank() -> int:
+    """Host (process) rank; reference utils.py:29-34."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """Number of host processes; reference utils.py:37-42."""
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """reference utils.py:45-46."""
+    return get_rank() == 0
+
+
+def barrier() -> None:
+    """Block until all processes arrive; reference utils.py:49-51."""
+    if jax.process_count() > 1:
+        # A tiny global psum forces a cross-host synchronization point.
+        x = jnp.ones((jax.local_device_count(),))
+        jax.block_until_ready(
+            jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")(x)
+        )
+
+
+def format_step(epoch, step, split: str = "") -> str:
+    """Human-readable step tag; reference utils.py:54-64."""
+    parts = []
+    if epoch is not None:
+        parts.append(f"Epoch: {epoch}")
+    if step is not None:
+        parts.append(f"Step: {step}")
+    if split:
+        parts.append(f"Split: {split}")
+    return " ".join(parts)
+
+
+def seed_for_worker(seed: int, rank: int | None = None) -> np.random.Generator:
+    """Seeded numpy generator per (seed, rank) — the WorkerInitObj analog
+    (reference utils.py:22-26, run_pretraining.py:583-586 seeds with
+    seed + local_rank)."""
+    rank = get_rank() if rank is None else rank
+    return np.random.default_rng(seed + rank)

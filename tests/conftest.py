@@ -1,0 +1,50 @@
+"""Test harness configuration.
+
+Multi-device logic is tested on a virtual 8-device CPU mesh
+(``--xla_force_host_platform_device_count=8``) — the TPU-world analog of the
+reference's Gloo-backend CPU test harness (reference src/dataset.py:455).
+These env vars must be set before jax initializes its backends, hence the
+module-level assignment in conftest.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the axon TPU plugin would
+# otherwise claim the default backend even without JAX_PLATFORMS set.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The CPU backend's default matmul precision truncates inputs to bf16 (TPU
+# MXU emulation), which would drown kernel-vs-reference comparisons in 1e-2
+# noise. Tests compare numerics, so force true fp32 matmuls.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def tiny_config():
+    from bert_pytorch_tpu.config import BertConfig
+
+    return BertConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=64,
+        type_vocab_size=2,
+        next_sentence=True,
+    )

@@ -1,0 +1,191 @@
+"""Model library tests: shapes, scan param layout, determinism, head parity.
+
+The reference has no test suite (SURVEY.md §4); these tests encode the
+documented behaviors of src/modeling.py instead.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu import models
+
+
+def _batch(cfg, batch=2, seq=16, rng=0):
+    r = np.random.default_rng(rng)
+    input_ids = r.integers(0, cfg.vocab_size, (batch, seq), dtype=np.int32)
+    token_type_ids = r.integers(0, 2, (batch, seq), dtype=np.int32)
+    mask = np.ones((batch, seq), dtype=np.int32)
+    mask[:, seq - 3 :] = 0
+    return jnp.asarray(input_ids), jnp.asarray(token_type_ids), jnp.asarray(mask)
+
+
+def test_pretraining_forward_shapes(tiny_config):
+    cfg = tiny_config
+    model = models.BertForPreTraining(cfg, dtype=jnp.float32)
+    ids, types, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    mlm_logits, nsp_logits = model.apply(variables, ids, types, mask)
+    assert mlm_logits.shape == (2, 16, cfg.vocab_size)
+    assert nsp_logits.shape == (2, 2)
+
+
+def test_encoder_params_are_stacked_by_scan(tiny_config):
+    cfg = tiny_config
+    model = models.BertForPreTraining(cfg, dtype=jnp.float32)
+    ids, types, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    params = nn.unbox(variables)["params"]
+    layer_params = params["bert"]["encoder"]["layers"]
+    q_kernel = layer_params["attention"]["query"]["kernel"]
+    # nn.scan stacks per-layer params on a leading 'layers' axis.
+    assert q_kernel.shape == (
+        cfg.num_hidden_layers,
+        cfg.hidden_size,
+        cfg.num_attention_heads,
+        cfg.head_dim,
+    )
+
+
+def test_tied_decoder_has_no_duplicate_weight(tiny_config):
+    """The MLM decoder weight IS the embedding matrix (modeling.py:570-574):
+    only a bias param may exist in the prediction head."""
+    cfg = tiny_config
+    model = models.BertForPreTraining(cfg, dtype=jnp.float32)
+    ids, types, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    pred = nn.unbox(variables)["params"]["predictions"]
+    assert set(pred.keys()) == {"transform", "bias"}
+    assert pred["bias"].shape == (cfg.vocab_size,)
+
+
+def test_next_sentence_false_drops_nsp_and_pooler(tiny_config):
+    cfg = BertConfig.from_dict({**tiny_config.to_dict(), "next_sentence": False})
+    model = models.BertForPreTraining(cfg, dtype=jnp.float32)
+    ids, _, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, None, mask)
+    mlm_logits, nsp_logits = model.apply(variables, ids, None, mask)
+    assert nsp_logits is None
+    params = variables["params"]
+    assert "seq_relationship" not in params
+    assert "pooler" not in params["bert"]
+    assert "token_type_embeddings" not in params["bert"]["embeddings"]
+
+
+def test_attention_mask_blocks_padding(tiny_config):
+    """Changing tokens at masked-out positions must not change outputs at
+    attended positions (extended_attention_mask semantics,
+    modeling.py:862-870)."""
+    cfg = tiny_config
+    model = models.BertModel(cfg, dtype=jnp.float32)
+    ids, types, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    seq_out, _ = model.apply(variables, ids, types, mask)
+    ids2 = ids.at[:, -1].set((ids[:, -1] + 7) % cfg.vocab_size)
+    seq_out2, _ = model.apply(variables, ids2, types, mask)
+    np.testing.assert_allclose(
+        np.asarray(seq_out[:, :13]), np.asarray(seq_out2[:, :13]), atol=1e-5
+    )
+
+
+def test_dropout_determinism(tiny_config):
+    cfg = tiny_config
+    model = models.BertForPreTraining(cfg, dtype=jnp.float32)
+    ids, types, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    out1, _ = model.apply(
+        variables, ids, types, mask, False,
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    out2, _ = model.apply(
+        variables, ids, types, mask, False,
+        rngs={"dropout": jax.random.PRNGKey(1)},
+    )
+    out3, _ = model.apply(
+        variables, ids, types, mask, False,
+        rngs={"dropout": jax.random.PRNGKey(2)},
+    )
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+    assert not np.allclose(np.asarray(out1), np.asarray(out3))
+
+
+def test_remat_matches_no_remat(tiny_config):
+    cfg = tiny_config
+    ids, types, mask = _batch(cfg)
+    m1 = models.BertForPreTraining(cfg, dtype=jnp.float32, remat="none")
+    m2 = models.BertForPreTraining(cfg, dtype=jnp.float32, remat="full")
+    v = m1.init(jax.random.PRNGKey(0), ids, types, mask)
+    o1, _ = m1.apply(v, ids, types, mask)
+    o2, _ = m2.apply(v, ids, types, mask)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "head_cls,kwargs,out_check",
+    [
+        (models.BertForMaskedLM, {}, lambda o, cfg: o.shape == (2, 16, cfg.vocab_size)),
+        (models.BertForNextSentencePrediction, {}, lambda o, cfg: o.shape == (2, 2)),
+        (
+            models.BertForSequenceClassification,
+            {"num_labels": 3},
+            lambda o, cfg: o.shape == (2, 3),
+        ),
+        (
+            models.BertForTokenClassification,
+            {"num_labels": 5},
+            lambda o, cfg: o.shape == (2, 16, 5),
+        ),
+    ],
+)
+def test_task_heads(tiny_config, head_cls, kwargs, out_check):
+    cfg = tiny_config
+    model = head_cls(cfg, dtype=jnp.float32, **kwargs)
+    ids, types, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    out = model.apply(variables, ids, types, mask)
+    assert out_check(out, cfg)
+
+
+def test_question_answering_head(tiny_config):
+    cfg = tiny_config
+    model = models.BertForQuestionAnswering(cfg, dtype=jnp.float32)
+    ids, types, mask = _batch(cfg)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    start, end = model.apply(variables, ids, types, mask)
+    assert start.shape == (2, 16) and end.shape == (2, 16)
+
+
+def test_multiple_choice_head(tiny_config):
+    cfg = tiny_config
+    model = models.BertForMultipleChoice(cfg, num_choices=4, dtype=jnp.float32)
+    r = np.random.default_rng(0)
+    ids = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 4, 16), dtype=np.int32))
+    types = jnp.zeros_like(ids)
+    mask = jnp.ones_like(ids)
+    variables = model.init(jax.random.PRNGKey(0), ids, types, mask)
+    out = model.apply(variables, ids, types, mask)
+    assert out.shape == (2, 4)
+
+
+def test_losses():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, 32)), jnp.float32)
+    labels = np.full((2, 8), -1, np.int32)
+    labels[0, 2] = 5
+    labels[1, 7] = 9
+    loss = models.masked_lm_loss(logits, jnp.asarray(labels))
+    assert loss.shape == () and float(loss) > 0
+    # all-ignored -> zero loss, no NaN
+    loss0 = models.masked_lm_loss(logits, jnp.full((2, 8), -1, jnp.int32))
+    assert float(loss0) == 0.0
+
+    nsp_logits = jnp.asarray([[2.0, -1.0], [0.5, 0.5]], jnp.float32)
+    nsp = models.next_sentence_loss(nsp_logits, jnp.asarray([0, 1]))
+    assert float(nsp) > 0
+
+    start = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8)), jnp.float32)
+    end = jnp.asarray(np.random.default_rng(2).normal(size=(2, 8)), jnp.float32)
+    sl = models.span_loss(start, end, jnp.asarray([1, 20]), jnp.asarray([2, 20]))
+    assert np.isfinite(float(sl))

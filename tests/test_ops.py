@@ -1,0 +1,94 @@
+"""Op-library tests: Pallas kernels vs the XLA reference paths.
+
+Kernels run in interpret mode on CPU (ops/pallas/common.py), so numerical
+agreement here carries to the compiled TPU path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bert_pytorch_tpu import ops
+
+
+def test_layer_norm_pallas_matches_xla():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(6, 16, 128)), jnp.float32)
+    scale = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+    ref = ops.layer_norm(x, scale, bias, eps=1e-12, backend="xla")
+    out = ops.layer_norm(x, scale, bias, eps=1e-12, backend="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_layer_norm_pallas_grads_match():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 128)), jnp.float32)
+    scale = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(128,)), jnp.float32)
+
+    def loss(backend):
+        def f(x, s, b):
+            return jnp.sum(jnp.sin(ops.layer_norm(x, s, b, backend=backend)))
+
+        return jax.grad(f, argnums=(0, 1, 2))(x, scale, bias)
+
+    gx_ref, gs_ref, gb_ref = loss("xla")
+    gx, gs, gb = loss("pallas")
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gs_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gb), np.asarray(gb_ref), atol=1e-4)
+
+
+def _qkv(batch=2, seq=64, heads=2, depth=32, seed=0):
+    rng = np.random.default_rng(seed)
+    shp = (batch, seq, heads, depth)
+    q = jnp.asarray(rng.normal(size=shp), jnp.float32)
+    k = jnp.asarray(rng.normal(size=shp), jnp.float32)
+    v = jnp.asarray(rng.normal(size=shp), jnp.float32)
+    mask = np.ones((batch, seq), np.int32)
+    mask[:, seq - 5 :] = 0
+    bias = ops.attention.make_attention_bias(jnp.asarray(mask))
+    return q, k, v, bias
+
+
+def test_flash_attention_matches_xla():
+    q, k, v, bias = _qkv()
+    ref = ops.dot_product_attention(q, k, v, bias=bias, backend="xla")
+    out = ops.dot_product_attention(q, k, v, bias=bias, backend="pallas")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_attention_grads_match():
+    q, k, v, bias = _qkv(batch=1, seq=32, heads=2, depth=16)
+
+    def make_loss(backend):
+        def f(q, k, v):
+            out = ops.dot_product_attention(q, k, v, bias=bias, backend=backend)
+            return jnp.sum(jnp.tanh(out))
+
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    ref = make_loss("xla")(q, k, v)
+    got = make_loss("pallas")(q, k, v)
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
+
+
+def test_global_norm_and_clip():
+    tree = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.zeros((2, 2))}
+    assert np.isclose(float(ops.global_norm(tree)), 5.0)
+    clipped, norm = ops.clip_by_global_norm(tree, 1.0)
+    assert np.isclose(float(norm), 5.0)
+    assert np.isclose(float(ops.global_norm(clipped)), 1.0, atol=1e-4)
+    # already within bounds -> unchanged
+    same, _ = ops.clip_by_global_norm(tree, 10.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), np.asarray(tree["a"]))
+
+
+def test_act2fn_bias_variants():
+    x = jnp.asarray([[0.5, -0.3]], jnp.float32)
+    b = jnp.asarray([0.1, 0.2], jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(ops.bias_gelu(b, x)), np.asarray(ops.gelu(x + b)), atol=1e-6
+    )
