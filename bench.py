@@ -1,0 +1,111 @@
+"""Benchmark: BERT-large phase-1 pretraining throughput on the local chip(s).
+
+Runs the full jitted training step (microbatch scan, bf16 forward/backward,
+LAMB with poly-warmup schedule) on synthetic phase-1-shaped data
+(seq 128, max_pred 20) and reports sequences/second — the reference's
+``training_seq_per_sec`` headline metric (run_pretraining.py:597-599).
+
+Prints ONE JSON line:
+  {"metric": "bert_large_phase1_seq_per_sec", "value": N,
+   "unit": "seq/s/chip", "vs_baseline": N}
+
+The reference repo publishes no numbers (BASELINE.md); ``vs_baseline``
+normalizes against the NVIDIA DeepLearningExamples BERT-large phase-1
+per-A100 throughput (~360 seq/s, fp16 + LAMB) that the reference's configs
+are tuned for — the closest external anchor the reference offers.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+A100_PHASE1_SEQ_PER_SEC = 360.0
+
+# Per-chip microbatch. The phase-1 recipe uses 96/GPU on 40GB A100s
+# (BASELINE.md); sized down for a 16GB v5e chip with fp32 master params.
+LOCAL_BATCH = 32
+SEQ_LEN = 128
+ACCUM = 1
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    from bert_pytorch_tpu import optim, pretrain
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
+    import os
+
+    config = BertConfig.from_json_file(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "configs", "bert_large_uncased_config.json"))
+    if config.vocab_size % 8 != 0:
+        config.vocab_size += 8 - (config.vocab_size % 8)
+
+    n_chips = len(jax.devices())
+    mesh = create_mesh(MeshConfig(data=-1))
+    rules = logical_axis_rules("dp")
+    model = BertForPreTraining(config, dtype=jnp.bfloat16)
+    schedule = optim.warmup_poly_schedule(6e-3, 0.2843, 7038)
+    tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+
+    global_batch = LOCAL_BATCH * n_chips * ACCUM
+    sample = (jnp.zeros((1, SEQ_LEN), jnp.int32),) * 3
+    rng = np.random.default_rng(0)
+    host = {
+        "input_ids": rng.integers(
+            0, config.vocab_size, (global_batch, SEQ_LEN)).astype(np.int32),
+        "segment_ids": rng.integers(0, 2, (global_batch, SEQ_LEN)).astype(np.int32),
+        "input_mask": np.ones((global_batch, SEQ_LEN), np.int32),
+        "masked_lm_labels": np.where(
+            rng.random((global_batch, SEQ_LEN)) < 0.15,
+            rng.integers(0, config.vocab_size, (global_batch, SEQ_LEN)),
+            -1).astype(np.int32),
+        "next_sentence_labels": rng.integers(0, 2, (global_batch,)).astype(np.int32),
+    }
+
+    with mesh:
+        shardings = pretrain.state_shardings(mesh, model, rules, sample)
+        b_shardings = pretrain.batch_shardings(
+            mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+                   "masked_lm_labels": 3, "next_sentence_labels": 2})
+        state = pretrain.make_init_fn(model, tx, sample, shardings)(
+            jax.random.PRNGKey(0))
+        step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            shardings=shardings, batch_shardings_=b_shardings)
+
+        batch = pretrain.put_batch(
+            pretrain.stack_microbatches(host, ACCUM), b_shardings)
+
+        # Per-step value fetch: a hard sync through the runtime each step.
+        # (block_until_ready alone has been observed to return early through
+        # the axon remote-execution tunnel, yielding bogus ~1000x numbers.)
+        for _ in range(WARMUP_STEPS):
+            state, metrics = step(state, batch)
+            _ = float(metrics["loss"])
+
+        start = time.perf_counter()
+        for _ in range(MEASURE_STEPS):
+            state, metrics = step(state, batch)
+            _ = float(metrics["loss"])
+        elapsed = time.perf_counter() - start
+
+    seq_per_sec = MEASURE_STEPS * global_batch / elapsed
+    seq_per_sec_chip = seq_per_sec / n_chips
+    print(json.dumps({
+        "metric": "bert_large_phase1_seq_per_sec",
+        "value": round(seq_per_sec_chip, 2),
+        "unit": "seq/s/chip",
+        "vs_baseline": round(seq_per_sec_chip / A100_PHASE1_SEQ_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

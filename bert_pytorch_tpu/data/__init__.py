@@ -1,0 +1,23 @@
+"""Data runtime: sharded HDF5 streaming, dynamic masking, samplers, loaders.
+
+The TPU-host analog of the reference's data stack (SURVEY.md §2.1):
+ShardedPretrainingDataset + contiguous DistributedSampler + a torch-free
+prefetching DataLoader.
+"""
+
+from bert_pytorch_tpu.data.dataset import (
+    LEGACY_FORMAT_KEYS,
+    NEW_FORMAT_KEYS,
+    ShardedPretrainingDataset,
+)
+from bert_pytorch_tpu.data.loader import BATCH_KEYS, DataLoader
+from bert_pytorch_tpu.data.sampler import DistributedSampler
+
+__all__ = [
+    "BATCH_KEYS",
+    "DataLoader",
+    "DistributedSampler",
+    "LEGACY_FORMAT_KEYS",
+    "NEW_FORMAT_KEYS",
+    "ShardedPretrainingDataset",
+]

@@ -1,0 +1,278 @@
+"""Sharded HDF5 pretraining dataset with dynamic masking.
+
+Behavioral parity with reference src/dataset.py:9-338
+(``ShardedPretrainingDataset``): at most two shard files in RAM (current +
+background-thread prefetch of the next), segment/input-mask derivation from
+``special_token_positions``, dynamic masking with the 80/10/10 split, legacy
+NVIDIA pre-masked format support, and warn-and-skip shard verification.
+
+Deliberate deviations from the reference (SURVEY.md §7 "known quirks"):
+  - mask positions are sampled WITHOUT replacement (the reference's
+    ``np.random.choice`` default could duplicate positions, dataset.py:286);
+  - per-instance ``np.random.Generator`` instead of the global seed
+    (dataset.py:122-123) so worker processes don't correlate;
+  - the in-file index is computed from the file start (the reference's
+    ``idx -= file_sample_end_idx`` negative indexing, dataset.py:171, is
+    equivalent but obscure).
+
+No torch dependency: samples are numpy int32 arrays ready for
+``jax.device_put`` batching.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import warnings
+from typing import Optional, Sequence
+
+import h5py
+import numpy as np
+
+NEW_FORMAT_KEYS = ("input_ids", "special_token_positions", "next_sentence_labels")
+LEGACY_FORMAT_KEYS = (
+    "input_ids",
+    "segment_ids",
+    "input_mask",
+    "masked_lm_positions",
+    "masked_lm_ids",
+    "next_sentence_labels",
+)
+
+
+class ShardedPretrainingDataset:
+    """Streams sorted HDF5 shards keeping <= 2 files in memory.
+
+    ``__getitem__`` must be called with sequential indices (per rank); use
+    :class:`bert_pytorch_tpu.data.sampler.DistributedSampler` which chunks
+    contiguously. Out-of-order access raises, mirroring the invariant check at
+    reference dataset.py:161-169.
+    """
+
+    def __init__(
+        self,
+        files: Sequence[str] | str,
+        mask_token_index: Optional[int],
+        max_pred_per_seq: int,
+        masked_lm_prob: float,
+        vocab_size: int,
+        original_token_prob: float = 0.1,
+        random_token_prob: float = 0.1,
+        seed: Optional[int] = None,
+    ):
+        if mask_token_index is not None and not isinstance(mask_token_index, (int, np.integer)):
+            raise ValueError("mask_token_index must be an integer")
+        if not isinstance(max_pred_per_seq, (int, np.integer)) or max_pred_per_seq < 0:
+            raise ValueError("max_pred_per_seq must be an integer >= 0")
+        if not 0 <= masked_lm_prob <= 1:
+            raise ValueError("masked_lm_prob must be in [0,1]")
+        if not isinstance(vocab_size, (int, np.integer)) or vocab_size < 0:
+            raise ValueError("vocab_size must be an integer >= 0")
+        if not 0 <= original_token_prob <= 1:
+            raise ValueError("original_token_prob must be in [0,1]")
+        if not 0 <= random_token_prob <= 1:
+            raise ValueError("random_token_prob must be in [0,1]")
+        if random_token_prob + original_token_prob > 1:
+            raise ValueError("random_token_prob + original_token_prob > 1")
+
+        if isinstance(files, str):
+            files = [files]
+        files = sorted(files)  # all processes must agree on the order
+        self.files, self.file_idxs = self._verify_and_count_samples(files)
+
+        self.mask_token_index = mask_token_index
+        self.max_pred_per_seq = int(max_pred_per_seq)
+        self.masked_lm_prob = float(masked_lm_prob)
+        self.vocab_size = int(vocab_size)
+        self.original_token_prob = float(original_token_prob)
+        self.random_token_prob = float(random_token_prob)
+        self.seed = seed
+        self.epoch = 0
+        self._rng = np.random.default_rng(seed)
+
+        self.file_idx: Optional[int] = None
+        self.next_file_idx: Optional[int] = None
+        self.file_sample_start_idx = -1
+        self.file_sample_end_idx = -1
+        self.data = None
+        self._next_file_data = None
+        self._next_file_thread: Optional[threading.Thread] = None
+
+    # -- epoch / size --------------------------------------------------------
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self.file_idxs[-1][1]
+
+    # -- streaming -----------------------------------------------------------
+
+    def __getitem__(self, idx: int):
+        if self.data is None:
+            # First access: infer the starting file from idx and prefetch it.
+            self.next_file_idx = self._file_idx_for(idx)
+            self._next_file_thread = self._async_load_file(self.next_file_idx)
+
+        if not (self.file_sample_start_idx <= idx < self.file_sample_end_idx):
+            # Exhausted the current file: swap in the prefetched one and start
+            # loading its successor in the background.
+            del self.data  # drop the old shard before holding two new ones
+            self._next_file_thread.join()
+            self.data = self._next_file_data
+            self.file_idx = self.next_file_idx
+            self.next_file_idx = (self.next_file_idx + 1) % len(self.files)
+            self._next_file_thread = self._async_load_file(self.next_file_idx)
+            self.file_sample_start_idx, self.file_sample_end_idx = self.file_idxs[
+                self.file_idx
+            ]
+
+        if not (self.file_sample_start_idx <= idx < self.file_sample_end_idx):
+            raise RuntimeError(
+                f"idx ({idx}) out of range ({self.file_sample_start_idx}, "
+                f"{self.file_sample_end_idx}) for current file. This happens "
+                "when __getitem__ is called with out-of-order indices (e.g. a "
+                "shuffling sampler)."
+            )
+
+        local = idx - self.file_sample_start_idx
+        input_ids = np.array(self.data["input_ids"][local])
+        next_sentence_label = np.asarray(self.data["next_sentence_labels"][local])
+
+        if "special_token_positions" in self.data:
+            special = np.asarray(self.data["special_token_positions"][local])
+            segment_ids = self._get_segment_ids(input_ids, special)
+            input_mask = self._get_input_mask(input_ids, special)
+            masked_input_ids, masked_lm_labels = self._mask_input(input_ids, special)
+        else:
+            # Legacy NVIDIA pre-masked format (reference dataset.py:184-192).
+            segment_ids = np.asarray(self.data["segment_ids"][local])
+            input_mask = np.asarray(self.data["input_mask"][local])
+            positions = np.asarray(self.data["masked_lm_positions"][local])
+            ids = np.asarray(self.data["masked_lm_ids"][local])
+            masked_input_ids = input_ids
+            masked_lm_labels = self._get_masked_labels(input_ids, positions, ids)
+
+        return [
+            masked_input_ids.astype(np.int32),
+            segment_ids.astype(np.int32),
+            input_mask.astype(np.int32),
+            masked_lm_labels.astype(np.int32),
+            next_sentence_label.astype(np.int32),
+        ]
+
+    def _file_idx_for(self, idx: int) -> int:
+        for i, (start, end) in enumerate(self.file_idxs):
+            if start <= idx < end:
+                return i
+        raise ValueError(f"idx ({idx}) exceeds dataset size ({len(self)})")
+
+    def _async_load_file(self, file_idx: int) -> threading.Thread:
+        th = threading.Thread(
+            target=self._load_hdf5, args=(self.files[file_idx],), daemon=True
+        )
+        th.start()
+        return th
+
+    def _load_hdf5(self, filepath: str) -> None:
+        data = {}
+        with h5py.File(filepath, "r") as f:
+            for key in f.keys():
+                data[key] = np.asarray(f[key][:])
+        self._next_file_data = data
+
+    # -- feature derivation (reference dataset.py:224-296) -------------------
+
+    @staticmethod
+    def _get_segment_ids(input_ids, special_token_positions):
+        """[CLS] a... [SEP] b... [SEP] pad -> 0 0...0 0 1...1 1 0...0
+        (reference dataset.py:224-238)."""
+        segment_ids = np.zeros_like(input_ids)
+        if len(special_token_positions) == 3:
+            segment_ids[
+                special_token_positions[1] + 1 : special_token_positions[2] + 1
+            ] = 1
+        return segment_ids
+
+    @staticmethod
+    def _get_input_mask(input_ids, special_token_positions):
+        """1 through the final [SEP], 0 on padding (dataset.py:240-252)."""
+        input_mask = np.zeros_like(input_ids)
+        input_mask[: special_token_positions[-1] + 1] = 1
+        return input_mask
+
+    @staticmethod
+    def _get_masked_labels(input_ids, masked_lm_positions, masked_lm_ids):
+        """Scatter true ids at masked positions, -1 elsewhere
+        (legacy format; dataset.py:254-275)."""
+        labels = np.full_like(input_ids, -1)
+        index = len(input_ids)
+        padded = np.nonzero(masked_lm_positions == 0)[0]
+        if len(padded) != 0:
+            index = padded[0]
+        labels[masked_lm_positions[:index]] = masked_lm_ids[:index]
+        return labels
+
+    def _mask_input(self, input_ids, special_token_positions):
+        """Dynamic masking (dataset.py:277-296): choose up to
+        min(max_pred, max(1, round-down of len*prob)) non-special positions;
+        each keeps its token w.p. original_token_prob, becomes random w.p.
+        random_token_prob, else [MASK]."""
+        masked_lm_labels = np.full_like(input_ids, -1)
+        special = set(int(p) for p in special_token_positions)
+        candidates = [
+            i for i in range(int(special_token_positions[-1])) if i not in special
+        ]
+        if not candidates:
+            return input_ids, masked_lm_labels
+        mask_count = min(
+            self.max_pred_per_seq,
+            max(1, int(len(candidates) * self.masked_lm_prob)),
+        )
+        mask_indices = self._rng.choice(
+            candidates, size=min(mask_count, len(candidates)), replace=False
+        )
+        masked_lm_labels[mask_indices] = input_ids[mask_indices]
+        draws = self._rng.random(len(mask_indices))
+        for idx, draw in zip(mask_indices, draws):
+            if draw < self.original_token_prob:
+                continue
+            elif draw < self.original_token_prob + self.random_token_prob:
+                input_ids[idx] = self._rng.integers(0, self.vocab_size - 1)
+            else:
+                input_ids[idx] = self.mask_token_index
+        return input_ids, masked_lm_labels
+
+    # -- shard verification (dataset.py:298-338) -----------------------------
+
+    @staticmethod
+    def _verify_and_count_samples(files):
+        current_idx = 0
+        verified_files, verified_idxs = [], []
+        keys = ["input_ids", "next_sentence_labels"]
+        for fpath in files:
+            if not os.path.isfile(fpath):
+                warnings.warn(f"File not found: {fpath}. Skipping File")
+                continue
+            try:
+                counts = []
+                with h5py.File(fpath, "r") as f:
+                    for key in keys:
+                        counts.append(len(f[key]))
+            except Exception:
+                warnings.warn(
+                    f"Unable to read keys ({keys}) from {fpath}. Skipping File"
+                )
+                continue
+            if len(set(counts)) != 1:
+                warnings.warn(
+                    f"Number of samples per key in {fpath} do not match. "
+                    "Skipping File"
+                )
+                continue
+            verified_files.append(fpath)
+            verified_idxs.append((current_idx, current_idx + counts[0]))
+            current_idx += counts[0]
+        if not verified_files:
+            raise RuntimeError("Unable to open any valid data files")
+        return verified_files, verified_idxs

@@ -1,0 +1,88 @@
+"""Torch-free batching loader with background prefetch.
+
+Replaces the reference's ``torch.utils.data.DataLoader(num_workers=4,
+pin_memory=True)`` (run_pretraining.py:394-395): a producer thread walks the
+sampler, pulls samples from the dataset (whose own background thread streams
+shard files), collates numpy batches, and keeps a small queue ahead of the
+training loop so host-side dynamic masking overlaps device compute — the
+TPU-feeding strategy called out in SURVEY.md §7 "hard parts".
+
+``drop_last`` defaults to True: XLA-jitted steps want static batch shapes, so
+ragged tail batches (which the reference tolerates, SURVEY §2.1) would force
+a recompile for one step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+BATCH_KEYS = (
+    "input_ids",
+    "segment_ids",
+    "input_mask",
+    "masked_lm_labels",
+    "next_sentence_labels",
+)
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset,
+        sampler,
+        batch_size: int,
+        drop_last: bool = True,
+        prefetch_batches: int = 2,
+    ):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.prefetch_batches = prefetch_batches
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def __iter__(self) -> Iterator[dict]:
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
+        stop = threading.Event()
+
+        def produce():
+            samples = []
+            try:
+                for idx in self.sampler:
+                    if stop.is_set():
+                        return
+                    samples.append(self.dataset[idx])
+                    if len(samples) == self.batch_size:
+                        q.put(self._collate(samples))
+                        samples = []
+                if samples and not self.drop_last:
+                    q.put(self._collate(samples))
+            except BaseException as e:  # surface worker errors to the consumer
+                q.put(e)
+                return
+            q.put(None)
+
+        worker = threading.Thread(target=produce, daemon=True)
+        worker.start()
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    @staticmethod
+    def _collate(samples) -> dict:
+        arrays = [np.stack([s[i] for s in samples]) for i in range(len(BATCH_KEYS))]
+        return dict(zip(BATCH_KEYS, arrays))

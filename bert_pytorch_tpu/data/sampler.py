@@ -1,0 +1,109 @@
+"""Contiguous-chunk distributed sampler with checkpointable position.
+
+Parity with reference src/dataset.py:341-428 (``DistributedSampler``): each
+rank takes a contiguous chunk of the index space (so ranks stream different
+shard files sequentially, not round-robin), the sampler is itself the
+iterator so its ``index`` can be saved/restored, and restore is skipped with
+a warning when the dataset size or replica count changed.
+
+Fixes the reference's latent ``math.ceil``-without-import bug in the pad
+branch (dataset.py:376) by actually importing math.
+"""
+
+from __future__ import annotations
+
+import math
+import warnings
+from typing import Optional
+
+
+class DistributedSampler:
+    def __init__(
+        self,
+        dataset,
+        num_replicas: int,
+        rank: int,
+        drop_last: bool = False,
+        seed: int = 0,
+    ):
+        if rank >= num_replicas or rank < 0:
+            raise ValueError(f"rank {rank} out of range for {num_replicas} replicas")
+        self.dataset = dataset
+        self.num_replicas = num_replicas
+        self.rank = rank
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        if hasattr(dataset, "seed"):
+            self.dataset.seed = seed
+
+        n = len(dataset)
+        if self.drop_last and n % num_replicas != 0:
+            self.num_samples = n // num_replicas
+        else:
+            self.num_samples = math.ceil(n / num_replicas)
+        self.total_size = self.num_samples * num_replicas
+
+        indices = list(range(n))
+        if not self.drop_last:
+            padding_size = self.total_size - len(indices)
+            if padding_size <= len(indices):
+                indices += indices[:padding_size]
+            else:
+                indices += (indices * math.ceil(padding_size / len(indices)))[
+                    :padding_size
+                ]
+        else:
+            indices = indices[: self.total_size]
+        assert len(indices) == self.total_size
+
+        self.global_indices = indices
+        self.index = 0
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> int:
+        if self.index == self.num_samples:
+            self.index = 0
+            raise StopIteration()
+        x = self.global_indices[self.index + self.rank * self.num_samples]
+        self.index += 1
+        return x
+
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "seed": self.seed,
+            "num_replicas": self.num_replicas,
+            "total_size": self.total_size,
+            "index": self.index,
+        }
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        if state_dict["total_size"] != self.total_size:
+            warnings.warn(
+                "The number of samples in the Sampler has changed. Skipping "
+                f"restoring sampler state. Expected size {self.total_size} but "
+                f"got size {state_dict['total_size']}. If the dataset was "
+                "changed and the sampler should be reset, ignore this message"
+            )
+            return
+        if state_dict["num_replicas"] != self.num_replicas:
+            warnings.warn(
+                "The number of replicas has changed so the resume index from "
+                "the sampler is no longer valid. Skipping restoring sampler "
+                "state."
+            )
+            return
+        self.epoch = state_dict["epoch"]
+        self.seed = state_dict["seed"]
+        self.index = state_dict["index"]
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        if hasattr(self.dataset, "set_epoch"):
+            self.dataset.set_epoch(epoch)

@@ -1,0 +1,40 @@
+"""Optimization library: LAMB / AdamW / BertAdam + warmup schedules + K-FAC.
+
+Replaces the reference's optimizer stack — Apex FusedLAMB/FusedAdam, BertAdam,
+and src/schedulers.py — with jit-fused functional equivalents (SURVEY.md §2.3,
+§7 stage 2).
+"""
+
+from bert_pytorch_tpu.optim.schedules import (
+    SCHEDULES,
+    make_schedule,
+    warmup_constant_schedule,
+    warmup_cosine_schedule,
+    warmup_exp_decay_exp_schedule,
+    warmup_linear_schedule,
+    warmup_poly_schedule,
+)
+from bert_pytorch_tpu.optim.transforms import (
+    OptState,
+    adamw,
+    bert_adam,
+    lamb,
+    no_decay_mask,
+    reset_count,
+)
+
+__all__ = [
+    "SCHEDULES",
+    "make_schedule",
+    "warmup_constant_schedule",
+    "warmup_cosine_schedule",
+    "warmup_exp_decay_exp_schedule",
+    "warmup_linear_schedule",
+    "warmup_poly_schedule",
+    "OptState",
+    "adamw",
+    "bert_adam",
+    "lamb",
+    "no_decay_mask",
+    "reset_count",
+]

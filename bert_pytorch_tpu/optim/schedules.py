@@ -1,0 +1,133 @@
+"""Learning-rate schedules — parity with reference src/schedulers.py and the
+schedule functions in src/optimization.py:36-62.
+
+Design difference from the reference: there is no stateful Scheduler object.
+The reference's schedulers read the optimizer's internal step count on every
+``step()`` so that resume Just Works (schedulers.py:126-131). Here the step
+count lives in the optimizer state and the schedule is a pure function
+``step -> lr`` evaluated inside the jitted update, so the same resume
+property holds by construction.
+
+Offset semantics: the reference sets ``last_epoch = optimizer_step + 1``
+before computing the lr (schedulers.py:97-105,126-134), i.e. the lr used at
+0-indexed optimizer step t is schedule((t+1)/total). These factories
+reproduce that with ``offset=1``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _warmup(progress, warmup):
+    return progress / jnp.maximum(warmup, 1e-12)
+
+
+def warmup_poly_schedule(
+    base_lr: float,
+    warmup: float,
+    total_steps: int,
+    degree: float = 0.5,
+    offset: int = 1,
+) -> Schedule:
+    """Warmup then (1-progress)^degree decay (PolyWarmUpScheduler,
+    schedulers.py:115-141; degree 0.5 is the BERT recipe)."""
+
+    def schedule(count):
+        progress = (count + offset) / total_steps
+        decay = jnp.maximum(1.0 - progress, 0.0) ** degree
+        return base_lr * jnp.where(
+            progress < warmup, _warmup(progress, warmup), decay
+        )
+
+    return schedule
+
+
+def warmup_linear_schedule(
+    base_lr: float, warmup: float, total_steps: int, offset: int = 1
+) -> Schedule:
+    """Warmup then linear decay to 0 at progress=1
+    (LinearWarmUpScheduler, schedulers.py:87-112)."""
+
+    def schedule(count):
+        progress = (count + offset) / total_steps
+        decay = jnp.maximum((progress - 1.0) / (warmup - 1.0), 0.0)
+        return base_lr * jnp.where(
+            progress < warmup, _warmup(progress, warmup), decay
+        )
+
+    return schedule
+
+
+def warmup_cosine_schedule(
+    base_lr: float, warmup: float, total_steps: int, offset: int = 1
+) -> Schedule:
+    """Warmup then 0.5*(1+cos(pi + progress)) decay — reproducing the
+    reference's formula verbatim (schedulers.py:66; note the reference adds
+    pi to progress rather than multiplying, we keep its behavior)."""
+
+    def schedule(count):
+        progress = (count + offset) / total_steps
+        decay = 0.5 * (1.0 + jnp.cos(jnp.pi + progress))
+        return base_lr * jnp.where(
+            progress < warmup, _warmup(progress, warmup), decay
+        )
+
+    return schedule
+
+
+def warmup_constant_schedule(
+    base_lr: float, warmup: float, total_steps: int, offset: int = 1
+) -> Schedule:
+    """Warmup then constant (ConstantWarmUpScheduler, schedulers.py:69-84)."""
+
+    def schedule(count):
+        progress = (count + offset) / total_steps
+        return base_lr * jnp.where(progress < warmup, _warmup(progress, warmup), 1.0)
+
+    return schedule
+
+
+def warmup_exp_decay_exp_schedule(
+    base_lr: float,
+    decay_rate: float,
+    decay_steps: int,
+    total_steps: int,
+    warmup: float = 0.002,
+    degree: float = 2.0,
+) -> Schedule:
+    """Polynomial warmup then exponential decay
+    (``warmup_exp_decay_exp``, schedulers.py:144-158). No +1 offset: the
+    reference calls this one with the raw global step."""
+
+    def schedule(count):
+        x = count / total_steps
+        warmup_end = warmup * total_steps
+        warm = _warmup(x, warmup) ** degree
+        decay = decay_rate ** ((count - warmup_end) / decay_steps)
+        if warmup == 0.0:
+            return jnp.full_like(jnp.asarray(x, jnp.float32), base_lr)
+        return base_lr * jnp.where(x < warmup, warm, decay)
+
+    return schedule
+
+
+SCHEDULES = {
+    "poly": warmup_poly_schedule,
+    "linear": warmup_linear_schedule,
+    "cosine": warmup_cosine_schedule,
+    "constant": warmup_constant_schedule,
+}
+
+
+def make_schedule(
+    name: str, base_lr: float, warmup: float, total_steps: int, **kwargs
+) -> Schedule:
+    """Factory keyed the way ``--lr_decay`` is (run_pretraining.py:288-293)."""
+    if name not in SCHEDULES:
+        raise ValueError(f"Unknown lr decay '{name}'; options: {sorted(SCHEDULES)}")
+    return SCHEDULES[name](base_lr, warmup, total_steps, **kwargs)

@@ -1,0 +1,274 @@
+"""Optimizers as optax-style gradient transformations.
+
+TPU-native replacements for the reference's native optimizer stack
+(SURVEY.md §2.3): Apex ``FusedLAMB``/``FusedAdam`` (run_pretraining.py:295,
+src/optimization.py:25) and the pure-torch ``BertAdam``
+(src/optimization.py:64-174). On TPU "fused" is what XLA does to any jitted
+elementwise update chain — the multi-tensor-apply machinery has no analog to
+build; what matters is matching the update *math* and keeping the state
+checkpointable (a flat (count, mu, nu) pytree).
+
+All three optimizers share the same state layout so checkpoints can swap
+between them across phases (the reference's phase-2 surgery overwrites step
+counts in place, run_pretraining.py:298-309 — see ``reset_count``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from bert_pytorch_tpu.ops.grad_utils import global_norm
+
+ScalarOrSchedule = Union[float, Callable]
+
+
+class OptState(NamedTuple):
+    count: jnp.ndarray  # int32 optimizer-step counter (drives the schedule)
+    mu: optax.Params  # first moment
+    nu: optax.Params  # second moment
+
+
+def _lr_at(learning_rate: ScalarOrSchedule, count):
+    return learning_rate(count) if callable(learning_rate) else learning_rate
+
+
+def _update_moments(grads, state, b1, b2):
+    mu = jax.tree_util.tree_map(
+        lambda m, g: b1 * m + (1.0 - b1) * g.astype(m.dtype), state.mu, grads
+    )
+    nu = jax.tree_util.tree_map(
+        lambda v, g: b2 * v + (1.0 - b2) * jnp.square(g.astype(v.dtype)),
+        state.nu,
+        grads,
+    )
+    return mu, nu
+
+
+def _init_moments(params):
+    zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+    return (
+        jax.tree_util.tree_map(zeros, params),
+        jax.tree_util.tree_map(zeros, params),
+    )
+
+
+def _mask_tree(params, mask):
+    if mask is None:
+        return jax.tree_util.tree_map(lambda _: True, params)
+    return mask(params) if callable(mask) else mask
+
+
+def lamb(
+    learning_rate: ScalarOrSchedule,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    weight_decay_mask=None,
+    max_grad_norm: Optional[float] = 1.0,
+    bias_correction: bool = True,
+    trust_clip: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """LAMB — the large-batch optimizer of the BERT recipe.
+
+    Semantics of Apex ``FusedLAMB`` (driven at run_pretraining.py:295 with the
+    no-decay grouping of :279-286): global-norm gradient clipping to
+    ``max_grad_norm``, bias-corrected Adam moments, update
+    ``m̂/(√v̂+eps) + wd·p``, and a per-parameter trust ratio
+    ``‖p‖/‖update‖`` scaling the learning rate (1.0 where either norm is 0).
+    ``weight_decay_mask`` plays the role of the reference's two param groups.
+    """
+
+    def init(params):
+        mu, nu = _init_moments(params)
+        return OptState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("lamb requires params")
+        if max_grad_norm is not None and max_grad_norm > 0:
+            gnorm = global_norm(grads)
+            gscale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+            grads = jax.tree_util.tree_map(lambda g: g * gscale, grads)
+
+        mu, nu = _update_moments(grads, state, b1, b2)
+        count = state.count + 1
+        if bias_correction:
+            c1 = 1.0 - b1 ** count.astype(jnp.float32)
+            c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        else:
+            c1 = c2 = 1.0
+
+        decay_mask = _mask_tree(params, weight_decay_mask)
+        lr = _lr_at(learning_rate, state.count)
+
+        def param_update(m, v, p, use_decay):
+            m_hat = m / c1
+            v_hat = v / c2
+            upd = m_hat / (jnp.sqrt(v_hat) + eps)
+            if weight_decay > 0:
+                upd = upd + weight_decay * jnp.where(use_decay, 1.0, 0.0) * p.astype(
+                    jnp.float32
+                )
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))
+            u_norm = jnp.sqrt(jnp.sum(jnp.square(upd)))
+            ratio = jnp.where(
+                (p_norm > 0) & (u_norm > 0), p_norm / u_norm, 1.0
+            )
+            if trust_clip is not None:
+                ratio = jnp.minimum(ratio, trust_clip)
+            return (-lr * ratio * upd).astype(p.dtype)
+
+        updates = jax.tree_util.tree_map(param_update, mu, nu, params, decay_mask)
+        return updates, OptState(count, mu, nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def adamw(
+    learning_rate: ScalarOrSchedule,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    weight_decay_mask=None,
+    bias_correction: bool = True,
+) -> optax.GradientTransformation:
+    """Adam with decoupled weight decay — the Apex ``FusedAdam`` role in
+    finetuning (run_squad.py:982-988, run_ner.py:243 use
+    bias_correction=False; the default here is True)."""
+
+    def init(params):
+        mu, nu = _init_moments(params)
+        return OptState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def update(grads, state, params):
+        mu, nu = _update_moments(grads, state, b1, b2)
+        count = state.count + 1
+        if bias_correction:
+            c1 = 1.0 - b1 ** count.astype(jnp.float32)
+            c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        else:
+            c1 = c2 = 1.0
+        decay_mask = _mask_tree(params, weight_decay_mask)
+        lr = _lr_at(learning_rate, state.count)
+
+        def param_update(m, v, p, use_decay):
+            upd = (m / c1) / (jnp.sqrt(v / c2) + eps)
+            if weight_decay > 0:
+                upd = upd + weight_decay * jnp.where(use_decay, 1.0, 0.0) * p.astype(
+                    jnp.float32
+                )
+            return (-lr * upd).astype(p.dtype)
+
+        updates = jax.tree_util.tree_map(param_update, mu, nu, params, decay_mask)
+        return updates, OptState(count, mu, nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def bert_adam(
+    learning_rate: float,
+    schedule: str = "warmup_linear",
+    warmup: float = -1.0,
+    t_total: int = -1,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-6,
+    weight_decay: float = 0.01,
+    weight_decay_mask=None,
+    max_grad_norm: float = 1.0,
+) -> optax.GradientTransformation:
+    """``BertAdam`` — Adam with the BERT weight-decay fix, schedule computed
+    *inside* the optimizer, no bias correction, per-parameter grad clipping.
+
+    Parity with src/optimization.py:64-174: lr at step t is
+    ``base * schedule_fct(t/t_total, warmup)`` evaluated with the pre-update
+    step count (optimization.py:163-170), clipping is per-parameter
+    ``clip_grad_norm_(p, max_grad_norm)`` (optimization.py:144-145), and the
+    decayed update is ``m/(√v+eps) + wd·p`` with no bias correction.
+    Used by the fp32 SQuAD path (run_squad.py:999-1002).
+    """
+    from bert_pytorch_tpu.optim.schedules import (
+        warmup_constant_schedule,
+        warmup_cosine_schedule,
+        warmup_linear_schedule,
+        warmup_poly_schedule,
+    )
+
+    factories = {
+        "warmup_linear": warmup_linear_schedule,
+        "warmup_cosine": warmup_cosine_schedule,
+        "warmup_constant": warmup_constant_schedule,
+        "warmup_poly": warmup_poly_schedule,
+    }
+    if schedule not in factories:
+        raise ValueError(f"Invalid schedule parameter: {schedule}")
+    if t_total != -1:
+        # offset=0: BertAdam reads state['step'] before incrementing it.
+        sched = factories[schedule](learning_rate, warmup, t_total, offset=0)
+    else:
+        sched = lambda count: jnp.asarray(learning_rate, jnp.float32)
+
+    def init(params):
+        mu, nu = _init_moments(params)
+        return OptState(jnp.zeros((), jnp.int32), mu, nu)
+
+    def update(grads, state, params):
+        # Per-parameter clipping (optimization.py:144-145).
+        if max_grad_norm > 0:
+
+            def clip(g):
+                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                return g * jnp.minimum(1.0, max_grad_norm / (n + 1e-6)).astype(
+                    g.dtype
+                )
+
+            grads = jax.tree_util.tree_map(clip, grads)
+        mu, nu = _update_moments(grads, state, b1, b2)
+        decay_mask = _mask_tree(params, weight_decay_mask)
+        lr = sched(state.count)
+
+        def param_update(m, v, p, use_decay):
+            upd = m / (jnp.sqrt(v) + eps)
+            if weight_decay > 0:
+                upd = upd + weight_decay * jnp.where(use_decay, 1.0, 0.0) * p.astype(
+                    jnp.float32
+                )
+            return (-lr * upd).astype(p.dtype)
+
+        updates = jax.tree_util.tree_map(param_update, mu, nu, params, decay_mask)
+        return updates, OptState(state.count + 1, mu, nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+def no_decay_mask(params) -> optax.Params:
+    """True where weight decay applies. The analog of the reference's no-decay
+    param grouping (run_pretraining.py:279-286: names containing bias/gamma/
+    beta/LayerNorm are excluded) — here: any 'bias' leaf and every LayerNorm
+    parameter ('scale' lives only in LayerNorm modules)."""
+    import flax.traverse_util as traverse_util
+
+    flat = traverse_util.flatten_dict(params)
+    mask = {
+        path: not (
+            path[-1] == "bias"
+            or path[-1] == "scale"
+            or any("layer_norm" in part for part in path)
+        )
+        for path in flat
+    }
+    return traverse_util.unflatten_dict(mask)
+
+
+def reset_count(state: OptState, count: int) -> OptState:
+    """Phase-switch surgery: overwrite the optimizer step counter, keeping
+    moments — the analog of rewriting 'step'/'t_total'/'warmup'/'lr' in the
+    loaded checkpoint (run_pretraining.py:298-309). t_total/warmup/lr live in
+    the schedule closure here and are rebuilt from the new phase config."""
+    return OptState(jnp.asarray(count, jnp.int32), state.mu, state.nu)

@@ -57,10 +57,14 @@ def initialize(
     global _INITIALIZED
     if _INITIALIZED:
         return
-    explicit = coordinator_address or num_processes or process_id is not None
-    auto_env = any(
-        v in os.environ
-        for v in ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    auto_env = len([h for h in hostnames.split(",") if h]) > 1 or (
+        "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
     )
     slurm = "SLURM_NODELIST" in os.environ and int(os.environ.get("SLURM_NNODES", "1")) > 1
     if not (explicit or auto_env or slurm):
@@ -76,5 +80,14 @@ def initialize(
         kwargs["process_id"] = process_id
     elif slurm:
         kwargs["process_id"] = int(os.environ.get("SLURM_NODEID", "0"))
-    jax.distributed.initialize(**kwargs)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as e:
+        # Backend already initialized (e.g. a harness touched jax.devices()
+        # first). Multi-host rendezvous is impossible now; continue
+        # single-process rather than killing a single-host run.
+        import warnings
+
+        warnings.warn(f"jax.distributed.initialize skipped: {e}")
+        return
     _INITIALIZED = True

@@ -1,0 +1,223 @@
+"""Pretraining engine: sharded train state + the single jitted train step.
+
+The XLA analog of the reference's hot loop (SURVEY.md §3.1,
+run_pretraining.py:405-460): where the reference does
+fwd -> bwd -> DDP bucket allreduce -> FusedLAMB per microbatch sequence,
+here ONE jitted function scans over the accumulation microbatches
+(``lax.scan``), accumulates gradients locally, and applies the optimizer —
+XLA inserts the cross-device gradient reduction implied by the shardings
+(params replicated/sharded per strategy, batch sharded over data axes), so
+no collective is ever written by hand. ``no_sync()`` (run_pretraining.py:
+448-453) has no analog: communication happens once per step by construction.
+
+bf16 activations / fp32 params+moments replace torch.cuda.amp + GradScaler
+(run_pretraining.py:314-318,424-434) — bf16 needs no loss scaling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bert_pytorch_tpu.models.losses import mlm_accuracy, pretraining_loss
+from bert_pytorch_tpu.ops.grad_utils import global_norm
+from bert_pytorch_tpu.optim.transforms import OptState
+from bert_pytorch_tpu.parallel.sharding import params_shardings
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: OptState
+    rng: jax.Array
+
+
+def _replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def state_shardings(mesh: Mesh, model, rules, sample_inputs) -> TrainState:
+    """Shardings for every leaf of TrainState, derived from the model's
+    logical axis annotations (no per-param code — the point of the design)."""
+    abstract = jax.eval_shape(
+        lambda r: model.init(r, *sample_inputs), jax.random.PRNGKey(0)
+    )
+    p_shardings = params_shardings(mesh, abstract, rules)["params"]
+    repl = _replicated(mesh)
+    return TrainState(
+        params=p_shardings,
+        opt_state=OptState(count=repl, mu=p_shardings, nu=p_shardings),
+        rng=repl,
+    )
+
+
+def batch_shardings(mesh: Mesh, batch_spec: dict) -> dict:
+    """Shardings for the [A, B, ...] stacked microbatch dict: accumulation
+    axis replicated (scanned), batch axis sharded over data(+fsdp)."""
+    out = {}
+    for key, ndim in batch_spec.items():
+        spec = [None, ("data", "fsdp")] + [None] * (ndim - 2)
+        out[key] = NamedSharding(mesh, P(*spec))
+    return out
+
+
+def make_init_fn(model, tx, sample_inputs, shardings: TrainState):
+    """Jitted initializer producing an already-sharded TrainState."""
+
+    def init_fn(rng):
+        init_rng, state_rng = jax.random.split(rng)
+        variables = nn.unbox(model.init(init_rng, *sample_inputs))
+        params = variables["params"]
+        return TrainState(
+            params=params, opt_state=tx.init(params), rng=state_rng
+        )
+
+    return jax.jit(init_fn, out_shardings=shardings)
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    schedule: Optional[Callable] = None,
+    next_sentence: bool = True,
+    shardings: Optional[TrainState] = None,
+    batch_shardings_: Optional[dict] = None,
+    max_pred_per_seq: Optional[int] = None,
+):
+    """Build the jitted train step.
+
+    ``batch`` is a dict of arrays with a leading accumulation axis:
+    input_ids/segment_ids/input_mask/masked_lm_labels [A, B, S],
+    next_sentence_labels [A, B]. Returns (new_state, metrics).
+
+    When ``max_pred_per_seq`` is set, the masked positions are extracted
+    inside the jitted step (top_k on the label mask — stable, so the first
+    max_pred masked positions win) and the 30k-vocab decoder runs only on
+    those [B, P] positions instead of all [B, S]: same loss, ~S/P less
+    decoder compute.
+    """
+
+    def loss_fn(params, mb, rng):
+        labels = mb["masked_lm_labels"]
+        masked_positions = None
+        if max_pred_per_seq is not None and max_pred_per_seq < labels.shape[-1]:
+            is_masked = (labels != -1).astype(jnp.int32)
+            _, masked_positions = jax.lax.top_k(is_masked, max_pred_per_seq)
+            labels = jnp.take_along_axis(labels, masked_positions, axis=1)
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params},
+            mb["input_ids"],
+            mb["segment_ids"],
+            mb["input_mask"],
+            False,  # deterministic
+            masked_positions,
+            rngs={"dropout": rng},
+        )
+        loss = pretraining_loss(
+            mlm_logits,
+            nsp_logits if next_sentence else None,
+            labels,
+            mb["next_sentence_labels"] if next_sentence else None,
+        )
+        acc = mlm_accuracy(mlm_logits, labels)
+        return loss, acc
+
+    def step_fn(state: TrainState, batch: dict):
+        accum_steps = batch["input_ids"].shape[0]
+        step_rng, new_rng = jax.random.split(state.rng)
+
+        def body(carry, mb):
+            grads_acc, rng = carry
+            rng, sub = jax.random.split(rng)
+            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, mb, sub
+            )
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), grads_acc, grads
+            )
+            return (grads_acc, rng), (loss, acc)
+
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (grads, _), (losses, accs) = jax.lax.scan(
+            body, (zero_grads, step_rng), batch
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": jnp.mean(losses),
+            "mlm_accuracy": jnp.mean(accs),
+            "grad_norm": global_norm(grads),
+        }
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.opt_state.count)
+        return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
+
+    if shardings is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    return jax.jit(
+        step_fn,
+        donate_argnums=(0,),
+        in_shardings=(shardings, batch_shardings_),
+        out_shardings=(shardings, None),
+    )
+
+
+def make_eval_step(model, next_sentence: bool = True):
+    """Deterministic forward + loss for held-out evaluation."""
+
+    def eval_fn(params, batch):
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch["segment_ids"],
+            batch["input_mask"],
+        )
+        loss = pretraining_loss(
+            mlm_logits,
+            nsp_logits if next_sentence else None,
+            batch["masked_lm_labels"],
+            batch["next_sentence_labels"] if next_sentence else None,
+        )
+        return loss, mlm_accuracy(mlm_logits, batch["masked_lm_labels"])
+
+    return jax.jit(eval_fn)
+
+
+def put_batch(batch: dict, shardings: dict) -> dict:
+    """Host numpy batch -> global sharded device arrays.
+
+    Single-process: a device_put per array. Multi-host: each process passes
+    its local slice of the global batch and
+    ``make_array_from_process_local_data`` assembles the global array — the
+    analog of per-rank DataLoaders feeding DDP (SURVEY §3.1).
+    """
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, shardings[k]) for k, v in batch.items()}
+    return {
+        k: jax.make_array_from_process_local_data(shardings[k], v)
+        for k, v in batch.items()
+    }
+
+
+def stack_microbatches(batch: dict, accum_steps: int) -> dict:
+    """[A*B, ...] host batch -> [A, B, ...] for the scan."""
+    out = {}
+    for k, v in batch.items():
+        if v.shape[0] % accum_steps != 0:
+            raise ValueError(
+                f"batch dim {v.shape[0]} not divisible by accumulation steps "
+                f"{accum_steps}"
+            )
+        out[k] = v.reshape((accum_steps, v.shape[0] // accum_steps) + v.shape[1:])
+    return out

@@ -1,0 +1,114 @@
+"""Generate synthetic pretraining shards for smoke tests and benchmarks.
+
+Writes HDF5 shards in the same formats the real pipeline produces
+(reference utils/encode_data.py:183-210 for the new
+``special_token_positions`` format; NVIDIA DeepLearningExamples layout for
+the legacy pre-masked format, reference dataset.py:184-192) so the data
+runtime and runners can be exercised end-to-end without the real corpus.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import h5py
+import numpy as np
+
+
+def make_shard(
+    path: str,
+    num_samples: int,
+    seq_len: int,
+    vocab_size: int,
+    seed: int = 0,
+    nsp: bool = True,
+    legacy: bool = False,
+    max_pred_per_seq: int = 20,
+):
+    rng = np.random.default_rng(seed)
+    input_ids = np.zeros((num_samples, seq_len), np.int32)
+    specials = []
+    next_sentence = rng.integers(0, 2 if nsp else 1, num_samples).astype(np.int8)
+
+    cls_id, sep_id = 2, 3  # arbitrary special ids clear of 0 ([PAD])
+    for i in range(num_samples):
+        # Random content length; two segments when NSP.
+        content = int(rng.integers(seq_len // 2, seq_len - 1))
+        ids = rng.integers(5, vocab_size, size=content).astype(np.int32)
+        if nsp:
+            split = int(rng.integers(1, content - 1)) if content > 2 else 1
+            row = np.concatenate(
+                [[cls_id], ids[:split], [sep_id], ids[split:], [sep_id]]
+            )
+            special = [0, split + 1, len(row) - 1]
+        else:
+            row = np.concatenate([[cls_id], ids, [sep_id]])
+            special = [0, len(row) - 1]
+        row = row[:seq_len]
+        special = [min(p, seq_len - 1) for p in special]
+        input_ids[i, : len(row)] = row
+        specials.append(special)
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with h5py.File(path, "w") as f:
+        f.create_dataset("input_ids", data=input_ids, dtype="i4", compression="gzip")
+        if legacy:
+            segment_ids = np.zeros_like(input_ids)
+            input_mask = np.zeros_like(input_ids)
+            positions = np.zeros((num_samples, max_pred_per_seq), np.int32)
+            label_ids = np.zeros((num_samples, max_pred_per_seq), np.int32)
+            for i, special in enumerate(specials):
+                input_mask[i, : special[-1] + 1] = 1
+                if len(special) == 3:
+                    segment_ids[i, special[1] + 1 : special[2] + 1] = 1
+                n_mask = int(rng.integers(1, max_pred_per_seq))
+                cand = [
+                    p for p in range(1, special[-1]) if p not in special
+                ][:n_mask]
+                positions[i, : len(cand)] = cand
+                label_ids[i, : len(cand)] = input_ids[i, cand]
+            f.create_dataset("segment_ids", data=segment_ids, dtype="i4")
+            f.create_dataset("input_mask", data=input_mask, dtype="i4")
+            f.create_dataset("masked_lm_positions", data=positions, dtype="i4")
+            f.create_dataset("masked_lm_ids", data=label_ids, dtype="i4")
+        else:
+            # Ragged special_token_positions (2 or 3 entries per sample).
+            dt = h5py.vlen_dtype(np.dtype("i4"))
+            ds = f.create_dataset("special_token_positions", (num_samples,), dtype=dt)
+            for i, special in enumerate(specials):
+                ds[i] = np.asarray(special, np.int32)
+        f.create_dataset(
+            "next_sentence_labels", data=next_sentence, dtype="i1", compression="gzip"
+        )
+    return path
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output_dir", required=True)
+    p.add_argument("--num_shards", type=int, default=2)
+    p.add_argument("--samples_per_shard", type=int, default=64)
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--vocab_size", type=int, default=30522)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no_nsp", action="store_true")
+    p.add_argument("--legacy", action="store_true")
+    args = p.parse_args(argv)
+
+    for s in range(args.num_shards):
+        path = os.path.join(args.output_dir, f"shard_{s:04d}.hdf5")
+        make_shard(
+            path,
+            args.samples_per_shard,
+            args.seq_len,
+            args.vocab_size,
+            seed=args.seed + s,
+            nsp=not args.no_nsp,
+            legacy=args.legacy,
+        )
+        print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Checkpoint / resume subsystem.
+
+Parity with the reference's most-developed subsystem (SURVEY.md §5.4): one
+checkpoint per optimizer-step cadence holding
+``{model, optimizer, sampler, epoch[, preconditioner][, scaler]}``
+(run_pretraining.py:513-523), written by the main process only, last-3
+retention (:525-528), resume by scanning the output dir for the max step
+(:246-253), and the phase-2 optimizer surgery hook
+(see :func:`bert_pytorch_tpu.optim.reset_count`).
+
+Storage is msgpack via flax.serialization: param/optimizer pytrees are
+fetched to host (fully materialized — fine at BERT scale) and restored with
+``from_state_dict`` onto the target tree, so the same checkpoint loads under
+any mesh/sharding layout. Writes are atomic (tmp + rename).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tempfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+from bert_pytorch_tpu.utils.dist import is_main_process
+
+CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+
+
+def checkpoint_path(output_dir: str, step: int) -> str:
+    return os.path.join(output_dir, f"ckpt_{step}.msgpack")
+
+
+def find_resume_step(output_dir: str) -> Optional[int]:
+    """Max step among ckpt_*.msgpack files (reference run_pretraining.py:246-253)."""
+    if not os.path.isdir(output_dir):
+        return None
+    steps = [
+        int(m.group(1))
+        for name in os.listdir(output_dir)
+        if (m := CKPT_RE.search(name))
+    ]
+    return max(steps) if steps else None
+
+
+def _to_host(tree: Any) -> Any:
+    """Device arrays -> host numpy (gathering sharded arrays)."""
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "dtype") else x, tree
+    )
+
+
+def save_checkpoint(
+    output_dir: str,
+    step: int,
+    contents: dict,
+    keep: int = 3,
+) -> Optional[str]:
+    """Serialize ``contents`` (a dict of pytrees/plain values) to
+    ``ckpt_{step}.msgpack``. Main-process-only; prunes to the newest ``keep``
+    checkpoints (reference cadence + retention, run_pretraining.py:496-528).
+    """
+    if not is_main_process():
+        return None
+    os.makedirs(output_dir, exist_ok=True)
+    state = serialization.to_state_dict(_to_host(contents))
+    blob = serialization.msgpack_serialize(state)
+    path = checkpoint_path(output_dir, step)
+    fd, tmp = tempfile.mkstemp(dir=output_dir, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+    steps = sorted(
+        int(m.group(1))
+        for name in os.listdir(output_dir)
+        if (m := CKPT_RE.search(name))
+    )
+    for old in steps[:-keep] if keep > 0 else []:
+        try:
+            os.unlink(checkpoint_path(output_dir, old))
+        except OSError:
+            pass
+    return path
+
+
+def load_checkpoint(path: str) -> dict:
+    """Raw state dict (nested dicts of numpy arrays / scalars)."""
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def restore_tree(target: Any, state: Any) -> Any:
+    """Restore a loaded state dict onto a target pytree (shape/type-checked
+    by flax). The analog of ``load_state_dict`` (non-strict loading is the
+    caller's concern: pass the matching subtree)."""
+    return serialization.from_state_dict(target, state)

@@ -1,0 +1,181 @@
+"""Multi-sink structured logging — the in-repo replacement for the external
+``loggerplus`` the reference drives (run_pretraining.py:21,191-204).
+
+Four handler types, all rank-0-gated via ``verbose``: stream, append-mode
+text file, CSV, and TensorBoard (skipped with a warning if no tensorboard
+backend is importable). ``log(tag=..., step=..., **metrics)`` writes one
+structured record to every sink (the reference's record shape:
+tag/step/epoch/average_loss/step_loss/learning_rate/samples_per_second,
+run_pretraining.py:554-564).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+import sys
+import time
+import warnings
+from typing import Iterable, Optional
+
+
+class Handler:
+    def __init__(self, verbose: bool = True):
+        self.verbose = verbose
+
+    def write_message(self, message: str) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def write_record(self, record: dict) -> None:
+        self.write_message(
+            " | ".join(f"{k}: {_fmt(v)}" for k, v in record.items())
+        )
+
+    def close(self) -> None:
+        pass
+
+
+def _fmt(v):
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return v
+
+
+class StreamHandler(Handler):
+    def __init__(self, verbose: bool = True, stream=None):
+        super().__init__(verbose)
+        self.stream = stream or sys.stdout
+
+    def write_message(self, message: str) -> None:
+        if self.verbose:
+            self.stream.write(message + "\n")
+            self.stream.flush()
+
+
+class FileHandler(Handler):
+    def __init__(self, path: str, overwrite: bool = False, verbose: bool = True):
+        super().__init__(verbose)
+        self.path = path
+        if verbose:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "w" if overwrite else "a")
+        else:
+            self._f = None
+
+    def write_message(self, message: str) -> None:
+        if self._f is not None:
+            self._f.write(message + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class CSVHandler(Handler):
+    """One CSV row per structured record; columns fixed by the first record
+    (extra keys in later records are dropped, missing keys are blank)."""
+
+    def __init__(self, path: str, overwrite: bool = False, verbose: bool = True):
+        super().__init__(verbose)
+        self.path = path
+        self._fieldnames: Optional[list] = None
+        if verbose:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "w" if overwrite else "a", newline="")
+        else:
+            self._f = None
+
+    def write_message(self, message: str) -> None:
+        pass  # CSV carries records only
+
+    def write_record(self, record: dict) -> None:
+        if self._f is None:
+            return
+        if self._fieldnames is None:
+            self._fieldnames = list(record.keys())
+            self._writer = csv.DictWriter(
+                self._f, fieldnames=self._fieldnames, extrasaction="ignore"
+            )
+            if self._f.tell() == 0:
+                self._writer.writeheader()
+        self._writer.writerow({k: record.get(k, "") for k in self._fieldnames})
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class TensorBoardHandler(Handler):
+    """Scalar metrics to TensorBoard via any importable writer backend."""
+
+    def __init__(self, log_dir: str, verbose: bool = True):
+        super().__init__(verbose)
+        self._writer = None
+        if not verbose:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter  # type: ignore
+
+            self._writer = SummaryWriter(log_dir)
+        except Exception:
+            try:
+                from tensorboardX import SummaryWriter  # type: ignore
+
+                self._writer = SummaryWriter(log_dir)
+            except Exception:
+                warnings.warn(
+                    "No tensorboard backend available; TensorBoardHandler disabled"
+                )
+
+    def write_message(self, message: str) -> None:
+        pass
+
+    def write_record(self, record: dict) -> None:
+        if self._writer is None:
+            return
+        step = record.get("step", 0)
+        tag = record.get("tag", "train")
+        for key, value in record.items():
+            if key in ("tag", "step"):
+                continue
+            if isinstance(value, (int, float)):
+                self._writer.add_scalar(f"{tag}/{key}", value, int(step))
+        self._writer.flush()
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+
+
+class Logger:
+    def __init__(self):
+        self.handlers: list[Handler] = [StreamHandler()]
+
+    def init(self, handlers: Iterable[Handler]) -> None:
+        self.close()
+        self.handlers = list(handlers)
+
+    def info(self, message: str) -> None:
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        for h in self.handlers:
+            h.write_message(f"[{stamp}] {message}")
+
+    def log(self, **record) -> None:
+        for h in self.handlers:
+            h.write_record(record)
+
+    def close(self) -> None:
+        for h in self.handlers:
+            h.close()
+
+
+# Module-level singleton, loggerplus-style.
+logger = Logger()
+init = logger.init
+info = logger.info
+log = logger.log
+close = logger.close

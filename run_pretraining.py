@@ -1,0 +1,355 @@
+"""BERT/RoBERTa pretraining runner — TPU-native counterpart of reference
+run_pretraining.py.
+
+Capability parity (SURVEY.md §2.1 "Pretraining runner"): CLI > JSON config >
+defaults argument handling, device-mesh setup (replacing NCCL DDP), bf16
+policy (replacing AMP), gradient accumulation inside one jitted step
+(replacing no_sync microbatching), LAMB + warmup-decay schedule, auto-resume
+with phase-switch optimizer surgery, contiguous-chunk sharded data streaming,
+multi-sink logging, checkpoint cadence with last-3 retention, and the
+``training_seq_per_sec`` summary metric (run_pretraining.py:597-599).
+
+Single-host example (smoke config, CPU-runnable):
+  python run_pretraining.py --input_dir data/ --output_dir out/ \
+      --model_config_file configs/bert_base_config.json \
+      --global_batch_size 8 --local_batch_size 8 --steps 3 --max_steps 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bert_pytorch_tpu import optim, pretrain
+from bert_pytorch_tpu.config import BertConfig, parse_args_with_config_file, require_args
+from bert_pytorch_tpu.data import DataLoader, DistributedSampler, ShardedPretrainingDataset
+from bert_pytorch_tpu.models import BertForPreTraining
+from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
+from bert_pytorch_tpu.parallel import launcher
+from bert_pytorch_tpu.utils import checkpoint as ckpt
+from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils.dist import get_rank, get_world_size, is_main_process
+
+
+def parse_arguments(argv=None) -> argparse.Namespace:
+    """Reference parse_arguments (run_pretraining.py:75-177) with TPU-mesh
+    flags replacing the CUDA/apex ones."""
+    parser = argparse.ArgumentParser(description="TPU BERT pretraining")
+    # data / io
+    parser.add_argument("--input_dir", type=str, default=None)
+    parser.add_argument("--output_dir", type=str, default=None)
+    parser.add_argument("--model_config_file", type=str, default=None)
+    parser.add_argument("--config_file", type=str, default=None,
+                        help="JSON overriding defaults; CLI overrides JSON")
+    parser.add_argument("--log_prefix", type=str, default="pretraining")
+    # schedule / steps
+    parser.add_argument("--max_steps", type=int, default=None,
+                        help="total optimizer steps of the phase (t_total)")
+    parser.add_argument("--steps", type=int, default=None,
+                        help="optimizer steps to run in this invocation")
+    parser.add_argument("--previous_phase_end_step", type=int, default=0)
+    parser.add_argument("--learning_rate", type=float, default=6e-3)
+    parser.add_argument("--lr_decay", type=str, default="poly",
+                        choices=["poly", "linear", "cosine", "constant"])
+    parser.add_argument("--warmup_proportion", type=float, default=0.2843)
+    # batch
+    parser.add_argument("--global_batch_size", type=int, default=None)
+    parser.add_argument("--local_batch_size", type=int, default=None)
+    # masking
+    parser.add_argument("--max_predictions_per_seq", type=int, default=20)
+    parser.add_argument("--masked_token_fraction", type=float, default=0.15)
+    # checkpoint / logging cadence
+    parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
+    parser.add_argument("--keep_checkpoints", type=int, default=3)
+    parser.add_argument("--log_steps", type=int, default=1)
+    # numerics / memory
+    parser.add_argument("--dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--checkpoint_activations", action="store_true")
+    parser.add_argument("--attention_backend", type=str, default="xla",
+                        choices=["xla", "pallas"])
+    # optimizer
+    parser.add_argument("--optimizer", type=str, default="lamb",
+                        choices=["lamb", "adamw"])
+    parser.add_argument("--weight_decay", type=float, default=0.01)
+    parser.add_argument("--max_grad_norm", type=float, default=1.0)
+    # K-FAC (SURVEY §2.2)
+    parser.add_argument("--kfac", action="store_true")
+    parser.add_argument("--kfac_stat_decay", type=float, default=0.95)
+    parser.add_argument("--kfac_damping", type=float, default=0.001)
+    parser.add_argument("--kfac_kl_clip", type=float, default=0.001)
+    parser.add_argument("--kfac_factor_interval", type=int, default=10)
+    parser.add_argument("--kfac_inv_interval", type=int, default=100)
+    parser.add_argument("--kfac_skip_layers", type=str, nargs="+",
+                        default=["embeddings", "predictions"])
+    # mesh
+    parser.add_argument("--mesh_data", type=int, default=-1)
+    parser.add_argument("--mesh_fsdp", type=int, default=1)
+    parser.add_argument("--mesh_seq", type=int, default=1)
+    parser.add_argument("--mesh_model", type=int, default=1)
+    parser.add_argument("--parallel_strategy", type=str, default="dp",
+                        choices=["dp", "fsdp", "tp", "tp_fsdp", "sp"])
+    parser.add_argument("--seed", type=int, default=42)
+
+    args = parse_args_with_config_file(parser, argv)
+    require_args(args, ["input_dir", "output_dir", "model_config_file",
+                        "max_steps", "global_batch_size", "local_batch_size"])
+    return args
+
+
+def setup_training(args):
+    """Mesh + logging + accumulation math (reference setup_training,
+    run_pretraining.py:180-230)."""
+    launcher.initialize()
+    mesh = create_mesh(MeshConfig(
+        data=args.mesh_data, fsdp=args.mesh_fsdp,
+        seq=args.mesh_seq, model=args.mesh_model,
+    ))
+    args.model_output_dir = os.path.join(args.output_dir, "pretrain_ckpts")
+    if is_main_process():
+        os.makedirs(args.model_output_dir, exist_ok=True)
+
+    logger.init(handlers=[
+        logger.StreamHandler(verbose=is_main_process()),
+        logger.FileHandler(
+            os.path.join(args.output_dir, args.log_prefix + ".txt"),
+            overwrite=False, verbose=is_main_process()),
+        logger.TensorBoardHandler(
+            os.path.join(args.output_dir, "tensorboard"),
+            verbose=is_main_process()),
+        logger.CSVHandler(
+            os.path.join(args.output_dir, args.log_prefix + "_metrics.csv"),
+            overwrite=False, verbose=is_main_process()),
+    ])
+    logger.info(
+        f"mesh initialized: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
+        f"({jax.process_count()} processes, {len(jax.devices())} devices)"
+    )
+
+    # Accumulation math (reference :213-228), in global terms: one optimizer
+    # step consumes global_batch_size sequences as accumulation_steps
+    # microbatches of local_batch_size per data shard.
+    n_data = mesh.shape["data"] * mesh.shape["fsdp"]
+    global_microbatch = args.local_batch_size * n_data
+    if args.global_batch_size % global_microbatch != 0:
+        raise ValueError(
+            f"global_batch_size={args.global_batch_size} must be divisible by "
+            f"local_batch_size*data_shards={global_microbatch}"
+        )
+    args.accumulation_steps = args.global_batch_size // global_microbatch
+    if args.global_batch_size % jax.process_count() != 0:
+        raise ValueError("global_batch_size must divide by process count")
+    args.host_batch_per_step = args.global_batch_size // jax.process_count()
+    return args, mesh
+
+
+def prepare_model(args, mesh):
+    """Model config + auto-resume discovery (reference prepare_model,
+    run_pretraining.py:233-274)."""
+    config = BertConfig.from_json_file(args.model_config_file)
+    if config.vocab_size % 8 != 0:  # MXU-friendly padding (reference :237)
+        config.vocab_size += 8 - (config.vocab_size % 8)
+
+    model = BertForPreTraining(
+        config,
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        remat="full" if args.checkpoint_activations else "none",
+        attention_backend=args.attention_backend,
+    )
+
+    resume_step = ckpt.find_resume_step(args.model_output_dir)
+    checkpoint = None
+    global_step = 0
+    args.resume_step = 0
+    if resume_step is not None:
+        args.resume_step = resume_step
+        if args.previous_phase_end_step > resume_step:
+            raise ValueError(
+                f"previous_phase_end_step={args.previous_phase_end_step} cannot "
+                f"be larger than resume_step={resume_step}")
+        checkpoint = ckpt.load_checkpoint(
+            ckpt.checkpoint_path(args.model_output_dir, resume_step))
+        global_step = resume_step - args.previous_phase_end_step
+        logger.info(f"Resume from step {resume_step} checkpoint")
+    return model, config, checkpoint, global_step
+
+
+def prepare_optimizer(args, params_example=None):
+    """LAMB/AdamW + schedule (reference prepare_optimizers,
+    run_pretraining.py:277-357)."""
+    schedule = optim.make_schedule(
+        args.lr_decay, args.learning_rate, args.warmup_proportion, args.max_steps)
+    mask = optim.no_decay_mask
+    if args.optimizer == "lamb":
+        tx = optim.lamb(
+            schedule, weight_decay=args.weight_decay,
+            weight_decay_mask=mask, max_grad_norm=args.max_grad_norm)
+    else:
+        tx = optim.adamw(schedule, weight_decay=args.weight_decay,
+                         weight_decay_mask=mask)
+    return tx, schedule
+
+
+def prepare_dataset(args, config, checkpoint):
+    """HDF5 discovery + tokenizer-derived mask id + sharded streaming
+    (reference prepare_dataset, run_pretraining.py:360-402)."""
+    input_files = []
+    if os.path.isfile(args.input_dir):
+        input_files.append(args.input_dir)
+    elif os.path.isdir(args.input_dir):
+        input_files = [str(p) for p in Path(args.input_dir).rglob("*.hdf5")
+                       if p.is_file()]
+
+    mask_token_id = getattr(config, "mask_token_id", None)
+    vocab_file = getattr(config, "vocab_file", None)
+    if mask_token_id is None and vocab_file and os.path.exists(vocab_file):
+        from bert_pytorch_tpu.data.tokenization import (
+            get_bpe_tokenizer, get_wordpiece_tokenizer)
+        kind = getattr(config, "tokenizer", "wordpiece")
+        lowercase = getattr(config, "lowercase", True)
+        tok = (get_wordpiece_tokenizer(vocab_file, uppercase=not lowercase)
+               if kind == "wordpiece"
+               else get_bpe_tokenizer(vocab_file, uppercase=not lowercase))
+        mask_token_id = tok.token_to_id("[MASK]")
+    if mask_token_id is None:
+        mask_token_id = 4  # synthetic-data default
+        logger.info("No vocab_file/mask_token_id in model config; "
+                    f"using mask_token_id={mask_token_id}")
+
+    dataset = ShardedPretrainingDataset(
+        input_files, int(mask_token_id), args.max_predictions_per_seq,
+        args.masked_token_fraction, vocab_size=int(config.vocab_size),
+        seed=args.seed + get_rank())
+    sampler = DistributedSampler(
+        dataset, num_replicas=jax.process_count(), rank=jax.process_index())
+    if checkpoint is not None and "sampler" in checkpoint:
+        sampler.load_state_dict(checkpoint["sampler"])
+    loader = DataLoader(dataset, sampler,
+                        batch_size=args.host_batch_per_step, drop_last=True)
+    logger.info(f"Samples in dataset: {len(dataset)}")
+    logger.info(f"Samples per process: {len(sampler)}")
+    logger.info(f"Sampler starting index: {sampler.index}")
+    return loader, sampler
+
+
+def main(args) -> dict:
+    args, mesh = setup_training(args)
+    model, config, checkpoint, global_step = prepare_model(args, mesh)
+    tx, schedule = prepare_optimizer(args)
+    loader, sampler = prepare_dataset(args, config, checkpoint)
+
+    rules = logical_axis_rules(args.parallel_strategy)
+    seq_len = config.max_position_embeddings
+    sample = (jnp.zeros((1, seq_len), jnp.int32),) * 3
+    with mesh:
+        shardings = pretrain.state_shardings(mesh, model, rules, sample)
+        b_shardings = pretrain.batch_shardings(
+            mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+                   "masked_lm_labels": 3, "next_sentence_labels": 2})
+        init_fn = pretrain.make_init_fn(model, tx, sample, shardings)
+        state = init_fn(jax.random.PRNGKey(args.seed))
+
+        if checkpoint is not None:
+            params = ckpt.restore_tree(
+                jax.device_get(state.params), checkpoint["model"])
+            opt_state = ckpt.restore_tree(
+                jax.device_get(state.opt_state), checkpoint["optimizer"])
+            state = pretrain.TrainState(
+                params=jax.device_put(params, shardings.params),
+                opt_state=jax.device_put(opt_state, shardings.opt_state),
+                rng=state.rng)
+            if args.resume_step >= args.previous_phase_end_step > 0:
+                # Phase-2 surgery (reference run_pretraining.py:298-309):
+                # schedule hyperparams come from the new config; only the
+                # optimizer step counter is rewritten.
+                state = state.replace(
+                    opt_state=optim.reset_count(state.opt_state, global_step))
+                logger.info(f"Phase switch: optimizer count reset to {global_step}")
+
+        train_step = pretrain.make_train_step(
+            model, tx, schedule=schedule,
+            next_sentence=bool(config.next_sentence),
+            shardings=shardings, batch_shardings_=b_shardings)
+
+        steps_this_run = args.steps or (args.max_steps - global_step)
+        steps_this_run = min(steps_this_run, args.max_steps - global_step)
+        logger.info(f"Starting at global step {global_step}; running "
+                    f"{steps_this_run} steps "
+                    f"(accumulation_steps={args.accumulation_steps})")
+
+        epoch = int(checkpoint["epoch"]) if checkpoint else 0
+        step_in_run = 0
+        train_start = time.perf_counter()
+        samples_seen = 0
+        last_metrics = {}
+        done = False
+        while not done:
+            sampler.set_epoch(epoch)
+            for host_batch in loader:
+                batch = pretrain.stack_microbatches(
+                    host_batch, args.accumulation_steps)
+                batch = pretrain.put_batch(batch, b_shardings)
+                state, metrics = train_step(state, batch)
+                global_step += 1
+                step_in_run += 1
+                if step_in_run > 1:  # skip step-0 compile in throughput
+                    samples_seen += args.global_batch_size
+                if step_in_run == 1:
+                    train_start = time.perf_counter()
+
+                if global_step % args.log_steps == 0:
+                    last_metrics = {k: float(v) for k, v in metrics.items()}
+                    elapsed = time.perf_counter() - train_start
+                    logger.log(
+                        tag="train", step=global_step, epoch=epoch,
+                        average_loss=last_metrics["loss"],
+                        step_loss=last_metrics["loss"],
+                        learning_rate=last_metrics.get("learning_rate", 0.0),
+                        samples_per_second=samples_seen / max(elapsed, 1e-9),
+                        mlm_accuracy=last_metrics.get("mlm_accuracy", 0.0),
+                        grad_norm=last_metrics.get("grad_norm", 0.0))
+
+                if global_step % args.num_steps_per_checkpoint == 0:
+                    save_step = global_step + args.previous_phase_end_step
+                    ckpt.save_checkpoint(
+                        args.model_output_dir, save_step,
+                        {"model": state.params,
+                         "optimizer": state.opt_state,
+                         "sampler": sampler.state_dict(),
+                         "epoch": epoch},
+                        keep=args.keep_checkpoints)
+                    logger.info(f"Saved checkpoint at step {save_step}")
+
+                if step_in_run >= steps_this_run or global_step >= args.max_steps:
+                    done = True
+                    break
+            epoch += 1
+
+        train_time = time.perf_counter() - train_start
+        seq_per_sec = samples_seen / max(train_time, 1e-9)
+        logger.info(f"Total time: {train_time:.2f} s")
+        logger.info(f"training_seq_per_sec = {seq_per_sec:.2f}")
+        # Final checkpoint so short runs resume exactly.
+        save_step = global_step + args.previous_phase_end_step
+        ckpt.save_checkpoint(
+            args.model_output_dir, save_step,
+            {"model": state.params, "optimizer": state.opt_state,
+             "sampler": sampler.state_dict(), "epoch": epoch},
+            keep=args.keep_checkpoints)
+        logger.close()
+        return {"global_step": global_step,
+                "training_seq_per_sec": seq_per_sec,
+                **last_metrics}
+
+
+if __name__ == "__main__":
+    arguments = parse_arguments()
+    np.random.seed(arguments.seed + get_rank())
+    main(arguments)

@@ -9,15 +9,23 @@ module-level assignment in conftest.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # force: the axon TPU plugin would
-# otherwise claim the default backend even without JAX_PLATFORMS set.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import sys  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax  # noqa: E402
+
+# A TPU plugin in the environment may force jax_platforms via jax.config at
+# interpreter startup (sitecustomize), which overrides the JAX_PLATFORMS env
+# var — so the config override is the only reliable way to pin tests to the
+# virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 # The CPU backend's default matmul precision truncates inputs to bf16 (TPU
 # MXU emulation), which would drown kernel-vs-reference comparisons in 1e-2

@@ -1,0 +1,175 @@
+"""Data runtime tests: shard streaming, masking semantics, sampler resume.
+
+Encodes the documented behaviors of reference src/dataset.py (segment/mask
+derivation examples at dataset.py:224-252, masking at :277-296, sampler
+resume at :401-425).
+"""
+
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.data import (
+    DataLoader,
+    DistributedSampler,
+    ShardedPretrainingDataset,
+)
+from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
+
+VOCAB = 1000
+MASK_ID = 4
+
+
+@pytest.fixture(scope="module")
+def shards(tmp_path_factory):
+    d = tmp_path_factory.mktemp("shards")
+    paths = [
+        make_shard(str(d / f"shard_{i}.hdf5"), 32, 64, VOCAB, seed=i)
+        for i in range(3)
+    ]
+    return paths
+
+
+@pytest.fixture(scope="module")
+def legacy_shard(tmp_path_factory):
+    d = tmp_path_factory.mktemp("legacy")
+    return make_shard(str(d / "legacy.hdf5"), 16, 64, VOCAB, seed=9, legacy=True)
+
+
+def _dataset(shards, **kw):
+    return ShardedPretrainingDataset(
+        shards, MASK_ID, max_pred_per_seq=20, masked_lm_prob=0.15,
+        vocab_size=VOCAB, seed=0, **kw,
+    )
+
+
+def test_sequential_iteration_crosses_files(shards):
+    ds = _dataset(shards)
+    assert len(ds) == 96
+    seen = 0
+    for i in range(len(ds)):
+        sample = ds[i]
+        assert len(sample) == 5
+        seen += 1
+    assert seen == 96
+
+
+def test_out_of_order_access_raises(shards):
+    ds = _dataset(shards)
+    ds[0]
+    with pytest.raises(RuntimeError, match="out of range"):
+        ds[70]  # skips into the third file out of order
+
+
+def test_segment_and_mask_derivation():
+    ids = np.zeros(16, np.int32)
+    special = np.asarray([0, 5, 10], np.int32)
+    seg = ShardedPretrainingDataset._get_segment_ids(ids, special)
+    # positions 6..10 inclusive are segment 1 (dataset.py:224-238)
+    assert seg[:6].sum() == 0 and (seg[6:11] == 1).all() and seg[11:].sum() == 0
+    mask = ShardedPretrainingDataset._get_input_mask(ids, special)
+    assert (mask[:11] == 1).all() and mask[11:].sum() == 0
+
+
+def test_masking_statistics(shards):
+    ds = _dataset(shards)
+    n_masked, n_masktok, n_kept, n_total = 0, 0, 0, 0
+    for i in range(32):
+        input_ids, seg, mask, labels, nsp = ds[i]
+        positions = np.nonzero(labels != -1)[0]
+        assert 1 <= len(positions) <= 20
+        # labels hold original ids; inputs are [MASK] / random / original
+        n_masked += len(positions)
+        n_masktok += int((input_ids[positions] == MASK_ID).sum())
+        n_kept += int((input_ids[positions] == labels[positions]).sum())
+        n_total += 1
+        # special positions are never masked
+        assert labels[0] == -1
+    # roughly 80% [MASK], 10% kept (random replacement can collide with orig)
+    assert 0.6 < n_masktok / n_masked < 0.95
+    assert n_kept / n_masked < 0.3
+
+
+def test_no_duplicate_mask_positions(shards):
+    ds = _dataset(shards)
+    for i in range(16):
+        _, _, _, labels, _ = ds[i]
+        pos = np.nonzero(labels != -1)[0]
+        assert len(pos) == len(set(pos.tolist()))
+
+
+def test_legacy_format(legacy_shard):
+    ds = ShardedPretrainingDataset(
+        [legacy_shard], None, 20, 0.15, vocab_size=VOCAB, seed=0
+    )
+    input_ids, seg, mask, labels, nsp = ds[0]
+    pos = np.nonzero(labels != -1)[0]
+    # pre-masked: labels reproduce the stored masked_lm ids
+    assert (labels[pos] == input_ids[pos]).all()  # synthetic shard stores originals
+    assert mask.sum() > 0
+
+
+def test_sampler_contiguous_chunks(shards):
+    ds = _dataset(shards)
+    samplers = [DistributedSampler(ds, 4, r) for r in range(4)]
+    chunks = [list(s) for s in samplers]
+    assert all(len(c) == 24 for c in chunks)
+    # contiguous, rank-ordered, covering 0..95
+    flat = sum(chunks, [])
+    assert flat == list(range(96))
+
+
+def test_sampler_padding_non_divisible(shards):
+    ds = _dataset(shards)  # 96 samples
+    samplers = [DistributedSampler(ds, 5, r) for r in range(5)]
+    total = sum(len(list(s)) for s in samplers)
+    assert total == samplers[0].total_size == 100  # padded with wrap-around
+
+
+def test_sampler_state_roundtrip(shards):
+    ds = _dataset(shards)
+    s = DistributedSampler(ds, 2, 0)
+    for _ in range(10):
+        next(s)
+    state = s.state_dict()
+    s2 = DistributedSampler(ds, 2, 0)
+    s2.load_state_dict(state)
+    assert next(s2) == next(s)
+
+
+def test_sampler_state_skipped_on_mismatch(shards):
+    ds = _dataset(shards)
+    s = DistributedSampler(ds, 2, 0)
+    state = s.state_dict()
+    state["num_replicas"] = 4
+    s2 = DistributedSampler(ds, 2, 0)
+    with pytest.warns(UserWarning, match="replicas has changed"):
+        s2.load_state_dict(state)
+    assert s2.index == 0
+
+
+def test_loader_batches_and_shapes(shards):
+    ds = _dataset(shards)
+    sampler = DistributedSampler(ds, 1, 0)
+    loader = DataLoader(ds, sampler, batch_size=8)
+    batches = list(loader)
+    assert len(batches) == 12
+    b = batches[0]
+    assert b["input_ids"].shape == (8, 64)
+    assert b["next_sentence_labels"].shape == (8,)
+    assert b["input_ids"].dtype == np.int32
+
+
+def test_loader_propagates_worker_errors(shards):
+    ds = _dataset(shards)
+
+    class BadSampler:
+        def __iter__(self):
+            yield 0
+            raise RuntimeError("boom")
+
+        def __len__(self):
+            return 8
+
+    loader = DataLoader(ds, BadSampler(), batch_size=1)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)

@@ -1,0 +1,154 @@
+"""Optimizer and schedule tests against independent numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu import optim
+
+
+def test_poly_schedule_values():
+    # BERT phase-1 recipe shape: warmup fraction then (1-p)^0.5 decay.
+    sched = optim.warmup_poly_schedule(6e-3, warmup=0.2843, total_steps=7038)
+    # step 0 -> last_epoch 1 -> lr = base * (1/7038)/0.2843
+    got = float(sched(jnp.asarray(0)))
+    want = 6e-3 * (1 / 7038) / 0.2843
+    assert np.isclose(got, want, rtol=1e-6)
+    # past warmup: poly decay
+    t = 5000
+    got = float(sched(jnp.asarray(t)))
+    want = 6e-3 * (1.0 - (t + 1) / 7038) ** 0.5
+    assert np.isclose(got, want, rtol=1e-6)
+    # end of schedule: lr ~ 0, never negative
+    assert float(sched(jnp.asarray(7037))) == 0.0
+    assert float(sched(jnp.asarray(8000))) == 0.0
+
+
+def test_linear_schedule_values():
+    sched = optim.warmup_linear_schedule(4e-4, warmup=0.06, total_steps=1000)
+    t = 500
+    progress = (t + 1) / 1000
+    want = 4e-4 * (progress - 1.0) / (0.06 - 1.0)
+    assert np.isclose(float(sched(jnp.asarray(t))), want, rtol=1e-6)
+
+
+def test_make_schedule_rejects_unknown():
+    with pytest.raises(ValueError):
+        optim.make_schedule("exponential", 1e-3, 0.1, 100)
+
+
+def _numpy_lamb_step(p, g, m, v, t, lr, b1, b2, eps, wd):
+    """Independent LAMB reference (bias-corrected, trust ratio)."""
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    m_hat = m / (1 - b1**t)
+    v_hat = v / (1 - b2**t)
+    upd = m_hat / (np.sqrt(v_hat) + eps) + wd * p
+    p_norm = np.linalg.norm(p)
+    u_norm = np.linalg.norm(upd)
+    ratio = p_norm / u_norm if p_norm > 0 and u_norm > 0 else 1.0
+    return p - lr * ratio * upd, m, v
+
+
+def test_lamb_matches_numpy_reference():
+    rng = np.random.default_rng(0)
+    p0 = rng.normal(size=(4, 3)).astype(np.float32)
+    params = {"w": jnp.asarray(p0)}
+    tx = optim.lamb(1e-2, max_grad_norm=None, weight_decay=0.01)
+    state = tx.init(params)
+
+    p_np, m_np, v_np = p0.copy(), np.zeros_like(p0), np.zeros_like(p0)
+    for t in range(1, 4):
+        g = rng.normal(size=(4, 3)).astype(np.float32)
+        updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, updates)
+        p_np, m_np, v_np = _numpy_lamb_step(
+            p_np, g, m_np, v_np, t, 1e-2, 0.9, 0.999, 1e-6, 0.01
+        )
+        np.testing.assert_allclose(np.asarray(params["w"]), p_np, rtol=2e-5, atol=1e-7)
+
+
+def test_lamb_grad_clipping_is_global():
+    params = {"a": jnp.ones((2,)), "b": jnp.ones((2,))}
+    tx = optim.lamb(1e-2, max_grad_norm=1.0)
+    state = tx.init(params)
+    huge = {"a": jnp.full((2,), 100.0), "b": jnp.full((2,), 100.0)}
+    updates1, s1 = tx.update(huge, state, params)
+    scaled = jax.tree_util.tree_map(lambda g: g / 200.0, huge)
+    updates2, _ = tx.update(scaled, state, params)
+    # after global clipping to norm 1, both give the same moments direction
+    gnorm = float(np.sqrt(4 * 100.0**2))
+    expect_scale = 1.0 / gnorm
+    # the clipped grads equal huge * expect_scale; just check updates finite & equal-ish
+    for k in ("a", "b"):
+        np.testing.assert_allclose(
+            np.asarray(updates1[k]),
+            np.asarray(updates2[k] / (0.5 / (100.0 * expect_scale))),
+            rtol=1e-3,
+        )
+
+
+def test_weight_decay_mask_routes_decay():
+    params = {
+        "dense": {"kernel": jnp.ones((2, 2)), "bias": jnp.ones((2,))},
+        "layer_norm": {"scale": jnp.ones((2,)), "bias": jnp.zeros((2,))},
+    }
+    mask = optim.no_decay_mask(params)
+    assert mask["dense"]["kernel"] is True
+    assert mask["dense"]["bias"] is False
+    assert mask["layer_norm"]["scale"] is False
+    assert mask["layer_norm"]["bias"] is False
+
+
+def test_bert_adam_no_bias_correction_and_schedule():
+    """BertAdam semantics (optimization.py:113-174): no bias correction,
+    schedule evaluated at pre-update count."""
+    p0 = np.full((3,), 2.0, np.float32)
+    g = np.full((3,), 0.5, np.float32)
+    lr, warmup, t_total = 1e-2, 0.5, 10
+    tx = optim.bert_adam(
+        lr, schedule="warmup_linear", warmup=warmup, t_total=t_total,
+        weight_decay=0.0, max_grad_norm=-1,
+    )
+    params = {"w": jnp.asarray(p0)}
+    state = tx.init(params)
+    updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+    # step count 0 -> progress 0 -> lr_scheduled = 0 => first update is zero.
+    np.testing.assert_allclose(np.asarray(updates["w"]), np.zeros(3), atol=1e-12)
+    # second step: count=1, progress=0.1 < warmup -> lr*0.1/0.5
+    updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+    m = 0.1 * 0.5 * (1 - 0.9) + 0.9 * (0.5 * (1 - 0.9))  # b1 EMA after 2 identical grads
+    m = (1 - 0.9) * 0.5 + 0.9 * ((1 - 0.9) * 0.5)
+    v = (1 - 0.999) * 0.25 + 0.999 * ((1 - 0.999) * 0.25)
+    want = -(lr * (0.1 / 0.5)) * (m / (np.sqrt(v) + 1e-6))
+    np.testing.assert_allclose(np.asarray(updates["w"]), np.full(3, want), rtol=1e-5)
+
+
+def test_reset_count_phase_surgery():
+    params = {"w": jnp.ones((2,))}
+    tx = optim.lamb(1e-2)
+    state = tx.init(params)
+    for _ in range(5):
+        _, state = tx.update({"w": jnp.ones((2,))}, state, params)
+    assert int(state.count) == 5
+    state2 = optim.reset_count(state, 0)
+    assert int(state2.count) == 0
+    np.testing.assert_allclose(np.asarray(state2.mu["w"]), np.asarray(state.mu["w"]))
+
+
+def test_adamw_converges_quadratic():
+    # sanity: minimize ||x - 3||^2
+    tx = optim.adamw(0.1, weight_decay=0.0)
+    params = {"x": jnp.zeros((2,))}
+    state = tx.init(params)
+
+    @jax.jit
+    def step(params, state):
+        grads = jax.grad(lambda p: jnp.sum((p["x"] - 3.0) ** 2))(params)
+        updates, state = tx.update(grads, state, params)
+        return jax.tree_util.tree_map(lambda p, u: p + u, params, updates), state
+
+    for _ in range(200):
+        params, state = step(params, state)
+    np.testing.assert_allclose(np.asarray(params["x"]), np.full(2, 3.0), atol=0.05)
