@@ -1,0 +1,204 @@
+"""NER finetuning runner — TPU-native counterpart of reference run_ner.py.
+
+Capability parity (SURVEY.md §3.4): CoNLL-style data via
+bert_pytorch_tpu.data.ner_dataset, BertForTokenClassification with
+``len(labels)+1`` classes (reference run_ner.py:224; id 0 reserved),
+pretrained-checkpoint warm start, AdamW(bias_correction=False) with the
+``1/(1+0.05*epoch)`` LambdaLR decay (:243-245), per-step global-norm grad
+clipping (:145-170), per-epoch validation and final test with macro-F1 over
+non-special tokens (:127-142 — computed here in numpy, no sklearn
+dependency).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bert_pytorch_tpu import optim
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.data.ner_dataset import NERDataset
+from bert_pytorch_tpu.data.tokenization import (
+    get_bpe_tokenizer,
+    get_wordpiece_tokenizer,
+)
+from bert_pytorch_tpu.models import BertForTokenClassification
+from bert_pytorch_tpu.models.losses import token_classification_loss
+from bert_pytorch_tpu.ops.grad_utils import clip_by_global_norm
+from bert_pytorch_tpu.utils import checkpoint as ckpt
+from bert_pytorch_tpu.utils import logging as logger
+
+
+def parse_arguments(argv=None):
+    parser = argparse.ArgumentParser(description="TPU BERT NER finetuning")
+    parser.add_argument("--train_file", type=str, required=True)
+    parser.add_argument("--val_file", type=str, default=None)
+    parser.add_argument("--test_file", type=str, default=None)
+    parser.add_argument("--labels", type=str, nargs="+", required=True)
+    parser.add_argument("--model_config_file", type=str, required=True)
+    parser.add_argument("--model_checkpoint", type=str, default=None)
+    parser.add_argument("--vocab_file", type=str, default=None)
+    parser.add_argument("--uppercase", action="store_true")
+    parser.add_argument("--tokenizer", type=str, default=None,
+                        choices=["wordpiece", "bpe"])
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument("--lr", type=float, default=5e-6)
+    parser.add_argument("--clip_grad", type=float, default=5.0)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--max_seq_len", type=int, default=128)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    args = parser.parse_args(argv)
+
+    with open(args.model_config_file) as f:
+        configs = json.load(f)
+    if args.vocab_file is None:
+        args.vocab_file = configs.get("vocab_file")
+        if args.vocab_file is None:
+            raise ValueError("vocab_file must be in model config or CLI")
+    if args.tokenizer is None:
+        args.tokenizer = configs.get("tokenizer")
+        if args.tokenizer is None:
+            raise ValueError("tokenizer must be in model config or CLI")
+    return args
+
+
+def macro_f1(predictions: np.ndarray, labels: np.ndarray) -> float:
+    """Macro-F1 over non-special positions (labels > 0), numpy reimplementation
+    of the sklearn call at reference run_ner.py:127-142."""
+    preds = predictions.argmax(axis=-1)
+    keep = labels > 0
+    y_true = labels[keep]
+    y_pred = preds[keep]
+    classes = np.unique(y_true)
+    f1s = []
+    for c in classes:
+        tp = np.sum((y_pred == c) & (y_true == c))
+        fp = np.sum((y_pred == c) & (y_true != c))
+        fn = np.sum((y_pred != c) & (y_true == c))
+        precision = tp / (tp + fp) if tp + fp else 0.0
+        recall = tp / (tp + fn) if tp + fn else 0.0
+        f1s.append(2 * precision * recall / (precision + recall)
+                   if precision + recall else 0.0)
+    return float(np.mean(f1s)) if f1s else 0.0
+
+
+def batches(dataset, batch_size, shuffle, rng):
+    order = rng.permutation(len(dataset)) if shuffle else np.arange(len(dataset))
+    for i in range(0, len(order) - batch_size + 1, batch_size):
+        idx = order[i:i + batch_size]
+        seqs, labels, masks = zip(*(dataset[j] for j in idx))
+        yield (np.stack(seqs), np.stack(labels), np.stack(masks))
+
+
+def main(args):
+    rng = np.random.default_rng(args.seed)
+    logger.init(handlers=[logger.StreamHandler()])
+
+    if args.tokenizer == "wordpiece":
+        tokenizer = get_wordpiece_tokenizer(args.vocab_file,
+                                            uppercase=args.uppercase)
+    else:
+        tokenizer = get_bpe_tokenizer(args.vocab_file, uppercase=args.uppercase)
+
+    datasets = {"train": NERDataset(args.train_file, tokenizer, args.labels,
+                                    args.max_seq_len)}
+    for split, path in (("val", args.val_file), ("test", args.test_file)):
+        if path:
+            datasets[split] = NERDataset(path, tokenizer, args.labels,
+                                         args.max_seq_len)
+    id_to_label = {i: l for i, l in enumerate(args.labels, start=1)}
+
+    config = BertConfig.from_json_file(args.model_config_file)
+    if config.vocab_size % 8 != 0:
+        config.vocab_size += 8 - (config.vocab_size % 8)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = BertForTokenClassification(
+        config, num_labels=len(args.labels) + 1, dtype=dtype)
+
+    sample = (jnp.zeros((1, args.max_seq_len), jnp.int32),) * 3
+    import flax.linen as nn
+
+    params = nn.unbox(model.init(jax.random.PRNGKey(args.seed), *sample))["params"]
+    if args.model_checkpoint:
+        state = ckpt.load_checkpoint(args.model_checkpoint)
+        source = state.get("model", state)
+        if "bert" in source:
+            params["bert"] = ckpt.restore_tree(params["bert"], source["bert"])
+        logger.info(f"loaded pretrained encoder from {args.model_checkpoint}")
+
+    # AdamW(bias_correction=False) + per-epoch 1/(1+0.05*epoch) decay
+    # (reference run_ner.py:243-245). The epoch index is passed per step.
+    base_tx = optim.adamw(1.0, bias_correction=False, weight_decay=0.0)
+    opt_state = base_tx.init(params)
+
+    def train_step(params, opt_state, batch, dropout_rng, epoch):
+        seqs, labels, masks = batch
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, seqs, None, masks, False,
+                                 rngs={"dropout": dropout_rng})
+            return token_classification_loss(logits, labels)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads, _ = clip_by_global_norm(grads, args.clip_grad)
+        updates, opt_state2 = base_tx.update(grads, opt_state, params)
+        lr = args.lr / (1.0 + 0.05 * epoch)
+        updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
+        return optax.apply_updates(params, updates), opt_state2, loss
+
+    train_step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    @jax.jit
+    def eval_step(params, seqs, masks):
+        return model.apply({"params": params}, seqs, None, masks)
+
+    def evaluate(split):
+        dataset = datasets[split]
+        all_logits, all_labels, losses = [], [], []
+        for seqs, labels, masks in batches(dataset, args.batch_size, False, rng):
+            logits = np.asarray(eval_step(params, seqs, masks), np.float32)
+            losses.append(float(token_classification_loss(
+                jnp.asarray(logits), jnp.asarray(labels))))
+            all_logits.append(logits)
+            all_labels.append(labels)
+        if not all_logits:
+            return 0.0, 0.0
+        f1 = macro_f1(np.concatenate(all_logits), np.concatenate(all_labels))
+        return float(np.mean(losses)), f1
+
+    key = jax.random.PRNGKey(args.seed)
+    results = {}
+    for epoch in range(args.epochs):
+        t0 = time.perf_counter()
+        losses = []
+        for batch in batches(datasets["train"], args.batch_size, True, rng):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = train_step(
+                params, opt_state, batch, sub, epoch)
+            losses.append(float(loss))
+        msg = (f"epoch {epoch}: train_loss={np.mean(losses):.4f} "
+               f"({time.perf_counter() - t0:.1f}s)")
+        if "val" in datasets:
+            val_loss, val_f1 = evaluate("val")
+            results["val_f1"] = val_f1
+            msg += f" val_loss={val_loss:.4f} val_f1={val_f1:.4f}"
+        logger.info(msg)
+
+    if "test" in datasets:
+        test_loss, test_f1 = evaluate("test")
+        results["test_f1"] = test_f1
+        logger.info(f"test_loss={test_loss:.4f} test_f1={test_f1:.4f}")
+    logger.close()
+    return results
+
+
+if __name__ == "__main__":
+    main(parse_arguments())

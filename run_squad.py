@@ -1,0 +1,370 @@
+"""SQuAD v1.1/v2.0 finetuning + prediction runner — TPU-native counterpart of
+reference run_squad.py.
+
+Capability parity (SURVEY.md §3.3): example reading / sliding-window
+featurization with pickle cache, span-loss finetuning of
+BertForQuestionAnswering (AdamW bias_correction=False + linear warmup — the
+FusedAdam path of run_squad.py:980-996 — or BertAdam with its internal
+schedule for the fp32 path, :999-1002), batched prediction into RawResults,
+n-best span decoding with text realignment (bert_pytorch_tpu/squad.py), the
+official-eval-script subprocess oracle (:1197-1204), and the dllogger-style
+summary metrics (e2e_train_time, training_sequences_per_second,
+e2e_inference_time, exact_match, F1; :1206-1224). bf16 on TPU replaces the
+Apex AMP O2 path; DDP is replaced by batch sharding over the device mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bert_pytorch_tpu import optim, pretrain, squad
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.data.tokenization import (
+    get_bpe_tokenizer,
+    get_wordpiece_tokenizer,
+)
+from bert_pytorch_tpu.models import BertForQuestionAnswering
+from bert_pytorch_tpu.models.losses import span_loss
+from bert_pytorch_tpu.ops.grad_utils import global_norm
+from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
+from bert_pytorch_tpu.utils import checkpoint as ckpt
+from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils.dist import is_main_process
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description="TPU BERT SQuAD finetuning")
+    parser.add_argument("--output_dir", type=str, required=True)
+    parser.add_argument("--init_checkpoint", type=str, default=None,
+                        help="pretraining checkpoint (.msgpack) to start from")
+    parser.add_argument("--config_file", type=str, required=True,
+                        help="BERT model config json")
+    parser.add_argument("--train_file", type=str, default=None)
+    parser.add_argument("--predict_file", type=str, default=None)
+    parser.add_argument("--max_seq_length", type=int, default=384)
+    parser.add_argument("--doc_stride", type=int, default=128)
+    parser.add_argument("--max_query_length", type=int, default=64)
+    parser.add_argument("--do_train", action="store_true")
+    parser.add_argument("--do_predict", action="store_true")
+    parser.add_argument("--do_eval", action="store_true")
+    parser.add_argument("--train_batch_size", type=int, default=32)
+    parser.add_argument("--predict_batch_size", type=int, default=8)
+    parser.add_argument("--learning_rate", type=float, default=3e-5)
+    parser.add_argument("--num_train_epochs", type=float, default=2.0)
+    parser.add_argument("--max_steps", type=int, default=-1)
+    parser.add_argument("--warmup_proportion", type=float, default=0.1)
+    parser.add_argument("--n_best_size", type=int, default=20)
+    parser.add_argument("--max_answer_length", type=int, default=30)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
+    parser.add_argument("--do_lower_case", action="store_true")
+    parser.add_argument("--version_2_with_negative", action="store_true")
+    parser.add_argument("--null_score_diff_threshold", type=float, default=0.0)
+    parser.add_argument("--vocab_file", type=str, default=None)
+    parser.add_argument("--tokenizer", type=str, default=None,
+                        choices=["wordpiece", "bpe"])
+    parser.add_argument("--optimizer", type=str, default="adamw",
+                        choices=["adamw", "bert_adam"],
+                        help="adamw+linear-warmup = the reference fp16 path; "
+                             "bert_adam = its fp32 path")
+    parser.add_argument("--max_grad_norm", type=float, default=1.0)
+    parser.add_argument("--dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--log_freq", type=int, default=50)
+    parser.add_argument("--json_summary", type=str, default="squad_log.json")
+    parser.add_argument("--eval_script", type=str, default=None)
+    parser.add_argument("--skip_checkpoint", action="store_true")
+    parser.add_argument("--skip_cache", action="store_true")
+    parser.add_argument("--cache_dir", type=str, default=None)
+    parser.add_argument("--mesh_data", type=int, default=-1,
+                        help="data-parallel mesh size; -1 = all local devices "
+                             "(batch sizes must divide it)")
+    args = parser.parse_args(argv)
+
+    # vocab/tokenizer ride in the model config (reference run_squad.py:862-876)
+    with open(args.config_file) as f:
+        configs = json.load(f)
+    if args.vocab_file is None:
+        args.vocab_file = configs.get("vocab_file")
+        if args.vocab_file is None:
+            raise ValueError("vocab_file must be in the model config or CLI")
+    if args.tokenizer is None:
+        args.tokenizer = configs.get("tokenizer")
+        if args.tokenizer is None:
+            raise ValueError("tokenizer must be in the model config or CLI")
+    if not args.do_train and not args.do_predict:
+        raise ValueError("At least one of do_train or do_predict required")
+    if args.do_train and not args.train_file:
+        raise ValueError("do_train requires train_file")
+    if args.do_predict and not args.predict_file:
+        raise ValueError("do_predict requires predict_file")
+    return args
+
+
+def build_tokenizer(args):
+    if args.tokenizer == "wordpiece":
+        return get_wordpiece_tokenizer(args.vocab_file,
+                                       uppercase=not args.do_lower_case)
+    return get_bpe_tokenizer(args.vocab_file, uppercase=not args.do_lower_case)
+
+
+def cached_features(args, examples, tokenizer, is_training, tag):
+    """Pickle-cached featurization (reference run_squad.py:1027-1043)."""
+    src = args.train_file if is_training else args.predict_file
+    cache_dir = args.cache_dir or os.path.dirname(os.path.abspath(src))
+    cache_file = os.path.join(
+        cache_dir,
+        f"{os.path.basename(src)}_{args.tokenizer}_{args.max_seq_length}_"
+        f"{args.doc_stride}_{args.max_query_length}_{tag}.feat")
+    if os.path.exists(cache_file) and not args.skip_cache:
+        with open(cache_file, "rb") as f:
+            return pickle.load(f)
+    features = squad.convert_examples_to_features(
+        examples, tokenizer, args.max_seq_length, args.doc_stride,
+        args.max_query_length, is_training)
+    if not args.skip_cache and is_main_process():
+        try:
+            with open(cache_file, "wb") as f:
+                pickle.dump(features, f)
+        except OSError:
+            pass
+    return features
+
+
+def load_init_params(args, abstract_params):
+    """Start from a pretraining checkpoint: copy the shared 'bert' encoder
+    subtree; the QA head keeps its fresh init (the strict=False analog of
+    reference run_squad.py:957-961)."""
+    state = ckpt.load_checkpoint(args.init_checkpoint)
+    source = state.get("model", state)
+    target = jax.device_get(abstract_params)
+    if "bert" in source:
+        target["bert"] = ckpt.restore_tree(target["bert"], source["bert"])
+    else:
+        target = ckpt.restore_tree(target, source)
+    return target
+
+
+def features_to_arrays(features, is_training):
+    arrays = {
+        "input_ids": np.asarray([f.input_ids for f in features], np.int32),
+        "segment_ids": np.asarray([f.segment_ids for f in features], np.int32),
+        "input_mask": np.asarray([f.input_mask for f in features], np.int32),
+    }
+    if is_training:
+        arrays["start_positions"] = np.asarray(
+            [f.start_position for f in features], np.int32)
+        arrays["end_positions"] = np.asarray(
+            [f.end_position for f in features], np.int32)
+    return arrays
+
+
+def main(args):
+    np.random.seed(args.seed)
+    devices = None
+    if args.mesh_data > 0:
+        devices = jax.devices()[: args.mesh_data]
+    mesh = create_mesh(MeshConfig(data=-1), devices=devices)
+    os.makedirs(args.output_dir, exist_ok=True)
+    logger.init(handlers=[
+        logger.StreamHandler(verbose=is_main_process()),
+        logger.FileHandler(os.path.join(args.output_dir, args.json_summary),
+                           verbose=is_main_process()),
+    ])
+
+    config = BertConfig.from_json_file(args.config_file)
+    if config.vocab_size % 8 != 0:
+        config.vocab_size += 8 - (config.vocab_size % 8)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = BertForQuestionAnswering(config, dtype=dtype)
+    tokenizer = build_tokenizer(args)
+    rules = logical_axis_rules("dp")
+
+    seq = args.max_seq_length
+    sample = (jnp.zeros((1, seq), jnp.int32),) * 3
+    summary = {}
+
+    with mesh:
+        shardings_abstract = jax.eval_shape(
+            lambda r: model.init(r, *sample), jax.random.PRNGKey(0))
+        import flax.linen as nn
+        from bert_pytorch_tpu.parallel.sharding import params_shardings
+
+        p_shardings = params_shardings(mesh, shardings_abstract, rules)["params"]
+        init_params = nn.unbox(
+            jax.jit(lambda r: model.init(r, *sample),
+                    out_shardings={"params": p_shardings})(
+                jax.random.PRNGKey(args.seed)))["params"]
+        if args.init_checkpoint:
+            host_params = load_init_params(args, init_params)
+            init_params = jax.device_put(host_params, p_shardings)
+        params = init_params
+
+        batch_sh = pretrain.batch_shardings(
+            mesh, {"input_ids": 2, "segment_ids": 2, "input_mask": 2,
+                   "start_positions": 1, "end_positions": 1})
+        # [B,...] (no accumulation axis): batch axis 0 over data mesh axes
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        batch_sh = {k: NamedSharding(mesh, P(("data", "fsdp")))
+                    for k in batch_sh}
+
+        if args.do_train:
+            train_examples = squad.read_squad_examples(
+                args.train_file, True, args.version_2_with_negative)
+            train_features = cached_features(
+                args, train_examples, tokenizer, True, "train")
+            n = len(train_features)
+            micro_bs = args.train_batch_size // args.gradient_accumulation_steps
+            steps_per_epoch = n // args.train_batch_size
+            total_steps = (args.max_steps if args.max_steps > 0 else
+                           int(steps_per_epoch * args.num_train_epochs))
+            logger.info(f"training features: {n}, optimizer steps: {total_steps}")
+
+            mask = optim.no_decay_mask
+            if args.optimizer == "adamw":
+                schedule = optim.warmup_linear_schedule(
+                    args.learning_rate, args.warmup_proportion, total_steps,
+                    offset=0)
+                tx = optim.adamw(schedule, bias_correction=False,
+                                 weight_decay_mask=mask)
+            else:
+                tx = optim.bert_adam(
+                    args.learning_rate, schedule="warmup_linear",
+                    warmup=args.warmup_proportion, t_total=total_steps,
+                    weight_decay_mask=mask)
+            opt_state = tx.init(params)
+
+            def train_step(params, opt_state, batch, rng):
+                def loss_fn(p):
+                    start_logits, end_logits = model.apply(
+                        {"params": p}, batch["input_ids"],
+                        batch["segment_ids"], batch["input_mask"],
+                        False, rngs={"dropout": rng})
+                    return span_loss(start_logits, end_logits,
+                                     batch["start_positions"],
+                                     batch["end_positions"])
+                loss, grads = jax.value_and_grad(loss_fn)(params)
+                if args.optimizer == "adamw" and args.max_grad_norm > 0:
+                    gnorm = global_norm(grads)
+                    scale = jnp.minimum(1.0, args.max_grad_norm / (gnorm + 1e-6))
+                    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                updates, opt_state2 = tx.update(grads, opt_state, params)
+                import optax
+                return optax.apply_updates(params, updates), opt_state2, loss
+
+            train_step = jax.jit(train_step, donate_argnums=(0, 1))
+
+            rng = jax.random.PRNGKey(args.seed)
+            order = np.random.permutation(n)
+            global_step = 0
+            t_start = time.perf_counter()
+            seqs = 0
+            epoch = 0
+            losses = []
+            while global_step < total_steps:
+                for i in range(0, n - args.train_batch_size + 1,
+                               args.train_batch_size):
+                    idx = order[i:i + args.train_batch_size]
+                    feats = [train_features[j] for j in idx]
+                    arrays = features_to_arrays(feats, True)
+                    batch = {k: jax.device_put(v, batch_sh[k])
+                             for k, v in arrays.items()}
+                    rng, sub = jax.random.split(rng)
+                    params, opt_state, loss = train_step(
+                        params, opt_state, batch, sub)
+                    global_step += 1
+                    seqs += args.train_batch_size
+                    if global_step % args.log_freq == 0:
+                        losses.append(float(loss))
+                        logger.log(tag="train", step=global_step,
+                                   step_loss=float(loss),
+                                   samples_per_second=seqs / (
+                                       time.perf_counter() - t_start))
+                    if global_step >= total_steps:
+                        break
+                epoch += 1
+                order = np.random.permutation(n)
+            train_time = time.perf_counter() - t_start
+            summary["e2e_train_time"] = train_time
+            summary["training_sequences_per_second"] = seqs / train_time
+            summary["final_loss"] = float(loss)
+
+            if not args.skip_checkpoint and is_main_process():
+                ckpt.save_checkpoint(args.output_dir, global_step,
+                                     {"model": params,
+                                      "config": config.to_dict()}, keep=1)
+
+        if args.do_predict:
+            eval_examples = squad.read_squad_examples(
+                args.predict_file, False, args.version_2_with_negative)
+            eval_features = cached_features(
+                args, eval_examples, tokenizer, False, "predict")
+            logger.info(f"predict features: {len(eval_features)}")
+
+            @jax.jit
+            def predict_step(params, batch):
+                return model.apply({"params": params}, batch["input_ids"],
+                                   batch["segment_ids"], batch["input_mask"])
+
+            t_infer = time.perf_counter()
+            results = []
+            bs = args.predict_batch_size
+            # pad to full batches for static shapes
+            padded = list(eval_features)
+            while len(padded) % bs != 0:
+                padded.append(eval_features[-1])
+            for i in range(0, len(padded), bs):
+                feats = padded[i:i + bs]
+                arrays = features_to_arrays(feats, False)
+                batch = {k: jax.device_put(v, batch_sh[k])
+                         for k, v in arrays.items()}
+                start_logits, end_logits = predict_step(params, batch)
+                start_logits = np.asarray(start_logits, np.float32)
+                end_logits = np.asarray(end_logits, np.float32)
+                for j, f in enumerate(feats):
+                    if i + j < len(eval_features):
+                        results.append(squad.RawResult(
+                            unique_id=f.unique_id,
+                            start_logits=start_logits[j].tolist(),
+                            end_logits=end_logits[j].tolist()))
+            summary["e2e_inference_time"] = time.perf_counter() - t_infer
+
+            answers, nbest = squad.get_answers(
+                eval_examples, eval_features, results, args)
+            output_prediction_file = os.path.join(
+                args.output_dir, "predictions.json")
+            with open(output_prediction_file, "w") as f:
+                f.write(json.dumps(answers, indent=4) + "\n")
+            with open(os.path.join(args.output_dir,
+                                   "nbest_predictions.json"), "w") as f:
+                f.write(json.dumps(nbest, indent=4) + "\n")
+
+            if args.do_eval and args.eval_script:
+                # Official-oracle evaluation (reference run_squad.py:1197-1204)
+                proc = subprocess.run(
+                    [sys.executable, args.eval_script, args.predict_file,
+                     output_prediction_file],
+                    capture_output=True, text=True, check=True)
+                scores = json.loads(proc.stdout)
+                summary["exact_match"] = scores.get("exact_match")
+                summary["F1"] = scores.get("f1")
+
+    logger.log(tag="summary", step=0, **{
+        k: v for k, v in summary.items() if isinstance(v, (int, float))})
+    logger.info(f"summary: {summary}")
+    logger.close()
+    return summary
+
+
+if __name__ == "__main__":
+    main(parse_args())

@@ -1,0 +1,252 @@
+"""SQuAD + NER finetuning tests: featurization, decoding, tiny e2e runs."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+VOCAB_TOKENS = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + ["the", "capital", "of", "france", "is", "paris", "what", "who",
+       "wrote", "hamlet", "shakespeare", "william", "city", "big", "a",
+       "in", "was", "by", "play", "##s", "##ing", "london", "england"]
+)
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("vocab")
+    path = d / "vocab.txt"
+    path.write_text("\n".join(VOCAB_TOKENS) + "\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def tokenizer(vocab_file):
+    from bert_pytorch_tpu.data.tokenization import BertTokenizer
+
+    return BertTokenizer(vocab_file, do_lower_case=True)
+
+
+@pytest.fixture(scope="module")
+def squad_json(tmp_path_factory):
+    d = tmp_path_factory.mktemp("squad")
+    context = "The capital of France is Paris"
+    data = {
+        "version": "1.1",
+        "data": [{
+            "title": "t",
+            "paragraphs": [{
+                "context": context,
+                "qas": [
+                    {"id": "q1",
+                     "question": "What is the capital of France",
+                     "answers": [{"text": "Paris",
+                                  "answer_start": context.index("Paris")}]},
+                    {"id": "q2",
+                     "question": "The capital of France is what city",
+                     "answers": [{"text": "Paris",
+                                  "answer_start": context.index("Paris")}]},
+                ],
+            }],
+        }],
+    }
+    path = d / "train.json"
+    path.write_text(json.dumps(data))
+    return str(path)
+
+
+def test_read_squad_examples(squad_json):
+    from bert_pytorch_tpu import squad
+
+    examples = squad.read_squad_examples(squad_json, True, False)
+    assert len(examples) == 2
+    ex = examples[0]
+    assert ex.doc_tokens == ["The", "capital", "of", "France", "is", "Paris"]
+    assert ex.start_position == 5 and ex.end_position == 5
+
+
+def test_convert_examples_to_features(squad_json, tokenizer):
+    from bert_pytorch_tpu import squad
+
+    examples = squad.read_squad_examples(squad_json, True, False)
+    features = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=32, doc_stride=8,
+        max_query_length=16, is_training=True)
+    f = features[0]
+    assert len(f.input_ids) == 32
+    assert f.tokens[0] == "[CLS]" and "[SEP]" in f.tokens
+    # answer position points at 'paris' inside the doc segment
+    assert f.tokens[f.start_position] == "paris"
+    assert f.segment_ids[f.start_position] == 1
+    assert f.input_mask[: len(f.tokens)] == [1] * len(f.tokens)
+
+
+def test_sliding_window_and_max_context(tokenizer):
+    from bert_pytorch_tpu import squad
+
+    # long synthetic doc forces multiple windows
+    doc = " ".join(["the", "big", "city"] * 20)
+    context = doc
+    data = {"data": [{"paragraphs": [{
+        "context": context,
+        "qas": [{"id": "q", "question": "what city",
+                 "answers": [{"text": "city", "answer_start": context.index("city")}]}],
+    }]}]}
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump(data, f)
+        path = f.name
+    examples = squad.read_squad_examples(path, True, False)
+    features = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=24, doc_stride=8,
+        max_query_length=8, is_training=True)
+    os.unlink(path)
+    assert len(features) > 1  # window slid
+    # every doc token position is max-context in exactly one window
+    for pos_key in features[0].token_is_max_context:
+        flags = [f.token_is_max_context.get(pos_key, False) for f in features]
+    # at least first window has some max-context tokens
+    assert any(features[0].token_is_max_context.values())
+
+
+def test_get_final_text_realignment():
+    from bert_pytorch_tpu.squad import get_final_text
+
+    # normalized prediction -> original casing/punctuation restored
+    assert get_final_text("steve smith", "Steve Smith's", True) == "Steve Smith"
+    # failure falls back to orig_text
+    assert get_final_text("zzz", "Steve Smith's", True) == "Steve Smith's"
+
+
+def test_get_answers_decodes_correct_span(squad_json, tokenizer):
+    from bert_pytorch_tpu import squad
+
+    examples = squad.read_squad_examples(squad_json, False, False)
+    features = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=32, doc_stride=8,
+        max_query_length=16, is_training=False)
+
+    class Args:
+        n_best_size = 5
+        max_answer_length = 10
+        version_2_with_negative = False
+        null_score_diff_threshold = 0.0
+        do_lower_case = True
+
+    results = []
+    for f in features:
+        start = np.full(32, -5.0)
+        end = np.full(32, -5.0)
+        # boost the position of 'paris' in the doc segment
+        paris_pos = f.tokens.index("paris", f.tokens.index("[SEP]"))
+        start[paris_pos] = 5.0
+        end[paris_pos] = 5.0
+        results.append(squad.RawResult(f.unique_id, start.tolist(), end.tolist()))
+    answers, nbest = squad.get_answers(examples, features, results, Args())
+    assert answers["q1"] == "Paris"
+    assert answers["q2"] == "Paris"
+    assert nbest["q1"][0]["probability"] > 0.3
+
+
+def test_squad_end_to_end_tiny(tmp_path, squad_json, vocab_file):
+    import run_squad
+
+    model_config = {
+        "vocab_size": len(VOCAB_TOKENS), "hidden_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 64, "max_position_embeddings": 64,
+        "type_vocab_size": 2, "next_sentence": True,
+        "vocab_file": vocab_file, "tokenizer": "wordpiece",
+        "lowercase": True,
+    }
+    config_path = tmp_path / "model.json"
+    config_path.write_text(json.dumps(model_config))
+    args = run_squad.parse_args([
+        "--output_dir", str(tmp_path / "out"),
+        "--config_file", str(config_path),
+        "--train_file", squad_json,
+        "--predict_file", squad_json,
+        "--do_train", "--do_predict", "--do_lower_case",
+        "--train_batch_size", "2", "--predict_batch_size", "2",
+        "--max_steps", "2", "--max_seq_length", "32",
+        "--doc_stride", "8", "--max_query_length", "16",
+        "--dtype", "float32", "--skip_cache", "--mesh_data", "2",
+    ])
+    summary = run_squad.main(args)
+    assert np.isfinite(summary["final_loss"])
+    assert summary["training_sequences_per_second"] > 0
+    pred_file = tmp_path / "out" / "predictions.json"
+    assert pred_file.exists()
+    answers = json.loads(pred_file.read_text())
+    assert set(answers.keys()) == {"q1", "q2"}
+
+
+@pytest.fixture(scope="module")
+def conll_file(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ner")
+    lines = []
+    for _ in range(8):
+        lines += [
+            "-DOCSTART- X X O", "",
+            "paris X X B-LOC", "is X X O", "big X X O", "",
+            "william X X B-PER", "shakespeare X X I-PER",
+            "wrote X X O", "hamlet X X O", "",
+        ]
+    path = d / "train.txt"
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_ner_dataset_parsing_and_encoding(conll_file, tokenizer):
+    from bert_pytorch_tpu.data.ner_dataset import NERDataset
+
+    labels = ["O", "B-PER", "I-PER", "B-LOC", "I-LOC"]
+    ds = NERDataset(conll_file, tokenizer, labels, max_seq_len=16)
+    assert len(ds) == 16  # 2 sentences x 8 repeats
+    seq, lab, mask = ds[0]
+    assert seq.shape == (16,)
+    assert lab[0] == -100  # [CLS]
+    # 'paris' gets B-LOC id (4 in 1-based ordering)
+    assert lab[1] == labels.index("B-LOC") + 1
+    assert mask.sum() == 5  # [CLS] paris is big [SEP]
+
+
+def test_ner_end_to_end_tiny(tmp_path, conll_file, vocab_file):
+    import run_ner
+
+    model_config = {
+        "vocab_size": len(VOCAB_TOKENS), "hidden_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 64, "max_position_embeddings": 32,
+        "type_vocab_size": 2, "next_sentence": True,
+        "vocab_file": vocab_file, "tokenizer": "wordpiece",
+    }
+    config_path = tmp_path / "model.json"
+    config_path.write_text(json.dumps(model_config))
+    args = run_ner.parse_arguments([
+        "--train_file", conll_file,
+        "--val_file", conll_file,
+        "--test_file", conll_file,
+        "--labels", "O", "B-PER", "I-PER", "B-LOC", "I-LOC",
+        "--model_config_file", str(config_path),
+        "--epochs", "2", "--batch_size", "8", "--max_seq_len", "16",
+        "--lr", "1e-3", "--dtype", "float32",
+    ])
+    results = run_ner.main(args)
+    assert 0.0 <= results["val_f1"] <= 1.0
+    assert "test_f1" in results
+
+
+def test_macro_f1_perfect_and_zero():
+    from run_ner import macro_f1
+
+    logits = np.zeros((1, 4, 3))
+    labels = np.asarray([[1, 2, 1, -100]])
+    logits[0, 0, 1] = 5; logits[0, 1, 2] = 5; logits[0, 2, 1] = 5
+    assert macro_f1(logits, labels) == 1.0
+    logits2 = np.zeros((1, 4, 3))
+    logits2[0, :, 0] = 5  # predict reserved class everywhere
+    assert macro_f1(logits2, labels) == 0.0
