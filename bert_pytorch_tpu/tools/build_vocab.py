@@ -1,0 +1,65 @@
+"""Train a WordPiece or byte-level-BPE vocabulary from formatted text.
+
+Parity with reference utils/build_vocab.py: trains on the corpus with the
+standard special tokens, then reorders so the specials sit at the front with
+[PAD] at index 0 (:53-75). The WordPiece path uses the in-repo C++ trainer
+(native/tokenizer.cpp) instead of the Rust `tokenizers` trainer; the BPE
+path uses the `tokenizers` package when available.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+
+SPECIAL_TOKENS = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+
+
+def build_wordpiece_vocab(input_files, output_file: str, vocab_size: int,
+                          lowercase: bool = True, min_frequency: int = 2) -> str:
+    from bert_pytorch_tpu.tools.tokenizer_cpp import train_wordpiece_vocab
+
+    return train_wordpiece_vocab(
+        list(input_files), vocab_size, output_file,
+        special_tokens=tuple(SPECIAL_TOKENS),
+        min_frequency=min_frequency, lowercase=lowercase)
+
+
+def build_bpe_vocab(input_files, output_dir: str, vocab_size: int,
+                    lowercase: bool = True, min_frequency: int = 2) -> str:
+    from tokenizers import ByteLevelBPETokenizer
+
+    tok = ByteLevelBPETokenizer(lowercase=lowercase)
+    tok.train(files=list(input_files), vocab_size=vocab_size,
+              min_frequency=min_frequency, special_tokens=SPECIAL_TOKENS)
+    os.makedirs(output_dir, exist_ok=True)
+    tok.save_model(output_dir)
+    return os.path.join(output_dir, "vocab.json")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input_glob", type=str, required=True)
+    parser.add_argument("--output", type=str, required=True,
+                        help="vocab .txt path (wordpiece) or directory (bpe)")
+    parser.add_argument("--tokenizer", choices=["wordpiece", "bpe"],
+                        default="wordpiece")
+    parser.add_argument("--vocab_size", type=int, default=30522)
+    parser.add_argument("--min_frequency", type=int, default=2)
+    parser.add_argument("--uppercase", action="store_true")
+    args = parser.parse_args(argv)
+    files = glob.glob(args.input_glob, recursive=True)
+    if not files:
+        raise ValueError(f"no files match {args.input_glob}")
+    if args.tokenizer == "wordpiece":
+        out = build_wordpiece_vocab(files, args.output, args.vocab_size,
+                                    not args.uppercase, args.min_frequency)
+    else:
+        out = build_bpe_vocab(files, args.output, args.vocab_size,
+                              not args.uppercase, args.min_frequency)
+    print(f"[vocab] wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,260 @@
+"""Encode formatted text into pretraining HDF5 shards.
+
+Parity with reference utils/encode_data.py: documents delimited by blank
+lines (:48-62), chunk sentences up to a (possibly short_seq_prob-reduced)
+target length (:65-167), optional NSP pair construction with a random next
+segment drawn from another document and index rewind (:112-130), in-file
+shuffle (:179), and gzip HDF5 output with ``input_ids`` i4,
+``special_token_positions`` i4 and ``next_sentence_labels`` i1 (:204-210).
+
+Sample layout (consumed by data/dataset.py):
+  NSP:    [CLS] seq [SEP] next_seq [SEP] pad   specials = [0, p1, p2]
+  no NSP: [CLS] seq [SEP] pad                  specials = [0, p1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import multiprocessing as mp
+import os
+import random
+import time
+from pathlib import Path
+from typing import List, Optional
+
+import h5py
+import numpy as np
+
+
+@dataclasses.dataclass
+class TrainingSample:
+    """[CLS]/[SEP]-wrapped token sequence with special-token bookkeeping
+    (reference utils/encode_data.py:12-35)."""
+
+    seq_tokens: List[str]
+    next_seq_tokens: Optional[List[str]] = None
+    is_random_next: bool = False
+
+    def __post_init__(self):
+        self.sequence = ["[CLS]"] + list(self.seq_tokens)
+        self.special_token_positions = [0]
+        if self.next_seq_tokens is not None:
+            self.special_token_positions.append(len(self.sequence))
+            self.sequence.append("[SEP]")
+            self.sequence.extend(self.next_seq_tokens)
+        self.special_token_positions.append(len(self.sequence))
+        self.sequence.append("[SEP]")
+
+
+def documents_from_file(input_file: str, tokenizer) -> List[List[List[str]]]:
+    """Blank-line-delimited documents -> lists of tokenized sentences
+    (reference :48-62)."""
+    documents: List[List[List[str]]] = [[]]
+    with open(input_file, "r", encoding="utf-8", errors="ignore") as reader:
+        for line in reader:
+            line = line.strip()
+            if not line:
+                documents.append([])
+                continue
+            tokens = tokenizer.encode(line, add_special_tokens=False).tokens
+            if tokens:
+                documents[-1].append(tokens)
+    return [d for d in documents if d]
+
+
+def _target_length(max_num_tokens: int, short_seq_prob: float, rng) -> int:
+    if rng.random() < short_seq_prob:
+        return rng.randint(2, max_num_tokens)
+    return max_num_tokens
+
+
+def create_samples_from_document(
+    document_idx: int,
+    documents: List[List[List[str]]],
+    max_seq_len: int,
+    next_seq_prob: float,
+    short_seq_prob: float,
+    rng=random,
+) -> List[TrainingSample]:
+    """Chunk one document into samples (reference :65-167)."""
+    nsp = next_seq_prob > 0
+    max_num_tokens = max_seq_len - (3 if nsp else 2)
+    target_len = _target_length(max_num_tokens, short_seq_prob, rng)
+
+    document = documents[document_idx]
+    samples: List[TrainingSample] = []
+    chunk: List[List[str]] = []
+    chunk_length = 0
+    i = 0
+    while i < len(document):
+        current = document[i][:target_len]
+        boundary = len(chunk) >= 1 and (
+            i + 1 == len(document) or chunk_length + len(current) >= target_len
+        )
+        if boundary:
+            if nsp:
+                if len(documents) <= 1:
+                    raise ValueError(
+                        "File only contained one document; unable to draw a "
+                        "random next sequence."
+                    )
+                seq_end = rng.randint(1, len(chunk) - 1) if len(chunk) >= 2 else 1
+                seq_tokens = [t for seg in chunk[:seq_end] for t in seg]
+                if rng.random() < next_seq_prob:
+                    # Random next: fill from a random position in another
+                    # document, and rewind i to reuse the displaced segments.
+                    is_random_next = True
+                    rand_idx = rng.randint(0, len(documents) - 1)
+                    while rand_idx == document_idx:
+                        rand_idx = rng.randint(0, len(documents) - 1)
+                    rand_doc = documents[rand_idx]
+                    rand_start = rng.randint(0, len(rand_doc) - 1)
+                    budget = target_len - len(seq_tokens)
+                    next_seq_tokens: List[str] = []
+                    for j in range(rand_start, len(rand_doc)):
+                        next_seq_tokens.extend(rand_doc[j])
+                        if len(next_seq_tokens) >= budget:
+                            next_seq_tokens = next_seq_tokens[:budget]
+                            break
+                    i -= len(chunk) - seq_end
+                else:
+                    is_random_next = False
+                    next_seq_tokens = [
+                        t for seg in chunk[seq_end:] for t in seg
+                    ]
+                samples.append(
+                    TrainingSample(seq_tokens, next_seq_tokens, is_random_next)
+                )
+            else:
+                seq_tokens = [t for seg in chunk for t in seg]
+                samples.append(TrainingSample(seq_tokens))
+            target_len = _target_length(max_num_tokens, short_seq_prob, rng)
+            chunk = []
+            chunk_length = 0
+
+        current = document[i][:target_len]
+        chunk.append(current)
+        chunk_length += len(current)
+        i += 1
+    return samples
+
+
+def create_samples(
+    input_file: str, tokenizer, max_seq_len: int, next_seq_prob: float,
+    short_seq_prob: float, rng=random,
+) -> List[TrainingSample]:
+    documents = documents_from_file(input_file, tokenizer)
+    samples: List[TrainingSample] = []
+    for i in range(len(documents)):
+        samples.extend(
+            create_samples_from_document(
+                i, documents, max_seq_len, next_seq_prob, short_seq_prob, rng
+            )
+        )
+    rng.shuffle(samples)
+    return samples
+
+
+def write_samples_to_hdf5(output_file, samples, tokenizer, max_seq_len) -> int:
+    """Gzip HDF5 in the runtime dataset's format (reference :183-210);
+    special_token_positions is a ragged (vlen) i4 dataset since samples mix
+    2- and 3-entry position lists."""
+    n = len(samples)
+    input_ids = np.zeros((n, max_seq_len), np.int32)
+    next_labels = np.zeros((n,), np.int8)
+    specials = []
+    for row, sample in enumerate(samples):
+        ids = [tokenizer.token_to_id(t) for t in sample.sequence]
+        assert None not in ids, "token missing from vocab"
+        assert len(ids) <= max_seq_len
+        input_ids[row, : len(ids)] = ids
+        specials.append(np.asarray(sample.special_token_positions, np.int32))
+        next_labels[row] = 1 if sample.is_random_next else 0
+
+    with h5py.File(output_file, "w") as f:
+        f.create_dataset("input_ids", data=input_ids, dtype="i4",
+                         compression="gzip")
+        dt = h5py.vlen_dtype(np.dtype("i4"))
+        ds = f.create_dataset("special_token_positions", (n,), dtype=dt,
+                              compression="gzip")
+        for row, sp in enumerate(specials):
+            ds[row] = sp
+        f.create_dataset("next_sentence_labels", data=next_labels, dtype="i1",
+                         compression="gzip")
+    return n
+
+
+def _make_tokenizer(args):
+    from bert_pytorch_tpu.data.tokenization import (
+        get_bpe_tokenizer, get_wordpiece_tokenizer)
+
+    if args.tokenizer == "wordpiece":
+        return get_wordpiece_tokenizer(args.vocab_file,
+                                       uppercase=args.uppercase)
+    return get_bpe_tokenizer(args.vocab_file, uppercase=args.uppercase)
+
+
+def encode_file(args, input_file: str, output_file: str) -> None:
+    print(f"[encoder] Creating instances from {input_file}")
+    start = time.time()
+    tokenizer = _make_tokenizer(args)
+    samples = create_samples(
+        input_file, tokenizer, args.max_seq_len, args.next_seq_prob,
+        args.short_seq_prob)
+    n = write_samples_to_hdf5(output_file, samples, tokenizer,
+                              args.max_seq_len)
+    print(f"[encoder] Encoded {output_file} ({n} samples, "
+          f"time={time.time() - start:.0f}s)")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--input_dir", type=str, required=True)
+    parser.add_argument("--output_dir", type=str, required=True)
+    parser.add_argument("--vocab_file", type=str, required=True)
+    parser.add_argument("--max_seq_len", type=int, default=512)
+    parser.add_argument("--short_seq_prob", type=float, default=0.1)
+    parser.add_argument("--next_seq_prob", type=float, default=0.0,
+                        help="probability of a random next segment; 0 "
+                             "disables the NSP task entirely")
+    parser.add_argument("--uppercase", action="store_true")
+    parser.add_argument("--tokenizer", type=str, default="wordpiece",
+                        choices=["wordpiece", "bpe"])
+    parser.add_argument("--processes", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    input_files = []
+    if os.path.isfile(args.input_dir):
+        input_files.append(args.input_dir)
+    elif os.path.isdir(args.input_dir):
+        input_files = sorted(
+            str(p) for p in Path(args.input_dir).rglob("*.txt") if p.is_file())
+    else:
+        raise ValueError(f"{args.input_dir} is not a valid path")
+    print(f"[encoder] Found {len(input_files)} input files")
+
+    prefix = (
+        f"sequences_{'uppercase' if args.uppercase else 'lowercase'}"
+        f"_max_seq_len_{args.max_seq_len}"
+        f"_next_seq_task_{str(args.next_seq_prob > 0).lower()}"
+    )
+    out_dir = os.path.join(args.output_dir, prefix)
+    os.makedirs(out_dir, exist_ok=True)
+
+    jobs = [
+        (args, ifile, os.path.join(out_dir, f"train_{i}.hdf5"))
+        for i, ifile in enumerate(input_files)
+    ]
+    start = time.time()
+    if args.processes <= 1 or len(jobs) <= 1:
+        for job in jobs:
+            encode_file(*job)
+    else:
+        with mp.Pool(processes=args.processes) as pool:
+            pool.starmap(encode_file, jobs)
+    print(f"[encoder] Finished processing (time={time.time() - start:.0f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""ctypes bindings for the C++ tokenizer core (native/tokenizer.cpp).
+
+The in-repo native replacement for the HuggingFace Rust `tokenizers`
+dependency (SURVEY.md §2.3). API shape mirrors the fast-tokenizer surface
+the reference code uses: ``encode(text).ids/.tokens``, ``token_to_id``,
+``id_to_token`` (reference src/tokenization.py:42-49,
+src/dataset.py mask-token lookup, run_squad.py:292).
+
+The library is built on demand with ``make -C native`` (g++ only, no
+external deps); when neither the prebuilt .so nor a compiler is available,
+callers fall back to the HF tokenizers package or the pure-Python
+implementation (bert_pytorch_tpu/data/tokenization.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbert_tokenizer.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-s"],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.wp_create.restype = ctypes.c_void_p
+        lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.wp_free.argtypes = [ctypes.c_void_p]
+        lib.wp_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.wp_vocab_size.restype = ctypes.c_int
+        lib.wp_token_to_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.wp_token_to_id.restype = ctypes.c_int
+        lib.wp_id_to_token.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.wp_id_to_token.restype = ctypes.c_char_p
+        lib.wp_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.wp_encode.restype = ctypes.c_int
+        lib.wp_get_ids.argtypes = [ctypes.c_void_p]
+        lib.wp_get_ids.restype = ctypes.POINTER(ctypes.c_int)
+        lib.wp_get_tokens.argtypes = [ctypes.c_void_p]
+        lib.wp_get_tokens.restype = ctypes.c_char_p
+        lib.wp_train.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.wp_train.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+@dataclass
+class Encoding:
+    ids: List[int]
+    tokens: List[str]
+
+
+class CppWordPieceTokenizer:
+    """BERT WordPiece tokenizer backed by the C++ core."""
+
+    def __init__(self, vocab_file: str, lowercase: bool = True):
+        self._lib = _load_library()
+        self._handle = self._lib.wp_create(
+            vocab_file.encode("utf-8"), 1 if lowercase else 0
+        )
+        if not self._handle:
+            raise OSError(f"could not load vocab from {vocab_file}")
+        self.lowercase = lowercase
+        # Encoding is stateful per handle; serialize access.
+        self._encode_lock = threading.Lock()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.wp_free(handle)
+            self._handle = None
+
+    def get_vocab_size(self) -> int:
+        return self._lib.wp_vocab_size(self._handle)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        tid = self._lib.wp_token_to_id(self._handle, token.encode("utf-8"))
+        return None if tid < 0 else tid
+
+    def id_to_token(self, token_id: int) -> str:
+        return self._lib.wp_id_to_token(self._handle, token_id).decode("utf-8")
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> Encoding:
+        with self._encode_lock:
+            n = self._lib.wp_encode(self._handle, text.encode("utf-8"))
+            ids = list(self._lib.wp_get_ids(self._handle)[:n])
+            raw = self._lib.wp_get_tokens(self._handle).decode("utf-8")
+        tokens = raw.split("\n") if raw else []
+        if add_special_tokens:
+            cls_id, sep_id = self.token_to_id("[CLS]"), self.token_to_id("[SEP]")
+            ids = [cls_id] + ids + [sep_id]
+            tokens = ["[CLS]"] + tokens + ["[SEP]"]
+        return Encoding(ids=ids, tokens=tokens)
+
+    def encode_batch(self, texts: List[str]) -> List[Encoding]:
+        return [self.encode(t) for t in texts]
+
+
+def train_wordpiece_vocab(
+    files: List[str],
+    vocab_size: int,
+    out_path: str,
+    special_tokens=("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"),
+    min_frequency: int = 2,
+    lowercase: bool = True,
+) -> str:
+    """Train a WordPiece vocab (reference utils/build_vocab.py:39-75 role:
+    specials forced to the front, [PAD] at index 0)."""
+    lib = _load_library()
+    rc = lib.wp_train(
+        "\n".join(files).encode("utf-8"),
+        "\n".join(special_tokens).encode("utf-8"),
+        vocab_size,
+        min_frequency,
+        1 if lowercase else 0,
+        out_path.encode("utf-8"),
+    )
+    if rc != 0:
+        raise RuntimeError(f"wp_train failed with code {rc}")
+    return out_path

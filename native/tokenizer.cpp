@@ -1,0 +1,416 @@
+// bert_pytorch_tpu native tokenizer core.
+//
+// C++ replacement for the HuggingFace Rust `tokenizers` dependency the
+// reference drives for its entire offline pipeline and runtime data path
+// (reference src/tokenization.py:42-57, utils/build_vocab.py:39-58,
+// utils/encode_data.py:280-293; SURVEY.md §2.3). The behavioral
+// specification is the pure-Python BasicTokenizer/WordpieceTokenizer
+// (src/tokenization.py:60-229 ≙ bert_pytorch_tpu/data/tokenization.py).
+//
+// Pipeline: UTF-8 decode -> clean (drop control/NUL/replacement chars,
+// canonicalize whitespace) -> CJK isolation -> optional lowercase +
+// accent strip (precomputed Latin fold table; full NFD is out of scope,
+// the fold table covers Latin-1 Supplement + Latin Extended-A which is
+// what BERT's English corpora contain) -> punctuation split -> greedy
+// longest-match WordPiece against a prefix-keyed hash vocab.
+//
+// Exposed as a C ABI for ctypes (see tools/tokenizer_cpp.py). A WordPiece
+// vocab trainer (pair-merge algorithm over word counts) lives here too,
+// replacing BertWordPieceTokenizer.train.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// UTF-8
+// ---------------------------------------------------------------------------
+
+// Decode one UTF-8 codepoint starting at s[i]; advances i. Invalid bytes
+// decode as U+FFFD and advance by one.
+uint32_t decode_utf8(const std::string& s, size_t& i) {
+  unsigned char c = s[i];
+  if (c < 0x80) { i += 1; return c; }
+  if ((c >> 5) == 0x6 && i + 1 < s.size()) {
+    uint32_t cp = ((c & 0x1F) << 6) | (s[i + 1] & 0x3F);
+    i += 2; return cp;
+  }
+  if ((c >> 4) == 0xE && i + 2 < s.size()) {
+    uint32_t cp = ((c & 0x0F) << 12) | ((s[i + 1] & 0x3F) << 6) |
+                  (s[i + 2] & 0x3F);
+    i += 3; return cp;
+  }
+  if ((c >> 3) == 0x1E && i + 3 < s.size()) {
+    uint32_t cp = ((c & 0x07) << 18) | ((s[i + 1] & 0x3F) << 12) |
+                  ((s[i + 2] & 0x3F) << 6) | (s[i + 3] & 0x3F);
+    i += 4; return cp;
+  }
+  i += 1;
+  return 0xFFFD;
+}
+
+void encode_utf8(uint32_t cp, std::string& out) {
+  if (cp < 0x80) {
+    out.push_back(static_cast<char>(cp));
+  } else if (cp < 0x800) {
+    out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Character classes (the subset of Unicode the BERT normalizer needs)
+// ---------------------------------------------------------------------------
+
+bool is_whitespace(uint32_t cp) {
+  return cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r' ||
+         cp == 0x00A0 || cp == 0x2000 || (cp >= 0x2000 && cp <= 0x200A) ||
+         cp == 0x202F || cp == 0x205F || cp == 0x3000 || cp == 0x1680;
+}
+
+bool is_control(uint32_t cp) {
+  if (cp == '\t' || cp == '\n' || cp == '\r') return false;
+  return cp < 0x20 || cp == 0x7F || (cp >= 0x80 && cp <= 0x9F) ||
+         (cp >= 0x200B && cp <= 0x200F) ||  // zero-width + direction marks
+         (cp >= 0x202A && cp <= 0x202E);
+}
+
+bool is_ascii_punct(uint32_t cp) {
+  return (cp >= 33 && cp <= 47) || (cp >= 58 && cp <= 64) ||
+         (cp >= 91 && cp <= 96) || (cp >= 123 && cp <= 126);
+}
+
+bool is_punct(uint32_t cp) {
+  if (is_ascii_punct(cp)) return true;
+  // General Punctuation, Supplemental, CJK symbols, fullwidth forms.
+  return (cp >= 0x2010 && cp <= 0x2027) || (cp >= 0x2030 && cp <= 0x205E) ||
+         (cp >= 0x3001 && cp <= 0x303F) || (cp >= 0xFF01 && cp <= 0xFF0F) ||
+         (cp >= 0xFF1A && cp <= 0xFF20) || (cp >= 0xFF3B && cp <= 0xFF40) ||
+         (cp >= 0xFF5B && cp <= 0xFF65) || cp == 0x00A1 || cp == 0x00BF ||
+         cp == 0x00AB || cp == 0x00BB;
+}
+
+bool is_cjk(uint32_t cp) {
+  return (cp >= 0x4E00 && cp <= 0x9FFF) || (cp >= 0x3400 && cp <= 0x4DBF) ||
+         (cp >= 0x20000 && cp <= 0x2A6DF) || (cp >= 0x2A700 && cp <= 0x2B73F) ||
+         (cp >= 0x2B740 && cp <= 0x2B81F) || (cp >= 0x2B820 && cp <= 0x2CEAF) ||
+         (cp >= 0xF900 && cp <= 0xFAFF) || (cp >= 0x2F800 && cp <= 0x2FA1F);
+}
+
+// Latin fold: lowercase + accent strip for Latin-1 Supplement and Latin
+// Extended-A. Returns 0 when the character should be dropped (combining
+// marks), the folded codepoint otherwise.
+uint32_t latin_fold(uint32_t cp, bool lower) {
+  if (lower && cp >= 'A' && cp <= 'Z') return cp + 32;
+  if (cp >= 0x0300 && cp <= 0x036F) return 0;  // combining marks
+  if (!lower) return cp;
+  struct Range { uint32_t lo, hi; char base; };
+  static const Range kFolds[] = {
+      {0x00C0, 0x00C5, 'a'}, {0x00E0, 0x00E5, 'a'},
+      {0x00C8, 0x00CB, 'e'}, {0x00E8, 0x00EB, 'e'},
+      {0x00CC, 0x00CF, 'i'}, {0x00EC, 0x00EF, 'i'},
+      {0x00D2, 0x00D6, 'o'}, {0x00F2, 0x00F6, 'o'},
+      {0x00D9, 0x00DC, 'u'}, {0x00F9, 0x00FC, 'u'},
+      {0x00C7, 0x00C7, 'c'}, {0x00E7, 0x00E7, 'c'},
+      {0x00D1, 0x00D1, 'n'}, {0x00F1, 0x00F1, 'n'},
+      {0x00DD, 0x00DD, 'y'}, {0x00FD, 0x00FD, 'y'}, {0x00FF, 0x00FF, 'y'},
+  };
+  for (const auto& r : kFolds)
+    if (cp >= r.lo && cp <= r.hi) return static_cast<uint32_t>(r.base);
+  // Latin Extended-A: alternates of base letters; map pairwise blocks.
+  if (cp >= 0x0100 && cp <= 0x017F) {
+    static const char* kExtBase =
+        "aaaaaacccccccccddddeeeeeeeeeegggggggghhhhiiiiiiiiiijjkkklllllllll"
+        "lnnnnnnnnnoooooooorrrrrrsssssssttttttuuuuuuuuuuuuwwyyyzzzzzzs";
+    size_t idx = cp - 0x0100;
+    if (idx < std::strlen(kExtBase)) return static_cast<uint32_t>(kExtBase[idx]);
+  }
+  if (cp >= 0x0391 && cp <= 0x03A9) return cp + 32;  // Greek upper->lower
+  return cp;
+}
+
+// ---------------------------------------------------------------------------
+// WordPiece tokenizer
+// ---------------------------------------------------------------------------
+
+struct Tokenizer {
+  std::unordered_map<std::string, int> vocab;
+  std::vector<std::string> id_to_token;
+  bool lowercase = true;
+  int unk_id = 0;
+  size_t max_chars_per_word = 200;
+  size_t max_token_len = 0;  // longest vocab entry (bytes), bounds matching
+
+  std::vector<int> last_ids;           // result buffers for the C API
+  std::string last_tokens_joined;      // '\n'-joined token strings
+};
+
+// Normalize + split into word/punct chunks (BasicTokenizer semantics).
+std::vector<std::string> basic_tokenize(const Tokenizer& t,
+                                        const std::string& text) {
+  std::vector<std::string> out;
+  std::string current;
+  auto flush = [&]() {
+    if (!current.empty()) { out.push_back(current); current.clear(); }
+  };
+  size_t i = 0;
+  while (i < text.size()) {
+    uint32_t cp = decode_utf8(text, i);
+    if (cp == 0 || cp == 0xFFFD || is_control(cp)) continue;
+    if (is_whitespace(cp)) { flush(); continue; }
+    if (is_cjk(cp)) {  // CJK chars become standalone tokens
+      flush();
+      std::string c; encode_utf8(cp, c); out.push_back(c);
+      continue;
+    }
+    if (t.lowercase) {
+      cp = latin_fold(cp, true);
+      if (cp == 0) continue;  // stripped combining mark
+    }
+    if (is_punct(cp)) {
+      flush();
+      std::string c; encode_utf8(cp, c); out.push_back(c);
+      continue;
+    }
+    encode_utf8(cp, current);
+  }
+  flush();
+  return out;
+}
+
+// Greedy longest-match WordPiece on one word (already normalized).
+void wordpiece(const Tokenizer& t, const std::string& word,
+               std::vector<int>& ids, std::vector<std::string>& tokens) {
+  if (word.size() > t.max_chars_per_word) {
+    ids.push_back(t.unk_id);
+    tokens.push_back(t.id_to_token[t.unk_id]);
+    return;
+  }
+  std::vector<int> piece_ids;
+  std::vector<std::string> piece_tokens;
+  size_t start = 0;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int found = -1;
+    std::string found_tok;
+    while (start < end) {
+      std::string sub = word.substr(start, end - start);
+      if (start > 0) sub = "##" + sub;
+      if (sub.size() <= t.max_token_len) {
+        auto it = t.vocab.find(sub);
+        if (it != t.vocab.end()) { found = it->second; found_tok = sub; break; }
+      }
+      // step back one UTF-8 codepoint
+      do { end--; } while (end > start && (word[end] & 0xC0) == 0x80);
+    }
+    if (found < 0) {
+      ids.push_back(t.unk_id);
+      tokens.push_back(t.id_to_token[t.unk_id]);
+      return;
+    }
+    piece_ids.push_back(found);
+    piece_tokens.push_back(found_tok);
+    start = end;
+  }
+  ids.insert(ids.end(), piece_ids.begin(), piece_ids.end());
+  tokens.insert(tokens.end(), piece_tokens.begin(), piece_tokens.end());
+}
+
+// ---------------------------------------------------------------------------
+// WordPiece vocab trainer (pair-merge over word counts)
+// ---------------------------------------------------------------------------
+
+struct TrainerState {
+  // Each word is a sequence of symbols; continuation symbols carry "##".
+  std::vector<std::pair<std::vector<std::string>, long>> words;
+};
+
+void trainer_count_file(TrainerState& st, Tokenizer& norm,
+                        const std::string& path) {
+  std::ifstream in(path);
+  std::unordered_map<std::string, long> counts;
+  std::string line;
+  while (std::getline(in, line)) {
+    for (const auto& w : basic_tokenize(norm, line)) counts[w] += 1;
+  }
+  for (auto& kv : counts) {
+    std::vector<std::string> symbols;
+    size_t i = 0;
+    bool first = true;
+    while (i < kv.first.size()) {
+      size_t j = i;
+      decode_utf8(kv.first, j);
+      std::string sym = kv.first.substr(i, j - i);
+      symbols.push_back(first ? sym : "##" + sym);
+      first = false;
+      i = j;
+    }
+    st.words.emplace_back(std::move(symbols), kv.second);
+  }
+}
+
+std::vector<std::string> trainer_run(TrainerState& st, size_t vocab_size,
+                                     const std::vector<std::string>& specials,
+                                     long min_frequency) {
+  // Alphabet first.
+  std::map<std::string, long> alphabet;
+  for (auto& [symbols, count] : st.words)
+    for (auto& s : symbols) alphabet[s] += count;
+
+  std::vector<std::string> vocab(specials);
+  for (auto& kv : alphabet) vocab.push_back(kv.first);
+
+  auto merged_symbol = [](const std::string& a, const std::string& b) {
+    // "fo" + "##o" -> "foo"; "##f" + "##oo" -> "##foo"
+    return a + (b.rfind("##", 0) == 0 ? b.substr(2) : b);
+  };
+
+  while (vocab.size() < vocab_size) {
+    std::map<std::pair<std::string, std::string>, long> pair_counts;
+    for (auto& [symbols, count] : st.words)
+      for (size_t i = 0; i + 1 < symbols.size(); i++)
+        pair_counts[{symbols[i], symbols[i + 1]}] += count;
+    if (pair_counts.empty()) break;
+    auto best = std::max_element(
+        pair_counts.begin(), pair_counts.end(),
+        [](const auto& a, const auto& b) { return a.second < b.second; });
+    if (best->second < min_frequency) break;
+    const auto [left, right] = best->first;
+    std::string merged = merged_symbol(left, right);
+    vocab.push_back(merged);
+    for (auto& [symbols, count] : st.words) {
+      std::vector<std::string> out;
+      out.reserve(symbols.size());
+      size_t i = 0;
+      while (i < symbols.size()) {
+        if (i + 1 < symbols.size() && symbols[i] == left &&
+            symbols[i + 1] == right) {
+          out.push_back(merged);
+          i += 2;
+        } else {
+          out.push_back(symbols[i]);
+          i += 1;
+        }
+      }
+      symbols = std::move(out);
+    }
+  }
+  return vocab;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* wp_create(const char* vocab_path, int lowercase) {
+  auto* t = new Tokenizer();
+  t->lowercase = lowercase != 0;
+  std::ifstream in(vocab_path);
+  if (!in) { delete t; return nullptr; }
+  std::string line;
+  int index = 0;
+  while (std::getline(in, line)) {
+    if (!line.empty() && line.back() == '\r') line.pop_back();
+    if (line.empty()) continue;
+    t->vocab.emplace(line, index);
+    t->id_to_token.push_back(line);
+    t->max_token_len = std::max(t->max_token_len, line.size());
+    index++;
+  }
+  auto unk = t->vocab.find("[UNK]");
+  t->unk_id = unk == t->vocab.end() ? 0 : unk->second;
+  return t;
+}
+
+void wp_free(void* handle) { delete static_cast<Tokenizer*>(handle); }
+
+int wp_vocab_size(void* handle) {
+  return static_cast<int>(static_cast<Tokenizer*>(handle)->id_to_token.size());
+}
+
+int wp_token_to_id(void* handle, const char* token) {
+  auto* t = static_cast<Tokenizer*>(handle);
+  auto it = t->vocab.find(token);
+  return it == t->vocab.end() ? -1 : it->second;
+}
+
+const char* wp_id_to_token(void* handle, int id) {
+  auto* t = static_cast<Tokenizer*>(handle);
+  if (id < 0 || id >= static_cast<int>(t->id_to_token.size())) return "";
+  return t->id_to_token[id].c_str();
+}
+
+// Encode text; returns number of tokens. Fetch results with wp_get_ids /
+// wp_get_tokens (valid until the next encode on this handle).
+int wp_encode(void* handle, const char* text) {
+  auto* t = static_cast<Tokenizer*>(handle);
+  t->last_ids.clear();
+  t->last_tokens_joined.clear();
+  std::vector<std::string> tokens;
+  for (const auto& word : basic_tokenize(*t, text))
+    wordpiece(*t, word, t->last_ids, tokens);
+  for (size_t i = 0; i < tokens.size(); i++) {
+    if (i) t->last_tokens_joined.push_back('\n');
+    t->last_tokens_joined += tokens[i];
+  }
+  return static_cast<int>(t->last_ids.size());
+}
+
+const int* wp_get_ids(void* handle) {
+  return static_cast<Tokenizer*>(handle)->last_ids.data();
+}
+
+const char* wp_get_tokens(void* handle) {
+  return static_cast<Tokenizer*>(handle)->last_tokens_joined.c_str();
+}
+
+// Train a WordPiece vocab from newline-delimited text files.
+// files: '\n'-joined list of paths. specials: '\n'-joined special tokens
+// (placed first, [PAD] at 0 per reference utils/build_vocab.py:64-75).
+// Returns 0 on success; writes one token per line to out_path.
+int wp_train(const char* files, const char* specials, int vocab_size,
+             int min_frequency, int lowercase, const char* out_path) {
+  Tokenizer norm;
+  norm.lowercase = lowercase != 0;
+  TrainerState st;
+  std::stringstream fs(files);
+  std::string path;
+  while (std::getline(fs, path, '\n'))
+    if (!path.empty()) trainer_count_file(st, norm, path);
+
+  std::vector<std::string> specials_list;
+  std::stringstream ss(specials);
+  std::string sp;
+  while (std::getline(ss, sp, '\n'))
+    if (!sp.empty()) specials_list.push_back(sp);
+
+  auto vocab = trainer_run(st, static_cast<size_t>(vocab_size), specials_list,
+                           min_frequency);
+  std::ofstream out(out_path);
+  if (!out) return 1;
+  for (auto& tok : vocab) out << tok << "\n";
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Offline dataset build orchestration (reference scripts/create_datasets.sh):
+# download -> format -> shard -> vocab -> encode-to-HDF5.
+set -euo pipefail
+DATA_DIR=${DATA_DIR:-data}
+VOCAB_SIZE=${VOCAB_SIZE:-30522}
+
+python -m bert_pytorch_tpu.tools.download --dataset wikicorpus --output_dir "$DATA_DIR/download"
+python -m bert_pytorch_tpu.tools.download --dataset squad --output_dir "$DATA_DIR/download"
+
+# wikiextractor (external, as in the reference) converts the XML dump:
+#   python -m wikiextractor.WikiExtractor "$DATA_DIR/download/wikicorpus/wikicorpus.xml" \
+#       --json -o "$DATA_DIR/extracted"
+
+python -m bert_pytorch_tpu.tools.format \
+    --input_glob "$DATA_DIR/extracted/**/wiki_*" --dataset wiki \
+    --output_dir "$DATA_DIR/formatted"
+
+python -m bert_pytorch_tpu.tools.shard \
+    --input_glob "$DATA_DIR/formatted/*.txt" \
+    --output_dir "$DATA_DIR/sharded" --max_bytes_per_shard 250M
+
+python -m bert_pytorch_tpu.tools.build_vocab \
+    --input_glob "$DATA_DIR/sharded/*.txt" \
+    --output "$DATA_DIR/vocab/wordpiece-vocab-${VOCAB_SIZE}.txt" \
+    --vocab_size "$VOCAB_SIZE"
+
+# phase 1: seq 128; phase 2: seq 512 (reference create_datasets.sh:130-140)
+python -m bert_pytorch_tpu.tools.encode_data \
+    --input_dir "$DATA_DIR/sharded" --output_dir "$DATA_DIR/encoded/phase1" \
+    --vocab_file "$DATA_DIR/vocab/wordpiece-vocab-${VOCAB_SIZE}.txt" \
+    --max_seq_len 128 --next_seq_prob 0.5
+python -m bert_pytorch_tpu.tools.encode_data \
+    --input_dir "$DATA_DIR/sharded" --output_dir "$DATA_DIR/encoded/phase2" \
+    --vocab_file "$DATA_DIR/vocab/wordpiece-vocab-${VOCAB_SIZE}.txt" \
+    --max_seq_len 512 --next_seq_prob 0.5

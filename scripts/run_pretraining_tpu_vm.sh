@@ -1,0 +1,14 @@
+#!/bin/bash
+# Cloud TPU VM launcher: run the same command on every host of the pod slice
+# (gcloud alpha compute tpus tpu-vm ssh --worker=all). jax.distributed
+# auto-discovers the pod topology on TPU VMs, so no nodefile inference is
+# needed (bert_pytorch_tpu/parallel/launcher.py).
+set -euo pipefail
+TPU_NAME=${1:?usage: run_pretraining_tpu_vm.sh <tpu-name> [phase]}
+PHASE=${2:-1}
+gcloud alpha compute tpus tpu-vm ssh "$TPU_NAME" --worker=all --command "
+  cd $(pwd) && python run_pretraining.py \
+    --input_dir data/encoded/phase${PHASE} \
+    --output_dir results/bert_pretraining \
+    --model_config_file configs/bert_large_uncased_config.json \
+    --config_file configs/bert_pretraining_phase${PHASE}_config.json"

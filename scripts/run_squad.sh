@@ -1,0 +1,15 @@
+#!/bin/bash
+# SQuAD finetune + eval (reference scripts/run_squad.sh:23-46 recipe:
+# lr 3e-5, 2 epochs, seq 384, doc_stride 128).
+set -euo pipefail
+SQUAD_DIR=${SQUAD_DIR:-data/download/squad/v1.1}
+python run_squad.py \
+    --do_train --do_predict --do_eval --do_lower_case \
+    --train_file "$SQUAD_DIR/train-v1.1.json" \
+    --predict_file "$SQUAD_DIR/dev-v1.1.json" \
+    --eval_script "$SQUAD_DIR/evaluate-v1.1.py" \
+    --config_file configs/bert_large_uncased_config.json \
+    --init_checkpoint "${INIT_CKPT:?set INIT_CKPT to a pretraining checkpoint}" \
+    --output_dir results/squad \
+    --learning_rate 3e-5 --num_train_epochs 2 \
+    --max_seq_length 384 --doc_stride 128 --train_batch_size 32

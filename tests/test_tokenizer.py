@@ -1,0 +1,144 @@
+"""Tokenizer tests: C++ core vs the pure-Python spec vs HF Rust tokenizers.
+
+The pure-Python BasicTokenizer/WordpieceTokenizer
+(bert_pytorch_tpu/data/tokenization.py, parity with reference
+src/tokenization.py:60-229) is the behavioral specification; the C++ core
+and the HF fast tokenizer must both agree with it (SQuAD answer alignment
+depends on it, SURVEY.md §7 'tokenizer bit-parity').
+"""
+
+import os
+
+import pytest
+
+from bert_pytorch_tpu.data.tokenization import (
+    BasicTokenizer,
+    BertTokenizer,
+    WordpieceTokenizer,
+    load_vocab,
+)
+
+VOCAB = [
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+    "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
+    "over", "lazy", "dog", "un", "##believ", "##able", "hello", "world",
+    "cafe", "resume", "2023", "!", ",", ".", "'", "don", "t", "中", "文",
+]
+
+SENTENCES = [
+    "The quick brown fox jumps over the lazy dog.",
+    "Hello, world!",
+    "unbelievable",
+    "Café résumé 2023",          # accents fold away when lowercasing
+    "don't",
+    "hello 中文 world",           # CJK isolation
+    "  weird\tspacing\n here ",
+    "UNKNOWNWORDXYZ",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tok") / "vocab.txt"
+    path.write_text("\n".join(VOCAB) + "\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def cpp_tok(vocab_file):
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    return CppWordPieceTokenizer(vocab_file, lowercase=True)
+
+
+@pytest.fixture(scope="module")
+def py_tok(vocab_file):
+    return BertTokenizer(vocab_file, do_lower_case=True)
+
+
+def test_basic_tokenizer_spec():
+    bt = BasicTokenizer(do_lower_case=True)
+    assert bt.tokenize("Hello, World!") == ["hello", ",", "world", "!"]
+    assert bt.tokenize("Café") == ["cafe"]
+    assert bt.tokenize("中文ab") == ["中", "文", "ab"]
+    assert bt.tokenize(" don't ") == ["don", "'", "t"]
+
+
+def test_wordpiece_greedy_longest_match(vocab_file):
+    wp = WordpieceTokenizer(load_vocab(vocab_file))
+    assert wp.tokenize("unbelievable") == ["un", "##believ", "##able"]
+    assert wp.tokenize("jumps") == ["jump", "##s"]
+    assert wp.tokenize("zzzqqq") == ["[UNK]"]
+
+
+def test_cpp_matches_python_spec(cpp_tok, py_tok):
+    for sentence in SENTENCES:
+        py_tokens = py_tok.tokenize(sentence)
+        py_ids = py_tok.convert_tokens_to_ids(py_tokens)
+        enc = cpp_tok.encode(sentence)
+        assert enc.tokens == py_tokens, sentence
+        assert enc.ids == py_ids, sentence
+
+
+def test_cpp_matches_hf_fast(vocab_file, cpp_tok):
+    tokenizers = pytest.importorskip("tokenizers")
+    hf = tokenizers.BertWordPieceTokenizer(
+        vocab_file, lowercase=True, strip_accents=True,
+        handle_chinese_chars=True, clean_text=True)
+    for sentence in SENTENCES:
+        hf_enc = hf.encode(sentence, add_special_tokens=False)
+        enc = cpp_tok.encode(sentence)
+        assert enc.tokens == hf_enc.tokens, sentence
+        assert enc.ids == hf_enc.ids, sentence
+
+
+def test_cpp_special_token_api(cpp_tok):
+    assert cpp_tok.token_to_id("[MASK]") == 4
+    assert cpp_tok.id_to_token(4) == "[MASK]"
+    assert cpp_tok.token_to_id("notavocabword") is None
+    enc = cpp_tok.encode("hello world", add_special_tokens=True)
+    assert enc.tokens[0] == "[CLS]" and enc.tokens[-1] == "[SEP]"
+
+
+def test_cpp_uppercase_mode(vocab_file, tmp_path):
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    cased_vocab = tmp_path / "cased.txt"
+    cased_vocab.write_text("\n".join(
+        ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "Hello", "hello"]) + "\n")
+    tok = CppWordPieceTokenizer(str(cased_vocab), lowercase=False)
+    assert tok.encode("Hello").tokens == ["Hello"]
+    assert tok.encode("hello").tokens == ["hello"]
+
+
+def test_vocab_trainer_roundtrip(tmp_path):
+    from bert_pytorch_tpu.tools.tokenizer_cpp import (
+        CppWordPieceTokenizer,
+        train_wordpiece_vocab,
+    )
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text(
+        "the cat sat on the mat\n" * 50
+        + "the cats sat on the mats\n" * 30
+        + "a dog ran in the park\n" * 40
+    )
+    out = str(tmp_path / "trained_vocab.txt")
+    train_wordpiece_vocab([str(corpus)], vocab_size=60, out_path=out)
+    lines = [l for l in open(out).read().splitlines() if l]
+    # specials first, [PAD] at 0 (reference utils/build_vocab.py:64-75)
+    assert lines[0] == "[PAD]"
+    assert lines[1:5] == ["[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    tok = CppWordPieceTokenizer(out, lowercase=True)
+    enc = tok.encode("the cat sat")
+    # frequent words must be single tokens after merging
+    assert "the" in enc.tokens and "cat" in enc.tokens
+    assert tok.token_to_id("[UNK]") == 1
+
+
+def test_get_wordpiece_tokenizer_prefers_cpp(vocab_file):
+    from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    tok = get_wordpiece_tokenizer(vocab_file)
+    assert isinstance(tok, CppWordPieceTokenizer)

@@ -1,0 +1,180 @@
+"""Offline pipeline integration: format -> shard -> vocab -> encode -> load.
+
+The TPU-framework analog of the reference's scripts/create_datasets.sh flow
+(SURVEY.md §3.5), run end-to-end on a synthetic corpus and consumed back
+through the runtime dataset.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+CORPUS_SENTENCES = [
+    "the cat sat on the mat",
+    "a dog ran in the park",
+    "the quick brown fox jumps over the lazy dog",
+    "hello world this is a test sentence",
+    "the mat was soft and warm",
+    "dogs and cats are animals",
+]
+
+
+@pytest.fixture(scope="module")
+def pipeline(tmp_path_factory):
+    """Run the full offline pipeline once; return its artifacts."""
+    root = tmp_path_factory.mktemp("pipeline")
+
+    # 1. raw "books" corpus files (paragraph text)
+    raw_dir = root / "raw"
+    raw_dir.mkdir()
+    rng = random.Random(0)
+    for i in range(4):
+        sentences = [rng.choice(CORPUS_SENTENCES) for _ in range(30)]
+        (raw_dir / f"book_{i}.txt").write_text(". ".join(sentences) + ".")
+
+    # 2. format -> one sentence per line
+    from bert_pytorch_tpu.tools.format import format_corpus
+
+    fmt_dir = root / "formatted"
+    outs = format_corpus(
+        [str(p) for p in raw_dir.iterdir()], str(fmt_dir), "books",
+        num_outputs=2, processes=1)
+    assert len(outs) == 2
+
+    # 3. shard
+    from bert_pytorch_tpu.tools.shard import shard
+
+    # Each shard must hold >=2 documents for NSP's random-next draw, so use
+    # a shard size that keeps all articles together.
+    shard_dir = root / "sharded"
+    shards = shard(outs, str(shard_dir), max_bytes=10**6)
+    assert len(shards) >= 1
+
+    # 4. vocab (C++ WordPiece trainer)
+    from bert_pytorch_tpu.tools.build_vocab import build_wordpiece_vocab
+
+    vocab_path = str(root / "vocab.txt")
+    build_wordpiece_vocab(shards, vocab_path, vocab_size=120)
+
+    # 5. encode to HDF5 (with NSP)
+    from bert_pytorch_tpu.tools import encode_data
+
+    out_dir = root / "encoded"
+    encode_data.main([
+        "--input_dir", str(shard_dir), "--output_dir", str(out_dir),
+        "--vocab_file", vocab_path, "--max_seq_len", "64",
+        "--next_seq_prob", "0.5", "--short_seq_prob", "0.1",
+        "--processes", "1",
+    ])
+    enc_dir = out_dir / "sequences_lowercase_max_seq_len_64_next_seq_task_true"
+    hdf5_files = sorted(str(p) for p in enc_dir.glob("*.hdf5"))
+    assert hdf5_files
+    return {"vocab": vocab_path, "hdf5": hdf5_files, "root": root}
+
+
+def test_encoded_shards_have_expected_format(pipeline):
+    import h5py
+
+    with h5py.File(pipeline["hdf5"][0], "r") as f:
+        assert set(f.keys()) == {
+            "input_ids", "special_token_positions", "next_sentence_labels"}
+        n = len(f["input_ids"])
+        assert n > 0
+        assert f["input_ids"].shape[1] == 64
+        labels = np.asarray(f["next_sentence_labels"][:])
+        assert set(np.unique(labels)) <= {0, 1}
+        specials = f["special_token_positions"][0]
+        assert len(specials) == 3  # NSP -> [CLS], mid [SEP], end [SEP]
+        assert specials[0] == 0
+
+
+def test_samples_wrap_with_cls_sep(pipeline):
+    import h5py
+
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    tok = CppWordPieceTokenizer(pipeline["vocab"])
+    cls_id, sep_id = tok.token_to_id("[CLS]"), tok.token_to_id("[SEP]")
+    with h5py.File(pipeline["hdf5"][0], "r") as f:
+        ids = np.asarray(f["input_ids"][0])
+        specials = np.asarray(f["special_token_positions"][0])
+    assert ids[specials[0]] == cls_id
+    assert ids[specials[1]] == sep_id
+    assert ids[specials[2]] == sep_id
+
+
+def test_encoded_data_trains_end_to_end(pipeline):
+    """The offline pipeline's output feeds the runtime dataset + a train
+    step — the full create_datasets -> run_pretraining contract."""
+    from bert_pytorch_tpu.data import DataLoader, DistributedSampler, \
+        ShardedPretrainingDataset
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    tok = CppWordPieceTokenizer(pipeline["vocab"])
+    ds = ShardedPretrainingDataset(
+        pipeline["hdf5"], tok.token_to_id("[MASK]"), 10, 0.15,
+        vocab_size=tok.get_vocab_size(), seed=0)
+    sampler = DistributedSampler(ds, 1, 0)
+    loader = DataLoader(ds, sampler, batch_size=4)
+    batch = next(iter(loader))
+    assert batch["input_ids"].shape == (4, 64)
+    assert (batch["masked_lm_labels"] != -1).sum() > 0
+
+
+def test_shard_respects_article_boundaries(tmp_path):
+    from bert_pytorch_tpu.tools.shard import iter_articles, shard
+
+    src = tmp_path / "in.txt"
+    src.write_text("a1 s1\na1 s2\n\nb1 s1\n\nc1 s1\nc1 s2\nc1 s3\n")
+    articles = list(iter_articles([str(src)]))
+    assert [len(a) for a in articles] == [2, 1, 3]
+    outs = shard([str(src)], str(tmp_path / "out"), max_bytes=10)
+    # every output shard starts at an article boundary
+    total = []
+    for o in outs:
+        arts = list(iter_articles([o]))
+        total.extend(arts)
+    assert [len(a) for a in total] == [2, 1, 3]
+
+
+def test_shard_sentence_sampling(tmp_path):
+    from bert_pytorch_tpu.tools.shard import iter_articles, shard
+
+    src = tmp_path / "in.txt"
+    src.write_text("\n".join(f"article{i} sentence" for i in range(50)) + "\n")
+    outs = shard([str(src)], str(tmp_path / "out"), max_bytes=10**6,
+                 sample_sentences=10)
+    sentences = [s for o in outs for a in iter_articles([o]) for s in a]
+    assert len(sentences) == 10
+
+
+def test_parse_value_as_int():
+    from bert_pytorch_tpu.tools.shard import parse_value_as_int
+
+    assert parse_value_as_int("250M") == 250_000_000
+    assert parse_value_as_int("1k") == 1000
+    assert parse_value_as_int("42") == 42
+
+
+def test_sha256_verification(tmp_path):
+    from bert_pytorch_tpu.tools.download import sha256_file, verify_sha256
+
+    p = tmp_path / "f.bin"
+    p.write_bytes(b"hello")
+    digest = sha256_file(str(p))
+    verify_sha256(str(p), digest)
+    with pytest.raises(ValueError, match="SHA256 mismatch"):
+        verify_sha256(str(p), "0" * 64)
+
+
+def test_bz2_extraction(tmp_path):
+    import bz2 as bz2mod
+
+    from bert_pytorch_tpu.tools.download import extract_bz2
+
+    src = tmp_path / "x.bz2"
+    src.write_bytes(bz2mod.compress(b"wiki dump contents"))
+    out = extract_bz2(str(src), str(tmp_path / "x.xml"))
+    assert open(out, "rb").read() == b"wiki dump contents"
