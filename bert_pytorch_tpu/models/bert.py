@@ -40,6 +40,29 @@ def bert_normal_init(stddev: float):
     return nn.initializers.normal(stddev=stddev)
 
 
+def _kfac_input_stat(x: Array, feature_ndim: int = 1) -> Array:
+    """Sum over tokens of x̃x̃ᵀ with the homogeneous bias coordinate appended
+    — the K-FAC 'A' factor statistic for a dense layer consuming ``x``.
+
+    The JAX-native analog of kfac_pytorch's forward-hook input capture
+    (driven at reference run_pretraining.py:320-355): instead of a module
+    hook saving activations, the model sows the already-reduced (d+1, d+1)
+    second-moment — under ``nn.scan`` these stack into an (L, d+1, d+1)
+    batch that a single batched eigendecomposition inverts on the MXU.
+    """
+    d = 1
+    for s in x.shape[-feature_ndim:]:
+        d *= s
+    a = x.astype(jnp.float32).reshape(-1, d)
+    a = jnp.concatenate([a, jnp.ones_like(a[:, :1])], axis=-1)
+    return a.T @ a
+
+
+# Collections used by the K-FAC taps (see optim/kfac.py).
+KFAC_A_COLLECTION = "kfac_a"
+KFAC_TAPS_COLLECTION = "kfac_taps"
+
+
 class LayerNorm(nn.Module):
     """Affine LayerNorm; parity with ``BertLayerNorm`` (modeling.py:311-336).
 
@@ -178,6 +201,7 @@ class BertSelfAttention(nn.Module):
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
     attention_backend: str = "xla"
+    kfac_tap: bool = False
 
     @nn.compact
     def __call__(
@@ -201,9 +225,18 @@ class BertSelfAttention(nn.Module):
                 name=name,
             )
 
+        if self.kfac_tap:
+            # q/k/v share the input, hence one A factor for all three — the
+            # values kfac_pytorch computes three identical copies of.
+            self.sow(KFAC_A_COLLECTION, "attn_in_a", _kfac_input_stat(hidden))
         q = qkv_proj("query")(hidden)
         k = qkv_proj("key")(hidden)
         v = qkv_proj("value")(hidden)
+        if self.kfac_tap:
+            # perturb name encodes '<dense submodule>__<A-factor name>'.
+            q = self.perturb("query__attn_in", q, collection=KFAC_TAPS_COLLECTION)
+            k = self.perturb("key__attn_in", k, collection=KFAC_TAPS_COLLECTION)
+            v = self.perturb("value__attn_in", v, collection=KFAC_TAPS_COLLECTION)
 
         dropout_rng = None
         if not deterministic and cfg.attention_probs_dropout_prob > 0.0:
@@ -218,6 +251,11 @@ class BertSelfAttention(nn.Module):
             deterministic=deterministic,
             backend=self.attention_backend,
         )
+        if self.kfac_tap:
+            self.sow(
+                KFAC_A_COLLECTION, "attn_ctx_a",
+                _kfac_input_stat(context, feature_ndim=2),
+            )
         # Output projection [B,S,H,D] -> [B,S,hidden] (BertSelfOutput dense).
         out = nn.DenseGeneral(
             features=cfg.hidden_size,
@@ -228,6 +266,10 @@ class BertSelfAttention(nn.Module):
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
             name="output",
         )(context)
+        if self.kfac_tap:
+            out = self.perturb(
+                "output__attn_ctx", out, collection=KFAC_TAPS_COLLECTION
+            )
         out = nn.Dropout(rate=cfg.hidden_dropout_prob)(
             out, deterministic=deterministic
         )
@@ -247,6 +289,7 @@ class BertLayer(nn.Module):
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
     attention_backend: str = "xla"
+    kfac_tap: bool = False
 
     @nn.compact
     def __call__(self, hidden: Array, bias: Array, deterministic: bool = True):
@@ -256,6 +299,7 @@ class BertLayer(nn.Module):
             cfg,
             dtype=self.dtype,
             attention_backend=self.attention_backend,
+            kfac_tap=self.kfac_tap,
             name="attention",
         )(hidden, bias, deterministic)
         intermediate = LinearActivation(
@@ -266,6 +310,8 @@ class BertLayer(nn.Module):
             kernel_axes=("embed", "mlp"),
             name="intermediate",
         )(attn_out)
+        if self.kfac_tap:
+            self.sow(KFAC_A_COLLECTION, "mlp_in_a", _kfac_input_stat(intermediate))
         out = nn.Dense(
             cfg.hidden_size,
             dtype=self.dtype,
@@ -274,6 +320,8 @@ class BertLayer(nn.Module):
             bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
             name="output",
         )(intermediate)
+        if self.kfac_tap:
+            out = self.perturb("output__mlp_in", out, collection=KFAC_TAPS_COLLECTION)
         out = nn.Dropout(rate=cfg.hidden_dropout_prob)(
             out, deterministic=deterministic
         )
@@ -296,6 +344,7 @@ class BertEncoder(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: str = "none"  # 'none' | 'full' | 'dots'
     attention_backend: str = "xla"
+    kfac_tap: bool = False
 
     @nn.compact
     def __call__(self, hidden: Array, bias: Array, deterministic: bool = True):
@@ -315,7 +364,10 @@ class BertEncoder(nn.Module):
             )
         scanned = nn.scan(
             layer_cls,
-            variable_axes={"params": 0},
+            # kfac collections scan to (L, ...) stacks; empty when taps are
+            # off, so the extra axes are free.
+            variable_axes={"params": 0, KFAC_A_COLLECTION: 0,
+                           KFAC_TAPS_COLLECTION: 0},
             split_rngs={"params": True, "dropout": True},
             in_axes=(nn.broadcast, nn.broadcast),
             length=cfg.num_hidden_layers,
@@ -324,6 +376,7 @@ class BertEncoder(nn.Module):
             cfg,
             dtype=self.dtype,
             attention_backend=self.attention_backend,
+            kfac_tap=self.kfac_tap,
             name="layers",
         )
         hidden, _ = scanned(hidden, bias, deterministic)
@@ -361,6 +414,7 @@ class BertModel(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: str = "none"
     attention_backend: str = "xla"
+    kfac_tap: bool = False
 
     def setup(self):
         cfg = self.config
@@ -370,6 +424,7 @@ class BertModel(nn.Module):
             dtype=self.dtype,
             remat=self.remat,
             attention_backend=self.attention_backend,
+            kfac_tap=self.kfac_tap,
         )
         if cfg.next_sentence:
             self.pooler = BertPooler(cfg, dtype=self.dtype)
@@ -455,6 +510,12 @@ class BertForPreTraining(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: str = "none"
     attention_backend: str = "xla"
+    # K-FAC factor-capture taps (optim/kfac.py). Covers the encoder's dense
+    # layers — the same set kfac_pytorch hooks in the reference (q/k/v,
+    # attention output, MLP output are nn.Linear; LinearActivation modules
+    # and the skipped predictions head / embeddings are not registered there
+    # either, reference run_pretraining.py:343-346, modeling.py:141-180).
+    kfac_tap: bool = False
 
     def setup(self):
         cfg = self.config
@@ -463,6 +524,7 @@ class BertForPreTraining(nn.Module):
             dtype=self.dtype,
             remat=self.remat,
             attention_backend=self.attention_backend,
+            kfac_tap=self.kfac_tap,
         )
         self.predictions = BertLMPredictionHead(cfg, dtype=self.dtype)
         if cfg.next_sentence:

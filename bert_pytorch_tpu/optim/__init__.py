@@ -14,6 +14,11 @@ from bert_pytorch_tpu.optim.schedules import (
     warmup_linear_schedule,
     warmup_poly_schedule,
 )
+from bert_pytorch_tpu.optim.kfac import (
+    KFAC,
+    KFACState,
+    kfac_state_shardings,
+)
 from bert_pytorch_tpu.optim.transforms import (
     OptState,
     adamw,
@@ -24,6 +29,9 @@ from bert_pytorch_tpu.optim.transforms import (
 )
 
 __all__ = [
+    "KFAC",
+    "KFACState",
+    "kfac_state_shardings",
     "SCHEDULES",
     "make_schedule",
     "warmup_constant_schedule",

@@ -1,0 +1,391 @@
+"""Distributed K-FAC preconditioner, TPU-native.
+
+The reference drives the external ``kfac_pytorch`` library with backward-hook
+factor capture and NCCL factor communication (reference
+run_pretraining.py:30-34, 320-355; SURVEY.md §2.2/§2.3). This is the
+JAX/XLA re-design of the same capability:
+
+- **Factor capture without hooks.** The model exposes taps
+  (``models/bert.py`` ``kfac_tap`` flag): inputs of each covered dense layer
+  are sown as already-reduced second moments x̃ᵀx̃ (bias-augmented) into the
+  ``kfac_a`` collection, and each layer output carries a zero additive
+  variable in ``kfac_taps`` whose cotangent under ``jax.grad`` IS the layer's
+  output gradient — the functional analog of torch's forward/backward hooks.
+- **Stacked factors.** Under the scanned encoder every per-layer factor
+  arrives as one (L, d, d) batch, so the eigendecompositions that
+  kfac_pytorch schedules layer-by-layer across ranks run here as a single
+  batched ``eigh`` — sharded over the mesh's data axes by the leading L axis
+  (the HYBRID_OPT distributed-inverse analog; see
+  :func:`kfac_state_shardings`).
+- **Cadence.** Factors every ``factor_interval`` optimizer steps (EMA with
+  ``factor_decay``, reference --kfac_stat_decay), eigendecompositions every
+  ``inv_interval`` (--kfac_inv_interval), preconditioning every step.
+- **Trust region.** Preconditioned gradients are rescaled by
+  ν = min(1, sqrt(kl_clip / Σ ĝ·g·lr²)) — kfac_pytorch's kl_clip
+  (--kfac_kl_clip).
+- **Math.** For a dense layer y = x W + b with x̃ = [x, 1] and
+  W̃ = [[W],[b]] ∈ R^{(d_in+1)×d_out}:  A = E[x̃x̃ᵀ], G = E[ĝĝᵀ] with
+  ĝ the batch-size-rescaled output gradient (the per-sample gradient scale
+  kfac_pytorch uses for batch-averaged losses). The preconditioned update is
+  computed in the eigenbasis: with A = Q_A Λ_A Q_Aᵀ and G = Q_G Λ_G Q_Gᵀ,
+  P = Q_A [ (Q_Aᵀ ∇W̃ Q_G) / (λ_A λ_Gᵀ + damping) ] Q_Gᵀ.
+  Eigenvectors are stored in ``inv_dtype`` (default bf16 — the analog of
+  kfac_pytorch's inv_dtype=torch.float16 memory optimization).
+
+Checkpointable: :class:`KFACState` is a flax dataclass pytree, saved as the
+``preconditioner`` entry of the training checkpoint (reference
+run_pretraining.py:351-352, 519-520).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+from flax import traverse_util
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@flax.struct.dataclass
+class KFACState:
+    """EMA Kronecker factors + their eigendecompositions.
+
+    ``a``/``qa``/``la`` are keyed by the A-factor tap path (shared by layers
+    with a common input, e.g. q/k/v); ``g``/``qg``/``lg`` by the output-tap
+    path. Leaves are (d, d) or stacked (L, d, d).
+    """
+
+    count: jnp.ndarray  # number of factor updates applied
+    a: Dict[str, jnp.ndarray]
+    g: Dict[str, jnp.ndarray]
+    qa: Dict[str, jnp.ndarray]
+    la: Dict[str, jnp.ndarray]
+    qg: Dict[str, jnp.ndarray]
+    lg: Dict[str, jnp.ndarray]
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerSpec:
+    """One preconditioned dense layer, resolved from the tap naming
+    convention '<dense submodule>__<A factor name>' (models/bert.py)."""
+
+    g_key: str  # flat '/'-joined path of the output tap
+    a_key: str  # flat path of the shared input-stat tap
+    kernel_path: Tuple[str, ...]
+    bias_path: Tuple[str, ...]
+    a_dim: int  # d_in + 1
+    g_dim: int
+    stacked: bool  # True for scanned-encoder (L, ...) layers
+
+
+def _flat_key(path: Tuple[str, ...]) -> str:
+    return "/".join(path)
+
+
+def _unwrap_sown(leaf):
+    """sow() stores values as a tuple per call-site; taps fire once."""
+    if isinstance(leaf, tuple):
+        (leaf,) = leaf
+    return leaf
+
+
+def build_layer_specs(tap_shapes, astat_shapes, params_shapes) -> Tuple[LayerSpec, ...]:
+    """Resolve taps against the param tree (no model knowledge needed beyond
+    the '<dense>__<afactor>' perturb naming convention)."""
+    flat_params = traverse_util.flatten_dict(params_shapes)
+    flat_astats = {
+        path: _unwrap_sown(leaf)
+        for path, leaf in traverse_util.flatten_dict(
+            astat_shapes, is_leaf=lambda _, v: isinstance(v, tuple)
+        ).items()
+    }
+    specs = []
+    for path, leaf in sorted(traverse_util.flatten_dict(tap_shapes).items()):
+        name = path[-1]
+        dense, a_name = name.split("__")
+        parent = path[:-1]
+        a_path = parent + (a_name + "_a",)
+        a_shape = flat_astats[a_path].shape
+        stacked = len(a_shape) == 3
+        a_dim = a_shape[-1]
+        kernel_path = parent + (dense, "kernel")
+        bias_path = parent + (dense, "bias")
+        kernel_shape = flat_params[kernel_path].shape
+        numel = 1
+        for s in kernel_shape[1 if stacked else 0:]:
+            numel *= s
+        g_dim = numel // (a_dim - 1)
+        specs.append(
+            LayerSpec(
+                g_key=_flat_key(path),
+                a_key=_flat_key(a_path),
+                kernel_path=kernel_path,
+                bias_path=bias_path,
+                a_dim=a_dim,
+                g_dim=g_dim,
+                stacked=stacked,
+            )
+        )
+    return tuple(specs)
+
+
+class KFAC:
+    """K-FAC preconditioner bound to a tapped model's loss.
+
+    Parameters
+    ----------
+    apply_loss:
+        ``(params, taps, batch, rng) -> (loss, a_stats)`` — runs the tapped
+        model forward with the zero output-taps inserted and the ``kfac_a``
+        collection mutable (see :func:`bert_pytorch_tpu.pretrain.make_kfac_fns`).
+    tap_shape_fn:
+        ``(params, batch, rng) -> (tap_shapes, astat_shapes)`` via
+        ``jax.eval_shape`` (trace-only, no FLOPs).
+    grad_scale:
+        ``batch -> scalar`` rescaling raw output gradients to per-sample
+        scale; defaults to the batch size of ``input_ids`` (batch-averaged
+        loss convention).
+    skip_layers:
+        substrings matched against tap paths; matching layers are excluded
+        from preconditioning (reference --kfac_skip_layers; the default skip
+        set — predictions head + embeddings — is never tapped to begin
+        with, models/bert.py).
+    """
+
+    def __init__(
+        self,
+        apply_loss: Callable,
+        tap_shape_fn: Callable,
+        *,
+        factor_decay: float = 0.95,
+        damping: float = 0.003,
+        kl_clip: float = 0.001,
+        inv_dtype=jnp.bfloat16,
+        grad_scale: Callable[[dict], Any] | None = None,
+        skip_layers: Tuple[str, ...] = (),
+    ):
+        self.apply_loss = apply_loss
+        self.tap_shape_fn = tap_shape_fn
+        self.factor_decay = factor_decay
+        self.damping = damping
+        self.kl_clip = kl_clip
+        self.inv_dtype = inv_dtype
+        self.grad_scale = grad_scale or (
+            lambda batch: batch["input_ids"].shape[0]
+        )
+        self.skip_layers = tuple(skip_layers)
+        self.specs: Tuple[LayerSpec, ...] = ()
+        self._abstract_params = None
+        self._update_cache: dict = {}
+        self._inv_jit = None
+
+    # ------------------------------------------------------------------ init
+
+    def init(self, params, sample_batch, rng=None) -> KFACState:
+        """Discover taps (shape-only model trace) and build zeroed state."""
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._abstract_params = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params
+        )
+        abstract_batch = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), dict(sample_batch)
+        )
+        tap_shapes, astat_shapes = self.tap_shape_fn(
+            self._abstract_params, abstract_batch, rng
+        )
+        self.specs = build_layer_specs(
+            tap_shapes, astat_shapes, self._abstract_params
+        )
+        if self.skip_layers:
+            self.specs = tuple(
+                s for s in self.specs
+                if not any(skip in s.g_key for skip in self.skip_layers)
+            )
+        if not self.specs:
+            raise ValueError(
+                "no K-FAC taps found — was the model built with kfac_tap=True "
+                "(and did skip_layers exclude everything)?"
+            )
+
+        flat_astats = {
+            _flat_key(p): _unwrap_sown(v)
+            for p, v in traverse_util.flatten_dict(
+                astat_shapes, is_leaf=lambda _, v: isinstance(v, tuple)
+            ).items()
+        }
+        a, g, qa, la, qg, lg = {}, {}, {}, {}, {}, {}
+        for spec in self.specs:
+            lead = ()
+            if spec.stacked:
+                lead = (flat_astats[spec.a_key].shape[0],)
+            if spec.a_key not in a:
+                a[spec.a_key] = jnp.zeros(
+                    lead + (spec.a_dim, spec.a_dim), jnp.float32
+                )
+                qa[spec.a_key] = jnp.broadcast_to(
+                    jnp.eye(spec.a_dim, dtype=self.inv_dtype),
+                    lead + (spec.a_dim, spec.a_dim),
+                )
+                la[spec.a_key] = jnp.ones(lead + (spec.a_dim,), jnp.float32)
+            g[spec.g_key] = jnp.zeros(lead + (spec.g_dim, spec.g_dim), jnp.float32)
+            qg[spec.g_key] = jnp.broadcast_to(
+                jnp.eye(spec.g_dim, dtype=self.inv_dtype),
+                lead + (spec.g_dim, spec.g_dim),
+            )
+            lg[spec.g_key] = jnp.ones(lead + (spec.g_dim,), jnp.float32)
+        return KFACState(
+            count=jnp.zeros((), jnp.int32), a=a, g=g, qa=qa, la=la, qg=qg, lg=lg
+        )
+
+    # --------------------------------------------------------------- factors
+
+    def update_factors(self, state: KFACState, params, batch, rng) -> KFACState:
+        """One tapped forward/backward on ``batch``; EMA the factors.
+
+        jit-cached per batch shape (the runner feeds one microbatch).
+        """
+        key = tuple(sorted((k, v.shape, str(v.dtype)) for k, v in batch.items()))
+        if key not in self._update_cache:
+            abstract_batch = {
+                k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in batch.items()
+            }
+            tap_shapes, _ = self.tap_shape_fn(
+                self._abstract_params, abstract_batch, jax.random.PRNGKey(0)
+            )
+            self._update_cache[key] = jax.jit(
+                self._build_update_impl(tap_shapes)
+            )
+        return self._update_cache[key](state, params, batch, rng)
+
+    def _build_update_impl(self, tap_shapes):
+        def impl(state, params, batch, rng):
+            zeros = jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), tap_shapes
+            )
+
+            def loss_of_taps(taps):
+                return self.apply_loss(params, taps, batch, rng)
+
+            (_, astats), gtaps = jax.value_and_grad(
+                loss_of_taps, has_aux=True
+            )(zeros)
+
+            flat_a = {
+                _flat_key(p): _unwrap_sown(v)
+                for p, v in traverse_util.flatten_dict(
+                    astats, is_leaf=lambda _, v: isinstance(v, tuple)
+                ).items()
+            }
+            flat_g = {
+                _flat_key(p): v
+                for p, v in traverse_util.flatten_dict(gtaps).items()
+            }
+            scale = jnp.asarray(self.grad_scale(batch), jnp.float32)
+
+            decay = self.factor_decay
+            first = state.count == 0
+
+            def ema(old, new):
+                return jnp.where(first, new, decay * old + (1.0 - decay) * new)
+
+            new_a = dict(state.a)
+            new_g = dict(state.g)
+            for spec in self.specs:
+                g_raw = flat_g[spec.g_key].astype(jnp.float32)
+                lead = g_raw.shape[:1] if spec.stacked else ()
+                rows = g_raw.size // (spec.g_dim * (lead[0] if lead else 1))
+                g2 = g_raw.reshape(lead + (rows, spec.g_dim)) * scale
+                g_fac = jnp.einsum("...ri,...rj->...ij", g2, g2) / rows
+                new_g[spec.g_key] = ema(state.g[spec.g_key], g_fac)
+                if spec.a_key in flat_a:  # compute each shared A once
+                    a_fac = flat_a.pop(spec.a_key) / rows
+                    new_a[spec.a_key] = ema(state.a[spec.a_key], a_fac)
+            return state.replace(
+                count=state.count + 1, a=new_a, g=new_g
+            )
+
+        return impl
+
+    # -------------------------------------------------------------- inverses
+
+    def update_inverses(self, state: KFACState) -> KFACState:
+        """Batched eigendecompositions of all factors (the inverse-update of
+        kfac_pytorch, distributed by the stacked-layer sharding instead of
+        per-layer rank assignment)."""
+        if self._inv_jit is None:
+
+            def impl(state):
+                def eig(fac):
+                    w, v = jnp.linalg.eigh(fac)
+                    return v.astype(self.inv_dtype), jnp.maximum(w, 0.0)
+
+                qa, la, qg, lg = {}, {}, {}, {}
+                for k, fac in state.a.items():
+                    qa[k], la[k] = eig(fac)
+                for k, fac in state.g.items():
+                    qg[k], lg[k] = eig(fac)
+                return state.replace(qa=qa, la=la, qg=qg, lg=lg)
+
+            self._inv_jit = jax.jit(impl)
+        return self._inv_jit(state)
+
+    # --------------------------------------------------------- precondition
+
+    def precondition(self, state: KFACState, grads, lr):
+        """grads -> preconditioned grads with kl_clip trust scaling.
+
+        Pure traced function — called inline from the jitted train step.
+        Non-tapped parameters pass through unchanged (reference behavior for
+        unregistered modules).
+        """
+        flat = traverse_util.flatten_dict(grads)
+        lr = jnp.asarray(lr, jnp.float32)
+        vg_sum = jnp.zeros((), jnp.float32)
+        pre = {}
+        for spec in self.specs:
+            kg = flat[spec.kernel_path].astype(jnp.float32)
+            bg = flat[spec.bias_path].astype(jnp.float32)
+            lead = kg.shape[:1] if spec.stacked else ()
+            k2 = kg.reshape(lead + (spec.a_dim - 1, spec.g_dim))
+            b2 = bg.reshape(lead + (1, spec.g_dim))
+            w = jnp.concatenate([k2, b2], axis=-2)  # (..., d_a, d_g)
+            qa = state.qa[spec.a_key].astype(jnp.float32)
+            qg = state.qg[spec.g_key].astype(jnp.float32)
+            la = state.la[spec.a_key]
+            lg = state.lg[spec.g_key]
+            v = jnp.einsum("...ab,...ag->...bg", qa, w)
+            v = jnp.einsum("...bg,...gh->...bh", v, qg)
+            v = v / (la[..., :, None] * lg[..., None, :] + self.damping)
+            p = jnp.einsum("...ab,...bh->...ah", qa, v)
+            p = jnp.einsum("...ah,...gh->...ag", p, qg)
+            vg_sum = vg_sum + jnp.sum(p * w) * lr * lr
+            pre[spec] = p
+
+        nu = jnp.minimum(
+            1.0, jnp.sqrt(self.kl_clip / jnp.maximum(vg_sum, 1e-30))
+        )
+        for spec in self.specs:
+            p = pre[spec] * nu
+            kg = flat[spec.kernel_path]
+            bg = flat[spec.bias_path]
+            flat[spec.kernel_path] = p[..., :-1, :].reshape(kg.shape).astype(kg.dtype)
+            flat[spec.bias_path] = p[..., -1, :].reshape(bg.shape).astype(bg.dtype)
+        return traverse_util.unflatten_dict(flat)
+
+
+def kfac_state_shardings(mesh: Mesh, state: KFACState) -> KFACState:
+    """Shard stacked (L, d, d) factor batches over the data axes when L
+    divides evenly — each data shard then eigendecomposes its slice of
+    layers (the distributed-inverse placement of kfac_pytorch's
+    HYBRID_OPT, expressed as a sharding instead of rank bookkeeping)."""
+    shards = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+
+    def rule(x):
+        if x.ndim >= 3 and shards > 1 and x.shape[0] % shards == 0:
+            return NamedSharding(mesh, P(("data", "fsdp")))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(rule, state)

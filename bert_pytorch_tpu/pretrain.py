@@ -82,6 +82,70 @@ def make_init_fn(model, tx, sample_inputs, shardings: TrainState):
     return jax.jit(init_fn, out_shardings=shardings)
 
 
+def _mlm_positions(labels, max_pred_per_seq):
+    """Extract [B, P] masked positions + gathered labels when P < S (top_k on
+    the label mask — stable, so the first max_pred masked positions win)."""
+    if max_pred_per_seq is None or max_pred_per_seq >= labels.shape[-1]:
+        return labels, None
+    is_masked = (labels != -1).astype(jnp.int32)
+    _, masked_positions = jax.lax.top_k(is_masked, max_pred_per_seq)
+    labels = jnp.take_along_axis(labels, masked_positions, axis=1)
+    return labels, masked_positions
+
+
+def make_kfac_fns(
+    model_tapped,
+    next_sentence: bool = True,
+    max_pred_per_seq: Optional[int] = None,
+):
+    """(apply_loss, tap_shape_fn) for :class:`bert_pytorch_tpu.optim.KFAC`,
+    sharing the pretraining loss with the train step.
+
+    ``model_tapped`` must be the same architecture built with
+    ``kfac_tap=True`` (and ``remat='none'`` — the stats pass re-runs
+    forward/backward on one microbatch, so no remat is needed).
+    """
+
+    def _apply(variables, mb, rng, mutable):
+        labels, masked_positions = _mlm_positions(
+            mb["masked_lm_labels"], max_pred_per_seq
+        )
+        (mlm_logits, nsp_logits), mutated = model_tapped.apply(
+            variables,
+            mb["input_ids"],
+            mb["segment_ids"],
+            mb["input_mask"],
+            False,  # deterministic
+            masked_positions,
+            rngs={"dropout": rng},
+            mutable=mutable,
+        )
+        loss = pretraining_loss(
+            mlm_logits,
+            nsp_logits if next_sentence else None,
+            labels,
+            mb["next_sentence_labels"] if next_sentence else None,
+        )
+        return loss, mutated
+
+    def apply_loss(params, taps, mb, rng):
+        loss, mutated = _apply(
+            {"params": params, "kfac_taps": taps}, mb, rng, ["kfac_a"]
+        )
+        return loss, mutated["kfac_a"]
+
+    def tap_shape_fn(params, mb, rng):
+        def f(p, mb_):
+            _, mutated = _apply(
+                {"params": p}, mb_, rng, ["kfac_taps", "kfac_a"]
+            )
+            return mutated["kfac_taps"], mutated["kfac_a"]
+
+        return jax.eval_shape(f, params, mb)
+
+    return apply_loss, tap_shape_fn
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -90,6 +154,8 @@ def make_train_step(
     shardings: Optional[TrainState] = None,
     batch_shardings_: Optional[dict] = None,
     max_pred_per_seq: Optional[int] = None,
+    kfac=None,
+    kfac_shardings=None,
 ):
     """Build the jitted train step.
 
@@ -98,19 +164,24 @@ def make_train_step(
     next_sentence_labels [A, B]. Returns (new_state, metrics).
 
     When ``max_pred_per_seq`` is set, the masked positions are extracted
-    inside the jitted step (top_k on the label mask — stable, so the first
-    max_pred masked positions win) and the 30k-vocab decoder runs only on
-    those [B, P] positions instead of all [B, S]: same loss, ~S/P less
-    decoder compute.
+    inside the jitted step and the 30k-vocab decoder runs only on those
+    [B, P] positions instead of all [B, S]: same loss, ~S/P less decoder
+    compute.
+
+    When ``kfac`` (a :class:`bert_pytorch_tpu.optim.KFAC`) is given, the
+    step takes a third ``kfac_state`` argument and preconditions the
+    accumulated gradients before the optimizer update (the
+    ``preconditioner.step()`` slot in the reference's
+    ``take_optimizer_step``, run_pretraining.py:405-417). Requires
+    ``schedule`` for the kl_clip learning-rate term.
     """
+    if kfac is not None and schedule is None:
+        raise ValueError("kfac preconditioning requires a schedule")
 
     def loss_fn(params, mb, rng):
-        labels = mb["masked_lm_labels"]
-        masked_positions = None
-        if max_pred_per_seq is not None and max_pred_per_seq < labels.shape[-1]:
-            is_masked = (labels != -1).astype(jnp.int32)
-            _, masked_positions = jax.lax.top_k(is_masked, max_pred_per_seq)
-            labels = jnp.take_along_axis(labels, masked_positions, axis=1)
+        labels, masked_positions = _mlm_positions(
+            mb["masked_lm_labels"], max_pred_per_seq
+        )
         mlm_logits, nsp_logits = model.apply(
             {"params": params},
             mb["input_ids"],
@@ -129,7 +200,7 @@ def make_train_step(
         acc = mlm_accuracy(mlm_logits, labels)
         return loss, acc
 
-    def step_fn(state: TrainState, batch: dict):
+    def step_fn(state: TrainState, batch: dict, kfac_state=None):
         accum_steps = batch["input_ids"].shape[0]
         step_rng, new_rng = jax.random.split(state.rng)
 
@@ -152,6 +223,10 @@ def make_train_step(
         )
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
 
+        if kfac is not None:
+            grads = kfac.precondition(
+                kfac_state, grads, schedule(state.opt_state.count)
+            )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -165,10 +240,17 @@ def make_train_step(
 
     if shardings is None:
         return jax.jit(step_fn, donate_argnums=(0,))
+    if kfac is None:
+        return jax.jit(
+            step_fn,
+            donate_argnums=(0,),
+            in_shardings=(shardings, batch_shardings_),
+            out_shardings=(shardings, None),
+        )
     return jax.jit(
         step_fn,
         donate_argnums=(0,),
-        in_shardings=(shardings, batch_shardings_),
+        in_shardings=(shardings, batch_shardings_, kfac_shardings),
         out_shardings=(shardings, None),
     )
 

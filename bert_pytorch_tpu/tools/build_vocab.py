@@ -20,6 +20,8 @@ def build_wordpiece_vocab(input_files, output_file: str, vocab_size: int,
                           lowercase: bool = True, min_frequency: int = 2) -> str:
     from bert_pytorch_tpu.tools.tokenizer_cpp import train_wordpiece_vocab
 
+    parent = os.path.dirname(os.path.abspath(output_file))
+    os.makedirs(parent, exist_ok=True)
     return train_wordpiece_vocab(
         list(input_files), vocab_size, output_file,
         special_tokens=tuple(SPECIAL_TOKENS),

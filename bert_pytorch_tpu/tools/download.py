@@ -35,21 +35,61 @@ WIKI_DUMP_URL = (
     "enwiki-latest-pages-articles.xml.bz2"
 )
 
-# Google BERT TF weight archives + SHA256 (the verification pattern of
-# reference utils/download.py:137-216; hashes verified at download time).
+# Google BERT TF weight archives (reference utils/download.py:123-135) and
+# per-extracted-file SHA256 tables (:137-175) checked after extraction.
 WEIGHTS = {
-    "bert-large-uncased": (
-        "https://storage.googleapis.com/bert_models/2019_05_30/"
-        "wwm_uncased_L-24_H-1024_A-16.zip"
-    ),
     "bert-base-uncased": (
         "https://storage.googleapis.com/bert_models/2018_10_18/"
         "uncased_L-12_H-768_A-12.zip"
     ),
-    "bert-large-cased": (
-        "https://storage.googleapis.com/bert_models/2019_05_30/"
-        "wwm_cased_L-24_H-1024_A-16.zip"
+    "bert-large-uncased": (
+        "https://storage.googleapis.com/bert_models/2018_10_18/"
+        "uncased_L-24_H-1024_A-16.zip"
     ),
+    "bert-base-cased": (
+        "https://storage.googleapis.com/bert_models/2018_10_18/"
+        "cased_L-12_H-768_A-12.zip"
+    ),
+    "bert-large-cased": (
+        "https://storage.googleapis.com/bert_models/2018_10_18/"
+        "cased_L-24_H-1024_A-16.zip"
+    ),
+}
+
+_UNCASED_VOCAB_SHA = (
+    "07eced375cec144d27c900241f3e339478dec958f92fddbc551f295c992038a3")
+_CASED_VOCAB_SHA = (
+    "eeaa9875b23b04b4c54ef759d03db9d1ba1554838f8fb26c5d96fa551df93d02")
+
+WEIGHTS_SHA = {
+    "bert-base-uncased": {
+        "bert_config.json": "7b4e5f53efbd058c67cda0aacfafb340113ea1b5797d9ce6ee411704ba21fcbc",
+        "bert_model.ckpt.data-00000-of-00001": "58580dc5e0bf0ae0d2efd51d0e8272b2f808857f0a43a88aaf7549da6d7a8a84",
+        "bert_model.ckpt.index": "04c1323086e2f1c5b7c0759d8d3e484afbb0ab45f51793daab9f647113a0117b",
+        "bert_model.ckpt.meta": "dd5682170a10c3ea0280c2e9b9a45fee894eb62da649bbdea37b38b0ded5f60e",
+        "vocab.txt": _UNCASED_VOCAB_SHA,
+    },
+    "bert-large-uncased": {
+        "bert_config.json": "bfa42236d269e2aeb3a6d30412a33d15dbe8ea597e2b01dc9518c63cc6efafcb",
+        "bert_model.ckpt.data-00000-of-00001": "bc6b3363e3be458c99ecf64b7f472d2b7c67534fd8f564c0556a678f90f4eea1",
+        "bert_model.ckpt.index": "68b52f2205ffc64dc627d1120cf399c1ef1cbc35ea5021d1afc889ffe2ce2093",
+        "bert_model.ckpt.meta": "6fcce8ff7628f229a885a593625e3d5ff9687542d5ef128d9beb1b0c05edc4a1",
+        "vocab.txt": _UNCASED_VOCAB_SHA,
+    },
+    "bert-base-cased": {
+        "bert_config.json": "f11dfb757bea16339a33e1bf327b0aade6e57fd9c29dc6b84f7ddb20682f48bc",
+        "bert_model.ckpt.data-00000-of-00001": "734d5a1b68bf98d4e9cb6b6692725d00842a1937af73902e51776905d8f760ea",
+        "bert_model.ckpt.index": "517d6ef5c41fc2ca1f595276d6fccf5521810d57f5a74e32616151557790f7b1",
+        "bert_model.ckpt.meta": "5f8a9771ff25dadd61582abb4e3a748215a10a6b55947cbb66d0f0ba1694be98",
+        "vocab.txt": _CASED_VOCAB_SHA,
+    },
+    "bert-large-cased": {
+        "bert_config.json": "7adb2125c8225da495656c982fd1c5f64ba8f20ad020838571a3f8a954c2df57",
+        "bert_model.ckpt.data-00000-of-00001": "6ff33640f40d472f7a16af0c17b1179ca9dcc0373155fb05335b6a4dd1657ef0",
+        "bert_model.ckpt.index": "ef42a53f577fbe07381f4161b13c7cab4f4fc3b167cec6a9ae382c53d18049cf",
+        "bert_model.ckpt.meta": "d2ddff3ed33b80091eac95171e94149736ea74eb645e575d942ec4a5e01a40a1",
+        "vocab.txt": _CASED_VOCAB_SHA,
+    },
 }
 
 
@@ -121,7 +161,24 @@ class WeightsDownloader(Downloader):
     def download(self, model: str = "bert-large-uncased") -> None:
         out = os.path.join(self.output_dir, "weights")
         archive = fetch(WEIGHTS[model], os.path.join(out, f"{model}.zip"))
-        extract_zip(archive, os.path.join(out, model))
+        dest = extract_zip(archive, os.path.join(out, model))
+        self.verify(dest, model)
+
+    @staticmethod
+    def verify(extracted_dir: str, model: str) -> None:
+        """Per-extracted-file SHA256 check (reference :203-216). The archive
+        nests files under its own top-level directory; search for each."""
+        for name, expected in WEIGHTS_SHA.get(model, {}).items():
+            matches = [
+                os.path.join(root, name)
+                for root, _, files in os.walk(extracted_dir)
+                if name in files
+            ]
+            if not matches:
+                raise FileNotFoundError(
+                    f"{name} missing from extracted archive {extracted_dir}")
+            verify_sha256(matches[0], expected)
+            print(f"[download] {matches[0]} verified")
 
 
 DOWNLOADERS = {

@@ -69,6 +69,17 @@ def _target_length(max_num_tokens: int, short_seq_prob: float, rng) -> int:
     return max_num_tokens
 
 
+def _truncate_pair(seq_tokens: List[str], next_seq_tokens: List[str],
+                   budget: int) -> None:
+    """Trim the longer segment from the back until the pair fits (the
+    canonical-BERT truncate_seq_pair role; the chunk may overshoot the
+    target because the closing sentence is included before the flush)."""
+    while len(seq_tokens) + len(next_seq_tokens) > budget:
+        longer = (seq_tokens if len(seq_tokens) >= len(next_seq_tokens)
+                  else next_seq_tokens)
+        longer.pop()
+
+
 def create_samples_from_document(
     document_idx: int,
     documents: List[List[List[str]]],
@@ -77,7 +88,18 @@ def create_samples_from_document(
     short_seq_prob: float,
     rng=random,
 ) -> List[TrainingSample]:
-    """Chunk one document into samples (reference :65-167)."""
+    """Chunk one document into samples (reference :65-167).
+
+    Two deliberate fixes over the reference's loop (which checks the
+    flush condition *before* appending the current sentence,
+    encode_data.py:92-96):
+      - the final sentence of every document is included in the last sample
+        instead of being silently dropped (and 1-sentence documents yield a
+        sample at all);
+      - a flushed chunk holding a single segment forces ``is_random_next``
+        (canonical BERT behavior) instead of emitting a degenerate pair with
+        an empty second segment labeled "actual next".
+    """
     nsp = next_seq_prob > 0
     max_num_tokens = max_seq_len - (3 if nsp else 2)
     target_len = _target_length(max_num_tokens, short_seq_prob, rng)
@@ -89,10 +111,9 @@ def create_samples_from_document(
     i = 0
     while i < len(document):
         current = document[i][:target_len]
-        boundary = len(chunk) >= 1 and (
-            i + 1 == len(document) or chunk_length + len(current) >= target_len
-        )
-        if boundary:
+        chunk.append(current)
+        chunk_length += len(current)
+        if i + 1 == len(document) or chunk_length >= target_len:
             if nsp:
                 if len(documents) <= 1:
                     raise ValueError(
@@ -101,7 +122,7 @@ def create_samples_from_document(
                     )
                 seq_end = rng.randint(1, len(chunk) - 1) if len(chunk) >= 2 else 1
                 seq_tokens = [t for seg in chunk[:seq_end] for t in seg]
-                if rng.random() < next_seq_prob:
+                if len(chunk) == 1 or rng.random() < next_seq_prob:
                     # Random next: fill from a random position in another
                     # document, and rewind i to reuse the displaced segments.
                     is_random_next = True
@@ -110,7 +131,7 @@ def create_samples_from_document(
                         rand_idx = rng.randint(0, len(documents) - 1)
                     rand_doc = documents[rand_idx]
                     rand_start = rng.randint(0, len(rand_doc) - 1)
-                    budget = target_len - len(seq_tokens)
+                    budget = max(1, target_len - len(seq_tokens))
                     next_seq_tokens: List[str] = []
                     for j in range(rand_start, len(rand_doc)):
                         next_seq_tokens.extend(rand_doc[j])
@@ -123,19 +144,16 @@ def create_samples_from_document(
                     next_seq_tokens = [
                         t for seg in chunk[seq_end:] for t in seg
                     ]
+                _truncate_pair(seq_tokens, next_seq_tokens, target_len)
                 samples.append(
                     TrainingSample(seq_tokens, next_seq_tokens, is_random_next)
                 )
             else:
-                seq_tokens = [t for seg in chunk for t in seg]
+                seq_tokens = [t for seg in chunk for t in seg][:target_len]
                 samples.append(TrainingSample(seq_tokens))
             target_len = _target_length(max_num_tokens, short_seq_prob, rng)
             chunk = []
             chunk_length = 0
-
-        current = document[i][:target_len]
-        chunk.append(current)
-        chunk_length += len(current)
         i += 1
     return samples
 

@@ -87,6 +87,14 @@ def _format_books(input_path: str, output_path: str) -> None:
 FORMATTERS = {"wiki": _format_wiki, "books": _format_books}
 
 
+def _run_job(dataset: str, output: str, inputs: list[str]) -> None:
+    """Module-level so mp.Pool can pickle it (workers look the formatter up
+    by dataset name)."""
+    fmt = FORMATTERS[dataset]
+    for ifile in inputs:
+        fmt(ifile, output)
+
+
 def format_corpus(input_files, output_dir: str, dataset: str,
                   num_outputs: int = 16, processes: int = 4) -> list[str]:
     os.makedirs(output_dir, exist_ok=True)
@@ -101,19 +109,14 @@ def format_corpus(input_files, output_dir: str, dataset: str,
     assignment: dict[str, list[str]] = {o: [] for o in outputs}
     for i, f in enumerate(sorted(input_files)):
         assignment[outputs[i % num_outputs]].append(f)
-    fmt = FORMATTERS[dataset]
 
-    def run(output, inputs):
-        for ifile in inputs:
-            fmt(ifile, output)
-
-    jobs = [(o, ins) for o, ins in assignment.items() if ins]
+    jobs = [(dataset, o, ins) for o, ins in assignment.items() if ins]
     if processes <= 1:
         for job in jobs:
-            run(*job)
+            _run_job(*job)
     else:
         with mp.Pool(processes=processes) as pool:
-            pool.starmap(run, jobs)
+            pool.starmap(_run_job, jobs)
     return [o for o, ins in assignment.items() if ins]
 
 

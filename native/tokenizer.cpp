@@ -118,31 +118,40 @@ bool is_cjk(uint32_t cp) {
 // Extended-A. Returns 0 when the character should be dropped (combining
 // marks), the folded codepoint otherwise.
 uint32_t latin_fold(uint32_t cp, bool lower) {
-  if (lower && cp >= 'A' && cp <= 'Z') return cp + 32;
-  if (cp >= 0x0300 && cp <= 0x036F) return 0;  // combining marks
+  // Spec: reference tokenization.py BasicTokenizer — lower() then NFD with
+  // combining marks (category Mn) dropped, applied only in lowercase mode.
   if (!lower) return cp;
-  struct Range { uint32_t lo, hi; char base; };
-  static const Range kFolds[] = {
-      {0x00C0, 0x00C5, 'a'}, {0x00E0, 0x00E5, 'a'},
-      {0x00C8, 0x00CB, 'e'}, {0x00E8, 0x00EB, 'e'},
-      {0x00CC, 0x00CF, 'i'}, {0x00EC, 0x00EF, 'i'},
-      {0x00D2, 0x00D6, 'o'}, {0x00F2, 0x00F6, 'o'},
-      {0x00D9, 0x00DC, 'u'}, {0x00F9, 0x00FC, 'u'},
-      {0x00C7, 0x00C7, 'c'}, {0x00E7, 0x00E7, 'c'},
-      {0x00D1, 0x00D1, 'n'}, {0x00F1, 0x00F1, 'n'},
-      {0x00DD, 0x00DD, 'y'}, {0x00FD, 0x00FD, 'y'}, {0x00FF, 0x00FF, 'y'},
+  if (cp >= 'A' && cp <= 'Z') return cp + 32;
+  if (cp >= 0x0300 && cp <= 0x036F) return 0;  // combining marks (post-NFD)
+  // Exact lower()+NFD+strip-Mn folds for Latin-1 Supplement and Latin
+  // Extended-A, generated from Python unicodedata (the behavioral spec).
+  static const uint16_t kLatin1[64] = {
+      0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x00E6, 0x0063, 0x0065, 0x0065,
+      0x0065, 0x0065, 0x0069, 0x0069, 0x0069, 0x0069, 0x00F0, 0x006E, 0x006F, 0x006F,
+      0x006F, 0x006F, 0x006F, 0x00D7, 0x00F8, 0x0075, 0x0075, 0x0075, 0x0075, 0x0079,
+      0x00FE, 0x00DF, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x00E6, 0x0063,
+      0x0065, 0x0065, 0x0065, 0x0065, 0x0069, 0x0069, 0x0069, 0x0069, 0x00F0, 0x006E,
+      0x006F, 0x006F, 0x006F, 0x006F, 0x006F, 0x00F7, 0x00F8, 0x0075, 0x0075, 0x0075,
+      0x0075, 0x0079, 0x00FE, 0x0079,
   };
-  for (const auto& r : kFolds)
-    if (cp >= r.lo && cp <= r.hi) return static_cast<uint32_t>(r.base);
-  // Latin Extended-A: alternates of base letters; map pairwise blocks.
-  if (cp >= 0x0100 && cp <= 0x017F) {
-    static const char* kExtBase =
-        "aaaaaacccccccccddddeeeeeeeeeegggggggghhhhiiiiiiiiiijjkkklllllllll"
-        "lnnnnnnnnnoooooooorrrrrrsssssssttttttuuuuuuuuuuuuwwyyyzzzzzzs";
-    size_t idx = cp - 0x0100;
-    if (idx < std::strlen(kExtBase)) return static_cast<uint32_t>(kExtBase[idx]);
-  }
-  if (cp >= 0x0391 && cp <= 0x03A9) return cp + 32;  // Greek upper->lower
+  static const uint16_t kExtA[128] = {
+      0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0063, 0x0063, 0x0063, 0x0063,
+      0x0063, 0x0063, 0x0063, 0x0063, 0x0064, 0x0064, 0x0111, 0x0111, 0x0065, 0x0065,
+      0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0067, 0x0067,
+      0x0067, 0x0067, 0x0067, 0x0067, 0x0067, 0x0067, 0x0068, 0x0068, 0x0127, 0x0127,
+      0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0131,
+      0x0133, 0x0133, 0x006A, 0x006A, 0x006B, 0x006B, 0x0138, 0x006C, 0x006C, 0x006C,
+      0x006C, 0x006C, 0x006C, 0x0140, 0x0140, 0x0142, 0x0142, 0x006E, 0x006E, 0x006E,
+      0x006E, 0x006E, 0x006E, 0x0149, 0x014B, 0x014B, 0x006F, 0x006F, 0x006F, 0x006F,
+      0x006F, 0x006F, 0x0153, 0x0153, 0x0072, 0x0072, 0x0072, 0x0072, 0x0072, 0x0072,
+      0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0074, 0x0074,
+      0x0074, 0x0074, 0x0167, 0x0167, 0x0075, 0x0075, 0x0075, 0x0075, 0x0075, 0x0075,
+      0x0075, 0x0075, 0x0075, 0x0075, 0x0075, 0x0075, 0x0077, 0x0077, 0x0079, 0x0079,
+      0x0079, 0x007A, 0x007A, 0x007A, 0x007A, 0x007A, 0x007A, 0x017F,
+  };
+  if (cp >= 0x00C0 && cp <= 0x00FF) return kLatin1[cp - 0x00C0];
+  if (cp >= 0x0100 && cp <= 0x017F) return kExtA[cp - 0x0100];
+  if (cp >= 0x0391 && cp <= 0x03A9 && cp != 0x03A2) return cp + 32;  // Greek
   return cp;
 }
 
@@ -238,19 +247,28 @@ void wordpiece(const Tokenizer& t, const std::string& word,
 // ---------------------------------------------------------------------------
 
 struct TrainerState {
-  // Each word is a sequence of symbols; continuation symbols carry "##".
+  // Global word counts across all input files (one entry per distinct word
+  // — counting per-file and appending would duplicate frequent words N×
+  // and inflate every merge iteration's scan by the same factor).
+  std::unordered_map<std::string, long> counts;
+  // Built from `counts` once at train time: each word as a sequence of
+  // symbols; continuation symbols carry "##".
   std::vector<std::pair<std::vector<std::string>, long>> words;
 };
 
 void trainer_count_file(TrainerState& st, Tokenizer& norm,
                         const std::string& path) {
   std::ifstream in(path);
-  std::unordered_map<std::string, long> counts;
   std::string line;
   while (std::getline(in, line)) {
-    for (const auto& w : basic_tokenize(norm, line)) counts[w] += 1;
+    for (const auto& w : basic_tokenize(norm, line)) st.counts[w] += 1;
   }
-  for (auto& kv : counts) {
+}
+
+void trainer_build_words(TrainerState& st) {
+  st.words.clear();
+  st.words.reserve(st.counts.size());
+  for (auto& kv : st.counts) {
     std::vector<std::string> symbols;
     size_t i = 0;
     bool first = true;
@@ -269,6 +287,7 @@ void trainer_count_file(TrainerState& st, Tokenizer& norm,
 std::vector<std::string> trainer_run(TrainerState& st, size_t vocab_size,
                                      const std::vector<std::string>& specials,
                                      long min_frequency) {
+  trainer_build_words(st);
   // Alphabet first.
   std::map<std::string, long> alphabet;
   for (auto& [symbols, count] : st.words)

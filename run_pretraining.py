@@ -273,10 +273,49 @@ def main(args) -> dict:
                     opt_state=optim.reset_count(state.opt_state, global_step))
                 logger.info(f"Phase switch: optimizer count reset to {global_step}")
 
+        kfac_obj = kfac_state = kfac_shardings = None
+        if args.kfac:
+            # Tapped twin of the model (same params, factor-capture taps on;
+            # reference drives kfac_pytorch hooks at run_pretraining.py:320-355).
+            model_tapped = BertForPreTraining(
+                config, dtype=model.dtype, remat="none",
+                attention_backend=args.attention_backend, kfac_tap=True)
+            apply_loss, tap_shape_fn = pretrain.make_kfac_fns(
+                model_tapped, next_sentence=bool(config.next_sentence),
+                max_pred_per_seq=args.max_predictions_per_seq)
+            kfac_obj = optim.KFAC(
+                apply_loss, tap_shape_fn,
+                factor_decay=args.kfac_stat_decay,
+                damping=args.kfac_damping,
+                kl_clip=args.kfac_kl_clip,
+                skip_layers=tuple(args.kfac_skip_layers))
+            micro_b = args.global_batch_size // args.accumulation_steps
+            sample_mb = {
+                "input_ids": np.zeros((micro_b, seq_len), np.int32),
+                "segment_ids": np.zeros((micro_b, seq_len), np.int32),
+                "input_mask": np.zeros((micro_b, seq_len), np.int32),
+                "masked_lm_labels": np.zeros((micro_b, seq_len), np.int32),
+                "next_sentence_labels": np.zeros((micro_b,), np.int32),
+            }
+            kfac_state = kfac_obj.init(state.params, sample_mb)
+            kfac_shardings = optim.kfac_state_shardings(mesh, kfac_state)
+            if checkpoint is not None and "preconditioner" in checkpoint:
+                kfac_state = ckpt.restore_tree(
+                    kfac_state, checkpoint["preconditioner"])
+                logger.info("Restored K-FAC preconditioner state")
+            kfac_state = jax.device_put(kfac_state, kfac_shardings)
+            logger.info(
+                f"K-FAC enabled: {len(kfac_obj.specs)} layer groups, "
+                f"damping={args.kfac_damping}, kl_clip={args.kfac_kl_clip}, "
+                f"factor_interval={args.kfac_factor_interval}, "
+                f"inv_interval={args.kfac_inv_interval}")
+
         train_step = pretrain.make_train_step(
             model, tx, schedule=schedule,
             next_sentence=bool(config.next_sentence),
-            shardings=shardings, batch_shardings_=b_shardings)
+            shardings=shardings, batch_shardings_=b_shardings,
+            max_pred_per_seq=args.max_predictions_per_seq,
+            kfac=kfac_obj, kfac_shardings=kfac_shardings)
 
         steps_this_run = args.steps or (args.max_steps - global_step)
         steps_this_run = min(steps_this_run, args.max_steps - global_step)
@@ -296,7 +335,21 @@ def main(args) -> dict:
                 batch = pretrain.stack_microbatches(
                     host_batch, args.accumulation_steps)
                 batch = pretrain.put_batch(batch, b_shardings)
-                state, metrics = train_step(state, batch)
+                if kfac_obj is not None:
+                    # kfac_pytorch cadence: factors (EMA) every
+                    # factor_interval steps from the current data, inverses
+                    # every inv_interval steps; both fire on the first step.
+                    if global_step % args.kfac_factor_interval == 0:
+                        mb0 = {k: v[0] for k, v in batch.items()}
+                        kfac_state = kfac_obj.update_factors(
+                            kfac_state, state.params, mb0,
+                            jax.random.fold_in(
+                                jax.random.PRNGKey(args.seed + 17), global_step))
+                    if global_step % args.kfac_inv_interval == 0:
+                        kfac_state = kfac_obj.update_inverses(kfac_state)
+                    state, metrics = train_step(state, batch, kfac_state)
+                else:
+                    state, metrics = train_step(state, batch)
                 global_step += 1
                 step_in_run += 1
                 if step_in_run > 1:  # skip step-0 compile in throughput
@@ -318,12 +371,14 @@ def main(args) -> dict:
 
                 if global_step % args.num_steps_per_checkpoint == 0:
                     save_step = global_step + args.previous_phase_end_step
+                    contents = {"model": state.params,
+                                "optimizer": state.opt_state,
+                                "sampler": sampler.state_dict(),
+                                "epoch": epoch}
+                    if kfac_state is not None:
+                        contents["preconditioner"] = kfac_state
                     ckpt.save_checkpoint(
-                        args.model_output_dir, save_step,
-                        {"model": state.params,
-                         "optimizer": state.opt_state,
-                         "sampler": sampler.state_dict(),
-                         "epoch": epoch},
+                        args.model_output_dir, save_step, contents,
                         keep=args.keep_checkpoints)
                     logger.info(f"Saved checkpoint at step {save_step}")
 
@@ -338,10 +393,12 @@ def main(args) -> dict:
         logger.info(f"training_seq_per_sec = {seq_per_sec:.2f}")
         # Final checkpoint so short runs resume exactly.
         save_step = global_step + args.previous_phase_end_step
+        contents = {"model": state.params, "optimizer": state.opt_state,
+                    "sampler": sampler.state_dict(), "epoch": epoch}
+        if kfac_state is not None:
+            contents["preconditioner"] = kfac_state
         ckpt.save_checkpoint(
-            args.model_output_dir, save_step,
-            {"model": state.params, "optimizer": state.opt_state,
-             "sampler": sampler.state_dict(), "epoch": epoch},
+            args.model_output_dir, save_step, contents,
             keep=args.keep_checkpoints)
         logger.close()
         return {"global_step": global_step,

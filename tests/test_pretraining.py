@@ -145,3 +145,38 @@ def test_masked_position_head_matches_full_head():
     m_loss = pretraining_loss(m_logits, nsp_logits2, glabels, nsp)
     assert m_logits.shape == (B, P, 128)
     np.testing.assert_allclose(float(m_loss), float(full_loss), rtol=1e-5)
+
+
+def test_kfac_end_to_end(workdir):
+    """Runner with --kfac: preconditioned steps, preconditioner in the
+    checkpoint, and resume restoring it (reference run_pretraining.py:320-355,
+    519-520)."""
+    argv = [
+        "--input_dir", workdir["data"],
+        "--output_dir", workdir["out"],
+        "--model_config_file", workdir["model"],
+        "--global_batch_size", "32",
+        "--local_batch_size", "2",
+        "--max_steps", "8",
+        "--steps", "3",
+        "--learning_rate", "1e-3",
+        "--warmup_proportion", "0.25",
+        "--num_steps_per_checkpoint", "100",
+        "--dtype", "float32",
+        "--seed", "7",
+        "--kfac",
+        "--kfac_factor_interval", "1",
+        "--kfac_inv_interval", "2",
+    ]
+    result = run_pretraining.main(run_pretraining.parse_arguments(argv))
+    assert result["global_step"] == 3
+    assert np.isfinite(result["loss"])
+    ckpt_dir = os.path.join(workdir["out"], "pretrain_ckpts")
+    loaded = ckpt.load_checkpoint(ckpt.checkpoint_path(ckpt_dir, 3))
+    assert "preconditioner" in loaded
+    assert int(loaded["preconditioner"]["count"]) == 3
+    # resume picks the preconditioner back up and keeps training
+    result2 = run_pretraining.main(
+        run_pretraining.parse_arguments(argv + ["--steps", "2"]))
+    assert result2["global_step"] == 5
+    assert np.isfinite(result2["loss"])

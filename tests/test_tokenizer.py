@@ -23,6 +23,7 @@ VOCAB = [
     "the", "quick", "brown", "fox", "jump", "##s", "##ed", "##ing",
     "over", "lazy", "dog", "un", "##believ", "##able", "hello", "world",
     "cafe", "resume", "2023", "!", ",", ".", "'", "don", "t", "中", "文",
+    "dvorak", "eric", "##son",
 ]
 
 SENTENCES = [
@@ -30,6 +31,7 @@ SENTENCES = [
     "Hello, world!",
     "unbelievable",
     "Café résumé 2023",          # accents fold away when lowercasing
+    "Dvořák Ēricson Łódź",       # Latin Extended-A folds (ř/Ē/ź; ł kept)
     "don't",
     "hello 中文 world",           # CJK isolation
     "  weird\tspacing\n here ",

@@ -178,3 +178,98 @@ def test_bz2_extraction(tmp_path):
     src.write_bytes(bz2mod.compress(b"wiki dump contents"))
     out = extract_bz2(str(src), str(tmp_path / "x.xml"))
     assert open(out, "rb").read() == b"wiki dump contents"
+
+
+def test_format_multiprocess(tmp_path):
+    """mp.Pool path (the reference's Pool.starmap, format.py:62-63) — job
+    functions must be picklable."""
+    from bert_pytorch_tpu.tools.format import format_corpus
+
+    raw = tmp_path / "raw"
+    raw.mkdir()
+    for i in range(4):
+        (raw / f"b{i}.txt").write_text("one sentence. and another one.")
+    outs = format_corpus(
+        [str(p) for p in raw.iterdir()], str(tmp_path / "fmt"), "books",
+        num_outputs=2, processes=2)
+    assert len(outs) == 2
+    text = "".join(open(o).read() for o in outs)
+    assert "one sentence." in text and "and another one." in text
+
+
+def test_encode_keeps_last_sentence():
+    """The closing sentence of each document lands in a sample, and
+    1-sentence documents produce a sample (deliberate fix over the
+    reference's flush-before-append loop, encode_data.py:92-96)."""
+    from bert_pytorch_tpu.tools.encode_data import create_samples_from_document
+
+    rng = random.Random(0)
+    docs = [
+        [["alpha", "beta"], ["gamma", "delta"], ["FINAL", "WORD"]],
+        [["other", "doc", "filler"]],
+    ]
+    all_tokens = set()
+    for _ in range(20):  # over rng draws
+        for sample in create_samples_from_document(
+                0, docs, 16, next_seq_prob=0.5, short_seq_prob=0.0, rng=rng):
+            all_tokens.update(sample.sequence)
+    assert "FINAL" in all_tokens and "WORD" in all_tokens
+
+    single = create_samples_from_document(
+        1, docs, 16, next_seq_prob=0.5, short_seq_prob=0.0, rng=rng)
+    assert single, "single-sentence document must yield a sample"
+
+
+def test_encode_single_segment_chunk_forces_random_next():
+    """A 1-segment chunk cannot provide an 'actual next' pair — canonical
+    BERT forces is_random_next (no empty-segment-B samples)."""
+    from bert_pytorch_tpu.tools.encode_data import create_samples_from_document
+
+    rng = random.Random(1)
+    docs = [
+        [["a"] * 20],  # one long sentence: every chunk is single-segment
+        [["rand", "next", "tokens"]],
+    ]
+    for _ in range(10):
+        for sample in create_samples_from_document(
+                0, docs, 16, next_seq_prob=0.5, short_seq_prob=0.0, rng=rng):
+            assert sample.is_random_next
+            assert sample.next_seq_tokens, "segment B must be non-empty"
+
+
+def test_encode_samples_respect_max_seq_len():
+    from bert_pytorch_tpu.tools.encode_data import create_samples_from_document
+
+    rng = random.Random(2)
+    docs = [
+        [["w%d" % i for i in range(j, j + 9)] for j in range(0, 90, 9)],
+        [["other", "document"]],
+    ]
+    for _ in range(10):
+        for sample in create_samples_from_document(
+                0, docs, 24, next_seq_prob=0.5, short_seq_prob=0.3, rng=rng):
+            assert len(sample.sequence) <= 24
+
+
+def test_weights_sha_verify(tmp_path):
+    """WeightsDownloader.verify checks extracted files against the SHA table
+    (reference utils/download.py:203-216)."""
+    from bert_pytorch_tpu.tools import download
+
+    d = tmp_path / "model" / "nested"
+    d.mkdir(parents=True)
+    (d / "bert_config.json").write_bytes(b"fake config")
+    sha = download.sha256_file(str(d / "bert_config.json"))
+    download.WEIGHTS_SHA["__test__"] = {"bert_config.json": sha}
+    try:
+        download.WeightsDownloader.verify(str(tmp_path / "model"), "__test__")
+        download.WEIGHTS_SHA["__test__"] = {"bert_config.json": "0" * 64}
+        with pytest.raises(ValueError, match="SHA256 mismatch"):
+            download.WeightsDownloader.verify(
+                str(tmp_path / "model"), "__test__")
+        with pytest.raises(FileNotFoundError):
+            download.WEIGHTS_SHA["__test__"] = {"missing.bin": sha}
+            download.WeightsDownloader.verify(
+                str(tmp_path / "model"), "__test__")
+    finally:
+        del download.WEIGHTS_SHA["__test__"]
