@@ -46,6 +46,28 @@ def dot_product_attention(
         from bert_pytorch_tpu.ops.pallas.attention import flash_attention
 
         return flash_attention(q, k, v, bias=bias)
+    if backend == "ring":
+        # Context parallelism: sequence sharded over the mesh 'seq' axis
+        # with K/V ring rotation (ops/ring.py). Falls back to dense when no
+        # seq sharding is active (e.g. single-device tests of an sp model).
+        from bert_pytorch_tpu.ops.ring import ring_attention
+        from bert_pytorch_tpu.parallel.mesh import current_mesh
+
+        mesh = current_mesh()
+        if mesh is not None and mesh.shape.get("seq", 1) > 1:
+            if q.shape[1] % mesh.shape["seq"] != 0:
+                # Silently densifying here would materialize the O(S²)
+                # scores exactly in the long-context regime ring exists for.
+                raise ValueError(
+                    f"backend='ring': sequence length {q.shape[1]} is not "
+                    f"divisible by the mesh 'seq' axis ({mesh.shape['seq']}); "
+                    "pad the sequence or resize the mesh")
+            return ring_attention(
+                q, k, v, bias=bias,
+                dropout_rng=None if deterministic else dropout_rng,
+                dropout_rate=0.0 if deterministic else dropout_rate,
+                mesh=mesh,
+            )
 
     depth = q.shape[-1]
     scale = 1.0 / jnp.sqrt(depth).astype(q.dtype)

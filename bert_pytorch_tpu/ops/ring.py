@@ -1,0 +1,166 @@
+"""Ring attention — context parallelism over the mesh's ``seq`` axis.
+
+The reference has no long-context support at all (SURVEY.md §5.7: plain
+O(S²) dense attention, seq<=512). This op makes sequence length a sharded
+dimension: each device holds an S/n slice of Q, K and V; K/V blocks rotate
+around the ring with ``lax.ppermute`` while each device accumulates its
+queries' attention with a numerically stable running softmax
+(flash-attention-style m/num/den carry). Communication is nearest-neighbor
+over ICI and overlaps with the block matmuls, so attention memory and
+per-device compute scale as S/n with no S² materialization anywhere.
+
+Usage: ``dot_product_attention(..., backend='ring')`` inside a
+``with mesh:`` context whose mesh has a ``seq`` axis > 1 (see
+``parallel.MeshConfig(seq=n)`` and the 'sp' strategy rules). Falls back to
+the dense XLA path when no sequence sharding is active — the same
+fused-or-fallback policy as the Pallas kernels (reference modeling.py's
+Apex-or-Python pattern at :327-335).
+
+Attention-probability dropout follows the dense semantics: probabilities
+are dropped *after* softmax normalization, which in the streaming form
+means the numerator accumulates dropped p while the denominator accumulates
+the full p. Each (device, ring-step) block gets an independent rng stream.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _ring_shard(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    kbias: jnp.ndarray,
+    rng: Optional[jnp.ndarray],
+    *,
+    axis_name: str,
+    rng_axes: tuple = (),
+    dropout_rate: float = 0.0,
+) -> jnp.ndarray:
+    """Per-shard body (runs inside shard_map).
+
+    q/k/v: [B, S_local, H, D]; kbias: [B, S_local] additive key bias.
+    ``rng_axes`` are the other mesh axes the inputs are sharded over —
+    folded into the dropout stream so every (batch shard, head shard,
+    q shard, k block) draws an independent mask.
+    """
+    n = jax.lax.psum(1, axis_name)
+    batch, s_q, heads, depth = q.shape
+    scale = 1.0 / jnp.sqrt(depth).astype(q.dtype)
+    qs = q * scale
+
+    if dropout_rate > 0.0 and rng is not None:
+        rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
+        for ax in rng_axes:
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
+
+    def block(k, v, kb, m, num, den, step):
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qs, k).astype(jnp.float32)
+        scores = scores + kb[:, None, None, :].astype(jnp.float32)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)  # 0 on the first block (m = -inf)
+        if dropout_rate > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(rng, step), 1.0 - dropout_rate, p.shape
+            )
+            p_num = p * keep.astype(p.dtype) / (1.0 - dropout_rate)
+        else:
+            p_num = p
+        blk = jnp.einsum(
+            "bhqk,bkhd->bqhd", p_num.astype(v.dtype), v
+        ).astype(jnp.float32)
+        num = num * corr.transpose(0, 2, 1)[..., None] + blk
+        den = den * corr + p.sum(axis=-1)
+        return m_new, num, den
+
+    m0 = jnp.full((batch, heads, s_q), -jnp.inf, jnp.float32)
+    den0 = jnp.zeros((batch, heads, s_q), jnp.float32)
+    num0 = jnp.zeros((batch, s_q, heads, depth), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Local block first, then n-1 rotate-and-accumulate steps (no wasted
+    # final rotation).
+    m, num, den = block(k, v, kbias, m0, num0, den0, 0)
+
+    def body(carry, step):
+        k, v, kb, m, num, den = carry
+        k, v, kb = jax.lax.ppermute((k, v, kb), axis_name, perm)
+        m, num, den = block(k, v, kb, m, num, den, step)
+        return (k, v, kb, m, num, den), None
+
+    (_, _, _, m, num, den), _ = jax.lax.scan(
+        jax.checkpoint(body), (k, v, kbias, m, num, den), jnp.arange(1, n)
+    )
+    out = num / den.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    dropout_rng=None,
+    dropout_rate: float = 0.0,
+    mesh=None,
+    seq_axis: str = "seq",
+    batch_axes=("data", "fsdp"),
+    heads_axis: str = "model",
+) -> jnp.ndarray:
+    """Sequence-sharded attention over global [B, S, H, D] tensors.
+
+    ``bias`` is the [B, 1, 1, S] (or [B, S]) additive key mask from
+    :func:`make_attention_bias`. Requires an ambient (or explicit) mesh with
+    ``seq_axis`` size > 1; S must divide by that size.
+    """
+    from bert_pytorch_tpu.parallel.mesh import current_mesh
+
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None or mesh.shape.get(seq_axis, 1) <= 1:
+        raise ValueError(
+            "ring attention needs an active mesh with a "
+            f"'{seq_axis}' axis > 1 (got {None if mesh is None else dict(mesh.shape)})"
+        )
+    batch, seq, heads, _ = q.shape
+    if seq % mesh.shape[seq_axis] != 0:
+        raise ValueError(
+            f"sequence length {seq} not divisible by mesh "
+            f"'{seq_axis}' axis {mesh.shape[seq_axis]}"
+        )
+    if bias is None:
+        kbias = jnp.zeros((batch, seq), jnp.float32)
+    else:
+        kbias = bias.reshape(batch, seq).astype(jnp.float32)
+
+    # Shard batch/heads only when they divide (model init traces at batch 1;
+    # replication there is free — it never runs real data).
+    n_batch = 1
+    for ax in batch_axes:
+        n_batch *= mesh.shape.get(ax, 1)
+    b_spec = batch_axes if n_batch > 1 and batch % n_batch == 0 else None
+    h_spec = (heads_axis
+              if heads % mesh.shape.get(heads_axis, 1) == 0 else None)
+
+    rng_axes = tuple(batch_axes) if b_spec is not None else ()
+    if h_spec is not None and mesh.shape.get(heads_axis, 1) > 1:
+        rng_axes = rng_axes + (heads_axis,)
+
+    qkv_spec = P(b_spec, seq_axis, h_spec, None)
+    fn = jax.shard_map(
+        functools.partial(
+            _ring_shard, axis_name=seq_axis, rng_axes=rng_axes,
+            dropout_rate=dropout_rate
+        ),
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(b_spec, seq_axis), P()),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kbias, dropout_rng)

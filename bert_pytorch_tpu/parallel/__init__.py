@@ -23,6 +23,7 @@ These compose: a mesh may use several axes at once.
 from bert_pytorch_tpu.parallel.mesh import (
     MeshConfig,
     create_mesh,
+    current_mesh,
     logical_axis_rules,
 )
 from bert_pytorch_tpu.parallel.sharding import (
@@ -35,6 +36,7 @@ from bert_pytorch_tpu.parallel.sharding import (
 __all__ = [
     "MeshConfig",
     "create_mesh",
+    "current_mesh",
     "logical_axis_rules",
     "batch_sharding",
     "mesh_sharding",

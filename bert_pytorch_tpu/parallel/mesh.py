@@ -131,3 +131,24 @@ def logical_axis_rules(strategy: str = "dp") -> list[tuple]:
             f"unknown strategy '{strategy}'; options: {sorted(_STRATEGY_RULES)}"
         )
     return _BASE_RULES + _STRATEGY_RULES[strategy]
+
+
+def current_mesh() -> Optional[Mesh]:
+    """The active mesh, from either the new ``jax.set_mesh``/``use_mesh``
+    context or the legacy ``with mesh:`` context used throughout this
+    codebase; None if neither is set."""
+    try:
+        m = jax.sharding.get_mesh()
+        if m is not None and getattr(m, "axis_names", ()):  # non-empty
+            return m
+    except Exception:
+        pass
+    try:
+        from jax._src.mesh import thread_resources
+
+        pm = thread_resources.env.physical_mesh
+        if not pm.empty:
+            return pm
+    except Exception:
+        pass
+    return None

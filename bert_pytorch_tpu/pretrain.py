@@ -58,12 +58,16 @@ def state_shardings(mesh: Mesh, model, rules, sample_inputs) -> TrainState:
     )
 
 
-def batch_shardings(mesh: Mesh, batch_spec: dict) -> dict:
+def batch_shardings(mesh: Mesh, batch_spec: dict, seq_sharded: bool = False) -> dict:
     """Shardings for the [A, B, ...] stacked microbatch dict: accumulation
-    axis replicated (scanned), batch axis sharded over data(+fsdp)."""
+    axis replicated (scanned), batch axis sharded over data(+fsdp), and —
+    under context parallelism (``seq_sharded``) — the sequence axis of
+    [A, B, S] entries sharded over the mesh 'seq' axis."""
     out = {}
     for key, ndim in batch_spec.items():
         spec = [None, ("data", "fsdp")] + [None] * (ndim - 2)
+        if seq_sharded and ndim == 3:
+            spec[2] = "seq"
         out[key] = NamedSharding(mesh, P(*spec))
     return out
 

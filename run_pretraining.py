@@ -74,7 +74,7 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                         choices=["bfloat16", "float32"])
     parser.add_argument("--checkpoint_activations", action="store_true")
     parser.add_argument("--attention_backend", type=str, default="xla",
-                        choices=["xla", "pallas"])
+                        choices=["xla", "pallas", "ring"])
     # optimizer
     parser.add_argument("--optimizer", type=str, default="lamb",
                         choices=["lamb", "adamw"])
@@ -252,7 +252,8 @@ def main(args) -> dict:
         shardings = pretrain.state_shardings(mesh, model, rules, sample)
         b_shardings = pretrain.batch_shardings(
             mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
-                   "masked_lm_labels": 3, "next_sentence_labels": 2})
+                   "masked_lm_labels": 3, "next_sentence_labels": 2},
+            seq_sharded=(args.parallel_strategy == "sp" and mesh.shape["seq"] > 1))
         init_fn = pretrain.make_init_fn(model, tx, sample, shardings)
         state = init_fn(jax.random.PRNGKey(args.seed))
 

@@ -1,0 +1,200 @@
+"""Ring attention / context parallelism tests (ops/ring.py).
+
+The reference has no long-context support (SURVEY.md §5.7); these tests pin
+the sequence-parallel design the TPU framework adds: ring attention must be
+numerically identical to dense attention (forward and gradients), compose
+with the model, and train end-to-end on an 'sp' mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu import optim, pretrain
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.models import BertForPreTraining
+from bert_pytorch_tpu.ops.attention import dot_product_attention, make_attention_bias
+from bert_pytorch_tpu.ops.ring import ring_attention
+from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    B, S, H, D = 4, 32, 4, 8
+    mk = lambda: jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    mask = np.ones((B, S), np.int32)
+    mask[:, -5:] = 0  # padding tail
+    return mk(), mk(), mk(), make_attention_bias(jnp.asarray(mask))
+
+
+def test_ring_matches_dense_forward(qkv, devices):
+    q, k, v, bias = qkv
+    dense = dot_product_attention(q, k, v, bias=bias)
+    mesh = create_mesh(MeshConfig(data=2, seq=4))
+    with mesh:
+        ring = jax.jit(lambda *a: ring_attention(*a, bias=bias))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.asarray(dense), rtol=2e-5, atol=2e-6)
+
+
+def test_ring_matches_dense_grads(qkv, devices):
+    q, k, v, bias = qkv
+    mesh = create_mesh(MeshConfig(seq=8))
+
+    def loss_d(q, k, v):
+        return (dot_product_attention(q, k, v, bias=bias) ** 2).sum()
+
+    def loss_r(q, k, v):
+        return (ring_attention(q, k, v, bias=bias) ** 2).sum()
+
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    with mesh:
+        gr = jax.jit(jax.grad(loss_r, argnums=(0, 1, 2)))(q, k, v)
+    for a, b in zip(gd, gr):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-5, atol=5e-6)
+
+
+def test_ring_backend_falls_back_without_seq_axis(qkv, devices):
+    """backend='ring' on a seq=1 mesh silently uses the dense path — the
+    fused-or-fallback policy (reference modeling.py:327-335 analog)."""
+    q, k, v, bias = qkv
+    dense = dot_product_attention(q, k, v, bias=bias)
+    with create_mesh(MeshConfig(data=-1)):
+        out = dot_product_attention(q, k, v, bias=bias, backend="ring")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(dense), rtol=1e-6)
+
+
+def test_ring_dropout_statistics(qkv, devices):
+    """Dropped-prob semantics match dense attention dropout: output mean is
+    preserved (unbiased), and deterministic mode ignores the rng."""
+    q, k, v, bias = qkv
+    mesh = create_mesh(MeshConfig(seq=4, data=2))
+    dense = dot_product_attention(q, k, v, bias=bias)
+    with mesh:
+        outs = []
+        for i in range(16):
+            outs.append(np.asarray(jax.jit(
+                lambda q, k, v, r: ring_attention(
+                    q, k, v, bias=bias, dropout_rng=r, dropout_rate=0.1)
+            )(q, k, v, jax.random.PRNGKey(i))))
+        avg = np.mean(outs, axis=0)
+    # dropout is unbiased; with 16 samples the mean is loosely close
+    np.testing.assert_allclose(avg, np.asarray(dense), rtol=0.5, atol=0.15)
+    assert not np.allclose(outs[0], np.asarray(dense))
+
+
+def test_model_forward_ring_vs_xla(tiny_config, devices):
+    """Full BertForPreTraining forward identical under the ring backend."""
+    model_x = BertForPreTraining(tiny_config, dtype=jnp.float32)
+    model_r = BertForPreTraining(
+        tiny_config, dtype=jnp.float32, attention_backend="ring")
+    rng = np.random.default_rng(1)
+    B, S = 8, 32
+    ids = jnp.asarray(rng.integers(0, tiny_config.vocab_size, (B, S)), jnp.int32)
+    types = jnp.zeros((B, S), jnp.int32)
+    mask = jnp.asarray((rng.random((B, S)) < 0.9).astype(np.int32))
+    variables = model_x.init(jax.random.PRNGKey(0), ids, types, mask)
+    mlm_x, nsp_x = model_x.apply(variables, ids, types, mask)
+    mesh = create_mesh(MeshConfig(data=2, seq=4))
+    with mesh:
+        mlm_r, nsp_r = jax.jit(
+            lambda v, a, b, c: model_r.apply(v, a, b, c))(variables, ids, types, mask)
+    np.testing.assert_allclose(
+        np.asarray(mlm_r), np.asarray(mlm_x), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(nsp_r), np.asarray(nsp_x), rtol=2e-4, atol=2e-4)
+
+
+def test_train_step_sp_strategy(tiny_config, devices):
+    """End-to-end sharded train step on an sp mesh (seq-sharded batch +
+    ring attention): runs, loss finite and decreasing."""
+    model = BertForPreTraining(
+        tiny_config, dtype=jnp.float32, attention_backend="ring")
+    mesh = create_mesh(MeshConfig(data=2, seq=4))
+    rules = logical_axis_rules("sp")
+    schedule = optim.warmup_poly_schedule(1e-3, 0.1, 100)
+    tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+    S = 32
+    sample = (jnp.zeros((1, S), jnp.int32),) * 3
+    rng = np.random.default_rng(2)
+    B = 8
+    host = {
+        "input_ids": rng.integers(
+            0, tiny_config.vocab_size, (B, S)).astype(np.int32),
+        "segment_ids": np.zeros((B, S), np.int32),
+        "input_mask": np.ones((B, S), np.int32),
+        "masked_lm_labels": np.where(
+            rng.random((B, S)) < 0.15,
+            rng.integers(0, tiny_config.vocab_size, (B, S)), -1).astype(np.int32),
+        "next_sentence_labels": rng.integers(0, 2, (B,)).astype(np.int32),
+    }
+    with mesh:
+        shardings = pretrain.state_shardings(mesh, model, rules, sample)
+        b_shardings = pretrain.batch_shardings(
+            mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+                   "masked_lm_labels": 3, "next_sentence_labels": 2},
+            seq_sharded=True)
+        state = pretrain.make_init_fn(model, tx, sample, shardings)(
+            jax.random.PRNGKey(0))
+        step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            shardings=shardings, batch_shardings_=b_shardings)
+        batch = pretrain.put_batch(
+            pretrain.stack_microbatches(host, 1), b_shardings)
+        losses = []
+        for _ in range(4):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+def test_long_sequence_beyond_reference(devices):
+    """Sequence length past the reference's 512 ceiling (its
+    max_position_embeddings bound, SURVEY §5.7) — the point of CP."""
+    config = BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=2048, next_sentence=False)
+    model = BertForPreTraining(
+        config, dtype=jnp.float32, attention_backend="ring")
+    rng = np.random.default_rng(3)
+    B, S = 2, 2048
+    ids = jnp.asarray(rng.integers(0, 128, (B, S)), jnp.int32)
+    mask = jnp.ones((B, S), jnp.int32)
+    mesh = create_mesh(MeshConfig(seq=8))
+    with mesh:
+        variables = model.init(jax.random.PRNGKey(0), ids, None, mask)
+        mlm, _ = jax.jit(
+            lambda v, a, b: model.apply(v, a, None, b))(variables, ids, mask)
+    assert mlm.shape == (B, S, 128)
+    assert bool(jnp.isfinite(mlm).all())
+
+
+def test_ring_raises_on_nondivisible_seq(devices):
+    """Active seq mesh + non-divisible S must error, not silently densify."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((2, 30, 2, 4)), jnp.float32)
+    with create_mesh(MeshConfig(seq=4, data=2)):
+        with pytest.raises(ValueError, match="not.*divisible|divisible"):
+            dot_product_attention(q, q, q, backend="ring")
+
+
+def test_ring_dropout_decorrelated_across_batch_shards(devices):
+    """Each data shard's dropout mask must differ (the dense path gives every
+    batch element independent noise; sharding must not correlate it)."""
+    rng = np.random.default_rng(5)
+    B, S, H, D = 4, 16, 2, 4
+    # identical rows: without dropout all outputs equal; with dropout,
+    # correlated masks across batch shards would keep shard outputs equal.
+    row = rng.standard_normal((1, S, H, D))
+    q = jnp.asarray(np.repeat(row, B, axis=0), jnp.float32)
+    with create_mesh(MeshConfig(seq=4, data=2)):
+        out = jax.jit(lambda q, r: ring_attention(
+            q, q, q, dropout_rng=r, dropout_rate=0.3))(q, jax.random.PRNGKey(0))
+    first_shard = np.asarray(out)[:2]
+    second_shard = np.asarray(out)[2:]
+    assert not np.allclose(first_shard, second_shard)
