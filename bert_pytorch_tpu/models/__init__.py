@@ -30,6 +30,15 @@ from bert_pytorch_tpu.models.bert import (
     LayerNorm,
     LinearActivation,
 )
+from bert_pytorch_tpu.models.convert import (
+    convert_torch_state_dict,
+    export_torch_state_dict,
+    from_pretrained,
+    is_foreign_checkpoint,
+    load_encoder_params,
+    load_tf_checkpoint,
+    merge_params,
+)
 from bert_pytorch_tpu.models.losses import (
     masked_lm_loss,
     next_sentence_loss,
@@ -53,6 +62,13 @@ __all__ = [
     "BertPooler",
     "LayerNorm",
     "LinearActivation",
+    "convert_torch_state_dict",
+    "export_torch_state_dict",
+    "from_pretrained",
+    "is_foreign_checkpoint",
+    "load_encoder_params",
+    "load_tf_checkpoint",
+    "merge_params",
     "masked_lm_loss",
     "next_sentence_loss",
     "pretraining_loss",

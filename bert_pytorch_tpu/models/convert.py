@@ -1,0 +1,381 @@
+"""Pretrained-weight import/export: torch / HF / TF checkpoints ↔ our params.
+
+Capability parity with the reference's weight-loading stack:
+  - ``BertPreTrainedModel.from_pretrained`` (modeling.py:659-799): load a
+    pretrained archive directory (config + weights) into a model;
+  - ``load_tf_weights_in_bert`` (modeling.py:58-116): import Google BERT
+    TensorFlow checkpoints (the archives WeightsDownloader fetches).
+
+Layout notes. Torch linear weights are [out, in]; flax kernels are
+[in, out] (TF convention), so torch weights transpose on the way in. Our
+encoder is a single ``nn.scan`` stack, so L per-layer tensors become one
+(L, ...) array; attention projections are DenseGeneral kernels of shape
+(H, heads, head_dim) / (heads, head_dim, H). When the target vocab is
+padded (MXU %8 padding, run_pretraining.py:157), word embeddings and the
+prediction bias are zero-padded to match.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from bert_pytorch_tpu.config import BertConfig
+
+
+def _np(x) -> np.ndarray:
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _get(sd: Dict[str, np.ndarray], *names: str) -> np.ndarray:
+    """First match among naming variants (dense_act vs dense,
+    LayerNorm.weight vs LayerNorm.gamma, ...)."""
+    for name in names:
+        if name in sd:
+            return _np(sd[name])
+    raise KeyError(f"none of {names} found in state dict")
+
+
+def _layer_norm(sd, prefix: str) -> dict:
+    return {
+        "scale": _get(sd, f"{prefix}.weight", f"{prefix}.gamma"),
+        "bias": _get(sd, f"{prefix}.bias", f"{prefix}.beta"),
+    }
+
+
+def _pad_vocab(arr: np.ndarray, vocab_size: int) -> np.ndarray:
+    """Zero-pad the vocab (leading) dimension up to ``vocab_size``."""
+    if arr.shape[0] == vocab_size:
+        return arr
+    if arr.shape[0] > vocab_size:
+        raise ValueError(
+            f"checkpoint vocab {arr.shape[0]} larger than config vocab "
+            f"{vocab_size}")
+    pad = [(0, vocab_size - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def convert_torch_state_dict(
+    state_dict: Dict, config: BertConfig
+) -> Dict[str, dict]:
+    """Reference/HF torch BERT state dict -> our flax params tree.
+
+    Accepts the naming of reference src/modeling.py (``dense_act``,
+    gamma/beta LayerNorms) and of HF transformers (``dense``,
+    weight/bias LayerNorms). Heads not present in the checkpoint
+    (e.g. loading a bare ``BertModel`` into ``BertForPreTraining``) are
+    simply absent from the result — merge over freshly initialized params
+    with :func:`merge_params`.
+    """
+    sd = {k[7:] if k.startswith("module.") else k: v
+          for k, v in state_dict.items()}
+    hidden = config.hidden_size
+    heads = config.num_attention_heads
+    head_dim = config.head_dim
+    n_layers = config.num_hidden_layers
+
+    def qkv_kernel(i, name):
+        w = _get(sd, f"bert.encoder.layer.{i}.attention.self.{name}.weight")
+        return w.T.reshape(hidden, heads, head_dim)
+
+    def qkv_bias(i, name):
+        return _get(
+            sd, f"bert.encoder.layer.{i}.attention.self.{name}.bias"
+        ).reshape(heads, head_dim)
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(n_layers)])
+
+    layers = {
+        "attention": {
+            "query": {"kernel": stack(lambda i: qkv_kernel(i, "query")),
+                      "bias": stack(lambda i: qkv_bias(i, "query"))},
+            "key": {"kernel": stack(lambda i: qkv_kernel(i, "key")),
+                    "bias": stack(lambda i: qkv_bias(i, "key"))},
+            "value": {"kernel": stack(lambda i: qkv_kernel(i, "value")),
+                      "bias": stack(lambda i: qkv_bias(i, "value"))},
+            "output": {
+                "kernel": stack(lambda i: _get(
+                    sd, f"bert.encoder.layer.{i}.attention.output.dense.weight"
+                ).T.reshape(heads, head_dim, hidden)),
+                "bias": stack(lambda i: _get(
+                    sd, f"bert.encoder.layer.{i}.attention.output.dense.bias")),
+            },
+            "output_layer_norm": {
+                k: stack(lambda i, k=k: _layer_norm(
+                    sd, f"bert.encoder.layer.{i}.attention.output.LayerNorm")[k])
+                for k in ("scale", "bias")
+            },
+        },
+        "intermediate": {"dense": {
+            "kernel": stack(lambda i: _get(
+                sd, f"bert.encoder.layer.{i}.intermediate.dense_act.weight",
+                f"bert.encoder.layer.{i}.intermediate.dense.weight").T),
+            "bias": stack(lambda i: _get(
+                sd, f"bert.encoder.layer.{i}.intermediate.dense_act.bias",
+                f"bert.encoder.layer.{i}.intermediate.dense.bias")),
+        }},
+        "output": {
+            "kernel": stack(lambda i: _get(
+                sd, f"bert.encoder.layer.{i}.output.dense.weight").T),
+            "bias": stack(lambda i: _get(
+                sd, f"bert.encoder.layer.{i}.output.dense.bias")),
+        },
+        "output_layer_norm": {
+            k: stack(lambda i, k=k: _layer_norm(
+                sd, f"bert.encoder.layer.{i}.output.LayerNorm")[k])
+            for k in ("scale", "bias")
+        },
+    }
+
+    embeddings = {
+        "word_embeddings": {"embedding": _pad_vocab(
+            _get(sd, "bert.embeddings.word_embeddings.weight"),
+            config.vocab_size)},
+        "position_embeddings": {"embedding": _get(
+            sd, "bert.embeddings.position_embeddings.weight")},
+        "layer_norm": _layer_norm(sd, "bert.embeddings.LayerNorm"),
+    }
+    if config.next_sentence and "bert.embeddings.token_type_embeddings.weight" in sd:
+        embeddings["token_type_embeddings"] = {"embedding": _get(
+            sd, "bert.embeddings.token_type_embeddings.weight")}
+
+    bert = {"embeddings": embeddings, "encoder": {"layers": layers}}
+    if "bert.pooler.dense_act.weight" in sd or "bert.pooler.dense.weight" in sd:
+        bert["pooler"] = {"dense_act": {"dense": {
+            "kernel": _get(sd, "bert.pooler.dense_act.weight",
+                           "bert.pooler.dense.weight").T,
+            "bias": _get(sd, "bert.pooler.dense_act.bias",
+                         "bert.pooler.dense.bias"),
+        }}}
+
+    params: Dict[str, dict] = {"bert": bert}
+    if "cls.predictions.bias" in sd:
+        params["predictions"] = {
+            "bias": _pad_vocab(_get(sd, "cls.predictions.bias"),
+                               config.vocab_size),
+            "transform": {
+                "dense_act": {"dense": {
+                    "kernel": _get(
+                        sd, "cls.predictions.transform.dense_act.weight",
+                        "cls.predictions.transform.dense.weight").T,
+                    "bias": _get(
+                        sd, "cls.predictions.transform.dense_act.bias",
+                        "cls.predictions.transform.dense.bias"),
+                }},
+                "layer_norm": _layer_norm(
+                    sd, "cls.predictions.transform.LayerNorm"),
+            },
+        }
+    if "cls.seq_relationship.weight" in sd:
+        params["seq_relationship"] = {
+            "kernel": _get(sd, "cls.seq_relationship.weight").T,
+            "bias": _get(sd, "cls.seq_relationship.bias"),
+        }
+    return params
+
+
+def export_torch_state_dict(params, config: BertConfig) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`convert_torch_state_dict` (HF naming) — interop out:
+    hand a model pretrained here to any torch/HF consumer."""
+    p = {k: np.asarray(v) for k, v in _flatten(params).items()}
+    hidden = config.hidden_size
+    sd: Dict[str, np.ndarray] = {}
+
+    def put(name, arr):
+        sd[name] = np.asarray(arr)
+
+    emb = "bert/embeddings"
+    put("bert.embeddings.word_embeddings.weight",
+        p[f"{emb}/word_embeddings/embedding"])
+    put("bert.embeddings.position_embeddings.weight",
+        p[f"{emb}/position_embeddings/embedding"])
+    if f"{emb}/token_type_embeddings/embedding" in p:
+        put("bert.embeddings.token_type_embeddings.weight",
+            p[f"{emb}/token_type_embeddings/embedding"])
+    put("bert.embeddings.LayerNorm.weight", p[f"{emb}/layer_norm/scale"])
+    put("bert.embeddings.LayerNorm.bias", p[f"{emb}/layer_norm/bias"])
+
+    enc = "bert/encoder/layers"
+    n_layers = config.num_hidden_layers
+    for i in range(n_layers):
+        pre = f"bert.encoder.layer.{i}"
+        for name in ("query", "key", "value"):
+            put(f"{pre}.attention.self.{name}.weight",
+                p[f"{enc}/attention/{name}/kernel"][i].reshape(hidden, -1).T)
+            put(f"{pre}.attention.self.{name}.bias",
+                p[f"{enc}/attention/{name}/bias"][i].reshape(-1))
+        put(f"{pre}.attention.output.dense.weight",
+            p[f"{enc}/attention/output/kernel"][i].reshape(-1, hidden).T)
+        put(f"{pre}.attention.output.dense.bias",
+            p[f"{enc}/attention/output/bias"][i])
+        put(f"{pre}.attention.output.LayerNorm.weight",
+            p[f"{enc}/attention/output_layer_norm/scale"][i])
+        put(f"{pre}.attention.output.LayerNorm.bias",
+            p[f"{enc}/attention/output_layer_norm/bias"][i])
+        put(f"{pre}.intermediate.dense.weight",
+            p[f"{enc}/intermediate/dense/kernel"][i].T)
+        put(f"{pre}.intermediate.dense.bias",
+            p[f"{enc}/intermediate/dense/bias"][i])
+        put(f"{pre}.output.dense.weight", p[f"{enc}/output/kernel"][i].T)
+        put(f"{pre}.output.dense.bias", p[f"{enc}/output/bias"][i])
+        put(f"{pre}.output.LayerNorm.weight",
+            p[f"{enc}/output_layer_norm/scale"][i])
+        put(f"{pre}.output.LayerNorm.bias",
+            p[f"{enc}/output_layer_norm/bias"][i])
+
+    if "bert/pooler/dense_act/dense/kernel" in p:
+        put("bert.pooler.dense.weight", p["bert/pooler/dense_act/dense/kernel"].T)
+        put("bert.pooler.dense.bias", p["bert/pooler/dense_act/dense/bias"])
+    if "predictions/bias" in p:
+        put("cls.predictions.bias", p["predictions/bias"])
+        put("cls.predictions.transform.dense.weight",
+            p["predictions/transform/dense_act/dense/kernel"].T)
+        put("cls.predictions.transform.dense.bias",
+            p["predictions/transform/dense_act/dense/bias"])
+        put("cls.predictions.transform.LayerNorm.weight",
+            p["predictions/transform/layer_norm/scale"])
+        put("cls.predictions.transform.LayerNorm.bias",
+            p["predictions/transform/layer_norm/bias"])
+        # tied decoder, exported for consumers that expect it
+        put("cls.predictions.decoder.weight",
+            p["bert/embeddings/word_embeddings/embedding"])
+    if "seq_relationship/kernel" in p:
+        put("cls.seq_relationship.weight", p["seq_relationship/kernel"].T)
+        put("cls.seq_relationship.bias", p["seq_relationship/bias"])
+    return sd
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    for key, value in tree.items():
+        path = f"{prefix}/{key}" if prefix else key
+        if isinstance(value, dict):
+            out.update(_flatten(value, path))
+        else:
+            out[path] = value
+    return out
+
+
+def load_tf_checkpoint(ckpt_path: str) -> Dict[str, np.ndarray]:
+    """Google BERT TF checkpoint -> torch-style state dict (then convert with
+    :func:`convert_torch_state_dict`). Name mapping per reference
+    load_tf_weights_in_bert (modeling.py:58-116): layer_N -> layer.N,
+    kernel -> weight (transposed to torch layout), gamma/beta ->
+    weight/bias, output_bias/output_weights -> bias/weight; optimizer
+    slots skipped."""
+    try:
+        import tensorflow as tf
+    except ImportError as exc:  # pragma: no cover
+        raise ImportError(
+            "Loading TF checkpoints requires tensorflow; convert the archive "
+            "to a torch state dict elsewhere or install tensorflow.") from exc
+
+    reader = tf.train.load_checkpoint(ckpt_path)
+    sd: Dict[str, np.ndarray] = {}
+    skip = ("adam_v", "adam_m", "global_step", "lamb", "bad_steps",
+            "loss_scale", "good_steps")
+    for tf_name in reader.get_variable_to_shape_map():
+        if any(s in tf_name.lower() for s in skip):
+            continue
+        arr = reader.get_tensor(tf_name)
+        parts = []
+        for piece in tf_name.split("/"):
+            if piece.startswith("layer_"):
+                parts.append("layer." + piece[len("layer_"):])
+            elif piece == "kernel":
+                arr = np.asarray(arr).T
+                parts.append("weight")
+            elif piece == "gamma":
+                parts.append("weight")
+            elif piece == "beta":
+                parts.append("bias")
+            elif piece == "output_bias":
+                parts.append("bias")
+            elif piece == "output_weights":
+                parts.append("weight")
+            elif piece == "squad":
+                parts.append("classifier")
+            else:
+                parts.append(piece)
+        sd[".".join(parts)] = np.asarray(arr)
+    # embedding tables are [vocab, hidden] in both layouts; the decoder is
+    # tied so 'cls.predictions.decoder' never materializes.
+    return sd
+
+
+def merge_params(initialized, loaded):
+    """Overlay ``loaded`` (possibly partial — e.g. backbone only) onto a
+    freshly initialized tree: the non-strict load_state_dict role
+    (reference run_pretraining.py:257, run_squad.py:957-961)."""
+    merged = dict(initialized)
+    for key, value in loaded.items():
+        if key in merged and isinstance(value, dict) and isinstance(
+                merged[key], dict):
+            merged[key] = merge_params(merged[key], value)
+        else:
+            merged[key] = value
+    return merged
+
+
+def is_foreign_checkpoint(path: str) -> bool:
+    """True for pretrained archives this module loads (directory, torch
+    .bin/.pt/.pth, TF ckpt prefix) as opposed to our msgpack checkpoints."""
+    return (os.path.isdir(path)
+            or path.endswith((".bin", ".pt", ".pth"))
+            or os.path.exists(path + ".index"))
+
+
+def load_encoder_params(path: str, config: BertConfig, target: Dict) -> Dict:
+    """Overlay the 'bert' encoder subtree of a foreign archive onto a
+    freshly initialized param tree (shared by the finetuning runners'
+    --init_checkpoint handling; reference run_squad.py:957-961's
+    strict=False load)."""
+    _, loaded = from_pretrained(path, config=config)
+    return merge_params(target, {"bert": loaded["bert"]})
+
+
+def from_pretrained(
+    path: str, config: Optional[BertConfig] = None
+) -> Tuple[BertConfig, Dict]:
+    """Load a pretrained archive directory or weights file.
+
+    Accepts (reference from_pretrained semantics, modeling.py:659-799):
+      - a directory holding ``config.json``/``bert_config.json`` plus
+        ``pytorch_model.bin`` (torch) or ``bert_model.ckpt*`` (TF);
+      - a ``.bin``/``.pt`` torch weights file (config required);
+      - a TF checkpoint prefix (config required).
+    Returns ``(config, params)``; merge over initialized params with
+    :func:`merge_params` before use.
+    """
+    weights: Optional[str] = None
+    if os.path.isdir(path):
+        for name in ("config.json", "bert_config.json"):
+            candidate = os.path.join(path, name)
+            if config is None and os.path.exists(candidate):
+                config = BertConfig.from_json_file(candidate)
+                break
+        if os.path.exists(os.path.join(path, "pytorch_model.bin")):
+            weights = os.path.join(path, "pytorch_model.bin")
+        elif os.path.exists(os.path.join(path, "bert_model.ckpt.index")):
+            weights = os.path.join(path, "bert_model.ckpt")
+        else:
+            raise FileNotFoundError(
+                f"no pytorch_model.bin or bert_model.ckpt.* under {path}")
+    else:
+        weights = path
+    if config is None:
+        raise ValueError("no config.json found; pass config explicitly")
+
+    if weights.endswith((".bin", ".pt", ".pth")):
+        import torch
+
+        sd = torch.load(weights, map_location="cpu", weights_only=True)
+        if isinstance(sd.get("model"), dict):
+            sd = sd["model"]  # reference checkpoint dict layout (run_squad.py:958)
+        return config, convert_torch_state_dict(sd, config)
+    return config, convert_torch_state_dict(load_tf_checkpoint(weights), config)

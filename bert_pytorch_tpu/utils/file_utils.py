@@ -1,0 +1,151 @@
+"""URL/S3 cached download with ETag-hashed filenames.
+
+Parity with reference src/file_utils.py:97-263 (the HF-style model cache):
+``cached_path`` resolves a URL or local path, downloading remote files once
+into a cache directory keyed by ``sha256(url).sha256(etag)`` with a sidecar
+``.json`` holding the original url/etag. S3 support is gated on boto3
+(reference :159-186). One behavior added for air-gapped hosts: if the ETag
+probe fails but a cached copy of the url exists, the newest cached copy is
+served instead of erroring.
+
+Cache location: ``$BERT_TPU_CACHE`` or ``~/.cache/bert_pytorch_tpu``
+(the ``PYTORCH_PRETRAINED_BERT_CACHE`` analog, reference :35-44).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import tempfile
+import urllib.request
+from pathlib import Path
+from typing import Optional, Tuple
+from urllib.parse import urlparse
+
+CACHE_DIR = os.getenv(
+    "BERT_TPU_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "bert_pytorch_tpu"),
+)
+
+
+def url_to_filename(url: str, etag: Optional[str] = None) -> str:
+    """sha256(url)[.sha256(etag)] (reference :52-66)."""
+    filename = hashlib.sha256(url.encode()).hexdigest()
+    if etag:
+        filename += "." + hashlib.sha256(etag.encode()).hexdigest()
+    return filename
+
+
+def filename_to_url(filename: str, cache_dir: Optional[str] = None) -> Tuple[str, Optional[str]]:
+    """Recover (url, etag) from a cache entry's sidecar (reference :69-94)."""
+    cache_dir = cache_dir or CACHE_DIR
+    cache_path = os.path.join(cache_dir, filename)
+    if not os.path.exists(cache_path):
+        raise EnvironmentError(f"file {cache_path} not found")
+    meta_path = cache_path + ".json"
+    if not os.path.exists(meta_path):
+        raise EnvironmentError(f"file {meta_path} not found")
+    with open(meta_path, encoding="utf-8") as f:
+        metadata = json.load(f)
+    return metadata["url"], metadata["etag"]
+
+
+def cached_path(url_or_filename, cache_dir: Optional[str] = None) -> str:
+    """URL -> cached local path (downloading once); local path -> itself
+    (reference :97-125)."""
+    if isinstance(url_or_filename, Path):
+        url_or_filename = str(url_or_filename)
+    cache_dir = str(cache_dir) if cache_dir is not None else CACHE_DIR
+    parsed = urlparse(url_or_filename)
+    if parsed.scheme in ("http", "https", "s3"):
+        return get_from_cache(url_or_filename, cache_dir)
+    if os.path.exists(url_or_filename):
+        return url_or_filename
+    if parsed.scheme == "":
+        raise EnvironmentError(f"file {url_or_filename} not found")
+    raise ValueError(
+        f"unable to parse {url_or_filename} as a URL or as a local path")
+
+
+def split_s3_path(url: str) -> Tuple[str, str]:
+    parsed = urlparse(url)
+    if not parsed.netloc or not parsed.path:
+        raise ValueError(f"bad s3 path {url}")
+    return parsed.netloc, parsed.path.lstrip("/")
+
+
+def _s3_resource():
+    try:
+        import boto3
+    except ImportError as exc:  # pragma: no cover
+        raise ImportError(
+            "s3:// paths require boto3, which is not installed") from exc
+    return boto3.resource("s3")
+
+
+def s3_etag(url: str) -> Optional[str]:
+    bucket, path = split_s3_path(url)
+    return _s3_resource().Object(bucket, path).e_tag
+
+
+def s3_get(url: str, temp_file) -> None:
+    bucket, path = split_s3_path(url)
+    _s3_resource().Bucket(bucket).download_fileobj(path, temp_file)
+
+
+def _http_etag(url: str) -> Optional[str]:
+    request = urllib.request.Request(url, method="HEAD")
+    with urllib.request.urlopen(request) as response:
+        if response.status != 200:
+            raise IOError(
+                f"HEAD request failed for url {url} with status "
+                f"{response.status}")
+        return response.headers.get("ETag")
+
+
+def _newest_cached(url: str, cache_dir: str) -> Optional[str]:
+    prefix = url_to_filename(url)
+    candidates = [
+        os.path.join(cache_dir, name)
+        for name in os.listdir(cache_dir)
+        if name.startswith(prefix) and not name.endswith(".json")
+    ] if os.path.isdir(cache_dir) else []
+    return max(candidates, key=os.path.getmtime) if candidates else None
+
+
+def get_from_cache(url: str, cache_dir: Optional[str] = None) -> str:
+    """Download-once semantics keyed by (url, etag) (reference :189-240)."""
+    cache_dir = cache_dir or CACHE_DIR
+    os.makedirs(cache_dir, exist_ok=True)
+
+    try:
+        etag = s3_etag(url) if url.startswith("s3://") else _http_etag(url)
+    except (OSError, ImportError):
+        # Offline / probe failure: serve the newest cached copy if any.
+        cached = _newest_cached(url, cache_dir)
+        if cached is not None:
+            return cached
+        raise
+
+    cache_path = os.path.join(cache_dir, url_to_filename(url, etag))
+    if os.path.exists(cache_path):
+        return cache_path
+
+    fd, temp_path = tempfile.mkstemp(dir=cache_dir, suffix=".part")
+    try:
+        with os.fdopen(fd, "wb") as temp_file:
+            if url.startswith("s3://"):
+                s3_get(url, temp_file)
+            else:
+                with urllib.request.urlopen(url) as response:
+                    shutil.copyfileobj(response, temp_file)
+        os.replace(temp_path, cache_path)
+    finally:
+        if os.path.exists(temp_path):
+            os.unlink(temp_path)
+
+    with open(cache_path + ".json", "w", encoding="utf-8") as meta_file:
+        json.dump({"url": url, "etag": etag}, meta_file)
+    return cache_path

@@ -13,6 +13,7 @@ dependency).
 from __future__ import annotations
 
 import argparse
+import os
 import json
 import time
 
@@ -128,10 +129,16 @@ def main(args):
 
     params = nn.unbox(model.init(jax.random.PRNGKey(args.seed), *sample))["params"]
     if args.model_checkpoint:
-        state = ckpt.load_checkpoint(args.model_checkpoint)
-        source = state.get("model", state)
-        if "bert" in source:
-            params["bert"] = ckpt.restore_tree(params["bert"], source["bert"])
+        from bert_pytorch_tpu.models import is_foreign_checkpoint, load_encoder_params
+
+        path = args.model_checkpoint
+        if is_foreign_checkpoint(path):
+            params = load_encoder_params(path, config, params)
+        else:
+            state = ckpt.load_checkpoint(path)
+            source = state.get("model", state)
+            if "bert" in source:
+                params["bert"] = ckpt.restore_tree(params["bert"], source["bert"])
         logger.info(f"loaded pretrained encoder from {args.model_checkpoint}")
 
     # AdamW(bias_correction=False) + per-epoch 1/(1+0.05*epoch) decay

@@ -144,6 +144,13 @@ def setup_training(args):
             f"local_batch_size*data_shards={global_microbatch}"
         )
     args.accumulation_steps = args.global_batch_size // global_microbatch
+    if (args.parallel_strategy == "sp" and mesh.shape["seq"] > 1
+            and args.attention_backend != "ring"):
+        # sp exists to avoid O(S^2) dense attention; never silently densify
+        # (same stance as ops/attention.py's non-divisible check).
+        logger.info("parallel_strategy=sp: switching attention_backend to "
+                    "'ring' (was '%s')" % args.attention_backend)
+        args.attention_backend = "ring"
     if args.global_batch_size % jax.process_count() != 0:
         raise ValueError("global_batch_size must divide by process count")
     args.host_batch_per_step = args.global_batch_size // jax.process_count()

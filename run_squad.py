@@ -46,7 +46,7 @@ def parse_args(argv=None):
     parser = argparse.ArgumentParser(description="TPU BERT SQuAD finetuning")
     parser.add_argument("--output_dir", type=str, required=True)
     parser.add_argument("--init_checkpoint", type=str, default=None,
-                        help="pretraining checkpoint (.msgpack) to start from")
+                        help="pretraining checkpoint (.msgpack), torch .bin/.pt, TF ckpt prefix, or pretrained archive dir")
     parser.add_argument("--config_file", type=str, required=True,
                         help="BERT model config json")
     parser.add_argument("--train_file", type=str, default=None)
@@ -141,13 +141,23 @@ def cached_features(args, examples, tokenizer, is_training, tag):
     return features
 
 
-def load_init_params(args, abstract_params):
+def load_init_params(args, abstract_params, config):
     """Start from a pretraining checkpoint: copy the shared 'bert' encoder
     subtree; the QA head keeps its fresh init (the strict=False analog of
-    reference run_squad.py:957-961)."""
-    state = ckpt.load_checkpoint(args.init_checkpoint)
-    source = state.get("model", state)
+    reference run_squad.py:957-961).
+
+    Accepts our msgpack checkpoints AND foreign pretrained archives — a
+    directory with config.json + pytorch_model.bin / bert_model.ckpt.*, a
+    torch .bin/.pt file, or a TF checkpoint prefix (the reference
+    from_pretrained surface, modeling.py:659-799)."""
+    from bert_pytorch_tpu.models import is_foreign_checkpoint, load_encoder_params
+
+    path = args.init_checkpoint
     target = jax.device_get(abstract_params)
+    if is_foreign_checkpoint(path):
+        return load_encoder_params(path, config, target)
+    state = ckpt.load_checkpoint(path)
+    source = state.get("model", state)
     if "bert" in source:
         target["bert"] = ckpt.restore_tree(target["bert"], source["bert"])
     else:
@@ -206,7 +216,7 @@ def main(args):
                     out_shardings={"params": p_shardings})(
                 jax.random.PRNGKey(args.seed)))["params"]
         if args.init_checkpoint:
-            host_params = load_init_params(args, init_params)
+            host_params = load_init_params(args, init_params, config)
             init_params = jax.device_put(host_params, p_shardings)
         params = init_params
 

@@ -1,0 +1,224 @@
+"""Pretrained-weight import/export tests (models/convert.py).
+
+Oracles: HF transformers' torch BertForPreTraining (same lineage as the
+reference's modeling.py) for numerical agreement, and a synthetic Google-
+style TF checkpoint for the load_tf_weights_in_bert path
+(reference modeling.py:58-116, from_pretrained :659-799).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.models import (
+    BertForPreTraining,
+    convert_torch_state_dict,
+    export_torch_state_dict,
+    from_pretrained,
+    load_tf_checkpoint,
+    merge_params,
+)
+
+HIDDEN, LAYERS, HEADS, INTER, VOCAB, TYPES = 32, 2, 4, 64, 100, 2
+
+
+@pytest.fixture(scope="module")
+def hf_model():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    hf_config = transformers.BertConfig(
+        vocab_size=VOCAB, hidden_size=HIDDEN, num_hidden_layers=LAYERS,
+        num_attention_heads=HEADS, intermediate_size=INTER,
+        max_position_embeddings=64, type_vocab_size=TYPES,
+        hidden_act="gelu", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, layer_norm_eps=1e-12)
+    torch.manual_seed(0)
+    model = transformers.BertForPreTraining(hf_config).eval()
+    return model
+
+
+@pytest.fixture(scope="module")
+def our_config():
+    return BertConfig(
+        vocab_size=VOCAB, hidden_size=HIDDEN, num_hidden_layers=LAYERS,
+        num_attention_heads=HEADS, intermediate_size=INTER,
+        max_position_embeddings=64, type_vocab_size=TYPES,
+        next_sentence=True)
+
+
+def test_hf_forward_agreement(hf_model, our_config):
+    """Imported HF weights reproduce the HF forward pass bit-for-bit-ish."""
+    import torch
+
+    params = convert_torch_state_dict(hf_model.state_dict(), our_config)
+    model = BertForPreTraining(our_config, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    B, S = 3, 16
+    ids = rng.integers(0, VOCAB, (B, S)).astype(np.int32)
+    types = rng.integers(0, TYPES, (B, S)).astype(np.int32)
+    mask = np.ones((B, S), np.int32)
+    mask[:, -3:] = 0
+
+    with torch.no_grad():
+        out = hf_model(
+            input_ids=torch.from_numpy(ids.astype(np.int64)),
+            token_type_ids=torch.from_numpy(types.astype(np.int64)),
+            attention_mask=torch.from_numpy(mask.astype(np.int64)))
+    mlm, nsp = model.apply(
+        {"params": params}, jnp.asarray(ids), jnp.asarray(types),
+        jnp.asarray(mask))
+    np.testing.assert_allclose(
+        np.asarray(mlm), out.prediction_logits.numpy(), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(nsp), out.seq_relationship_logits.numpy(),
+        rtol=1e-4, atol=1e-4)
+
+
+def test_export_roundtrip(our_config):
+    """params -> torch naming -> params is the identity."""
+    import flax.linen as nn
+
+    model = BertForPreTraining(our_config, dtype=jnp.float32)
+    params = nn.unbox(model.init(
+        jax.random.PRNGKey(1), *(jnp.zeros((1, 8), jnp.int32),) * 3))["params"]
+    sd = export_torch_state_dict(params, our_config)
+    back = convert_torch_state_dict(sd, our_config)
+    flat_a = jax.tree_util.tree_leaves_with_path(params)
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(back))
+    for path, leaf in flat_a:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_b[path]), rtol=1e-6,
+            err_msg=str(path))
+
+
+def test_vocab_padding(our_config, hf_model):
+    """MXU %8 vocab padding (run_pretraining.py:157): checkpoint vocab 100
+    loads into a config padded to 104 with zero rows."""
+    padded = BertConfig.from_dict({**our_config.to_dict(), "vocab_size": 104})
+    params = convert_torch_state_dict(hf_model.state_dict(), padded)
+    emb = params["bert"]["embeddings"]["word_embeddings"]["embedding"]
+    assert emb.shape == (104, HIDDEN)
+    assert np.all(emb[100:] == 0)
+    assert params["predictions"]["bias"].shape == (104,)
+
+
+def test_partial_load_merges_over_init(our_config, hf_model):
+    """Backbone-only checkpoints merge over fresh heads — the strict=False
+    load of reference run_squad.py:957-961."""
+    import flax.linen as nn
+
+    sd = {k: v for k, v in hf_model.state_dict().items()
+          if k.startswith("bert.")}
+    loaded = convert_torch_state_dict(sd, our_config)
+    assert "predictions" not in loaded
+    model = BertForPreTraining(our_config, dtype=jnp.float32)
+    init = nn.unbox(model.init(
+        jax.random.PRNGKey(0), *(jnp.zeros((1, 8), jnp.int32),) * 3))["params"]
+    merged = merge_params(init, loaded)
+    assert "predictions" in merged  # head kept from init
+    np.testing.assert_allclose(
+        np.asarray(merged["bert"]["embeddings"]["word_embeddings"]["embedding"]),
+        hf_model.state_dict()["bert.embeddings.word_embeddings.weight"].numpy())
+
+
+def test_tf_checkpoint_loading(tmp_path, our_config, hf_model):
+    """Google-style TF checkpoint (v1 names: layer_N, kernel/gamma/beta,
+    output_bias/output_weights) loads identically to the torch path."""
+    tf = pytest.importorskip("tensorflow")
+
+    sd = {k: v.numpy() for k, v in hf_model.state_dict().items()}
+    tf_vars = {}
+    for name, arr in sd.items():
+        if name == "cls.predictions.decoder.weight" or name.endswith(
+                "position_ids"):
+            continue
+        parts = []
+        for piece in name.split("."):
+            parts.append(piece)
+        tf_name = "/".join(parts)
+        tf_name = tf_name.replace("LayerNorm/weight", "LayerNorm/gamma")
+        tf_name = tf_name.replace("LayerNorm/bias", "LayerNorm/beta")
+        import re
+        tf_name = re.sub(r"layer/(\d+)", r"layer_\1", tf_name)
+        if tf_name == "cls/seq_relationship/weight":
+            tf_name, arr = "cls/seq_relationship/output_weights", arr
+        elif tf_name == "cls/seq_relationship/bias":
+            tf_name = "cls/seq_relationship/output_bias"
+        elif tf_name == "cls/predictions/bias":
+            tf_name = "cls/predictions/output_bias"
+        elif tf_name.endswith("/weight"):
+            tf_name, arr = tf_name[:-len("/weight")] + "/kernel", arr.T
+        elif tf_name.endswith("/bias"):
+            pass
+        tf_vars[tf_name] = arr
+
+    ckpt_prefix = str(tmp_path / "bert_model.ckpt")
+    with tf.compat.v1.Graph().as_default():
+        variables = [
+            tf.compat.v1.get_variable(
+                name, initializer=tf.constant(value))
+            for name, value in tf_vars.items()
+        ]
+        saver = tf.compat.v1.train.Saver(variables)
+        with tf.compat.v1.Session() as sess:
+            sess.run(tf.compat.v1.global_variables_initializer())
+            saver.save(sess, ckpt_prefix)
+
+    sd_tf = load_tf_checkpoint(ckpt_prefix)
+    params_tf = convert_torch_state_dict(sd_tf, our_config)
+    params_torch = convert_torch_state_dict(hf_model.state_dict(), our_config)
+    flat_torch = dict(jax.tree_util.tree_leaves_with_path(params_torch))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params_tf):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_torch[path]), rtol=1e-6,
+            err_msg=str(path))
+
+
+def test_from_pretrained_directory(tmp_path, our_config, hf_model):
+    """Archive-directory loading: config.json + pytorch_model.bin
+    (reference from_pretrained, modeling.py:659-799)."""
+    import json
+
+    import torch
+
+    archive = tmp_path / "archive"
+    archive.mkdir()
+    (archive / "config.json").write_text(json.dumps({
+        "vocab_size": VOCAB, "hidden_size": HIDDEN,
+        "num_hidden_layers": LAYERS, "num_attention_heads": HEADS,
+        "intermediate_size": INTER, "max_position_embeddings": 64,
+        "type_vocab_size": TYPES, "next_sentence": True}))
+    torch.save(hf_model.state_dict(), archive / "pytorch_model.bin")
+    config, params = from_pretrained(str(archive))
+    assert config.hidden_size == HIDDEN
+    model = BertForPreTraining(config, dtype=jnp.float32)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    mlm, nsp = model.apply({"params": params}, ids, ids, jnp.ones((1, 8), jnp.int32))
+    assert mlm.shape == (1, 8, VOCAB)
+
+
+def test_squad_runner_accepts_torch_init(tmp_path, our_config, hf_model):
+    """run_squad.load_init_params loads a torch .bin archive (the reference
+    --init_checkpoint from_pretrained path) and keeps the fresh QA head."""
+    import argparse
+
+    import flax.linen as nn
+    import torch
+
+    import run_squad
+    from bert_pytorch_tpu.models import BertForQuestionAnswering
+
+    weights = tmp_path / "pytorch_model.bin"
+    torch.save(hf_model.state_dict(), weights)
+    model = BertForQuestionAnswering(our_config, dtype=jnp.float32)
+    init = nn.unbox(model.init(
+        jax.random.PRNGKey(0), *(jnp.zeros((1, 8), jnp.int32),) * 3))["params"]
+    args = argparse.Namespace(init_checkpoint=str(weights))
+    params = run_squad.load_init_params(args, init, our_config)
+    np.testing.assert_allclose(
+        np.asarray(params["bert"]["embeddings"]["word_embeddings"]["embedding"]),
+        hf_model.state_dict()["bert.embeddings.word_embeddings.weight"].numpy())
+    assert "qa_outputs" in params

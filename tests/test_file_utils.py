@@ -1,0 +1,104 @@
+"""Cached-download tests (utils/file_utils.py; reference src/file_utils.py).
+
+A loopback http.server stands in for the network (zero-egress environment).
+"""
+
+import http.server
+import json
+import os
+import threading
+
+import pytest
+
+from bert_pytorch_tpu.utils import file_utils
+
+
+@pytest.fixture()
+def http_srv(tmp_path):
+    content = b"pretrained weights blob"
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        etag = '"v1"'
+        hits = {"GET": 0, "HEAD": 0}
+
+        def _respond(self, body):
+            self.send_response(200)
+            self.send_header("ETag", Handler.etag)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            return body
+
+        def do_HEAD(self):
+            Handler.hits["HEAD"] += 1
+            self._respond(b"")
+
+        def do_GET(self):
+            Handler.hits["GET"] += 1
+            self.wfile.write(self._respond(content))
+
+        def log_message(self, *args):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}/weights.bin", Handler, content
+    server.shutdown()
+
+
+def test_local_path_passthrough(tmp_path):
+    path = tmp_path / "f.txt"
+    path.write_text("x")
+    assert file_utils.cached_path(str(path)) == str(path)
+    with pytest.raises(EnvironmentError):
+        file_utils.cached_path(str(tmp_path / "missing.txt"))
+
+
+def test_url_to_filename_etag():
+    base = file_utils.url_to_filename("http://x/y")
+    with_tag = file_utils.url_to_filename("http://x/y", '"abc"')
+    assert with_tag.startswith(base + ".")
+    assert base != with_tag
+
+
+def test_download_once_and_meta(http_srv, tmp_path):
+    url, handler, content = http_srv
+    cache = str(tmp_path / "cache")
+    path1 = file_utils.cached_path(url, cache_dir=cache)
+    assert open(path1, "rb").read() == content
+    meta = json.load(open(path1 + ".json"))
+    assert meta["url"] == url and meta["etag"] == '"v1"'
+    # second call: HEAD only, no new GET
+    gets = handler.hits["GET"]
+    path2 = file_utils.cached_path(url, cache_dir=cache)
+    assert path2 == path1
+    assert handler.hits["GET"] == gets
+
+
+def test_etag_change_redownloads(http_srv, tmp_path):
+    url, handler, _ = http_srv
+    cache = str(tmp_path / "cache")
+    path1 = file_utils.cached_path(url, cache_dir=cache)
+    handler.etag = '"v2"'
+    path2 = file_utils.cached_path(url, cache_dir=cache)
+    assert path1 != path2  # new etag -> new cache entry
+    url_back, etag = file_utils.filename_to_url(
+        os.path.basename(path2), cache)
+    assert url_back == url and etag == '"v2"'
+
+
+def test_offline_serves_cached_copy(http_srv, tmp_path):
+    url, handler, content = http_srv
+    cache = str(tmp_path / "cache")
+    path1 = file_utils.cached_path(url, cache_dir=cache)
+    # unreachable host, same cache prefix? -> different url misses
+    with pytest.raises(OSError):
+        file_utils.cached_path(
+            "http://127.0.0.1:1/never-cached.bin", cache_dir=cache)
+    # simulate the probe failing for a cached url: point at a dead server
+    # after renaming the cache entry to that url's hash
+    dead_url = "http://127.0.0.1:1/weights.bin"
+    prefix = file_utils.url_to_filename(dead_url)
+    os.replace(path1, os.path.join(cache, prefix + ".deadbeef"))
+    assert file_utils.cached_path(dead_url, cache_dir=cache).startswith(
+        os.path.join(cache, prefix))

@@ -74,12 +74,10 @@ def test_ring_dropout_statistics(qkv, devices):
     mesh = create_mesh(MeshConfig(seq=4, data=2))
     dense = dot_product_attention(q, k, v, bias=bias)
     with mesh:
-        outs = []
-        for i in range(16):
-            outs.append(np.asarray(jax.jit(
-                lambda q, k, v, r: ring_attention(
-                    q, k, v, bias=bias, dropout_rng=r, dropout_rate=0.1)
-            )(q, k, v, jax.random.PRNGKey(i))))
+        fn = jax.jit(lambda q, k, v, r: ring_attention(
+            q, k, v, bias=bias, dropout_rng=r, dropout_rate=0.1))
+        outs = [np.asarray(fn(q, k, v, jax.random.PRNGKey(i)))
+                for i in range(16)]
         avg = np.mean(outs, axis=0)
     # dropout is unbiased; with 16 samples the mean is loosely close
     np.testing.assert_allclose(avg, np.asarray(dense), rtol=0.5, atol=0.15)
