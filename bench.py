@@ -30,6 +30,7 @@ A100_PHASE1_SEQ_PER_SEC = 360.0
 # (BASELINE.md); sized down for a 16GB v5e chip with fp32 master params.
 LOCAL_BATCH = 32
 SEQ_LEN = 128
+MAX_PRED = 20  # phase-1 max_predictions_per_seq (BASELINE.md recipe)
 ACCUM = 1
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
@@ -79,22 +80,27 @@ def main():
             jax.random.PRNGKey(0))
         step = pretrain.make_train_step(
             model, tx, schedule=schedule, next_sentence=True,
-            shardings=shardings, batch_shardings_=b_shardings)
+            shardings=shardings, batch_shardings_=b_shardings,
+            max_pred_per_seq=MAX_PRED)
 
         batch = pretrain.put_batch(
             pretrain.stack_microbatches(host, ACCUM), b_shardings)
 
-        # Per-step value fetch: a hard sync through the runtime each step.
-        # (block_until_ready alone has been observed to return early through
-        # the axon remote-execution tunnel, yielding bogus ~1000x numbers.)
         for _ in range(WARMUP_STEPS):
             state, metrics = step(state, batch)
             _ = float(metrics["loss"])
 
+        # Chained dispatch: each step consumes the previous step's donated
+        # state, so fetching only the FINAL loss forces the whole chain to
+        # have executed (a value dependent on every step can't be returned
+        # early — unlike block_until_ready, which has been observed to
+        # return early through the axon remote-execution tunnel). Per-step
+        # value fetches would serialize a host<->device round-trip into
+        # every step and understate steady-state throughput by ~35%.
         start = time.perf_counter()
         for _ in range(MEASURE_STEPS):
             state, metrics = step(state, batch)
-            _ = float(metrics["loss"])
+        _ = float(metrics["loss"])
         elapsed = time.perf_counter() - start
 
     seq_per_sec = MEASURE_STEPS * global_batch / elapsed

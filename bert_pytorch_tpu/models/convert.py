@@ -348,10 +348,18 @@ def from_pretrained(
       - a directory holding ``config.json``/``bert_config.json`` plus
         ``pytorch_model.bin`` (torch) or ``bert_model.ckpt*`` (TF);
       - a ``.bin``/``.pt`` torch weights file (config required);
-      - a TF checkpoint prefix (config required).
+      - a TF checkpoint prefix (config required);
+      - an http(s)/s3 URL of a weights file, resolved through the ETag
+        download cache (utils/file_utils.py; reference :687-699's
+        cached_path step).
     Returns ``(config, params)``; merge over initialized params with
     :func:`merge_params` before use.
     """
+    kind_hint = path  # cache filenames are hashes; type comes from the URL
+    if path.split("://", 1)[0] in ("http", "https", "s3"):
+        from bert_pytorch_tpu.utils.file_utils import cached_path
+
+        path = cached_path(path)
     weights: Optional[str] = None
     if os.path.isdir(path):
         for name in ("config.json", "bert_config.json"):
@@ -371,7 +379,9 @@ def from_pretrained(
     if config is None:
         raise ValueError("no config.json found; pass config explicitly")
 
-    if weights.endswith((".bin", ".pt", ".pth")):
+    if weights.endswith((".bin", ".pt", ".pth")) or (
+            weights != kind_hint
+            and kind_hint.rstrip("/").endswith((".bin", ".pt", ".pth"))):
         import torch
 
         sd = torch.load(weights, map_location="cpu", weights_only=True)

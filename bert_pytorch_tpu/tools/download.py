@@ -157,6 +157,55 @@ class WikiCorpusDownloader(Downloader):
         extract_bz2(archive, os.path.join(out, "wikicorpus.xml"))
 
 
+class BooksCorpusDownloader(Downloader):
+    """Clone soskek/bookcorpus and drive its downloader (reference
+    utils/download.py:59-79). Needs git + network."""
+
+    def download(self) -> None:
+        import subprocess
+        import sys
+
+        out = os.path.join(self.output_dir, "bookscorpus")
+        repo = os.path.join(out, "bookcorpus")
+        if not os.path.exists(repo):
+            subprocess.run(
+                ["git", "clone",
+                 "https://github.com/soskek/bookcorpus.git", repo],
+                check=True)
+        subprocess.run(
+            [sys.executable, os.path.join(repo, "download_files.py"),
+             "--list", os.path.join(repo, "url_list.jsonl"),
+             "--out", os.path.join(out, "data"), "--trash-bad-count"],
+            check=True)
+
+
+class GLUEDownloader(Downloader):
+    """Fetch the community GLUE download script and run it per task
+    (reference utils/download.py:81-101)."""
+
+    SCRIPT_URL = (
+        "https://gist.githubusercontent.com/W4ngatang/"
+        "60c2bdb54d156a41194446737ce03e2e/raw/"
+        "17b8dd0d724281ed7c3b2aeeda662b92809aadd5/download_glue_data.py"
+    )
+    DEFAULT_TASKS = ("MRPC", "SST")
+
+    def download(self, tasks=DEFAULT_TASKS) -> None:
+        import importlib
+        import sys
+
+        out = os.path.join(self.output_dir, "glue")
+        fetch(self.SCRIPT_URL, os.path.join(out, "download_glue_data.py"))
+        sys.path.insert(0, out)
+        try:
+            download_glue_data = importlib.import_module("download_glue_data")
+            for task in tasks:
+                download_glue_data.main(
+                    ["--data_dir", out, "--tasks", task])
+        finally:
+            sys.path.remove(out)
+
+
 class WeightsDownloader(Downloader):
     def download(self, model: str = "bert-large-uncased") -> None:
         out = os.path.join(self.output_dir, "weights")
@@ -184,6 +233,8 @@ class WeightsDownloader(Downloader):
 DOWNLOADERS = {
     "squad": SquadDownloader,
     "wikicorpus": WikiCorpusDownloader,
+    "bookscorpus": BooksCorpusDownloader,
+    "glue": GLUEDownloader,
     "weights": WeightsDownloader,
 }
 

@@ -13,7 +13,6 @@ dependency).
 from __future__ import annotations
 
 import argparse
-import os
 import json
 import time
 

@@ -69,6 +69,10 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
     parser.add_argument("--keep_checkpoints", type=int, default=3)
     parser.add_argument("--log_steps", type=int, default=1)
+    parser.add_argument("--profile_steps", type=int, default=0,
+                        help="capture a JAX profiler trace of this many "
+                             "steps (after the compile step) into "
+                             "<output_dir>/profile; 0 disables (SURVEY §5.1)")
     # numerics / memory
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
@@ -333,6 +337,7 @@ def main(args) -> dict:
 
         epoch = int(checkpoint["epoch"]) if checkpoint else 0
         step_in_run = 0
+        profiling = False
         train_start = time.perf_counter()
         samples_seen = 0
         last_metrics = {}
@@ -364,6 +369,23 @@ def main(args) -> dict:
                     samples_seen += args.global_batch_size
                 if step_in_run == 1:
                     train_start = time.perf_counter()
+                # Profiler window: steps [2, 2+profile_steps) — after the
+                # compile step, so the trace holds steady-state device work.
+                if args.profile_steps > 0 and is_main_process():
+                    # block on the dispatched step so the trace window holds
+                    # exactly the profiled steps' device work (steps are
+                    # async dispatches otherwise).
+                    if step_in_run == 1:
+                        jax.block_until_ready(metrics)
+                        jax.profiler.start_trace(
+                            os.path.join(args.output_dir, "profile"))
+                        profiling = True
+                    elif profiling and step_in_run == 1 + args.profile_steps:
+                        jax.block_until_ready(metrics)
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        logger.info("profiler trace written to "
+                                    f"{args.output_dir}/profile")
 
                 if global_step % args.log_steps == 0:
                     last_metrics = {k: float(v) for k, v in metrics.items()}
@@ -394,6 +416,11 @@ def main(args) -> dict:
                     done = True
                     break
             epoch += 1
+
+        if profiling:  # run ended inside the profile window
+            jax.block_until_ready(metrics)
+            jax.profiler.stop_trace()
+            logger.info(f"profiler trace written to {args.output_dir}/profile")
 
         train_time = time.perf_counter() - train_start
         seq_per_sec = samples_seen / max(train_time, 1e-9)

@@ -222,3 +222,48 @@ def test_squad_runner_accepts_torch_init(tmp_path, our_config, hf_model):
         np.asarray(params["bert"]["embeddings"]["word_embeddings"]["embedding"]),
         hf_model.state_dict()["bert.embeddings.word_embeddings.weight"].numpy())
     assert "qa_outputs" in params
+
+
+def test_from_pretrained_url(tmp_path, our_config, hf_model, monkeypatch):
+    """URL weights resolve through the cached_path download cache
+    (reference from_pretrained's cached_path step, file_utils.py:97-125)."""
+    import http.server
+    import threading
+
+    import torch
+
+    weights = tmp_path / "w.bin"
+    torch.save(hf_model.state_dict(), weights)
+    blob = weights.read_bytes()
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def _respond(self):
+            self.send_response(200)
+            self.send_header("ETag", '"w1"')
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+
+        def do_HEAD(self):
+            self._respond()
+
+        def do_GET(self):
+            self._respond()
+            self.wfile.write(blob)
+
+        def log_message(self, *a):
+            pass
+
+    server = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    monkeypatch.setenv("BERT_TPU_CACHE", str(tmp_path / "cache"))
+    import bert_pytorch_tpu.utils.file_utils as fu
+    monkeypatch.setattr(fu, "CACHE_DIR", str(tmp_path / "cache"))
+    try:
+        url = f"http://127.0.0.1:{server.server_port}/pytorch_model.bin"
+        config, params = from_pretrained(url, config=our_config)
+        assert "predictions" in params
+        np.testing.assert_allclose(
+            np.asarray(params["bert"]["embeddings"]["word_embeddings"]["embedding"]),
+            hf_model.state_dict()["bert.embeddings.word_embeddings.weight"].numpy())
+    finally:
+        server.shutdown()

@@ -180,3 +180,25 @@ def test_kfac_end_to_end(workdir):
         run_pretraining.parse_arguments(argv + ["--steps", "2"]))
     assert result2["global_step"] == 5
     assert np.isfinite(result2["loss"])
+
+
+def test_roberta_path_no_nsp(workdir, tmp_path):
+    """next_sentence=False (the RoBERTa config path,
+    configs/roberta_pretraining_config.json): no token-type embeddings, no
+    pooler/NSP head, MLM-only loss."""
+    model_config = json.loads(open(workdir["model"]).read())
+    model_config["next_sentence"] = False
+    config_path = tmp_path / "roberta.json"
+    config_path.write_text(json.dumps(model_config))
+    args = _args({**workdir, "model": str(config_path)},
+                 lr_decay="linear", warmup_proportion="0.06")
+    result = run_pretraining.main(args)
+    assert result["global_step"] == 3
+    assert np.isfinite(result["loss"])
+    # NSP-free loss is pure MLM cross-entropy: ~ln(vocab)
+    assert 4.0 < result["loss"] < 9.0
+    loaded = ckpt.load_checkpoint(ckpt.checkpoint_path(
+        os.path.join(workdir["out"], "pretrain_ckpts"), 3))
+    assert "seq_relationship" not in loaded["model"]
+    assert "token_type_embeddings" not in loaded["model"]["bert"]["embeddings"]
+    assert "pooler" not in loaded["model"]["bert"]
