@@ -379,9 +379,12 @@ def from_pretrained(
     if config is None:
         raise ValueError("no config.json found; pass config explicitly")
 
+    from urllib.parse import urlparse
+
+    hint_path = urlparse(kind_hint).path if "://" in kind_hint else kind_hint
     if weights.endswith((".bin", ".pt", ".pth")) or (
             weights != kind_hint
-            and kind_hint.rstrip("/").endswith((".bin", ".pt", ".pth"))):
+            and hint_path.endswith((".bin", ".pt", ".pth"))):
         import torch
 
         sd = torch.load(weights, map_location="cpu", weights_only=True)

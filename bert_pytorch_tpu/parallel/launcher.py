@@ -66,11 +66,25 @@ def initialize(
     auto_env = len([h for h in hostnames.split(",") if h]) > 1 or (
         "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
     )
+    # Generic env override (the Cobalt ssh fan-out script sets these,
+    # scripts/run_pretraining.cobalt; any launcher without SLURM vars can).
+    # ANY of the three present marks the run as explicitly multi-host, so a
+    # partially-configured rank fails loudly inside initialize() instead of
+    # silently training solo while its peers block on the rendezvous.
+    env_explicit = any(v in os.environ for v in (
+        "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"))
+    explicit = explicit or env_explicit
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
     slurm = "SLURM_NODELIST" in os.environ and int(os.environ.get("SLURM_NNODES", "1")) > 1
     if not (explicit or auto_env or slurm):
         return  # single host, single process: nothing to rendezvous
     kwargs = {}
-    if coordinator_address or slurm:
+    if coordinator_address or slurm or process_id is not None:
         kwargs["coordinator_address"] = coordinator_address or infer_coordinator()
     if num_processes is not None:
         kwargs["num_processes"] = num_processes
@@ -83,11 +97,14 @@ def initialize(
     try:
         jax.distributed.initialize(**kwargs)
     except RuntimeError as e:
-        # Backend already initialized (e.g. a harness touched jax.devices()
-        # first). Multi-host rendezvous is impossible now; continue
-        # single-process rather than killing a single-host run.
-        import warnings
+        if "already initialized" in str(e).lower() and not env_explicit:
+            # A harness touched jax.devices() first on a single-host run;
+            # continue single-process rather than killing it.
+            import warnings
 
-        warnings.warn(f"jax.distributed.initialize skipped: {e}")
-        return
+            warnings.warn(f"jax.distributed.initialize skipped: {e}")
+            return
+        # Explicitly configured multi-host: a failed rendezvous must be
+        # fatal, or this rank trains solo against its peers.
+        raise
     _INITIALIZED = True

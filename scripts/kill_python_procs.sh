@@ -1,0 +1,6 @@
+#!/bin/bash
+# Kill stray training processes on this host (reference
+# scripts/kill_python_procs.sh:3-4 — its GPU-process killer).
+pkill -f run_pretraining.py
+pkill -f run_squad.py
+pkill -f run_ner.py
