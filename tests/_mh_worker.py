@@ -1,0 +1,63 @@
+"""Worker for the 2-process multi-host integration test."""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+coordinator, n_proc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(
+    coordinator_address=coordinator, num_processes=n_proc, process_id=rank)
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from bert_pytorch_tpu import optim, pretrain
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.models import BertForPreTraining
+from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
+
+assert jax.process_count() == n_proc, jax.process_count()
+assert len(jax.devices()) == 4 * n_proc, len(jax.devices())
+
+config = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=2,
+                    num_attention_heads=2, intermediate_size=32,
+                    max_position_embeddings=16, next_sentence=True)
+model = BertForPreTraining(config, dtype=jnp.float32)
+mesh = create_mesh(MeshConfig(data=-1))
+rules = logical_axis_rules("dp")
+schedule = optim.warmup_poly_schedule(1e-3, 0.1, 50)
+tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+S = 16
+local_b = 8  # per process; global batch 16
+sample = (jnp.zeros((1, S), jnp.int32),) * 3
+
+rng = np.random.default_rng(rank)
+host = {
+    "input_ids": rng.integers(0, 64, (local_b, S)).astype(np.int32),
+    "segment_ids": np.zeros((local_b, S), np.int32),
+    "input_mask": np.ones((local_b, S), np.int32),
+    "masked_lm_labels": np.where(rng.random((local_b, S)) < 0.2,
+                                 rng.integers(0, 64, (local_b, S)),
+                                 -1).astype(np.int32),
+    "next_sentence_labels": rng.integers(0, 2, (local_b,)).astype(np.int32),
+}
+with mesh:
+    sh = pretrain.state_shardings(mesh, model, rules, sample)
+    bs = pretrain.batch_shardings(mesh, {"input_ids": 3, "segment_ids": 3,
+        "input_mask": 3, "masked_lm_labels": 3, "next_sentence_labels": 2})
+    state = pretrain.make_init_fn(model, tx, sample, sh)(jax.random.PRNGKey(0))
+    step = pretrain.make_train_step(model, tx, schedule=schedule,
+        next_sentence=True, shardings=sh, batch_shardings_=bs)
+    # multi-host path of put_batch: each process contributes its local slice
+    batch = pretrain.put_batch(pretrain.stack_microbatches(host, 1), bs)
+    losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+print(f"RANK{rank} OK losses={['%.4f' % l for l in losses]}", flush=True)
