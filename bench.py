@@ -18,6 +18,7 @@ are tuned for — the closest external anchor the reference offers.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import jax
@@ -27,8 +28,22 @@ import numpy as np
 A100_PHASE1_SEQ_PER_SEC = 360.0
 
 # Per-chip microbatch. The phase-1 recipe uses 96/GPU on 40GB A100s
-# (BASELINE.md); sized down for a 16GB v5e chip with fp32 master params.
-LOCAL_BATCH = 32
+# (BASELINE.md); tuned for a 16GB v5e chip with fp32 master params.
+# Measured on v5e (seq 128, max_pred 20, dropout on):
+#   batch 32, remat none, threefry: 281 seq/s   (fits without remat)
+#   batch 32, remat none, rbg:      327 seq/s   (hardware RNG for dropout)
+#   batch 64, remat dots, rbg:      382 seq/s   (remat unlocks 2x batch)
+# 'dots' remat keeps matmul outputs and recomputes elementwise ops in the
+# backward; with the TPU hardware RNG ('rbg') that recompute is cheap, so the
+# larger microbatch wins. With threefry the same config is SLOWER than
+# batch 32 (recompute regenerates every dropout mask in ALU ops).
+LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", "64"))
+REMAT = os.environ.get("BENCH_REMAT", "dots")
+RNG_IMPL = os.environ.get("BENCH_RNG_IMPL", "rbg")
+if REMAT not in ("none", "dots", "full"):
+    raise ValueError(f"BENCH_REMAT must be none|dots|full, got {REMAT!r}")
+if RNG_IMPL not in ("rbg", "threefry2x32"):
+    raise ValueError(f"BENCH_RNG_IMPL must be rbg|threefry2x32, got {RNG_IMPL!r}")
 SEQ_LEN = 128
 MAX_PRED = 20  # phase-1 max_predictions_per_seq (BASELINE.md recipe)
 ACCUM = 1
@@ -37,11 +52,11 @@ MEASURE_STEPS = 20
 
 
 def main():
+    jax.config.update("jax_default_prng_impl", RNG_IMPL)
     from bert_pytorch_tpu import optim, pretrain
     from bert_pytorch_tpu.config import BertConfig
     from bert_pytorch_tpu.models import BertForPreTraining
     from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
-    import os
 
     config = BertConfig.from_json_file(
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -52,7 +67,7 @@ def main():
     n_chips = len(jax.devices())
     mesh = create_mesh(MeshConfig(data=-1))
     rules = logical_axis_rules("dp")
-    model = BertForPreTraining(config, dtype=jnp.bfloat16)
+    model = BertForPreTraining(config, dtype=jnp.bfloat16, remat=REMAT)
     schedule = optim.warmup_poly_schedule(6e-3, 0.2843, 7038)
     tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
 

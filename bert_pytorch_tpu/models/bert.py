@@ -349,6 +349,8 @@ class BertEncoder(nn.Module):
     @nn.compact
     def __call__(self, hidden: Array, bias: Array, deterministic: bool = True):
         cfg = self.config
+        if self.remat not in ("none", "dots", "full"):
+            raise ValueError(f"remat must be none|dots|full, got {self.remat!r}")
         layer_cls = BertLayer
         if self.remat != "none":
             policy = (

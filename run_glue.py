@@ -1,0 +1,238 @@
+"""GLUE finetuning runner — sequence classification / regression on TPU.
+
+Beyond-reference capability: the reference downloads GLUE
+(utils/download.py:81-101) but has no runner that consumes it; this closes
+the loop with a `BertForSequenceClassification` finetune in the classic BERT
+GLUE recipe (lr 2e-5, 3 epochs, warmup 0.1, AdamW, max_seq 128). All nine
+tasks from the downloader's TSV layout are supported
+(:mod:`bert_pytorch_tpu.data.glue`), including the STS-B regression path
+(num_labels=1, MSE) and MNLI's matched/mismatched dev sets.
+
+Follows the same conventions as run_ner.py / run_squad.py: model config
+JSON supplies vocab/tokenizer, ``--init_checkpoint`` accepts this
+framework's checkpoints or foreign (torch/TF) archives, results land in a
+dllogger-style one-line JSON summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from bert_pytorch_tpu import optim
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.data import glue
+from bert_pytorch_tpu.data.tokenization import (
+    get_bpe_tokenizer,
+    get_wordpiece_tokenizer,
+)
+from bert_pytorch_tpu.models import BertForSequenceClassification
+from bert_pytorch_tpu.models.losses import _xent_ignore
+from bert_pytorch_tpu.ops.grad_utils import clip_by_global_norm
+from bert_pytorch_tpu.utils import checkpoint as ckpt
+from bert_pytorch_tpu.utils import logging as logger
+
+
+def parse_arguments(argv=None):
+    parser = argparse.ArgumentParser(description="TPU BERT GLUE finetuning")
+    parser.add_argument("--task", type=str, required=True,
+                        choices=sorted(glue.PROCESSORS))
+    parser.add_argument("--data_dir", type=str, required=True,
+                        help="Directory holding the task's train/dev TSVs")
+    parser.add_argument("--model_config_file", type=str, required=True)
+    parser.add_argument("--init_checkpoint", type=str, default=None)
+    parser.add_argument("--output_dir", type=str, default=None)
+    parser.add_argument("--vocab_file", type=str, default=None)
+    parser.add_argument("--uppercase", action="store_true")
+    parser.add_argument("--tokenizer", type=str, default=None,
+                        choices=["wordpiece", "bpe"])
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--warmup_proportion", type=float, default=0.1)
+    parser.add_argument("--clip_grad", type=float, default=1.0)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--max_seq_len", type=int, default=128)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--skip_eval", action="store_true")
+    args = parser.parse_args(argv)
+
+    with open(args.model_config_file) as f:
+        configs = json.load(f)
+    if args.vocab_file is None:
+        args.vocab_file = configs.get("vocab_file")
+        if args.vocab_file is None:
+            raise ValueError("vocab_file must be in model config or CLI")
+    if args.tokenizer is None:
+        args.tokenizer = configs.get("tokenizer", "wordpiece")
+    return args
+
+
+def batches(arrays: dict, batch_size: int, shuffle: bool, rng):
+    """Yield dict minibatches; the last partial batch is padded to a full
+    batch with repeated rows plus a ``valid`` mask so every jitted call sees
+    one static shape (one compile, XLA-friendly)."""
+    n = len(arrays["labels"])
+    order = rng.permutation(n) if shuffle else np.arange(n)
+    for i in range(0, n, batch_size):
+        idx = order[i:i + batch_size]
+        valid = np.ones(batch_size, bool)
+        if len(idx) < batch_size:
+            valid[len(idx):] = False
+            idx = np.concatenate([idx, np.zeros(batch_size - len(idx), idx.dtype)])
+        yield {k: v[idx] for k, v in arrays.items()}, valid
+
+
+def main(args):
+    processor = glue.PROCESSORS[args.task]()
+    regression = processor.regression
+    num_labels = 1 if regression else len(processor.labels)
+    logger.init(handlers=[logger.StreamHandler()])
+
+    if args.tokenizer == "wordpiece":
+        tokenizer = get_wordpiece_tokenizer(args.vocab_file,
+                                            uppercase=args.uppercase)
+    else:
+        tokenizer = get_bpe_tokenizer(args.vocab_file, uppercase=args.uppercase)
+
+    splits = {"train": processor.get_train_examples(args.data_dir)}
+    if not args.skip_eval:
+        splits["dev"] = processor.get_dev_examples(args.data_dir)
+    arrays = {
+        name: glue.features_to_arrays(
+            glue.convert_examples_to_features(
+                examples, tokenizer, args.max_seq_len,
+                processor.labels, regression),
+            regression)
+        for name, examples in splits.items()
+    }
+    logger.info(
+        f"task={args.task} train={len(arrays['train']['labels'])} "
+        + (f"dev={len(arrays['dev']['labels'])}" if "dev" in arrays else "")
+    )
+
+    config = BertConfig.from_json_file(args.model_config_file)
+    if config.vocab_size % 8 != 0:
+        config.vocab_size += 8 - (config.vocab_size % 8)
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = BertForSequenceClassification(config, num_labels=num_labels,
+                                          dtype=dtype)
+
+    sample = (jnp.zeros((1, args.max_seq_len), jnp.int32),) * 3
+    import flax.linen as nn
+
+    params = nn.unbox(
+        model.init(jax.random.PRNGKey(args.seed), *sample))["params"]
+    if args.init_checkpoint:
+        from bert_pytorch_tpu.models import (
+            is_foreign_checkpoint,
+            load_encoder_params,
+        )
+
+        if is_foreign_checkpoint(args.init_checkpoint):
+            params = load_encoder_params(args.init_checkpoint, config, params)
+        else:
+            state = ckpt.load_checkpoint(args.init_checkpoint)
+            source = state.get("model", state)
+            if "bert" not in source:
+                raise ValueError(
+                    f"checkpoint {args.init_checkpoint} has no 'bert' encoder "
+                    f"subtree (top-level keys: {sorted(source)[:8]})")
+            params["bert"] = ckpt.restore_tree(params["bert"], source["bert"])
+        logger.info(f"loaded pretrained encoder from {args.init_checkpoint}")
+
+    steps_per_epoch = max(
+        1, -(-len(arrays["train"]["labels"]) // args.batch_size))
+    total_steps = steps_per_epoch * args.epochs
+    schedule = optim.warmup_linear_schedule(
+        args.lr, args.warmup_proportion, total_steps)
+    # bias_correction=False for parity with the sibling finetune runners'
+    # FusedAdam recipe (run_squad.py, run_ner.py; optim/transforms.py).
+    tx = optim.adamw(schedule, weight_decay=0.01, bias_correction=False,
+                     weight_decay_mask=optim.no_decay_mask)
+    opt_state = tx.init(params)
+
+    def loss_from_logits(logits, labels, valid):
+        weights = valid.astype(jnp.float32)
+        if regression:
+            err = (logits.squeeze(-1).astype(jnp.float32) - labels) ** 2
+            return jnp.sum(err * weights) / jnp.maximum(weights.sum(), 1.0)
+        return _xent_ignore(
+            logits.astype(jnp.float32), jnp.where(valid, labels, -1), -1)
+
+    def train_step(params, opt_state, batch, valid, dropout_rng):
+        def loss_fn(p):
+            logits = model.apply(
+                {"params": p}, batch["input_ids"], batch["segment_ids"],
+                batch["input_mask"], False, rngs={"dropout": dropout_rng})
+            return loss_from_logits(logits, batch["labels"], valid)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads, _ = clip_by_global_norm(grads, args.clip_grad)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    train_step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    @jax.jit
+    def eval_step(params, batch):
+        return model.apply(
+            {"params": params}, batch["input_ids"], batch["segment_ids"],
+            batch["input_mask"])
+
+    def evaluate():
+        preds, labels = [], []
+        for batch, valid in batches(arrays["dev"], args.batch_size, False,
+                                    np.random.default_rng(0)):
+            logits = np.asarray(eval_step(params, batch), np.float32)
+            out = (logits.squeeze(-1) if regression
+                   else logits.argmax(axis=-1))
+            preds.append(out[valid])
+            labels.append(batch["labels"][valid])
+        return glue.compute_metrics(
+            args.task, np.concatenate(preds), np.concatenate(labels))
+
+    rng = np.random.default_rng(args.seed)
+    key = jax.random.PRNGKey(args.seed)
+    t0 = time.perf_counter()
+    seen = 0
+    for epoch in range(args.epochs):
+        losses = []
+        for batch, valid in batches(arrays["train"], args.batch_size, True,
+                                    rng):
+            key, sub = jax.random.split(key)
+            params, opt_state, loss = train_step(
+                params, opt_state, batch, valid, sub)
+            losses.append(float(loss))
+            seen += int(valid.sum())
+        logger.info(f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
+    train_time = time.perf_counter() - t0
+
+    results = {
+        "e2e_train_time": train_time,
+        "training_sequences_per_second": seen / train_time if train_time else 0,
+    }
+    if not args.skip_eval:
+        results.update(evaluate())
+    logger.info(json.dumps({"glue_summary": {"task": args.task, **results}}))
+
+    if args.output_dir:
+        os.makedirs(args.output_dir, exist_ok=True)
+        ckpt.save_checkpoint(args.output_dir, total_steps, {"model": params})
+        with open(os.path.join(args.output_dir,
+                               f"eval_results_{args.task}.json"), "w") as f:
+            json.dump(results, f, indent=2)
+    logger.close()
+    return results
+
+
+if __name__ == "__main__":
+    main(parse_arguments())

@@ -1,0 +1,20 @@
+#!/bin/bash
+# GLUE finetune + eval in the classic BERT recipe (lr 2e-5, 3 epochs,
+# warmup 0.1, seq 128). The reference only downloads GLUE
+# (utils/download.py:81-101); this runner closes the loop.
+# Usage: TASK=mrpc GLUE_DIR=data/download/glue ./scripts/run_glue.sh
+set -euo pipefail
+TASK=${TASK:-mrpc}
+GLUE_DIR=${GLUE_DIR:-data/download/glue}
+declare -A DIRS=(
+    [cola]=CoLA [sst-2]=SST-2 [mrpc]=MRPC [sts-b]=STS-B [qqp]=QQP
+    [mnli]=MNLI [mnli-mm]=MNLI [qnli]=QNLI [rte]=RTE [wnli]=WNLI
+)
+python run_glue.py \
+    --task "$TASK" \
+    --data_dir "$GLUE_DIR/${DIRS[$TASK]}" \
+    --model_config_file configs/bert_large_uncased_config.json \
+    --init_checkpoint "${INIT_CKPT:?set INIT_CKPT to a pretraining checkpoint}" \
+    --output_dir "results/glue_$TASK" \
+    --lr 2e-5 --epochs 3 --warmup_proportion 0.1 \
+    --batch_size 32 --max_seq_len 128

@@ -35,6 +35,15 @@ jax.config.update("jax_default_matmul_precision", "highest")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _reset_prng_impl():
+    """run_pretraining sets the process-global PRNG impl (--rng_impl, default
+    'rbg'); reset it so tests that ran after a runner test see the same
+    threefry streams as tests that ran first."""
+    yield
+    jax.config.update("jax_default_prng_impl", "threefry2x32")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -1,0 +1,217 @@
+"""GLUE processors, featurization, metrics, and a tiny end-to-end finetune."""
+
+import json
+
+import numpy as np
+import pytest
+
+VOCAB_TOKENS = (
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    + ["the", "movie", "was", "great", "terrible", "a", "film", "good",
+       "bad", "very", "it", "is", "same", "different", "paris", "london"]
+)
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    path.write_text("\n".join(VOCAB_TOKENS) + "\n")
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def tokenizer(vocab_file):
+    from bert_pytorch_tpu.data.tokenization import get_wordpiece_tokenizer
+
+    return get_wordpiece_tokenizer(vocab_file)
+
+
+def _write_tsv(path, rows, header=None):
+    lines = (["\t".join(header)] if header else []) + [
+        "\t".join(str(c) for c in row) for row in rows
+    ]
+    path.write_text("\n".join(lines) + "\n")
+
+
+@pytest.fixture(scope="module")
+def sst2_dir(tmp_path_factory):
+    """SST-2-shaped data where sentiment is decidable from one word."""
+    d = tmp_path_factory.mktemp("SST-2")
+    rows = []
+    for i in range(24):
+        good = i % 2 == 0
+        text = f"the movie was {'great' if good else 'terrible'}"
+        rows.append((text, int(good)))
+    _write_tsv(d / "train.tsv", rows, header=("sentence", "label"))
+    _write_tsv(d / "dev.tsv", rows[:8], header=("sentence", "label"))
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def mrpc_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("MRPC")
+    header = ("Quality", "#1 ID", "#2 ID", "#1 String", "#2 String")
+    rows = [
+        (1, i, i, "the movie was great", "the film was good")
+        if i % 2 == 0
+        else (0, i, i, "the movie was great", "paris is different")
+        for i in range(12)
+    ]
+    _write_tsv(d / "train.tsv", rows, header=header)
+    _write_tsv(d / "dev.tsv", rows[:6], header=header)
+    return str(d)
+
+
+@pytest.fixture(scope="module")
+def stsb_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("STS-B")
+    header = tuple(f"c{i}" for i in range(7)) + ("sentence1", "sentence2", "score")
+    rows = [
+        ("x",) * 7 + ("the movie was great", "the film was good", "4.2")
+        if i % 2 == 0
+        else ("x",) * 7 + ("the movie was great", "paris is different", "0.5")
+        for i in range(12)
+    ]
+    _write_tsv(d / "train.tsv", rows, header=header)
+    _write_tsv(d / "dev.tsv", rows[:6], header=header)
+    return str(d)
+
+
+def test_sst2_processor_reads_rows(sst2_dir):
+    from bert_pytorch_tpu.data import glue
+
+    proc = glue.PROCESSORS["sst-2"]()
+    train = proc.get_train_examples(sst2_dir)
+    dev = proc.get_dev_examples(sst2_dir)
+    assert len(train) == 24 and len(dev) == 8
+    assert train[0].text_a == "the movie was great"
+    assert train[0].text_b is None
+    assert train[0].label == "1" and train[1].label == "0"
+
+
+def test_mrpc_processor_pairs(mrpc_dir):
+    from bert_pytorch_tpu.data import glue
+
+    ex = glue.PROCESSORS["mrpc"]().get_train_examples(mrpc_dir)[0]
+    assert ex.text_a == "the movie was great"
+    assert ex.text_b == "the film was good"
+    assert ex.label == "1"
+
+
+def test_stsb_processor_regression(stsb_dir):
+    from bert_pytorch_tpu.data import glue
+
+    proc = glue.PROCESSORS["sts-b"]()
+    assert proc.regression
+    ex = proc.get_train_examples(stsb_dir)[0]
+    assert float(ex.label) == pytest.approx(4.2)
+
+
+def test_features_pair_layout(mrpc_dir, tokenizer):
+    from bert_pytorch_tpu.data import glue
+
+    proc = glue.PROCESSORS["mrpc"]()
+    examples = proc.get_train_examples(mrpc_dir)
+    feats = glue.convert_examples_to_features(
+        examples, tokenizer, 16, proc.labels)
+    f = feats[0]
+    cls_id = tokenizer.token_to_id("[CLS]")
+    sep_id = tokenizer.token_to_id("[SEP]")
+    assert f.input_ids[0] == cls_id
+    sep_positions = np.flatnonzero(f.input_ids == sep_id)
+    assert len(sep_positions) == 2
+    # segment 0 through the first [SEP], segment 1 for the b side
+    assert f.segment_ids[sep_positions[0]] == 0
+    assert f.segment_ids[sep_positions[0] + 1] == 1
+    assert f.segment_ids[sep_positions[1]] == 1
+    # padding after the second [SEP]
+    assert f.input_mask[sep_positions[1]] == 1
+    assert np.all(f.input_ids[len(np.flatnonzero(f.input_mask)):] == 0)
+
+
+def test_truncate_pair_budget(tokenizer):
+    from bert_pytorch_tpu.data import glue
+
+    examples = [glue.InputExample(
+        "t-0", " ".join(["movie"] * 30), " ".join(["film"] * 3), "0")]
+    feats = glue.convert_examples_to_features(examples, tokenizer, 16, ("0", "1"))
+    # longest-first truncation keeps the short b side intact
+    ids = feats[0].input_ids[feats[0].input_mask.astype(bool)]
+    film = tokenizer.token_to_id("film")
+    assert int(np.sum(ids == film)) == 3
+    assert len(ids) == 16
+
+
+def test_metrics_matthews_and_correlation():
+    from bert_pytorch_tpu.data import glue
+
+    preds = np.array([1, 1, 0, 0])
+    labels = np.array([1, 1, 0, 0])
+    assert glue.matthews(preds, labels)["matthews"] == pytest.approx(1.0)
+    assert glue.matthews(1 - preds, labels)["matthews"] == pytest.approx(-1.0)
+
+    x = np.array([1.0, 2.0, 3.0, 4.0])
+    m = glue.pearson_and_spearman(x, 2 * x + 1)
+    assert m["pearson"] == pytest.approx(1.0)
+    assert m["spearman"] == pytest.approx(1.0)
+    m = glue.pearson_and_spearman(x, np.array([1.0, 4.0, 9.0, 16.0]))
+    assert m["spearman"] == pytest.approx(1.0)  # monotone, nonlinear
+    assert m["pearson"] < 1.0
+
+    m = glue.acc_and_f1(np.array([1, 0, 1, 0]), np.array([1, 1, 1, 0]))
+    assert m["accuracy"] == pytest.approx(0.75)
+    assert m["f1"] == pytest.approx(0.8)
+
+
+def _model_config(tmp_path, vocab_file):
+    config = {
+        "vocab_size": len(VOCAB_TOKENS), "hidden_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 64, "max_position_embeddings": 32,
+        "type_vocab_size": 2, "next_sentence": True,
+        "vocab_file": vocab_file, "tokenizer": "wordpiece",
+    }
+    path = tmp_path / "model.json"
+    path.write_text(json.dumps(config))
+    return str(path)
+
+
+def test_glue_end_to_end_sst2(tmp_path, sst2_dir, vocab_file):
+    import run_glue
+
+    args = run_glue.parse_arguments([
+        "--task", "sst-2", "--data_dir", sst2_dir,
+        "--model_config_file", _model_config(tmp_path, vocab_file),
+        "--output_dir", str(tmp_path / "out"),
+        "--epochs", "10", "--batch_size", "8", "--max_seq_len", "16",
+        "--lr", "3e-3", "--dtype", "float32",
+    ])
+    results = run_glue.main(args)
+    # single-word sentiment on a 2-layer model must be learnable
+    assert results["accuracy"] >= 0.75
+    assert (tmp_path / "out" / "eval_results_sst-2.json").exists()
+
+
+def test_glue_end_to_end_stsb_regression(tmp_path, stsb_dir, vocab_file):
+    import run_glue
+
+    args = run_glue.parse_arguments([
+        "--task", "sts-b", "--data_dir", stsb_dir,
+        "--model_config_file", _model_config(tmp_path, vocab_file),
+        "--epochs", "4", "--batch_size", "4", "--max_seq_len", "16",
+        "--lr", "1e-3", "--dtype", "float32",
+    ])
+    results = run_glue.main(args)
+    assert "pearson" in results and np.isfinite(results["pearson"])
+
+
+def test_glue_partial_batch_padding():
+    from run_glue import batches
+
+    arrays = {"labels": np.arange(10, dtype=np.int32),
+              "input_ids": np.arange(10, dtype=np.int32)[:, None]}
+    out = list(batches(arrays, 4, False, np.random.default_rng(0)))
+    assert len(out) == 3
+    last_batch, valid = out[-1]
+    assert last_batch["labels"].shape == (4,)
+    assert valid.tolist() == [True, True, False, False]
