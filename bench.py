@@ -40,8 +40,11 @@ A100_PHASE1_SEQ_PER_SEC = 360.0
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", "64"))
 REMAT = os.environ.get("BENCH_REMAT", "dots")
 RNG_IMPL = os.environ.get("BENCH_RNG_IMPL", "rbg")
+ATTN = os.environ.get("BENCH_ATTN", "xla")  # 'xla' | 'pallas'
 if REMAT not in ("none", "dots", "full"):
     raise ValueError(f"BENCH_REMAT must be none|dots|full, got {REMAT!r}")
+if ATTN not in ("xla", "pallas"):
+    raise ValueError(f"BENCH_ATTN must be xla|pallas, got {ATTN!r}")
 if RNG_IMPL not in ("rbg", "threefry2x32"):
     raise ValueError(f"BENCH_RNG_IMPL must be rbg|threefry2x32, got {RNG_IMPL!r}")
 SEQ_LEN = 128
@@ -67,7 +70,8 @@ def main():
     n_chips = len(jax.devices())
     mesh = create_mesh(MeshConfig(data=-1))
     rules = logical_axis_rules("dp")
-    model = BertForPreTraining(config, dtype=jnp.bfloat16, remat=REMAT)
+    model = BertForPreTraining(config, dtype=jnp.bfloat16, remat=REMAT,
+                               attention_backend=ATTN)
     schedule = optim.warmup_poly_schedule(6e-3, 0.2843, 7038)
     tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
 
