@@ -36,6 +36,7 @@ from bert_pytorch_tpu.models.convert import (
     from_pretrained,
     is_foreign_checkpoint,
     load_encoder_params,
+    load_pretrained_encoder,
     load_tf_checkpoint,
     merge_params,
 )
@@ -67,6 +68,7 @@ __all__ = [
     "from_pretrained",
     "is_foreign_checkpoint",
     "load_encoder_params",
+    "load_pretrained_encoder",
     "load_tf_checkpoint",
     "merge_params",
     "masked_lm_loss",

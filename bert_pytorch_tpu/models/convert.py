@@ -339,6 +339,40 @@ def load_encoder_params(path: str, config: BertConfig, target: Dict) -> Dict:
     return merge_params(target, {"bert": loaded["bert"]})
 
 
+def load_pretrained_encoder(
+    path: str,
+    config: BertConfig,
+    target: Dict,
+    fallback_full_tree: bool = False,
+) -> Dict:
+    """The finetuning runners' shared ``--init_checkpoint`` handling: accept
+    a foreign archive (dir / torch .bin / TF prefix — :func:`from_pretrained`
+    surface) or one of our msgpack checkpoints, and overlay its 'bert'
+    encoder subtree onto freshly initialized ``target`` params (the
+    strict=False analog of reference run_squad.py:957-961).
+
+    ``fallback_full_tree`` restores the whole tree when the checkpoint has no
+    'bert' subtree (resuming a finetuned head, not just an encoder); without
+    it that case raises — a silent skip would leave random init in place
+    while claiming success.
+    """
+    from bert_pytorch_tpu.utils import checkpoint as ckpt
+
+    if is_foreign_checkpoint(path):
+        return load_encoder_params(path, config, target)
+    state = ckpt.load_checkpoint(path)
+    source = state.get("model", state)
+    if "bert" in source:
+        target = dict(target)
+        target["bert"] = ckpt.restore_tree(target["bert"], source["bert"])
+        return target
+    if fallback_full_tree:
+        return ckpt.restore_tree(target, source)
+    raise ValueError(
+        f"checkpoint {path} has no 'bert' encoder subtree "
+        f"(top-level keys: {sorted(source)[:8]})")
+
+
 def from_pretrained(
     path: str, config: Optional[BertConfig] = None
 ) -> Tuple[BertConfig, Dict]:

@@ -230,11 +230,25 @@ class WeightsDownloader(Downloader):
             print(f"[download] {matches[0]} verified")
 
 
+class SwagDownloader(Downloader):
+    """SWAG multiple-choice CSVs (rowanz/swagaf) for run_swag.py —
+    beyond-reference: the reference's BertForMultipleChoice has no data
+    source at all."""
+
+    BASE = "https://raw.githubusercontent.com/rowanz/swagaf/master/data"
+
+    def download(self) -> None:
+        out = os.path.join(self.output_dir, "swag")
+        for name in ("train.csv", "val.csv", "test.csv"):
+            fetch(f"{self.BASE}/{name}", os.path.join(out, name))
+
+
 DOWNLOADERS = {
     "squad": SquadDownloader,
     "wikicorpus": WikiCorpusDownloader,
     "bookscorpus": BooksCorpusDownloader,
     "glue": GLUEDownloader,
+    "swag": SwagDownloader,
     "weights": WeightsDownloader,
 }
 

@@ -128,16 +128,9 @@ def main(args):
 
     params = nn.unbox(model.init(jax.random.PRNGKey(args.seed), *sample))["params"]
     if args.model_checkpoint:
-        from bert_pytorch_tpu.models import is_foreign_checkpoint, load_encoder_params
+        from bert_pytorch_tpu.models import load_pretrained_encoder
 
-        path = args.model_checkpoint
-        if is_foreign_checkpoint(path):
-            params = load_encoder_params(path, config, params)
-        else:
-            state = ckpt.load_checkpoint(path)
-            source = state.get("model", state)
-            if "bert" in source:
-                params["bert"] = ckpt.restore_tree(params["bert"], source["bert"])
+        params = load_pretrained_encoder(args.model_checkpoint, config, params)
         logger.info(f"loaded pretrained encoder from {args.model_checkpoint}")
 
     # AdamW(bias_correction=False) + per-epoch 1/(1+0.05*epoch) decay

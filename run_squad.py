@@ -150,19 +150,11 @@ def load_init_params(args, abstract_params, config):
     directory with config.json + pytorch_model.bin / bert_model.ckpt.*, a
     torch .bin/.pt file, or a TF checkpoint prefix (the reference
     from_pretrained surface, modeling.py:659-799)."""
-    from bert_pytorch_tpu.models import is_foreign_checkpoint, load_encoder_params
+    from bert_pytorch_tpu.models import load_pretrained_encoder
 
-    path = args.init_checkpoint
     target = jax.device_get(abstract_params)
-    if is_foreign_checkpoint(path):
-        return load_encoder_params(path, config, target)
-    state = ckpt.load_checkpoint(path)
-    source = state.get("model", state)
-    if "bert" in source:
-        target["bert"] = ckpt.restore_tree(target["bert"], source["bert"])
-    else:
-        target = ckpt.restore_tree(target, source)
-    return target
+    return load_pretrained_encoder(
+        args.init_checkpoint, config, target, fallback_full_tree=True)
 
 
 def features_to_arrays(features, is_training):

@@ -1,0 +1,15 @@
+#!/bin/bash
+# SWAG multiple-choice finetune + eval (original SWAG BERT recipe:
+# lr 2e-5, 3 epochs, warmup 0.1). Beyond-reference: the reference defines
+# BertForMultipleChoice but has no runner for it.
+# Data: python -m bert_pytorch_tpu.tools.download --dataset swag --output_dir data/download
+set -euo pipefail
+SWAG_DIR=${SWAG_DIR:-data/download/swag}
+python run_swag.py \
+    --train_file "$SWAG_DIR/train.csv" \
+    --val_file "$SWAG_DIR/val.csv" \
+    --model_config_file configs/bert_large_uncased_config.json \
+    --init_checkpoint "${INIT_CKPT:?set INIT_CKPT to a pretraining checkpoint}" \
+    --output_dir results/swag \
+    --lr 2e-5 --epochs 3 --warmup_proportion 0.1 \
+    --batch_size 16 --max_seq_len 128
