@@ -44,6 +44,15 @@ def dot_product_attention(
     Returns [B, S, H, D]. Scores are scaled by 1/sqrt(D) and softmaxed in
     fp32 (modeling.py:403-429's score path, bf16-safe).
     """
+    if backend == "auto":
+        # Measured crossover (module docstring): the fused kernel wins from
+        # seq ~256 up; below that the XLA path is faster. Off-TPU the kernel
+        # would run in pure-Python interpret mode, so auto never picks it.
+        from bert_pytorch_tpu.ops.pallas.common import interpret_mode
+
+        backend = (
+            "pallas" if q.shape[1] >= 256 and not interpret_mode() else "xla"
+        )
     if backend == "pallas":
         # Fused kernel incl. in-kernel dropout from the TPU hardware PRNG
         # (the [B,H,S,S] mask never reaches HBM; see ops/pallas/attention.py).

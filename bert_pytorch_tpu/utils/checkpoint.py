@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import re
 import tempfile
+import threading
 from typing import Any, Optional
 
 import jax
@@ -28,6 +29,37 @@ from flax import serialization
 from bert_pytorch_tpu.utils.dist import is_main_process
 
 CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+
+# At most one background write in flight (async_write=True): a second save
+# joins the first, so checkpoints land in order and memory holds at most one
+# extra host copy of the state.
+_pending_save: Optional[threading.Thread] = None
+_pending_error: list = []
+_pending_lock = threading.Lock()
+
+
+def wait_for_pending_save() -> None:
+    """Block until any in-flight async checkpoint write has finished; raise
+    if it failed.
+
+    Call before reading checkpoints back, at end of training, and before
+    process exit — an unjoined write may otherwise be truncated by
+    interpreter teardown (the write itself is atomic, so a killed process
+    loses only the newest checkpoint, never corrupts one). A failed write
+    (disk full, permissions) re-raises here / at the next save rather than
+    letting training run on while no checkpoints land.
+    """
+    global _pending_save
+    with _pending_lock:
+        thread = _pending_save
+        _pending_save = None
+    if thread is not None:
+        thread.join()
+    with _pending_lock:
+        if _pending_error:
+            error = _pending_error.pop()
+            _pending_error.clear()
+            raise RuntimeError("async checkpoint write failed") from error
 
 
 def checkpoint_path(output_dir: str, step: int) -> str:
@@ -47,26 +79,28 @@ def find_resume_step(output_dir: str) -> Optional[int]:
 
 
 def _to_host(tree: Any) -> Any:
-    """Device arrays -> host numpy (gathering sharded arrays)."""
-    return jax.tree_util.tree_map(
-        lambda x: np.asarray(jax.device_get(x)) if hasattr(x, "dtype") else x, tree
-    )
+    """Device arrays -> host numpy (gathering sharded arrays).
 
-
-def save_checkpoint(
-    output_dir: str,
-    step: int,
-    contents: dict,
-    keep: int = 3,
-) -> Optional[str]:
-    """Serialize ``contents`` (a dict of pytrees/plain values) to
-    ``ckpt_{step}.msgpack``. Main-process-only; prunes to the newest ``keep``
-    checkpoints (reference cadence + retention, run_pretraining.py:496-528).
+    Always returns buffers the caller owns: async writes serialize after this
+    function returns, so a view into a host array (or a CPU-backend jax
+    array's buffer) would let the next train step's buffer reuse corrupt the
+    snapshot. TPU device_get already copies; the owndata check makes the
+    host/CPU cases copy too without double-copying the TPU path.
     """
-    if not is_main_process():
-        return None
-    os.makedirs(output_dir, exist_ok=True)
-    state = serialization.to_state_dict(_to_host(contents))
+
+    def get(x):
+        if not hasattr(x, "dtype"):
+            return x
+        out = np.asarray(jax.device_get(x))
+        # A plain-numpy leaf comes back as the caller's own object (owndata
+        # True but still aliased) — copy it; a view copies too. Only a fresh
+        # device_get transfer is returned as-is.
+        return out.copy() if (out is x or not out.flags.owndata) else out
+
+    return jax.tree_util.tree_map(get, tree)
+
+
+def _write_and_prune(state: Any, output_dir: str, step: int, keep: int) -> None:
     blob = serialization.msgpack_serialize(state)
     path = checkpoint_path(output_dir, step)
     fd, tmp = tempfile.mkstemp(dir=output_dir, suffix=".tmp")
@@ -88,6 +122,49 @@ def save_checkpoint(
             os.unlink(checkpoint_path(output_dir, old))
         except OSError:
             pass
+
+
+def save_checkpoint(
+    output_dir: str,
+    step: int,
+    contents: dict,
+    keep: int = 3,
+    async_write: bool = False,
+) -> Optional[str]:
+    """Serialize ``contents`` (a dict of pytrees/plain values) to
+    ``ckpt_{step}.msgpack``. Main-process-only; prunes to the newest ``keep``
+    checkpoints (reference cadence + retention, run_pretraining.py:496-528).
+
+    ``async_write=True`` fetches the state to host synchronously (it must be
+    snapshotted before the donated train-state buffers are overwritten by the
+    next step), then serializes and writes in a background thread so the
+    train loop only pays for the device->host gather, not the multi-second
+    msgpack+disk write of a BERT-large state. At most one write is in
+    flight; a newer save (or :func:`wait_for_pending_save`) joins it first.
+    """
+    global _pending_save
+    if not is_main_process():
+        return None
+    os.makedirs(output_dir, exist_ok=True)
+    state = serialization.to_state_dict(_to_host(contents))
+    path = checkpoint_path(output_dir, step)
+    if not async_write:
+        wait_for_pending_save()
+        _write_and_prune(state, output_dir, step, keep)
+        return path
+    wait_for_pending_save()
+
+    def run():
+        try:
+            _write_and_prune(state, output_dir, step, keep)
+        except BaseException as e:  # surfaced by wait_for_pending_save
+            with _pending_lock:
+                _pending_error.append(e)
+
+    with _pending_lock:
+        _pending_save = threading.Thread(
+            target=run, name=f"ckpt-write-{step}", daemon=False)
+        _pending_save.start()
     return path
 
 

@@ -85,8 +85,10 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "(keep matmul outputs, recompute elementwise) "
                              "unlocks ~2x larger microbatches and is the "
                              "fastest configuration on 16GB v5e chips")
-    parser.add_argument("--attention_backend", type=str, default="xla",
-                        choices=["xla", "pallas", "ring"])
+    parser.add_argument("--attention_backend", type=str, default="auto",
+                        choices=["auto", "xla", "pallas", "ring"],
+                        help="'auto' picks the measured winner by sequence "
+                             "length: XLA <256, fused Pallas kernel >=256")
     parser.add_argument("--rng_impl", type=str, default="rbg",
                         choices=["rbg", "threefry2x32"],
                         help="dropout PRNG: 'rbg' uses the TPU hardware "
@@ -430,9 +432,11 @@ def main(args) -> dict:
                                 "epoch": epoch}
                     if kfac_state is not None:
                         contents["preconditioner"] = kfac_state
+                    # Async: the loop pays only the device->host gather; the
+                    # msgpack+disk write overlaps the next training steps.
                     ckpt.save_checkpoint(
                         args.model_output_dir, save_step, contents,
-                        keep=args.keep_checkpoints)
+                        keep=args.keep_checkpoints, async_write=True)
                     logger.info(f"Saved checkpoint at step {save_step}")
 
                 if step_in_run >= steps_this_run or global_step >= args.max_steps:
@@ -458,6 +462,7 @@ def main(args) -> dict:
         ckpt.save_checkpoint(
             args.model_output_dir, save_step, contents,
             keep=args.keep_checkpoints)
+        ckpt.wait_for_pending_save()
         logger.close()
         return {"global_step": global_step,
                 "training_seq_per_sec": seq_per_sec,

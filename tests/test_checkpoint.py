@@ -1,0 +1,75 @@
+"""Checkpoint subsystem unit tests: atomic writes, retention, async mode."""
+
+import numpy as np
+
+from bert_pytorch_tpu.utils import checkpoint as ckpt
+
+
+def _contents(step):
+    return {"model": {"w": np.full((4, 4), float(step))}, "epoch": step}
+
+
+def test_save_load_roundtrip(tmp_path):
+    path = ckpt.save_checkpoint(str(tmp_path), 3, _contents(3))
+    state = ckpt.load_checkpoint(path)
+    np.testing.assert_array_equal(state["model"]["w"], np.full((4, 4), 3.0))
+    assert state["epoch"] == 3
+    assert ckpt.find_resume_step(str(tmp_path)) == 3
+
+
+def test_retention_keeps_newest(tmp_path):
+    for step in (1, 2, 3, 4, 5):
+        ckpt.save_checkpoint(str(tmp_path), step, _contents(step), keep=3)
+    assert ckpt.find_resume_step(str(tmp_path)) == 5
+    steps = sorted(
+        int(m.group(1)) for name in tmp_path.iterdir()
+        if (m := ckpt.CKPT_RE.search(name.name)))
+    assert steps == [3, 4, 5]
+
+
+def test_async_write_lands_and_orders(tmp_path):
+    """Async saves must serialize in order and be visible after the wait."""
+    for step in (1, 2, 3, 4):
+        ckpt.save_checkpoint(str(tmp_path), step, _contents(step), keep=2,
+                             async_write=True)
+    ckpt.wait_for_pending_save()
+    assert ckpt.find_resume_step(str(tmp_path)) == 4
+    state = ckpt.load_checkpoint(ckpt.checkpoint_path(str(tmp_path), 4))
+    np.testing.assert_array_equal(state["model"]["w"], np.full((4, 4), 4.0))
+    steps = sorted(
+        int(m.group(1)) for name in tmp_path.iterdir()
+        if (m := ckpt.CKPT_RE.search(name.name)))
+    assert steps == [3, 4]
+    # no stray tmp files
+    assert not [p for p in tmp_path.iterdir() if p.suffix == ".tmp"]
+
+
+def test_async_snapshot_immune_to_mutation(tmp_path):
+    """The state must be snapshotted before save_checkpoint returns: mutating
+    the source buffers afterwards (what donated train-state buffers do on the
+    next step) cannot corrupt the written checkpoint."""
+    contents = _contents(7)
+    ckpt.save_checkpoint(str(tmp_path), 7, contents, async_write=True)
+    contents["model"]["w"][:] = -1.0  # simulate buffer reuse
+    ckpt.wait_for_pending_save()
+    state = ckpt.load_checkpoint(ckpt.checkpoint_path(str(tmp_path), 7))
+    np.testing.assert_array_equal(state["model"]["w"], np.full((4, 4), 7.0))
+
+
+def test_wait_without_pending_is_noop():
+    ckpt.wait_for_pending_save()
+
+
+def test_async_write_failure_raises_at_wait(tmp_path, monkeypatch):
+    """A failed background write must surface, not let training run on."""
+    import pytest
+
+    def boom(*args, **kwargs):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt, "_write_and_prune", boom)
+    ckpt.save_checkpoint(str(tmp_path), 1, _contents(1), async_write=True)
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        ckpt.wait_for_pending_save()
+    # error is consumed; subsequent waits are clean
+    ckpt.wait_for_pending_save()
