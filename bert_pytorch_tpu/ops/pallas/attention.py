@@ -87,21 +87,25 @@ def _flash_fwd_kernel(
     seed_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref, *, block_k, scale, rate
 ):
     # q_ref: [1, block_q, D]; k_ref/v_ref: [1, S, D]; bias_ref: [1, 1, S]
+    # Matmul operands stay in the input dtype (bf16 in training) with fp32
+    # accumulation — a single MXU pass per dot; casting inputs up to fp32
+    # first would decompose each matmul into several passes. The softmax
+    # chain (max/exp/sum) runs in fp32 throughout.
     bh = pl.program_id(0)
     qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0]
     seq_k = k_ref.shape[1]
     block_q, depth = q.shape
     num_kb = seq_k // block_k
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         b = bias_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
+        ) * scale  # [block_q, block_k]
         s = s + b[None, :]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -116,7 +120,8 @@ def _flash_fwd_kernel(
         else:
             p_v = p
         acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p_v, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_v.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return m_new, l_new, acc
 
@@ -135,22 +140,22 @@ def _flash_dq_kernel(
     """dq for one [1, block_q, D] tile; loops over k blocks."""
     bh = pl.program_id(0)
     qb = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0]
     lse = lse_ref[0, 0]  # [block_q]
     delta = delta_ref[0, 0]  # [block_q]
-    do = do_ref[0].astype(jnp.float32)  # [block_q, D]
+    do = do_ref[0]  # [block_q, D]
     seq_k = k_ref.shape[1]
     block_q, depth = q.shape
     num_kb = seq_k // block_k
     inv_keep = 1.0 / (1.0 - rate)
 
     def body(j, dq_acc):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
         b = bias_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) + b[None, :]
+        ) * scale + b[None, :]
         p = jnp.exp(s - lse[:, None])  # normalized probabilities
         da = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -161,7 +166,8 @@ def _flash_dq_kernel(
             da = jnp.where(keep, da * inv_keep, 0.0)
         ds = p * (da - delta[:, None])
         return dq_acc + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
 
     dq = jax.lax.fori_loop(
@@ -177,8 +183,8 @@ def _flash_dkv_kernel(
     """dk/dv/dbias for one [1, block_k, D] tile; loops over q blocks."""
     bh = pl.program_id(0)
     kb = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]  # [block_k, D]
+    v = v_ref[0]
     b = bias_ref[0, 0].astype(jnp.float32)  # [block_k]
     seq_q = q_ref.shape[1]
     block_k, depth = k.shape
@@ -187,13 +193,13 @@ def _flash_dkv_kernel(
 
     def body(i, carry):
         dk_acc, dv_acc, db_acc = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) + b[None, :]
+        ) * scale + b[None, :]
         p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
         da = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -207,11 +213,13 @@ def _flash_dkv_kernel(
             p_v = p
         # dV += (D ⊙ P)ᵀ dO / (1-r)
         dv_acc = dv_acc + jax.lax.dot_general(
-            p_v, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         ds = p * (da - delta[:, None])
         dk_acc = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         return dk_acc, dv_acc, db_acc + jnp.sum(ds, axis=0)
 
@@ -225,7 +233,7 @@ def _flash_dkv_kernel(
             jnp.zeros((block_k,), jnp.float32),
         ),
     )
-    dk_ref[0] = dk.astype(dk_ref.dtype)  # q already carried `scale`
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
     dbias_ref[0, 0] = db.astype(dbias_ref.dtype)
 

@@ -391,12 +391,23 @@ def main(args) -> dict:
         samples_seen = 0
         last_metrics = {}
         done = False
+        # Position of the last TRAINED sample this epoch. The sampler's live
+        # ``index`` runs ahead of training by the loader queue plus the
+        # device_prefetch depth (the reference's checkpoints have the same
+        # skew from its 4 DataLoader workers, src/dataset.py:401-425 — data
+        # those pipelines had buffered is silently skipped on resume).
+        # Checkpoints therefore save THIS counter, not the live index.
+        trained_index = sampler.index
+
+        def sampler_checkpoint_state():
+            s = sampler.state_dict()
+            s["index"] = trained_index
+            return s
+
         while not done:
             sampler.set_epoch(epoch)
-            for host_batch in loader:
-                batch = pretrain.stack_microbatches(
-                    host_batch, args.accumulation_steps)
-                batch = pretrain.put_batch(batch, b_shardings)
+            for batch in pretrain.device_prefetch(
+                    loader, args.accumulation_steps, b_shardings):
                 if kfac_obj is not None:
                     # kfac_pytorch cadence: factors (EMA) every
                     # factor_interval steps from the current data, inverses
@@ -423,18 +434,25 @@ def main(args) -> dict:
                     state, metrics = train_step(state, batch)
                 global_step += 1
                 step_in_run += 1
+                trained_index += args.host_batch_per_step
                 if step_in_run > 1:  # skip step-0 compile in throughput
                     samples_seen += args.global_batch_size
                 if step_in_run == 1:
+                    # Wait for the first step to EXECUTE before starting the
+                    # clock (reference skips step 0 the same way, its
+                    # run_pretraining.py:494-495). Dispatch of step 1 returns
+                    # as soon as compilation ends; on remote-attached TPUs the
+                    # executable upload still congests the link for a while,
+                    # and without this barrier that tail lands inside the
+                    # measured window (observed: 280 vs 400 seq/s reported
+                    # for identical steady-state device throughput).
+                    jax.block_until_ready(metrics)
                     train_start = time.perf_counter()
                 # Profiler window: steps [2, 2+profile_steps) — after the
-                # compile step, so the trace holds steady-state device work.
+                # compile step (metrics already blocked on above), so the
+                # trace holds steady-state device work.
                 if args.profile_steps > 0 and is_main_process():
-                    # block on the dispatched step so the trace window holds
-                    # exactly the profiled steps' device work (steps are
-                    # async dispatches otherwise).
                     if step_in_run == 1:
-                        jax.block_until_ready(metrics)
                         jax.profiler.start_trace(
                             os.path.join(args.output_dir, "profile"))
                         profiling = True
@@ -461,7 +479,7 @@ def main(args) -> dict:
                     save_step = global_step + args.previous_phase_end_step
                     contents = {"model": state.params,
                                 "optimizer": state.opt_state,
-                                "sampler": sampler.state_dict(),
+                                "sampler": sampler_checkpoint_state(),
                                 "epoch": epoch}
                     if kfac_state is not None:
                         contents["preconditioner"] = kfac_state
@@ -475,7 +493,11 @@ def main(args) -> dict:
                 if step_in_run >= steps_this_run or global_step >= args.max_steps:
                     done = True
                     break
-            epoch += 1
+            else:
+                epoch += 1
+                trained_index = 0
+                continue
+            break
 
         if profiling:  # run ended inside the profile window
             jax.block_until_ready(metrics)
@@ -489,7 +511,7 @@ def main(args) -> dict:
         # Final checkpoint so short runs resume exactly.
         save_step = global_step + args.previous_phase_end_step
         contents = {"model": state.params, "optimizer": state.opt_state,
-                    "sampler": sampler.state_dict(), "epoch": epoch}
+                    "sampler": sampler_checkpoint_state(), "epoch": epoch}
         if kfac_state is not None:
             contents["preconditioner"] = kfac_state
         ckpt.save_checkpoint(

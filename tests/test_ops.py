@@ -75,6 +75,35 @@ def test_flash_attention_grads_match():
         np.testing.assert_allclose(np.asarray(g), np.asarray(r), atol=2e-4)
 
 
+def test_flash_attention_bf16_matches_xla():
+    """Exercise the mixed-precision path: bf16 operands with fp32 softmax and
+    accumulation (the training dtype). The fp32 tests above collapse the
+    kernel's .astype(v.dtype) operand casts to no-ops; this one doesn't."""
+    q, k, v, bias = _qkv(batch=1, seq=64, heads=2, depth=32)
+    q, k, v = (t.astype(jnp.bfloat16) for t in (q, k, v))
+    ref = ops.dot_product_attention(q, k, v, bias=bias, backend="xla")
+    out = ops.dot_product_attention(q, k, v, bias=bias, backend="pallas")
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+    )
+
+    def make_loss(backend):
+        def f(q, k, v):
+            o = ops.dot_product_attention(q, k, v, bias=bias, backend=backend)
+            return jnp.sum(jnp.tanh(o.astype(jnp.float32)))
+
+        return jax.grad(f, argnums=(0, 1, 2))
+
+    ref_g = make_loss("xla")(q, k, v)
+    got_g = make_loss("pallas")(q, k, v)
+    for r, g in zip(ref_g, got_g):
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(r, np.float32), atol=5e-2
+        )
+
+
 def test_global_norm_and_clip():
     tree = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.zeros((2, 2))}
     assert np.isclose(float(ops.global_norm(tree)), 5.0)

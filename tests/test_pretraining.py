@@ -83,6 +83,22 @@ def test_resume_continues_and_losses_drop(workdir):
     assert ckpt.find_resume_step(out_dir) == 5
 
 
+def test_checkpoint_sampler_index_matches_trained_samples(workdir):
+    """The saved sampler position must equal the samples actually TRAINED,
+    not the loader's read-ahead position: the DataLoader queue plus
+    device_prefetch stage batches ahead of the train step, and saving the
+    live index would skip that buffered-but-untrained data on resume (a
+    latent defect of the reference, whose DataLoader workers run ahead of
+    its checkpoints the same way, reference src/dataset.py:401-425)."""
+    run_pretraining.main(_args(workdir, steps=3))
+    out = os.path.join(workdir["out"], "pretrain_ckpts")
+    step = ckpt.find_resume_step(out)
+    saved = ckpt.load_checkpoint(ckpt.checkpoint_path(out, step))
+    # dataset: 128 samples; 3 steps x global_batch 32 trained = 96 < 128,
+    # while the pipelines have buffered well past 96 by save time.
+    assert int(saved["sampler"]["index"]) == 3 * 32
+
+
 def test_phase_switch_resets_optimizer_count(workdir):
     run_pretraining.main(_args(workdir, steps=4, max_steps=4))
     out_dir = os.path.join(workdir["out"], "pretrain_ckpts")
