@@ -17,6 +17,10 @@ Strategies (rule sets):
                 (heads/mlp/vocab sharded).
   - ``sp``    — sequence/context parallelism over the seq axis for
                 long-context (ring attention lives in ops/pallas).
+  - ``pp``    — pipeline parallelism over the pipe axis: the encoder's
+                stacked layers shard into contiguous stage blocks and
+                microbatches rotate through them on a GPipe schedule
+                (parallel/pipeline.py).
 These compose: a mesh may use several axes at once.
 """
 
@@ -26,6 +30,7 @@ from bert_pytorch_tpu.parallel.mesh import (
     current_mesh,
     logical_axis_rules,
 )
+from bert_pytorch_tpu.parallel.pipeline import gpipe, stage_layer_count
 from bert_pytorch_tpu.parallel.sharding import (
     batch_sharding,
     mesh_sharding,
@@ -38,6 +43,8 @@ __all__ = [
     "create_mesh",
     "current_mesh",
     "logical_axis_rules",
+    "gpipe",
+    "stage_layer_count",
     "batch_sharding",
     "mesh_sharding",
     "params_shardings",

@@ -16,7 +16,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "fsdp", "seq", "model")
+MESH_AXES = ("data", "fsdp", "pipe", "seq", "model")
 
 
 @dataclasses.dataclass
@@ -24,28 +24,32 @@ class MeshConfig:
     """Sizes of each mesh axis; -1 on ``data`` means 'all remaining devices'.
 
     The product must equal the device count. The default is the reference's
-    capability: pure data parallelism over every chip (§2.2).
+    capability: pure data parallelism over every chip (§2.2). ``pipe`` is the
+    pipeline-stage axis (parallel/pipeline.py).
     """
 
     data: int = -1
     fsdp: int = 1
+    pipe: int = 1
     seq: int = 1
     model: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        fixed = self.fsdp * self.seq * self.model
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        fixed = self.fsdp * self.pipe * self.seq * self.model
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by fsdp*seq*model={fixed}"
+                    f"{n_devices} devices not divisible by "
+                    f"fsdp*pipe*seq*model={fixed}"
                 )
             data = n_devices // fixed
         if data * fixed != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.fsdp}x{self.seq}x{self.model} != {n_devices} devices"
+                f"mesh {data}x{self.fsdp}x{self.pipe}x{self.seq}x{self.model}"
+                f" != {n_devices} devices"
             )
-        return (data, self.fsdp, self.seq, self.model)
+        return (data, self.fsdp, self.pipe, self.seq, self.model)
 
 
 def create_mesh(
@@ -74,10 +78,23 @@ _BASE_RULES = [
     ("pos", None),
     ("types", None),
     ("classes", None),
-    ("layers", None),  # scan axis: never sharded (pipeline would map this)
+    ("layers", None),  # scan axis; the 'pp' strategy overrides this to 'pipe'
 ]
 
 _STRATEGY_RULES = {
+    # pipeline parallelism: the stacked-layer axis shards over 'pipe' (each
+    # stage holds L/P contiguous layers); everything else replicates like dp.
+    # The 'layers' base rule is overridden below (first match wins in
+    # flax.linen.logical_to_mesh_sharding).
+    "pp": [
+        ("layers", "pipe"),
+        ("embed", None),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ],
     # sequence/context parallelism: params replicated like dp; the activation
     # sequence axis ('seq_act', in _BASE_RULES) shards over the seq mesh axis.
     "sp": [
@@ -125,12 +142,15 @@ _STRATEGY_RULES = {
 
 
 def logical_axis_rules(strategy: str = "dp") -> list[tuple]:
-    """Rule list for ``nn.logical_to_mesh_sharding``."""
+    """Rule list for ``nn.logical_to_mesh_sharding``.
+
+    Strategy rules come first: matching is first-wins, and 'pp' overrides the
+    base ``('layers', None)`` with ``('layers', 'pipe')``."""
     if strategy not in _STRATEGY_RULES:
         raise ValueError(
             f"unknown strategy '{strategy}'; options: {sorted(_STRATEGY_RULES)}"
         )
-    return _BASE_RULES + _STRATEGY_RULES[strategy]
+    return _STRATEGY_RULES[strategy] + _BASE_RULES
 
 
 def current_mesh() -> Optional[Mesh]:

@@ -1,0 +1,150 @@
+"""Pipeline parallelism: a GPipe schedule over the mesh 'pipe' axis.
+
+The reference's only model-training parallelism is data parallel (SURVEY.md
+§2.2); this module is a beyond-parity strategy for models whose layer stack
+does not fit (or does not scale) on one chip. TPU-native design: the encoder's
+stacked layer parameters ([L, ...] from ``nn.scan``, bert.py) shard over a
+'pipe' mesh axis — each stage holds L/P *contiguous* layers — and microbatch
+activations rotate stage-to-stage with ``ppermute`` under ``shard_map``. The
+communication pattern IS the algorithm here, so this is hand-written
+collective code, like ops/ring.py and unlike everything under pjit.
+
+Schedule: plain GPipe. M microbatches flow through P stages in M + P - 1
+ticks; every stage applies its layer block each tick (bubble fraction
+(P-1)/(M+P-1)). The backward pass is jax autodiff through the tick scan,
+which reverses the rotation into the symmetric backward pipeline. Combine
+with ``remat`` so each stage keeps only block boundaries alive.
+
+Composition: 'pipe' composes with 'data'/'fsdp' batch sharding (specs carry
+the batch axis through shard_map untouched). 'seq' (ring attention) and
+'model' (tensor parallel) inside a pipeline stage are not supported in this
+version — the engine raises rather than silently densify/replicate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stage_layer_count(n_layers: int, n_stages: int) -> int:
+    if n_layers % n_stages != 0:
+        raise ValueError(
+            f"num_hidden_layers={n_layers} must divide by pipeline stages "
+            f"={n_stages} (contiguous equal blocks per stage)"
+        )
+    return n_layers // n_stages
+
+
+def gpipe(
+    stage_fn: Callable[..., jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    consts: Any,
+    mesh: Mesh,
+    replicated: Any = None,
+    axis: str = "pipe",
+    batch_spec=("data", "fsdp"),
+) -> jax.Array:
+    """Run ``x`` microbatches through the pipelined layer stack.
+
+    Args:
+      stage_fn: ``(local_params, x_mb, consts_mb, replicated, stage_id,
+        mb_idx) -> y_mb``; applies one stage's L/P layers to one microbatch.
+        ``mb_idx`` is the microbatch index (for PRNG folding); during bubble
+        ticks it is clipped garbage and the result is discarded.
+      stacked_params: pytree with leaves ``[L, ...]``, sharded over ``axis``
+        on dim 0 (the 'pp' rules in parallel/mesh.py).
+      x: ``[M, B, ...]`` microbatched activations, batch sharded over
+        ``batch_spec``, replicated over ``axis``.
+      consts: pytree of per-microbatch side inputs (e.g. the attention bias),
+        leaves ``[M, B, ...]``, sharded like ``x``.
+      mesh: the device mesh; ``mesh.shape[axis]`` is the stage count.
+      replicated: pytree passed to ``stage_fn`` verbatim on every stage
+        (fully replicated — e.g. a PRNG key). Traced values must come in
+        this way rather than by closure: ``shard_map`` rejects closed-over
+        tracers.
+
+    Returns ``[M, B, ...]`` outputs, replicated over ``axis`` (every stage
+    ends up with the full result — heads after the pipeline run replicated).
+    """
+    n_stages = mesh.shape[axis]
+    n_mb = x.shape[0]
+    if n_mb < n_stages:
+        raise ValueError(
+            f"need at least as many microbatches as pipeline stages: "
+            f"{n_mb} < {n_stages} (the bubble would dominate anyway)"
+        )
+    for off_axis in ("seq", "model"):
+        if mesh.shape.get(off_axis, 1) > 1:
+            raise ValueError(
+                f"pipeline parallelism does not compose with the '{off_axis}' "
+                "mesh axis in this version"
+            )
+
+    def param_spec(leaf):
+        return P(axis, *(None,) * (leaf.ndim - 1))
+
+    def act_spec(leaf):
+        return P(None, batch_spec, *(None,) * (leaf.ndim - 2))
+
+    in_specs = (
+        jax.tree_util.tree_map(param_spec, stacked_params),
+        act_spec(x),
+        jax.tree_util.tree_map(act_spec, consts),
+        jax.tree_util.tree_map(lambda r: P(*(None,) * r.ndim), replicated),
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=act_spec(x),
+    )
+    def run(local_params, x_local, consts_local, replicated_local):
+        stage = jax.lax.axis_index(axis)
+        ticks = n_mb + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            outs, act = carry
+            mb = jnp.clip(t - stage, 0, n_mb - 1)
+            x_t = jax.lax.dynamic_index_in_dim(
+                x_local, jnp.clip(t, 0, n_mb - 1), 0, keepdims=False
+            )
+            c_t = jax.tree_util.tree_map(
+                lambda c: jax.lax.dynamic_index_in_dim(c, mb, 0, keepdims=False),
+                consts_local,
+            )
+            inp = jnp.where(stage == 0, x_t, act)
+            y = stage_fn(local_params, inp, c_t, replicated_local, stage, mb)
+            out_idx = t - (n_stages - 1)
+            idx = jnp.clip(out_idx, 0, n_mb - 1)
+            cur = jax.lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+            keep = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, jnp.where(keep, y, cur), idx, 0
+            )
+            act_next = jax.lax.ppermute(y, axis, perm)
+            return (outs, act_next), None
+
+        # The carry is device-varying over 'pipe' after the first tick; mark
+        # the zero initializers as varying so the scan carry type is stable
+        # (shard_map's varying-manual-axes typing).
+        outs0 = jax.lax.pcast(jnp.zeros_like(x_local), axis, to="varying")
+        act0 = jax.lax.pcast(jnp.zeros_like(x_local[0]), axis, to="varying")
+        (outs, _), _ = jax.lax.scan(
+            tick, (outs0, act0), jnp.arange(ticks, dtype=jnp.int32)
+        )
+        # Only the last stage holds real outputs; give every stage the full
+        # result so the (replicated) heads can run without a reshard.
+        return jax.lax.psum(
+            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)), axis
+        )
+
+    return run(stacked_params, x, consts, replicated)

@@ -259,6 +259,188 @@ def make_train_step(
     )
 
 
+def make_pp_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    schedule: Optional[Callable] = None,
+    next_sentence: bool = True,
+    shardings: Optional[TrainState] = None,
+    batch_shardings_: Optional[dict] = None,
+    max_pred_per_seq: Optional[int] = None,
+):
+    """Train step with the encoder executed as a GPipe pipeline over the
+    mesh 'pipe' axis (parallel/pipeline.py).
+
+    The accumulation microbatches ([A, B, ...] stacked batch) ARE the
+    pipeline microbatches: instead of ``lax.scan``-ing them sequentially
+    (make_train_step), all A flow through the P pipeline stages concurrently
+    and autodiff reverses the schedule for the backward — gradient
+    accumulation falls out of the sum over microbatch losses. Embeddings and
+    heads (<5% of BERT-large FLOPs) run replicated across stages on the
+    flattened [A*B, ...] batch rather than being placed on the first/last
+    stage.
+
+    The forward reassembles ``BertForPreTraining.__call__`` (models/bert.py)
+    from its submodules functionally, because the encoder's stacked layer
+    params must be driven per stage-block; the module definitions and the
+    parameter tree are shared with the non-pp path, so checkpoints are
+    interchangeable between strategies.
+    """
+    from bert_pytorch_tpu.models.bert import (
+        BertEmbeddings,
+        BertLayer,
+        BertLMPredictionHead,
+        BertPooler,
+        bert_normal_init,
+    )
+    from bert_pytorch_tpu.ops.attention import make_attention_bias
+    from bert_pytorch_tpu.parallel.pipeline import gpipe, stage_layer_count
+
+    cfg = model.config
+    n_stages = mesh.shape["pipe"]
+    stage_layer_count(cfg.num_hidden_layers, n_stages)  # validate divisibility
+
+    emb_mod = BertEmbeddings(cfg, dtype=model.dtype)
+    layer_mod = BertLayer(
+        cfg, dtype=model.dtype, attention_backend=model.attention_backend
+    )
+    head_mod = BertLMPredictionHead(cfg, dtype=model.dtype)
+    pooler_mod = BertPooler(cfg, dtype=model.dtype) if next_sentence else None
+    nsp_mod = (
+        nn.Dense(
+            2,
+            dtype=model.dtype,
+            param_dtype=jnp.float32,
+            kernel_init=bert_normal_init(cfg.initializer_range),
+        )
+        if next_sentence
+        else None
+    )
+
+    remat_policy = None
+    if model.remat == "dots":
+        remat_policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    elif model.remat == "full":
+        remat_policy = jax.checkpoint_policies.nothing_saveable
+
+    def loss_fn(params, batch, rng):
+        n_mb, b, seq = batch["input_ids"].shape
+        # Two streams: embeddings dropout + the per-(layer, microbatch)
+        # folding inside the pipeline. The heads are dropout-free.
+        emb_rng, pipe_rng = jax.random.split(rng)
+
+        flat = lambda a: a.reshape((n_mb * b,) + a.shape[2:])
+        hidden = emb_mod.apply(
+            {"params": params["bert"]["embeddings"]},
+            flat(batch["input_ids"]),
+            flat(batch["segment_ids"]),
+            False,  # deterministic
+            rngs={"dropout": emb_rng},
+        )
+        hidden = hidden.reshape(n_mb, b, seq, -1)
+        bias = make_attention_bias(flat(batch["input_mask"]), dtype=jnp.float32)
+        bias = bias.reshape(n_mb, b, 1, 1, seq)
+
+        def apply_one(carry, lp, key, bias_mb):
+            out, _ = layer_mod.apply(
+                {"params": lp}, carry, bias_mb, False, rngs={"dropout": key}
+            )
+            return out
+
+        if remat_policy is not None:
+            apply_one = jax.checkpoint(
+                apply_one, policy=remat_policy, prevent_cse=False
+            )
+
+        def stage_fn(local_params, h, bias_mb, rng_rep, stage, mb):
+            n_local = jax.tree_util.tree_leaves(local_params)[0].shape[0]
+
+            def body(carry, xs):
+                lp, j = xs
+                key = jax.random.fold_in(
+                    jax.random.fold_in(rng_rep, stage * n_local + j), mb
+                )
+                return apply_one(carry, lp, key, bias_mb), None
+
+            h, _ = jax.lax.scan(
+                body, h, (local_params, jnp.arange(n_local, dtype=jnp.int32))
+            )
+            return h
+
+        hidden = gpipe(
+            stage_fn,
+            params["bert"]["encoder"]["layers"],
+            hidden,
+            bias,
+            mesh,
+            replicated=pipe_rng,
+        )
+
+        seq_out = hidden.reshape(n_mb * b, seq, -1)
+        labels, masked_positions = _mlm_positions(
+            flat(batch["masked_lm_labels"]), max_pred_per_seq
+        )
+        if masked_positions is not None:
+            onehot = jax.nn.one_hot(masked_positions, seq, dtype=model.dtype)
+            seq_out = jnp.einsum("bps,bsh->bph", onehot, seq_out)
+        word_embedding = params["bert"]["embeddings"]["word_embeddings"][
+            "embedding"
+        ]
+        mlm_logits = head_mod.apply(
+            {"params": params["predictions"]}, seq_out, word_embedding
+        )
+        nsp_logits = None
+        nsp_labels = None
+        if next_sentence:
+            pooled = pooler_mod.apply(
+                {"params": params["bert"]["pooler"]},
+                hidden.reshape(n_mb * b, seq, -1),
+            )
+            nsp_logits = nsp_mod.apply(
+                {"params": params["seq_relationship"]}, pooled
+            )
+            nsp_labels = batch["next_sentence_labels"]
+        # Per-MICROBATCH loss, then mean — the accumulation semantics of
+        # make_train_step (and the reference's loss/accumulation_steps,
+        # run_pretraining.py:445): each microbatch's masked-token mean gets
+        # equal weight regardless of how many positions were masked in it.
+        unflat = lambda a: a.reshape((n_mb, b) + a.shape[1:])
+        losses = jax.vmap(pretraining_loss)(
+            unflat(mlm_logits),
+            unflat(nsp_logits) if next_sentence else None,
+            unflat(labels),
+            nsp_labels if next_sentence else None,
+        )
+        accs = jax.vmap(mlm_accuracy)(unflat(mlm_logits), unflat(labels))
+        return jnp.mean(losses), jnp.mean(accs)
+
+    def step_fn(state: TrainState, batch: dict):
+        step_rng, new_rng = jax.random.split(state.rng)
+        (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, step_rng
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        metrics = {
+            "loss": loss,
+            "mlm_accuracy": acc,
+            "grad_norm": global_norm(grads),
+        }
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(state.opt_state.count)
+        return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
+
+    if shardings is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    return jax.jit(
+        step_fn,
+        donate_argnums=(0,),
+        in_shardings=(shardings, batch_shardings_),
+        out_shardings=(shardings, None),
+    )
+
+
 def make_eval_step(model, next_sentence: bool = True):
     """Deterministic forward + loss for held-out evaluation."""
 

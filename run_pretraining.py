@@ -127,10 +127,15 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     # mesh
     parser.add_argument("--mesh_data", type=int, default=-1)
     parser.add_argument("--mesh_fsdp", type=int, default=1)
+    parser.add_argument("--mesh_pipe", type=int, default=1,
+                        help="pipeline stages (with --parallel_strategy pp; "
+                             "accumulation microbatches become the GPipe "
+                             "microbatches, so accumulation_steps must be "
+                             ">= stages)")
     parser.add_argument("--mesh_seq", type=int, default=1)
     parser.add_argument("--mesh_model", type=int, default=1)
     parser.add_argument("--parallel_strategy", type=str, default="dp",
-                        choices=["dp", "fsdp", "tp", "tp_fsdp", "sp"])
+                        choices=["dp", "fsdp", "tp", "tp_fsdp", "sp", "pp"])
     parser.add_argument("--seed", type=int, default=42)
 
     args = parse_args_with_config_file(parser, argv)
@@ -145,7 +150,7 @@ def setup_training(args):
     jax.config.update("jax_default_prng_impl", args.rng_impl)
     launcher.initialize()
     mesh = create_mesh(MeshConfig(
-        data=args.mesh_data, fsdp=args.mesh_fsdp,
+        data=args.mesh_data, fsdp=args.mesh_fsdp, pipe=args.mesh_pipe,
         seq=args.mesh_seq, model=args.mesh_model,
     ))
     args.model_output_dir = os.path.join(args.output_dir, "pretrain_ckpts")
@@ -187,6 +192,11 @@ def setup_training(args):
             f"local_batch_size*data_shards={global_microbatch}"
         )
     args.accumulation_steps = args.global_batch_size // global_microbatch
+    if args.mesh_pipe > 1 and args.parallel_strategy != "pp":
+        # Without the pp rules the layer stack REPLICATES over the pipe axis
+        # and those devices duplicate work — never what anyone wants.
+        raise ValueError(
+            f"--mesh_pipe {args.mesh_pipe} requires --parallel_strategy pp")
     if (args.parallel_strategy == "sp" and mesh.shape["seq"] > 1
             and args.attention_backend != "ring"):
         # sp exists to avoid O(S^2) dense attention; never silently densify
@@ -371,12 +381,31 @@ def main(args) -> dict:
                 f"factor_interval={args.kfac_factor_interval}, "
                 f"inv_interval={args.kfac_inv_interval}")
 
-        train_step = pretrain.make_train_step(
-            model, tx, schedule=schedule,
-            next_sentence=bool(config.next_sentence),
-            shardings=shardings, batch_shardings_=b_shardings,
-            max_pred_per_seq=args.max_predictions_per_seq,
-            kfac=kfac_obj, kfac_shardings=kfac_shardings)
+        if args.parallel_strategy == "pp":
+            if kfac_obj is not None:
+                raise ValueError(
+                    "K-FAC does not compose with pipeline parallelism")
+            if mesh.shape["pipe"] < 2:
+                raise ValueError(
+                    "--parallel_strategy pp needs --mesh_pipe >= 2 (a "
+                    "1-stage pipeline is just dp with schedule overhead)")
+            if args.accumulation_steps < mesh.shape["pipe"]:
+                raise ValueError(
+                    f"pp needs accumulation_steps >= pipeline stages "
+                    f"({args.accumulation_steps} < {mesh.shape['pipe']}); "
+                    "raise global_batch_size or lower local_batch_size")
+            train_step = pretrain.make_pp_train_step(
+                model, tx, mesh, schedule=schedule,
+                next_sentence=bool(config.next_sentence),
+                shardings=shardings, batch_shardings_=b_shardings,
+                max_pred_per_seq=args.max_predictions_per_seq)
+        else:
+            train_step = pretrain.make_train_step(
+                model, tx, schedule=schedule,
+                next_sentence=bool(config.next_sentence),
+                shardings=shardings, batch_shardings_=b_shardings,
+                max_pred_per_seq=args.max_predictions_per_seq,
+                kfac=kfac_obj, kfac_shardings=kfac_shardings)
 
         steps_this_run = args.steps or (args.max_steps - global_step)
         steps_this_run = min(steps_this_run, args.max_steps - global_step)

@@ -1,0 +1,225 @@
+"""Pipeline-parallelism tests: the GPipe engine (parallel/pipeline.py) and
+the pp train step (pretrain.make_pp_train_step) against the plain dp path.
+
+Strategy equivalence is the invariant: pp is an execution schedule, not a
+different model, so loss/params after a step must match the dp train step on
+the same params and data (up to fp32 reduction-order noise). Runs on the
+virtual 8-device CPU mesh (conftest.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu import optim, pretrain
+from bert_pytorch_tpu.models import BertForPreTraining
+from bert_pytorch_tpu.parallel import (
+    MeshConfig,
+    create_mesh,
+    gpipe,
+    logical_axis_rules,
+)
+
+
+def _batch(rng, n_mb, b, seq, vocab):
+    return {
+        "input_ids": rng.integers(0, vocab, (n_mb, b, seq)).astype(np.int32),
+        "segment_ids": rng.integers(0, 2, (n_mb, b, seq)).astype(np.int32),
+        "input_mask": np.ones((n_mb, b, seq), np.int32),
+        "masked_lm_labels": np.where(
+            rng.random((n_mb, b, seq)) < 0.2,
+            rng.integers(0, vocab, (n_mb, b, seq)),
+            -1,
+        ).astype(np.int32),
+        "next_sentence_labels": rng.integers(0, 2, (n_mb, b)).astype(np.int32),
+    }
+
+
+def test_gpipe_engine_matches_sequential(devices):
+    """The engine alone: y = fn(...fn(x)) layer chain, pipelined == serial."""
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    n_layers, n_mb, b, d = 8, 4, 4, 16
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(n_layers, d, d)) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n_mb, b, d)), jnp.float32)
+    scale = jnp.asarray(rng.normal(size=(n_mb, b, 1)), jnp.float32)
+
+    def layer(w_j, h):
+        return jnp.tanh(h @ w_j)
+
+    def stage_fn(local_w, h, scale_mb, _rep, stage, mb):
+        def body(carry, w_j):
+            return layer(w_j, carry), None
+
+        h, _ = jax.lax.scan(body, h, local_w)
+        return h * scale_mb
+
+    with mesh:
+        out = gpipe(stage_fn, w, x, scale, mesh)
+
+    # serial reference: full chain per microbatch, scale applied per stage
+    n_stages, per = 4, n_layers // 4
+    ref = x
+    for s in range(n_stages):
+        h = ref
+        for j in range(s * per, (s + 1) * per):
+            h = layer(w[j], h)
+        ref = h * scale
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_gpipe_grads_match_sequential(devices):
+    mesh = create_mesh(MeshConfig(data=1, pipe=2), devices=jax.devices()[:2])
+    n_layers, n_mb, b, d = 4, 4, 2, 8
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.normal(size=(n_layers, d, d)) * 0.3, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(n_mb, b, d)), jnp.float32)
+    ones = jnp.ones((n_mb, b, 1), jnp.float32)
+
+    def stage_fn(local_w, h, _c, _rep, stage, mb):
+        def body(carry, w_j):
+            return jnp.tanh(carry @ w_j), None
+
+        h, _ = jax.lax.scan(body, h, local_w)
+        return h
+
+    def loss_pp(w):
+        with mesh:
+            return jnp.sum(gpipe(stage_fn, w, x, ones, mesh) ** 2)
+
+    def loss_ref(w):
+        h = x
+        for j in range(n_layers):
+            h = jnp.tanh(h @ w[j])
+        return jnp.sum(h**2)
+
+    l_pp, g_pp = jax.value_and_grad(loss_pp)(w)
+    l_ref, g_ref = jax.value_and_grad(loss_ref)(w)
+    np.testing.assert_allclose(float(l_pp), float(l_ref), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_ref), atol=1e-4)
+
+
+def test_gpipe_rejects_bad_shapes(devices):
+    mesh = create_mesh(MeshConfig(data=2, pipe=4))
+    w = jnp.zeros((8, 4, 4))
+    with pytest.raises(ValueError, match="at least as many microbatches"):
+        with mesh:
+            gpipe(lambda *a: a[1], w, jnp.zeros((2, 2, 4)), None, mesh)
+
+
+def test_pp_runner_end_to_end(tmp_path, devices):
+    """run_pretraining with --parallel_strategy pp: smoke + resume compat
+    (pp and dp share one parameter tree, so the checkpoint layout is
+    strategy-independent)."""
+    import json
+
+    import run_pretraining
+    from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    make_shard(str(data_dir / "shard_0.hdf5"), 64, 32, 96, seed=0)
+    model_config = {
+        "vocab_size": 96, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 32, "type_vocab_size": 2,
+        "next_sentence": True, "mask_token_id": 4,
+    }
+    cfg_path = tmp_path / "model.json"
+    cfg_path.write_text(json.dumps(model_config))
+    argv = [
+        "--input_dir", str(data_dir),
+        "--output_dir", str(tmp_path / "out"),
+        "--model_config_file", str(cfg_path),
+        "--global_batch_size", "16",
+        "--local_batch_size", "2",
+        "--max_steps", "4",
+        "--steps", "2",
+        "--learning_rate", "1e-3",
+        "--warmup_proportion", "0.25",
+        "--dtype", "float32",
+        "--parallel_strategy", "pp",
+        "--mesh_pipe", "2",
+        "--log_prefix", str(tmp_path / "log"),
+    ]
+    result = run_pretraining.main(run_pretraining.parse_arguments(argv))
+    assert np.isfinite(result["loss"])
+    # resume under plain dp from the pp checkpoint
+    argv_dp = [a for a in argv]
+    argv_dp[argv_dp.index("pp")] = "dp"
+    argv_dp[argv_dp.index("--mesh_pipe") + 1] = "1"
+    result2 = run_pretraining.main(
+        run_pretraining.parse_arguments(argv_dp + ["--steps", "2"]))
+    assert result2["global_step"] == 4
+    assert np.isfinite(result2["loss"])
+
+
+def test_pp_train_step_matches_dp(tiny_config, devices):
+    """One optimizer step under pp(2 stages)x dp(2) == plain dp: same loss,
+    same updated params, from the same initial state and batch. Dropout off:
+    the two paths fold the step PRNG differently, so only the deterministic
+    computation is comparable."""
+    from bert_pytorch_tpu.config import BertConfig
+
+    cfg_dict = tiny_config.to_dict()
+    cfg_dict["hidden_dropout_prob"] = 0.0
+    cfg_dict["attention_probs_dropout_prob"] = 0.0
+    cfg = BertConfig.from_dict(cfg_dict)
+    vocab, b, seq, n_mb = cfg.vocab_size, 4, 32, 4
+    model = BertForPreTraining(cfg, dtype=jnp.float32)
+    schedule = optim.warmup_poly_schedule(1e-3, 0.25, 100)
+    sample = (jnp.zeros((1, seq), jnp.int32),) * 3
+    host = _batch(np.random.default_rng(2), n_mb, b, seq, vocab)
+
+    results = {}
+    for name, meshcfg, strategy in [
+        ("dp", MeshConfig(data=4), "dp"),
+        ("pp", MeshConfig(data=2, pipe=2), "pp"),
+    ]:
+        mesh = create_mesh(meshcfg, devices=jax.devices()[: 4])
+        rules = logical_axis_rules(strategy)
+        tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+        with mesh:
+            shardings = pretrain.state_shardings(mesh, model, rules, sample)
+            b_shardings = pretrain.batch_shardings(
+                mesh,
+                {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+                 "masked_lm_labels": 3, "next_sentence_labels": 2},
+            )
+            state = pretrain.make_init_fn(model, tx, sample, shardings)(
+                jax.random.PRNGKey(5)
+            )
+            if name == "pp":
+                step = pretrain.make_pp_train_step(
+                    model, tx, mesh, schedule=schedule, next_sentence=True,
+                    shardings=shardings, batch_shardings_=b_shardings,
+                    max_pred_per_seq=8)
+            else:
+                step = pretrain.make_train_step(
+                    model, tx, schedule=schedule, next_sentence=True,
+                    shardings=shardings, batch_shardings_=b_shardings,
+                    max_pred_per_seq=8)
+            batch = pretrain.put_batch(host, b_shardings)
+            new_state, metrics = step(state, batch)
+            results[name] = (
+                float(metrics["loss"]),
+                jax.device_get(new_state.params),
+            )
+
+    loss_dp, params_dp = results["dp"]
+    loss_pp, params_pp = results["pp"]
+    # Dropout draws differ between the paths (different rng folding), so
+    # compare with dropout effectively disabled via the config used here:
+    np.testing.assert_allclose(loss_pp, loss_dp, rtol=1e-5)
+    flat_dp = jax.tree_util.tree_leaves_with_path(params_dp)
+    flat_pp = dict(
+        (jax.tree_util.keystr(kp), leaf)
+        for kp, leaf in jax.tree_util.tree_leaves_with_path(params_pp)
+    )
+    for kp, leaf in flat_dp:
+        np.testing.assert_allclose(
+            np.asarray(flat_pp[jax.tree_util.keystr(kp)]),
+            np.asarray(leaf),
+            atol=2e-5,
+            err_msg=jax.tree_util.keystr(kp),
+        )
