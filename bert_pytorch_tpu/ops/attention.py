@@ -7,9 +7,9 @@ reference's ``(1 - mask) * -10000`` bias convention (modeling.py:862-870).
 
 ``backend='pallas'`` routes to the fused flash-style kernel with in-kernel
 dropout (ops/pallas/attention.py). Measured on one v5e chip, BERT-large
-training with dropout: at seq 512 the fused kernel wins by ~35% (the XLA
-path's [B,H,S,S] probability/mask materialization is the cost); at seq 128
-the XLA path wins by ~20% (tiles are too small to amortize the kernel
+training with dropout: at seq 512 the fused kernel wins by ~60% (82 vs ~52
+seq/s — the XLA path materializes the [B,H,S,S] probabilities/masks); at
+seq 128 the XLA path wins by ~25% (tiles too small to amortize the kernel
 pipeline). Rule of thumb: 'xla' for phase-1 (seq<=128), 'pallas' for phase-2
 (seq>=256) and anything longer.
 """

@@ -35,10 +35,11 @@ lowering, so ``dropout_rate > 0`` requires a real TPU; rate 0 runs everywhere
 validated on-chip).
 
 Measured (one v5e chip, BERT-large training step, remat='dots', rbg host
-dropout for the non-attention dropouts): seq 512 batch 16 — XLA attention
-51.0 seq/s with dropout / 71.8 without; this kernel 69.4 with dropout.
-Seq 128 favors the XLA path (317 vs 382 seq/s at batch 64): tiles are too
-small to amortize the kernel pipeline. See ops/attention.py for routing.
+dropout for the non-attention dropouts): seq 512 batch 28 — XLA attention
+~52 seq/s with dropout; this kernel 82.4 with dropout (512-wide tiles,
+_pick_blocks; 256x256 tiles measured 70.7). Seq 128 favors the XLA path
+(314 vs 396 seq/s at the phase-1 bench shape): tiles are too small to
+amortize the kernel pipeline. See ops/attention.py for routing.
 """
 
 from __future__ import annotations
@@ -79,7 +80,10 @@ def _pick_blocks(seq):
     use the same blocks: the dropout keep-mask is regenerated per tile from
     (bh, q_block, k_block), so differing tile boundaries would silently
     compute gradients under a different mask than the forward applied."""
-    candidates = (256, 128, 64, 32, 16, 8)
+    # 512-wide tiles win at seq 512 (5.0 vs 7.2 ms fwd+bwd for the
+    # BERT-large shape with 256x256): fewer grid steps amortize the
+    # pipeline, and VMEM stays modest (512x512 fp32 scores = 1MB).
+    candidates = (512, 256, 128, 64, 32, 16, 8)
     return pick_block(seq, candidates), pick_block(seq, candidates)
 
 

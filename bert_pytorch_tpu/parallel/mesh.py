@@ -56,7 +56,7 @@ def create_mesh(
     mesh_config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build the ('data', 'fsdp', 'seq', 'model') mesh.
+    """Build the ('data', 'fsdp', 'pipe', 'seq', 'model') mesh.
 
     Device order comes from `jax.devices()`, which JAX already returns in
     ICI-topology order — nearest-neighbor axes (model/seq) get the fastest
