@@ -48,10 +48,10 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 # batch 32 (recompute regenerates every dropout mask in ALU ops).
 # BENCH_PHASE=2 switches to the phase-2 recipe shape (seq 512, max_pred 80)
 # where the fused Pallas attention kernel is the winning backend
-# (ops/attention.py: 82 vs ~52 seq/s); the driver's headline stays phase-1.
-# Phase-2 batch sweep (pallas 512-wide tiles, remat dots, rbg): 24→81.7,
-# 28→82.4, 32→82.2 seq/s; 28 is the smallest batch on the plateau.
-# (With the older 256x256 attention tiles the plateau was 70.7.)
+# (ops/attention.py: 84 vs ~52 seq/s); the driver's headline stays phase-1.
+# Phase-2 batch sweep (pallas, remat dots, rbg): 24→81.7, 28→82.4, 32→82.2
+# seq/s with 512-wide tiles; bh-batched tiles (G=8/program) lift 28 to
+# 84.3. (The original 256x256 single-bh tiles measured 70.7.)
 PHASE = int(os.environ.get("BENCH_PHASE", "1"))
 _P2 = PHASE == 2
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", "28" if _P2 else "56"))

@@ -7,11 +7,10 @@ reference's ``(1 - mask) * -10000`` bias convention (modeling.py:862-870).
 
 ``backend='pallas'`` routes to the fused flash-style kernel with in-kernel
 dropout (ops/pallas/attention.py). Measured on one v5e chip, BERT-large
-training with dropout: at seq 512 the fused kernel wins by ~60% (82 vs ~52
+training with dropout: at seq 512 the fused kernel wins by ~60% (84 vs ~52
 seq/s — the XLA path materializes the [B,H,S,S] probabilities/masks); at
-seq 128 the XLA path wins by ~25% (tiles too small to amortize the kernel
-pipeline). Rule of thumb: 'xla' for phase-1 (seq<=128), 'pallas' for phase-2
-(seq>=256) and anything longer.
+seq 128 the XLA path still wins (396 vs 366). Rule of thumb: 'xla' for
+phase-1 (seq<=128), 'pallas' for phase-2 (seq>=256) and anything longer.
 """
 
 from __future__ import annotations

@@ -36,10 +36,11 @@ validated on-chip).
 
 Measured (one v5e chip, BERT-large training step, remat='dots', rbg host
 dropout for the non-attention dropouts): seq 512 batch 28 — XLA attention
-~52 seq/s with dropout; this kernel 82.4 with dropout (512-wide tiles,
-_pick_blocks; 256x256 tiles measured 70.7). Seq 128 favors the XLA path
-(314 vs 396 seq/s at the phase-1 bench shape): tiles are too small to
-amortize the kernel pipeline. See ops/attention.py for routing.
+~52 seq/s with dropout; this kernel 84.3 with dropout (512-wide tiles +
+8 bh pairs per program; the original 256x256 single-bh tiles measured
+70.7). Seq 128 still favors the XLA path (366 vs 396 seq/s at the phase-1
+bench shape) — bh-batching closes most but not all of the short-seq grid
+overhead. See ops/attention.py for routing.
 """
 
 from __future__ import annotations
@@ -83,163 +84,195 @@ def _pick_blocks(seq):
     # 512-wide tiles win at seq 512 (5.0 vs 7.2 ms fwd+bwd for the
     # BERT-large shape with 256x256): fewer grid steps amortize the
     # pipeline, and VMEM stays modest (512x512 fp32 scores = 1MB).
-    candidates = (512, 256, 128, 64, 32, 16, 8)
-    return pick_block(seq, candidates), pick_block(seq, candidates)
+    # pick_block's default candidate ladder tops out at 512 for this reason.
+    return pick_block(seq), pick_block(seq)
+
+
+def _pick_bh_block(seq, bh):
+    """How many (batch*head) pairs each program processes (an unrolled loop
+    in the kernel). Short sequences make per-bh tiles tiny, so the grid —
+    not the MXU — bounds throughput; batching pairs per program amortizes
+    it. G does NOT affect the dropout masks: tile ids are derived from the
+    recovered global bh index and the block_q/block_k grid, so any G (even
+    different ones for forward and backward) regenerates identical masks —
+    the load-bearing invariant is block agreement, documented on
+    _pick_blocks.
+
+    Measured, BERT-large phase-2 shape (seq 512, one v5e): G=1 82.4,
+    G=4 84.0, G=8 84.25 seq/s; G=16 exhausts VMEM (tile footprint scales
+    with G x seq, hence the 4096 budget). At seq 128 G=16 is the best of
+    the sweep (314 -> 366 seq/s), though the XLA path still wins there and
+    stays the router default (ops/attention.py)."""
+    target = min(16, max(1, 4096 // max(seq, 1)))
+    g = 1
+    while g * 2 <= target and bh % (g * 2) == 0:
+        g *= 2
+    return g
 
 
 def _flash_fwd_kernel(
-    seed_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref, *, block_k, scale, rate
+    seed_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
+    *, block_k, scale, rate, bh_block
 ):
-    # q_ref: [1, block_q, D]; k_ref/v_ref: [1, S, D]; bias_ref: [1, 1, S]
+    # q_ref: [G, block_q, D]; k_ref/v_ref: [G, S, D]; bias_ref: [G, 1, S]
+    # where G = bh_block (batch*head) pairs per program — an unrolled loop
+    # that amortizes the grid at short sequence lengths (_pick_bh_block).
     # Matmul operands stay in the input dtype (bf16 in training) with fp32
     # accumulation — a single MXU pass per dot; casting inputs up to fp32
     # first would decompose each matmul into several passes. The softmax
     # chain (max/exp/sum) runs in fp32 throughout.
-    bh = pl.program_id(0)
     qb = pl.program_id(1)
-    q = q_ref[0]
     seq_k = k_ref.shape[1]
-    block_q, depth = q.shape
     num_kb = seq_k // block_k
 
-    def body(j, carry):
-        m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        b = bias_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # [block_q, block_k]
-        s = s + b[None, :]
-        m_cur = jnp.max(s, axis=-1)
-        m_new = jnp.maximum(m_prev, m_cur)
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        # l accumulates the TRUE softmax denominator (unmasked) so lse is
-        # exact; only the value accumulation sees the dropout mask.
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-        if rate > 0.0:
-            tid = _tile_id(bh, qb, j, pl.num_programs(1), num_kb)
-            p_v = jnp.where(_keep_mask(seed_ref, tid, p.shape, rate), p, 0.0)
-        else:
-            p_v = p
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p_v.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return m_new, l_new, acc
+    for g in range(bh_block):
+        bh = pl.program_id(0) * bh_block + g
+        q = q_ref[g]
 
-    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, depth), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-    out_ref[0] = (acc / (l[:, None] * (1.0 - rate))).astype(out_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)
+        def body(j, carry):
+            m_prev, l_prev, acc = carry
+            k = k_ref[g, pl.ds(j * block_k, block_k), :]
+            v = v_ref[g, pl.ds(j * block_k, block_k), :]
+            b = bias_ref[g, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [block_q, block_k]
+            s = s + b[None, :]
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            # l accumulates the TRUE softmax denominator (unmasked) so lse
+            # is exact; only the value accumulation sees the dropout mask.
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            if rate > 0.0:
+                tid = _tile_id(bh, qb, j, pl.num_programs(1), num_kb)
+                p_v = jnp.where(_keep_mask(seed_ref, tid, p.shape, rate), p, 0.0)
+            else:
+                p_v = p
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p_v.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc
+
+        m0 = jnp.full((q.shape[0],), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((q.shape[0],), jnp.float32)
+        acc0 = jnp.zeros(q.shape, jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+        out_ref[g] = (acc / (l[:, None] * (1.0 - rate))).astype(out_ref.dtype)
+        lse_ref[g, 0] = m + jnp.log(l)
 
 
 def _flash_dq_kernel(
     seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref, do_ref,
-    dq_ref, *, block_k, scale, rate
+    dq_ref, *, block_k, scale, rate, bh_block
 ):
-    """dq for one [1, block_q, D] tile; loops over k blocks."""
-    bh = pl.program_id(0)
+    """dq for [G, block_q, D] tiles (G bh pairs/program); loops over k blocks."""
     qb = pl.program_id(1)
-    q = q_ref[0]
-    lse = lse_ref[0, 0]  # [block_q]
-    delta = delta_ref[0, 0]  # [block_q]
-    do = do_ref[0]  # [block_q, D]
     seq_k = k_ref.shape[1]
-    block_q, depth = q.shape
     num_kb = seq_k // block_k
     inv_keep = 1.0 / (1.0 - rate)
 
-    def body(j, dq_acc):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, pl.ds(j * block_k, block_k), :]
-        b = bias_ref[0, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale + b[None, :]
-        p = jnp.exp(s - lse[:, None])  # normalized probabilities
-        da = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [block_q, block_k]
-        if rate > 0.0:
-            tid = _tile_id(bh, qb, j, pl.num_programs(1), num_kb)
-            keep = _keep_mask(seed_ref, tid, p.shape, rate)
-            da = jnp.where(keep, da * inv_keep, 0.0)
-        ds = p * (da - delta[:, None])
-        return dq_acc + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    for g in range(bh_block):
+        bh = pl.program_id(0) * bh_block + g
+        q = q_ref[g]
+        lse = lse_ref[g, 0]  # [block_q]
+        delta = delta_ref[g, 0]  # [block_q]
+        do = do_ref[g]  # [block_q, D]
 
-    dq = jax.lax.fori_loop(
-        0, num_kb, body, jnp.zeros((block_q, depth), jnp.float32)
-    )
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        def body(j, dq_acc):
+            k = k_ref[g, pl.ds(j * block_k, block_k), :]
+            v = v_ref[g, pl.ds(j * block_k, block_k), :]
+            b = bias_ref[g, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale + b[None, :]
+            p = jnp.exp(s - lse[:, None])  # normalized probabilities
+            da = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [block_q, block_k]
+            if rate > 0.0:
+                tid = _tile_id(bh, qb, j, pl.num_programs(1), num_kb)
+                keep = _keep_mask(seed_ref, tid, p.shape, rate)
+                da = jnp.where(keep, da * inv_keep, 0.0)
+            ds = p * (da - delta[:, None])
+            return dq_acc + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        dq = jax.lax.fori_loop(0, num_kb, body, jnp.zeros(q.shape, jnp.float32))
+        dq_ref[g] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(
     seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref, do_ref,
-    dk_ref, dv_ref, dbias_ref, *, block_q, scale, rate
+    dk_ref, dv_ref, dbias_ref, *, block_q, scale, rate, bh_block
 ):
-    """dk/dv/dbias for one [1, block_k, D] tile; loops over q blocks."""
-    bh = pl.program_id(0)
+    """dk/dv/dbias for [G, block_k, D] tiles; loops over q blocks."""
     kb = pl.program_id(1)
-    k = k_ref[0]  # [block_k, D]
-    v = v_ref[0]
-    b = bias_ref[0, 0].astype(jnp.float32)  # [block_k]
     seq_q = q_ref.shape[1]
-    block_k, depth = k.shape
     num_qb = seq_q // block_q
     inv_keep = 1.0 / (1.0 - rate)
 
-    def body(i, carry):
-        dk_acc, dv_acc, db_acc = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
-        do = do_ref[0, pl.ds(i * block_q, block_q), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale + b[None, :]
-        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
-        da = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        if rate > 0.0:
-            tid = _tile_id(bh, i, kb, num_qb, pl.num_programs(1))
-            keep = _keep_mask(seed_ref, tid, p.shape, rate)
-            p_v = jnp.where(keep, p * inv_keep, 0.0)
-            da = jnp.where(keep, da * inv_keep, 0.0)
-        else:
-            p_v = p
-        # dV += (D ⊙ P)ᵀ dO / (1-r)
-        dv_acc = dv_acc + jax.lax.dot_general(
-            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (da - delta[:, None])
-        dk_acc = dk_acc + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return dk_acc, dv_acc, db_acc + jnp.sum(ds, axis=0)
+    for g in range(bh_block):
+        bh = pl.program_id(0) * bh_block + g
+        k = k_ref[g]  # [block_k, D]
+        v = v_ref[g]
+        b = bias_ref[g, 0].astype(jnp.float32)  # [block_k]
+        block_k, depth = k.shape
 
-    dk, dv, db = jax.lax.fori_loop(
-        0,
-        num_qb,
-        body,
-        (
-            jnp.zeros((block_k, depth), jnp.float32),
-            jnp.zeros((block_k, depth), jnp.float32),
-            jnp.zeros((block_k,), jnp.float32),
-        ),
-    )
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
-    dbias_ref[0, 0] = db.astype(dbias_ref.dtype)
+        def body(i, carry):
+            dk_acc, dv_acc, db_acc = carry
+            q = q_ref[g, pl.ds(i * block_q, block_q), :]
+            lse = lse_ref[g, 0, pl.ds(i * block_q, block_q)]
+            delta = delta_ref[g, 0, pl.ds(i * block_q, block_q)]
+            do = do_ref[g, pl.ds(i * block_q, block_q), :]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale + b[None, :]
+            p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+            da = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if rate > 0.0:
+                tid = _tile_id(bh, i, kb, num_qb, pl.num_programs(1))
+                keep = _keep_mask(seed_ref, tid, p.shape, rate)
+                p_v = jnp.where(keep, p * inv_keep, 0.0)
+                da = jnp.where(keep, da * inv_keep, 0.0)
+            else:
+                p_v = p
+            # dV += (D ⊙ P)ᵀ dO / (1-r)
+            dv_acc = dv_acc + jax.lax.dot_general(
+                p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (da - delta[:, None])
+            dk_acc = dk_acc + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_acc, dv_acc, db_acc + jnp.sum(ds, axis=0)
+
+        dk, dv, db = jax.lax.fori_loop(
+            0,
+            num_qb,
+            body,
+            (
+                jnp.zeros((block_k, depth), jnp.float32),
+                jnp.zeros((block_k, depth), jnp.float32),
+                jnp.zeros((block_k,), jnp.float32),
+            ),
+        )
+        dk_ref[g] = (dk * scale).astype(dk_ref.dtype)
+        dv_ref[g] = dv.astype(dv_ref.dtype)
+        dbias_ref[g, 0] = db.astype(dbias_ref.dtype)
 
 
 def _seed_spec():
@@ -250,20 +283,22 @@ def _flash_forward(q3, k3, v3, bias3, seed, scale, rate):
     """q3/k3/v3: [BH, S, D]; bias3: [BH, 1, S] additive key bias."""
     bh, seq, depth = q3.shape
     block_q, block_k = _pick_blocks(seq)
-    grid = (bh, seq // block_q)
+    g = _pick_bh_block(seq, bh)
+    grid = (bh // g, seq // block_q)
     out, lse = pl.pallas_call(
-        partial(_flash_fwd_kernel, block_k=block_k, scale=scale, rate=rate),
+        partial(_flash_fwd_kernel, block_k=block_k, scale=scale, rate=rate,
+                bh_block=g),
         grid=grid,
         in_specs=[
             _seed_spec(),
-            pl.BlockSpec((1, block_q, depth), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, depth), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, depth), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, seq), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, depth), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((g, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((g, 1, block_q), lambda b, i: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, depth), q3.dtype),
@@ -294,41 +329,44 @@ def _flash_bwd(scale, rate, residuals, g):
         g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )[:, None, :]
 
+    gb = _pick_bh_block(seq, bh)
     dq = pl.pallas_call(
-        partial(_flash_dq_kernel, block_k=block_k, scale=scale, rate=rate),
-        grid=(bh, seq // block_q),
+        partial(_flash_dq_kernel, block_k=block_k, scale=scale, rate=rate,
+                bh_block=gb),
+        grid=(bh // gb, seq // block_q),
         in_specs=[
             _seed_spec(),
-            pl.BlockSpec((1, block_q, depth), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq, depth), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq, depth), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i)),
-            pl.BlockSpec((1, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((gb, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((gb, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((gb, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((gb, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((gb, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((gb, 1, block_q), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((gb, block_q, depth), lambda b, i: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, depth), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((gb, block_q, depth), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq, depth), q3.dtype),
         interpret=interpret_mode(),
     )(seed, q3, k3, v3, bias3, lse, delta, g)
 
     dk, dv, dbias = pl.pallas_call(
-        partial(_flash_dkv_kernel, block_q=block_q, scale=scale, rate=rate),
-        grid=(bh, seq // block_k),
+        partial(_flash_dkv_kernel, block_q=block_q, scale=scale, rate=rate,
+                bh_block=gb),
+        grid=(bh // gb, seq // block_k),
         in_specs=[
             _seed_spec(),
-            pl.BlockSpec((1, seq, depth), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, depth), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, depth), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, j: (b, 0, j)),
-            pl.BlockSpec((1, 1, seq), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq, depth), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((gb, seq, depth), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((gb, block_k, depth), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((gb, block_k, depth), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((gb, 1, block_k), lambda b, j: (b, 0, j)),
+            pl.BlockSpec((gb, 1, seq), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((gb, 1, seq), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((gb, seq, depth), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, depth), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, depth), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, 1, block_k), lambda b, j: (b, 0, j)),
+            pl.BlockSpec((gb, block_k, depth), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((gb, block_k, depth), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((gb, 1, block_k), lambda b, j: (b, 0, j)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq, depth), k3.dtype),
