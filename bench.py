@@ -52,6 +52,14 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 # Phase-2 batch sweep (pallas, remat dots, rbg): 24→81.7, 28→82.4, 32→82.2
 # seq/s with 512-wide tiles; bh-batched tiles (G=8/program) lift 28 to
 # 84.3. (The original 256x256 single-bh tiles measured 70.7.)
+# BENCH_KFAC=1 preconditions with distributed K-FAC at the runner's default
+# cadence (factors every 10 steps, inverses every 100): the measured window
+# holds 2 factor passes + 1 Cholesky inverse update in 20 steps, so the
+# reported number is steady-state throughput with the inverse amortization
+# ~5x pessimistic. Measured: 236 seq/s/chip vs 397 first-order (1.7x
+# per-step cost: every-step preconditioning solves on the MXU + a 16-seq
+# stats fwd/bwd every 10 steps + a Cholesky inverse update).
+KFAC = os.environ.get("BENCH_KFAC", "0") == "1"
 PHASE = int(os.environ.get("BENCH_PHASE", "1"))
 _P2 = PHASE == 2
 LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", "28" if _P2 else "56"))
@@ -117,16 +125,45 @@ def main():
                    "masked_lm_labels": 3, "next_sentence_labels": 2})
         state = pretrain.make_init_fn(model, tx, sample, shardings)(
             jax.random.PRNGKey(0))
+
+        kfac_obj = kfac_state = kfac_shardings = None
+        if KFAC:
+            tapped = BertForPreTraining(
+                config, dtype=jnp.bfloat16, remat="none",
+                attention_backend=ATTN, kfac_tap=True)
+            apply_loss, tap_shape_fn = pretrain.make_kfac_fns(
+                tapped, next_sentence=True, max_pred_per_seq=MAX_PRED)
+            kfac_obj = optim.KFAC(apply_loss, tap_shape_fn)
+            stats_mb = {k: v[:16] for k, v in host.items()}
+            kfac_state = kfac_obj.init(state.params, stats_mb)
+            kfac_shardings = optim.kfac_state_shardings(mesh, kfac_state)
+            kfac_state = jax.device_put(kfac_state, kfac_shardings)
+
         step = pretrain.make_train_step(
             model, tx, schedule=schedule, next_sentence=True,
             shardings=shardings, batch_shardings_=b_shardings,
-            max_pred_per_seq=MAX_PRED)
+            max_pred_per_seq=MAX_PRED,
+            kfac=kfac_obj, kfac_shardings=kfac_shardings)
 
         batch = pretrain.put_batch(
             pretrain.stack_microbatches(host, ACCUM), b_shardings)
 
-        for _ in range(WARMUP_STEPS):
-            state, metrics = step(state, batch)
+        def run_one(state, kfac_state, global_step):
+            if kfac_obj is not None:
+                if global_step % 10 == 0:
+                    kfac_state = kfac_obj.update_factors(
+                        kfac_state, state.params,
+                        {k: v[0][:16] for k, v in batch.items()},
+                        jax.random.fold_in(jax.random.PRNGKey(17), global_step))
+                if global_step % 100 == 0:
+                    kfac_state = kfac_obj.update_inverses(kfac_state)
+                state, metrics = step(state, batch, kfac_state)
+            else:
+                state, metrics = step(state, batch)
+            return state, kfac_state, metrics
+
+        for i in range(WARMUP_STEPS):
+            state, kfac_state, metrics = run_one(state, kfac_state, i + 100)
             _ = float(metrics["loss"])
 
         # Chained dispatch: each step consumes the previous step's donated
@@ -137,8 +174,8 @@ def main():
         # value fetches would serialize a host<->device round-trip into
         # every step and understate steady-state throughput by ~35%.
         start = time.perf_counter()
-        for _ in range(MEASURE_STEPS):
-            state, metrics = step(state, batch)
+        for i in range(MEASURE_STEPS):
+            state, kfac_state, metrics = run_one(state, kfac_state, i)
         _ = float(metrics["loss"])
         elapsed = time.perf_counter() - start
 
@@ -146,7 +183,8 @@ def main():
     seq_per_sec_chip = seq_per_sec / n_chips
     anchor = A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC
     print(json.dumps({
-        "metric": f"bert_large_phase{PHASE}_seq_per_sec",
+        "metric": (f"bert_large_phase{PHASE}"
+                   + ("_kfac" if KFAC else "") + "_seq_per_sec"),
         "value": round(seq_per_sec_chip, 2),
         "unit": "seq/s/chip",
         "vs_baseline": round(seq_per_sec_chip / anchor, 4),
