@@ -62,10 +62,18 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 KFAC = os.environ.get("BENCH_KFAC", "0") == "1"
 PHASE = int(os.environ.get("BENCH_PHASE", "1"))
 _P2 = PHASE == 2
-LOCAL_BATCH = int(os.environ.get("BENCH_LOCAL_BATCH", "28" if _P2 else "56"))
+# BENCH_SEQ overrides the sequence length for long-context runs (the
+# reference hard-caps at max_position_embeddings=512; this framework's
+# fused attention is O(S) memory, and 'sp' ring attention shards S across
+# chips). vs_baseline then uses a FLOP-proportional courtesy scaling of the
+# phase-2 anchor (72 * 512/S) — the reference cannot run the shape at all.
+LONG_SEQ = int(os.environ.get("BENCH_SEQ", "0"))
+LOCAL_BATCH = int(os.environ.get(
+    "BENCH_LOCAL_BATCH",
+    str(max(1, 28 * 512 // LONG_SEQ)) if LONG_SEQ else ("28" if _P2 else "56")))
 REMAT = os.environ.get("BENCH_REMAT", "dots")
 RNG_IMPL = os.environ.get("BENCH_RNG_IMPL", "rbg")
-ATTN = os.environ.get("BENCH_ATTN", "pallas" if _P2 else "xla")
+ATTN = os.environ.get("BENCH_ATTN", "pallas" if (_P2 or LONG_SEQ) else "xla")
 if PHASE not in (1, 2):
     raise ValueError(f"BENCH_PHASE must be 1|2, got {PHASE}")
 if REMAT not in ("none", "dots", "full"):
@@ -74,8 +82,13 @@ if ATTN not in ("xla", "pallas"):
     raise ValueError(f"BENCH_ATTN must be xla|pallas, got {ATTN!r}")
 if RNG_IMPL not in ("rbg", "threefry2x32"):
     raise ValueError(f"BENCH_RNG_IMPL must be rbg|threefry2x32, got {RNG_IMPL!r}")
-SEQ_LEN = 512 if _P2 else 128
-MAX_PRED = 80 if _P2 else 20  # max_predictions_per_seq (BASELINE.md recipes)
+if LONG_SEQ and (LONG_SEQ < 128 or LONG_SEQ % 128 != 0):
+    raise ValueError(
+        f"BENCH_SEQ must be a positive multiple of 128 (tile alignment for "
+        f"the fused attention kernel), got {LONG_SEQ}")
+SEQ_LEN = LONG_SEQ or (512 if _P2 else 128)
+MAX_PRED = (max(20, SEQ_LEN * 80 // 512) if LONG_SEQ
+            else (80 if _P2 else 20))  # max_predictions_per_seq (BASELINE.md)
 ACCUM = 1
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
@@ -93,6 +106,8 @@ def main():
                      "configs", "bert_large_uncased_config.json"))
     if config.vocab_size % 8 != 0:
         config.vocab_size += 8 - (config.vocab_size % 8)
+    if LONG_SEQ:
+        config.max_position_embeddings = SEQ_LEN
 
     n_chips = len(jax.devices())
     mesh = create_mesh(MeshConfig(data=-1))
@@ -134,7 +149,8 @@ def main():
             apply_loss, tap_shape_fn = pretrain.make_kfac_fns(
                 tapped, next_sentence=True, max_pred_per_seq=MAX_PRED)
             kfac_obj = optim.KFAC(apply_loss, tap_shape_fn)
-            stats_mb = {k: v[:16] for k, v in host.items()}
+            _st = max(1, global_batch // 16)
+            stats_mb = {k: v[::_st][:16] for k, v in host.items()}
             kfac_state = kfac_obj.init(state.params, stats_mb)
             kfac_shardings = optim.kfac_state_shardings(mesh, kfac_state)
             kfac_state = jax.device_put(kfac_state, kfac_shardings)
@@ -151,9 +167,13 @@ def main():
         def run_one(state, kfac_state, global_step):
             if kfac_obj is not None:
                 if global_step % 10 == 0:
+                    # Strided rows so every data shard contributes to the
+                    # statistics (the runner's pattern; a [:16] head-slice
+                    # would sample only shard 0's data on multi-chip runs).
+                    stride = max(1, batch["input_ids"].shape[1] // 16)
                     kfac_state = kfac_obj.update_factors(
                         kfac_state, state.params,
-                        {k: v[0][:16] for k, v in batch.items()},
+                        {k: v[0][::stride][:16] for k, v in batch.items()},
                         jax.random.fold_in(jax.random.PRNGKey(17), global_step))
                 if global_step % 100 == 0:
                     kfac_state = kfac_obj.update_inverses(kfac_state)
@@ -181,10 +201,15 @@ def main():
 
     seq_per_sec = MEASURE_STEPS * global_batch / elapsed
     seq_per_sec_chip = seq_per_sec / n_chips
-    anchor = A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC
+    kfac_tag = "_kfac" if KFAC else ""
+    if LONG_SEQ:
+        anchor = A100_PHASE2_SEQ_PER_SEC * 512.0 / SEQ_LEN
+        name = f"bert_large_seq{SEQ_LEN}{kfac_tag}_seq_per_sec"
+    else:
+        anchor = A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC
+        name = f"bert_large_phase{PHASE}{kfac_tag}_seq_per_sec"
     print(json.dumps({
-        "metric": (f"bert_large_phase{PHASE}"
-                   + ("_kfac" if KFAC else "") + "_seq_per_sec"),
+        "metric": name,
         "value": round(seq_per_sec_chip, 2),
         "unit": "seq/s/chip",
         "vs_baseline": round(seq_per_sec_chip / anchor, 4),

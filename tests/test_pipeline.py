@@ -107,6 +107,44 @@ def test_gpipe_rejects_bad_shapes(devices):
             gpipe(lambda *a: a[1], w, jnp.zeros((2, 2, 4)), None, mesh)
 
 
+def test_pp_no_nsp_and_remat(tiny_config, devices):
+    """The RoBERTa path (next_sentence=False: no pooler/NSP head) and
+    remat='dots' inside pipeline stages both work under pp."""
+    from bert_pytorch_tpu.config import BertConfig
+
+    cfg_dict = tiny_config.to_dict()
+    cfg_dict["next_sentence"] = False
+    cfg = BertConfig.from_dict(cfg_dict)
+    model = BertForPreTraining(cfg, dtype=jnp.float32, remat="dots")
+    schedule = optim.warmup_poly_schedule(1e-3, 0.25, 100)
+    tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+    seq, b, n_mb = 32, 2, 4
+    sample = (jnp.zeros((1, seq), jnp.int32),) * 3
+    host = _batch(np.random.default_rng(3), n_mb, b, seq, cfg.vocab_size)
+    mesh = create_mesh(MeshConfig(data=2, pipe=2), devices=jax.devices()[:4])
+    rules = logical_axis_rules("pp")
+    with mesh:
+        shardings = pretrain.state_shardings(mesh, model, rules, sample)
+        b_shardings = pretrain.batch_shardings(
+            mesh,
+            {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+             "masked_lm_labels": 3, "next_sentence_labels": 2},
+        )
+        state = pretrain.make_init_fn(model, tx, sample, shardings)(
+            jax.random.PRNGKey(6)
+        )
+        step = pretrain.make_pp_train_step(
+            model, tx, mesh, schedule=schedule, next_sentence=False,
+            shardings=shardings, batch_shardings_=b_shardings,
+            max_pred_per_seq=8)
+        batch = pretrain.put_batch(host, b_shardings)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        # second step exercises donated-state reuse
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+
 def test_pp_runner_end_to_end(tmp_path, devices):
     """run_pretraining with --parallel_strategy pp: smoke + resume compat
     (pp and dp share one parameter tree, so the checkpoint layout is
