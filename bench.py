@@ -78,8 +78,8 @@ if PHASE not in (1, 2):
     raise ValueError(f"BENCH_PHASE must be 1|2, got {PHASE}")
 if REMAT not in ("none", "dots", "full"):
     raise ValueError(f"BENCH_REMAT must be none|dots|full, got {REMAT!r}")
-if ATTN not in ("xla", "pallas"):
-    raise ValueError(f"BENCH_ATTN must be xla|pallas, got {ATTN!r}")
+if ATTN not in ("xla", "pallas", "ring"):
+    raise ValueError(f"BENCH_ATTN must be xla|pallas|ring, got {ATTN!r}")
 if RNG_IMPL not in ("rbg", "threefry2x32"):
     raise ValueError(f"BENCH_RNG_IMPL must be rbg|threefry2x32, got {RNG_IMPL!r}")
 if LONG_SEQ and (LONG_SEQ < 128 or LONG_SEQ % 128 != 0):
@@ -110,15 +110,29 @@ def main():
         config.max_position_embeddings = SEQ_LEN
 
     n_chips = len(jax.devices())
-    mesh = create_mesh(MeshConfig(data=-1))
-    rules = logical_axis_rules("dp")
+    if ATTN == "ring":
+        # Context parallelism: the sequence axis shards across the chips
+        # and K/V blocks rotate over ICI (ops/ring.py). Single-chip runs
+        # can't exercise the rotation — require a real seq axis.
+        if n_chips < 2:
+            raise ValueError(
+                "BENCH_ATTN=ring needs >=2 chips (the sequence axis shards "
+                "across the mesh); on one chip use the fused 'pallas' kernel")
+        mesh = create_mesh(MeshConfig(data=1, seq=n_chips))
+        rules = logical_axis_rules("sp")
+    else:
+        mesh = create_mesh(MeshConfig(data=-1))
+        rules = logical_axis_rules("dp")
     model = BertForPreTraining(config, dtype=jnp.bfloat16, remat=REMAT,
                                attention_backend=ATTN)
     schedule = (optim.warmup_poly_schedule(4e-3, 0.128, 1563) if _P2
                 else optim.warmup_poly_schedule(6e-3, 0.2843, 7038))
     tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
 
-    global_batch = LOCAL_BATCH * n_chips * ACCUM
+    # Batch scales with the DATA shards only (under 'ring' the chips hold
+    # sequence shards, not batch shards).
+    data_shards = mesh.shape["data"] * mesh.shape["fsdp"]
+    global_batch = LOCAL_BATCH * data_shards * ACCUM
     sample = (jnp.zeros((1, SEQ_LEN), jnp.int32),) * 3
     rng = np.random.default_rng(0)
     host = {
@@ -137,7 +151,8 @@ def main():
         shardings = pretrain.state_shardings(mesh, model, rules, sample)
         b_shardings = pretrain.batch_shardings(
             mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
-                   "masked_lm_labels": 3, "next_sentence_labels": 2})
+                   "masked_lm_labels": 3, "next_sentence_labels": 2},
+            seq_sharded=ATTN == "ring")
         state = pretrain.make_init_fn(model, tx, sample, shardings)(
             jax.random.PRNGKey(0))
 

@@ -20,6 +20,7 @@ import os
 import re
 import tempfile
 import threading
+import warnings
 from typing import Any, Optional
 
 import jax
@@ -66,16 +67,44 @@ def checkpoint_path(output_dir: str, step: int) -> str:
     return os.path.join(output_dir, f"ckpt_{step}.msgpack")
 
 
-def find_resume_step(output_dir: str) -> Optional[int]:
-    """Max step among ckpt_*.msgpack files (reference run_pretraining.py:246-253)."""
+def _ckpt_steps(output_dir: str) -> list[int]:
+    """Ascending steps of the ckpt_*.msgpack files in ``output_dir``."""
     if not os.path.isdir(output_dir):
-        return None
-    steps = [
+        return []
+    return sorted(
         int(m.group(1))
         for name in os.listdir(output_dir)
         if (m := CKPT_RE.search(name))
-    ]
-    return max(steps) if steps else None
+    )
+
+
+def find_resume_step(output_dir: str) -> Optional[int]:
+    """Max step among ckpt_*.msgpack files (reference run_pretraining.py:246-253)."""
+    steps = _ckpt_steps(output_dir)
+    return steps[-1] if steps else None
+
+
+def load_latest_checkpoint(output_dir: str):
+    """(step, state) of the newest LOADABLE checkpoint, or None.
+
+    Writes are atomic (tmp + rename in :func:`_write_and_prune`), but a
+    checkpoint can still arrive corrupt — a torn filesystem, a partial copy
+    from another machine, bit rot. The reference would crash on it
+    (torch.load of the max-step file, run_pretraining.py:246-257); here a
+    bad newest file costs the training between it and the previous retained
+    checkpoint, not the run: we walk steps newest-first and warn-and-skip
+    unreadable files (the dataset layer's warn-and-skip stance, SURVEY §4).
+    """
+    for step in reversed(_ckpt_steps(output_dir)):
+        path = checkpoint_path(output_dir, step)
+        try:
+            return step, load_checkpoint(path)
+        except Exception as e:  # corrupt/truncated/unreadable
+            warnings.warn(
+                f"Skipping unreadable checkpoint {path} ({type(e).__name__}: "
+                f"{e}); falling back to the previous one"
+            )
+    return None
 
 
 def _to_host(tree: Any) -> Any:
@@ -112,11 +141,7 @@ def _write_and_prune(state: Any, output_dir: str, step: int, keep: int) -> None:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
-    steps = sorted(
-        int(m.group(1))
-        for name in os.listdir(output_dir)
-        if (m := CKPT_RE.search(name))
-    )
+    steps = _ckpt_steps(output_dir)
     for old in steps[:-keep] if keep > 0 else []:
         try:
             os.unlink(checkpoint_path(output_dir, old))

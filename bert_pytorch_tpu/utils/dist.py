@@ -39,6 +39,40 @@ def barrier() -> None:
         )
 
 
+def agree_on_resume_step(step: int | None) -> int | None:
+    """Cross-process agreement on which checkpoint step to resume from.
+
+    Every process proposes the newest step it could LOAD (or None). On a
+    single process this is the identity. Multi-host, the processes share a
+    checkpoint directory but can observe it differently (NFS/GCS propagation
+    lag after an async write, partial copies): resuming from different steps
+    would silently diverge the run. Policy: if all propose the same step,
+    proceed; if they differ but all have one, everyone resumes from the
+    MINIMUM (the newest checkpoint every process can see); if any process
+    has none while others do, fail fast — the shared storage is
+    inconsistent and no silent choice is safe.
+    """
+    if jax.process_count() == 1:
+        return step
+    from jax.experimental import multihost_utils
+
+    proposals = np.asarray(
+        multihost_utils.process_allgather(
+            jnp.int32(-1 if step is None else step)
+        )
+    )
+    lo, hi = int(proposals.min()), int(proposals.max())
+    if lo == hi:
+        return None if lo == -1 else lo
+    if lo == -1:
+        raise RuntimeError(
+            f"checkpoint directory inconsistent across hosts: some processes "
+            f"see no loadable checkpoint while others see step {hi} "
+            f"(proposals per process: {proposals.tolist()})"
+        )
+    return lo
+
+
 def format_step(epoch, step, split: str = "") -> str:
     """Human-readable step tag; reference utils.py:54-64."""
     parts = []

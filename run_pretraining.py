@@ -35,7 +35,12 @@ from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rule
 from bert_pytorch_tpu.parallel import launcher
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
-from bert_pytorch_tpu.utils.dist import get_rank, get_world_size, is_main_process
+from bert_pytorch_tpu.utils.dist import (
+    agree_on_resume_step,
+    get_rank,
+    get_world_size,
+    is_main_process,
+)
 
 
 def parse_arguments(argv=None) -> argparse.Namespace:
@@ -224,18 +229,29 @@ def prepare_model(args, mesh):
         attention_backend=args.attention_backend,
     )
 
-    resume_step = ckpt.find_resume_step(args.model_output_dir)
+    # Newest LOADABLE checkpoint: a corrupt newest file is warn-skipped and
+    # the previous retained one resumes instead of crashing the job.
+    found = ckpt.load_latest_checkpoint(args.model_output_dir)
+    # Multi-host: all processes must resume from the SAME step even when
+    # they observe the shared checkpoint dir differently (utils/dist.py).
+    agreed = agree_on_resume_step(None if found is None else found[0])
+    if agreed is None:
+        found = None
+    elif found is None or found[0] != agreed:
+        # This process must re-load the agreed step; failure here is fatal
+        # (no silent divergence).
+        found = (agreed, ckpt.load_checkpoint(
+            ckpt.checkpoint_path(args.model_output_dir, agreed)))
     checkpoint = None
     global_step = 0
     args.resume_step = 0
-    if resume_step is not None:
+    if found is not None:
+        resume_step, checkpoint = found
         args.resume_step = resume_step
         if args.previous_phase_end_step > resume_step:
             raise ValueError(
                 f"previous_phase_end_step={args.previous_phase_end_step} cannot "
                 f"be larger than resume_step={resume_step}")
-        checkpoint = ckpt.load_checkpoint(
-            ckpt.checkpoint_path(args.model_output_dir, resume_step))
         global_step = resume_step - args.previous_phase_end_step
         logger.info(f"Resume from step {resume_step} checkpoint")
     return model, config, checkpoint, global_step

@@ -73,3 +73,62 @@ def test_async_write_failure_raises_at_wait(tmp_path, monkeypatch):
         ckpt.wait_for_pending_save()
     # error is consumed; subsequent waits are clean
     ckpt.wait_for_pending_save()
+
+
+def test_load_latest_skips_corrupt_newest(tmp_path):
+    """A corrupt newest checkpoint must not kill resume: the loader warns
+    and falls back to the previous retained one."""
+    import pytest
+
+    ckpt.save_checkpoint(str(tmp_path), 1, _contents(1))
+    ckpt.save_checkpoint(str(tmp_path), 2, _contents(2))
+    # truncate the newest file mid-blob
+    p2 = ckpt.checkpoint_path(str(tmp_path), 2)
+    blob = open(p2, "rb").read()
+    open(p2, "wb").write(blob[: len(blob) // 2])
+    with pytest.warns(UserWarning, match="Skipping unreadable checkpoint"):
+        step, state = ckpt.load_latest_checkpoint(str(tmp_path))
+    assert step == 1
+    assert state["epoch"] == 1
+
+
+def test_load_latest_none_when_all_corrupt(tmp_path):
+    import pytest
+
+    ckpt.save_checkpoint(str(tmp_path), 1, _contents(1))
+    p = ckpt.checkpoint_path(str(tmp_path), 1)
+    open(p, "wb").write(b"not msgpack")
+    with pytest.warns(UserWarning):
+        assert ckpt.load_latest_checkpoint(str(tmp_path)) is None
+    assert ckpt.load_latest_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_agree_on_resume_step_policies(monkeypatch):
+    """Multi-host resume agreement (utils/dist.py): same -> keep, differing
+    loadable steps -> minimum, any-missing-while-others-have -> fail fast."""
+    import pytest
+
+    from bert_pytorch_tpu.utils import dist
+
+    monkeypatch.setattr(dist.jax, "process_count", lambda: 2)
+
+    class FakeMH:
+        def __init__(self, values):
+            self.values = values
+
+        def process_allgather(self, _x):
+            return np.asarray(self.values, np.int32)
+
+    import sys
+
+    def run(values, step):
+        fake = FakeMH(values)
+        monkeypatch.setitem(
+            sys.modules, "jax.experimental.multihost_utils", fake)
+        return dist.agree_on_resume_step(step)
+
+    assert run([7, 7], 7) == 7
+    assert run([5, 7], 7) == 5          # lagging host wins: everyone at 5
+    assert run([-1, -1], None) is None  # nobody has one: fresh start
+    with pytest.raises(RuntimeError, match="inconsistent across hosts"):
+        run([-1, 7], 7)
