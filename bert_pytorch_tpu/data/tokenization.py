@@ -61,10 +61,22 @@ def get_wordpiece_tokenizer(
 def get_bpe_tokenizer(vocab_file: str, uppercase: bool = False, backend: str = "auto"):
     """Byte-level BPE tokenizer (reference tokenization.py:51-57).
     ``vocab_file`` may be a merges-adjacent vocab.json path prefix per the
-    reference's convention."""
+    reference's convention. ``backend='cpp'`` forces the in-repo C++ core
+    (native/tokenizer.cpp); 'auto' tries it and falls back to HF."""
+    merges = vocab_file.replace("vocab.json", "merges.txt")
+    if backend in ("auto", "cpp"):
+        try:
+            from bert_pytorch_tpu.tools.tokenizer_cpp import (
+                CppByteLevelBPETokenizer,
+            )
+
+            return CppByteLevelBPETokenizer(
+                vocab_file, merges, lowercase=not uppercase)
+        except Exception:
+            if backend == "cpp":
+                raise
     from tokenizers import ByteLevelBPETokenizer
 
-    merges = vocab_file.replace("vocab.json", "merges.txt")
     tok = ByteLevelBPETokenizer(vocab_file, merges, lowercase=not uppercase)
     return tok
 

@@ -35,12 +35,20 @@ def _load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        # Always invoke make: it is a timestamp-checked no-op when the .so
+        # is current, and it rebuilds a STALE one (a prebuilt library from
+        # an older source would be missing newer symbols and poison every
+        # ctypes prototype below). If make itself is unavailable, fall
+        # through to loading whatever .so exists.
+        try:
             subprocess.run(
                 ["make", "-C", _NATIVE_DIR, "-s"],
                 check=True,
                 capture_output=True,
             )
+        except (OSError, subprocess.CalledProcessError):
+            if not os.path.exists(_LIB_PATH):
+                raise
         lib = ctypes.CDLL(_LIB_PATH)
         lib.wp_create.restype = ctypes.c_void_p
         lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
@@ -51,7 +59,8 @@ def _load_library() -> ctypes.CDLL:
         lib.wp_token_to_id.restype = ctypes.c_int
         lib.wp_id_to_token.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.wp_id_to_token.restype = ctypes.c_char_p
-        lib.wp_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.wp_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
         lib.wp_encode.restype = ctypes.c_int
         lib.wp_get_ids.argtypes = [ctypes.c_void_p]
         lib.wp_get_ids.restype = ctypes.POINTER(ctypes.c_int)
@@ -62,6 +71,23 @@ def _load_library() -> ctypes.CDLL:
             ctypes.c_int, ctypes.c_char_p,
         ]
         lib.wp_train.restype = ctypes.c_int
+        lib.bpe_create.restype = ctypes.c_void_p
+        lib.bpe_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        lib.bpe_free.argtypes = [ctypes.c_void_p]
+        lib.bpe_vocab_size.argtypes = [ctypes.c_void_p]
+        lib.bpe_vocab_size.restype = ctypes.c_int
+        lib.bpe_token_to_id.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.bpe_token_to_id.restype = ctypes.c_int
+        lib.bpe_id_to_token.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.bpe_id_to_token.restype = ctypes.c_char_p
+        lib.bpe_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        lib.bpe_encode.restype = ctypes.c_int
+        lib.bpe_get_ids.argtypes = [ctypes.c_void_p]
+        lib.bpe_get_ids.restype = ctypes.POINTER(ctypes.c_int)
+        lib.bpe_get_tokens.argtypes = [ctypes.c_void_p]
+        lib.bpe_get_tokens.restype = ctypes.c_char_p
         _lib = lib
         return lib
 
@@ -104,7 +130,8 @@ class CppWordPieceTokenizer:
 
     def encode(self, text: str, add_special_tokens: bool = False) -> Encoding:
         with self._encode_lock:
-            n = self._lib.wp_encode(self._handle, text.encode("utf-8"))
+            raw_text = text.encode("utf-8")
+            n = self._lib.wp_encode(self._handle, raw_text, len(raw_text))
             ids = list(self._lib.wp_get_ids(self._handle)[:n])
             raw = self._lib.wp_get_tokens(self._handle).decode("utf-8")
         tokens = raw.split("\n") if raw else []
@@ -113,6 +140,70 @@ class CppWordPieceTokenizer:
             ids = [cls_id] + ids + [sep_id]
             tokens = ["[CLS]"] + tokens + ["[SEP]"]
         return Encoding(ids=ids, tokens=tokens)
+
+    def encode_batch(self, texts: List[str]) -> List[Encoding]:
+        return [self.encode(t) for t in texts]
+
+
+class CppByteLevelBPETokenizer:
+    """Byte-level BPE tokenizer (GPT-2/RoBERTa) backed by the C++ core.
+
+    Mirrors HF ``ByteLevelBPETokenizer(vocab.json, merges.txt)``'s encode
+    surface (reference src/tokenization.py:51-57): GPT-2 byte-to-unicode
+    mapping + pre-tokenizer regex + ranked merge loop. ``vocab_file`` is
+    the vocab.json (token -> id); ``merges_file`` the merges.txt.
+    """
+
+    def __init__(self, vocab_file: str, merges_file: str,
+                 lowercase: bool = False):
+        import json
+
+        self._lib = _load_library()
+        with open(vocab_file, encoding="utf-8") as f:
+            vocab = json.load(f)
+        by_id = sorted(vocab.items(), key=lambda kv: kv[1])
+        n = by_id[-1][1] + 1 if by_id else 0
+        tokens = [""] * n
+        for tok, tid in by_id:
+            tokens[tid] = tok
+        with open(merges_file, encoding="utf-8") as f:
+            merges = f.read()
+        self._handle = self._lib.bpe_create(
+            "\n".join(tokens).encode("utf-8"), merges.encode("utf-8"),
+            1 if lowercase else 0)
+        if not self._handle:
+            raise OSError(f"could not build BPE from {vocab_file}")
+        self.lowercase = lowercase
+        self._encode_lock = threading.Lock()
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.bpe_free(handle)
+            self._handle = None
+
+    def get_vocab_size(self) -> int:
+        return self._lib.bpe_vocab_size(self._handle)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        tid = self._lib.bpe_token_to_id(self._handle, token.encode("utf-8"))
+        return None if tid < 0 else tid
+
+    def id_to_token(self, token_id: int) -> str:
+        return self._lib.bpe_id_to_token(self._handle, token_id).decode("utf-8")
+
+    def encode(self, text: str, add_special_tokens: bool = False) -> Encoding:
+        # ``add_special_tokens`` is accepted for HF signature compatibility
+        # (callers like tools/encode_data.py pass it); like HF's
+        # ByteLevelBPETokenizer — which has no post-processor template —
+        # it is a no-op here.
+        del add_special_tokens
+        with self._encode_lock:
+            raw_text = text.encode("utf-8")
+            n = self._lib.bpe_encode(self._handle, raw_text, len(raw_text))
+            ids = list(self._lib.bpe_get_ids(self._handle)[:n])
+            raw = self._lib.bpe_get_tokens(self._handle).decode("utf-8")
+        return Encoding(ids=ids, tokens=raw.split("\n") if raw else [])
 
     def encode_batch(self, texts: List[str]) -> List[Encoding]:
         return [self.encode(t) for t in texts]

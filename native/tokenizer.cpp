@@ -334,6 +334,211 @@ std::vector<std::string> trainer_run(TrainerState& st, size_t vocab_size,
   return vocab;
 }
 
+// ---------------------------------------------------------------------------
+// Byte-level BPE (GPT-2/RoBERTa; reference src/tokenization.py:51-57 drives
+// HF ByteLevelBPETokenizer — this is the C++ equivalent of its encode path)
+// ---------------------------------------------------------------------------
+
+// Unicode letter/number classes for the GPT-2 pre-tokenizer regex
+// (\p{L}/\p{N}). Covers ASCII, Latin-1/Extended, Greek, Cyrillic, CJK,
+// kana, and Hangul — the scripts in BERT/RoBERTa's corpora; exotic scripts
+// degrade to the punctuation branch, mirroring the fold-table stance above.
+bool is_letter(uint32_t cp) {
+  if ((cp >= 'a' && cp <= 'z') || (cp >= 'A' && cp <= 'Z')) return true;
+  if (cp == 0x00AA || cp == 0x00B5 || cp == 0x00BA) return true;
+  if (cp >= 0x00C0 && cp <= 0x02AF && cp != 0x00D7 && cp != 0x00F7) return true;
+  if (cp >= 0x0386 && cp <= 0x03FF && cp != 0x0387) return true;  // Greek
+  if (cp >= 0x0400 && cp <= 0x04FF) return true;                  // Cyrillic
+  // Kana LETTERS only: the block also holds combining sound marks
+  // (U+3099-U+309C), the interpunct U+30FB, and U+30A0 (punctuation),
+  // which \p{L} excludes.
+  if ((cp >= 0x3041 && cp <= 0x3096) || (cp >= 0x309D && cp <= 0x309F) ||
+      (cp >= 0x30A1 && cp <= 0x30FA) || (cp >= 0x30FC && cp <= 0x30FF))
+    return true;
+  if (cp >= 0xAC00 && cp <= 0xD7A3) return true;                  // Hangul
+  return is_cjk(cp);
+}
+
+bool is_number(uint32_t cp) {
+  if (cp >= '0' && cp <= '9') return true;
+  return cp == 0x00B2 || cp == 0x00B3 || cp == 0x00B9 ||
+         (cp >= 0x00BC && cp <= 0x00BE) || (cp >= 0x0660 && cp <= 0x0669);
+}
+
+// \s of the GPT-2 regex (Unicode whitespace).
+bool is_bpe_space(uint32_t cp) {
+  return is_whitespace(cp) || cp == 0x0B || cp == 0x0C || cp == 0x85 ||
+         cp == 0x2028 || cp == 0x2029;
+}
+
+uint32_t simple_lower(uint32_t cp) {
+  // HF Lowercase normalizer (no accent strip). ASCII + Latin-1 + Greek +
+  // Cyrillic. Latin Extended-A pairs upper/lower adjacently but the parity
+  // FLIPS at U+0138 (and Ÿ lives at U+0178 with its lowercase back in
+  // Latin-1), so the ranges are spelled out.
+  if (cp >= 'A' && cp <= 'Z') return cp + 32;
+  if (cp >= 0x00C0 && cp <= 0x00DE && cp != 0x00D7) return cp + 32;
+  if (cp >= 0x0100 && cp <= 0x0137 && cp % 2 == 0) return cp + 1;
+  if (cp >= 0x0139 && cp <= 0x0148 && cp % 2 == 1) return cp + 1;
+  if (cp >= 0x014A && cp <= 0x0177 && cp % 2 == 0) return cp + 1;
+  if (cp == 0x0178) return 0x00FF;
+  if (cp >= 0x0179 && cp <= 0x017E && cp % 2 == 1) return cp + 1;
+  if (cp >= 0x0391 && cp <= 0x03A9 && cp != 0x03A2) return cp + 32;
+  if (cp >= 0x0410 && cp <= 0x042F) return cp + 32;
+  return cp;
+}
+
+struct BpeTokenizer {
+  std::unordered_map<std::string, int> vocab;  // byte-mapped token -> id
+  std::vector<std::string> id_to_token;
+  // merge pair "left\x01right" -> rank (lower merges first)
+  std::unordered_map<std::string, int> merges;
+  bool lowercase = false;
+  int unk_id = 0;
+  std::string byte_to_uni[256];  // UTF-8 of each byte's mapped codepoint
+  std::unordered_map<std::string, std::vector<int>> cache;  // pretoken -> ids
+
+  std::vector<int> last_ids;
+  std::string last_tokens_joined;
+};
+
+void init_byte_map(BpeTokenizer& t) {
+  // GPT-2 bytes_to_unicode: printable bytes keep their codepoint, the rest
+  // are assigned 256, 257, ... in byte order.
+  int next = 0;
+  for (int b = 0; b < 256; b++) {
+    bool printable = (b >= 33 && b <= 126) || (b >= 161 && b <= 172) ||
+                     (b >= 174 && b <= 255);
+    uint32_t cp = printable ? static_cast<uint32_t>(b)
+                            : static_cast<uint32_t>(256 + next++);
+    encode_utf8(cp, t.byte_to_uni[b]);
+  }
+}
+
+// GPT-2 pre-tokenizer:
+//   's|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+
+// implemented as a hand scanner over codepoints (same match order).
+std::vector<std::string> bpe_pretokenize(const std::string& text) {
+  // Decode once into (codepoint, byte offset) pairs.
+  std::vector<uint32_t> cps;
+  std::vector<size_t> offs;
+  size_t i = 0;
+  while (i < text.size()) {
+    offs.push_back(i);
+    cps.push_back(decode_utf8(text, i));
+  }
+  offs.push_back(text.size());
+  const size_t n = cps.size();
+
+  auto slice = [&](size_t a, size_t b) {
+    return text.substr(offs[a], offs[b] - offs[a]);
+  };
+  std::vector<std::string> out;
+  size_t p = 0;
+  while (p < n) {
+    // contractions (no leading space)
+    if (cps[p] == '\'' && p + 1 < n) {
+      uint32_t c1 = cps[p + 1];
+      if (c1 == 's' || c1 == 't' || c1 == 'm' || c1 == 'd') {
+        out.push_back(slice(p, p + 2)); p += 2; continue;
+      }
+      if (p + 2 < n &&
+          ((c1 == 'r' && cps[p + 2] == 'e') ||
+           (c1 == 'v' && cps[p + 2] == 'e') ||
+           (c1 == 'l' && cps[p + 2] == 'l'))) {
+        out.push_back(slice(p, p + 3)); p += 3; continue;
+      }
+    }
+    // " ?\p{L}+" / " ?\p{N}+" / " ?[^\s\p{L}\p{N}]+"
+    size_t start = p;
+    size_t q = p;
+    if (cps[q] == ' ' && q + 1 < n && !is_bpe_space(cps[q + 1])) q++;
+    if (q < n && is_letter(cps[q])) {
+      while (q < n && is_letter(cps[q])) q++;
+      out.push_back(slice(start, q)); p = q; continue;
+    }
+    if (q < n && is_number(cps[q])) {
+      while (q < n && is_number(cps[q])) q++;
+      out.push_back(slice(start, q)); p = q; continue;
+    }
+    if (q < n && !is_bpe_space(cps[q])) {
+      while (q < n && !is_bpe_space(cps[q]) && !is_letter(cps[q]) &&
+             !is_number(cps[q]))
+        q++;
+      out.push_back(slice(start, q)); p = q; continue;
+    }
+    // whitespace runs: "\s+(?!\S)" then "\s+"
+    q = p;
+    while (q < n && is_bpe_space(cps[q])) q++;
+    if (q < n && q - p >= 2) {
+      // followed by non-space: leave the last whitespace char for the
+      // next token's optional leading space
+      out.push_back(slice(p, q - 1));
+      p = q - 1;
+      // a trailing single non-' ' whitespace becomes its own \s+ token
+      if (cps[p] != ' ') { out.push_back(slice(p, p + 1)); p += 1; }
+      continue;
+    }
+    if (q == n) { out.push_back(slice(p, q)); p = q; continue; }
+    // single whitespace followed by non-space
+    if (cps[p] == ' ') {
+      // handled by the " ?" branches above unless followed by space (ruled
+      // out) — reaching here means ' ' followed by something the classes
+      // all rejected; emit it alone.
+      out.push_back(slice(p, p + 1)); p += 1; continue;
+    }
+    out.push_back(slice(p, p + 1));
+    p += 1;
+  }
+  return out;
+}
+
+// Ranked merge loop on one pre-token (bytes already mapped to symbols).
+std::vector<int> bpe_word(BpeTokenizer& t, const std::string& pretoken) {
+  auto cached = t.cache.find(pretoken);
+  if (cached != t.cache.end()) return cached->second;
+
+  std::vector<std::string> symbols;
+  for (unsigned char b : pretoken) symbols.push_back(t.byte_to_uni[b]);
+
+  while (symbols.size() > 1) {
+    int best_rank = INT32_MAX;
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < symbols.size(); i++) {
+      auto it = t.merges.find(symbols[i] + '\x01' + symbols[i + 1]);
+      if (it != t.merges.end() && it->second < best_rank) {
+        best_rank = it->second;
+        best_i = i;
+      }
+    }
+    if (best_rank == INT32_MAX) break;
+    const std::string left = symbols[best_i], right = symbols[best_i + 1];
+    // merge ALL adjacent (left, right) occurrences left-to-right
+    std::vector<std::string> merged;
+    merged.reserve(symbols.size());
+    for (size_t i = 0; i < symbols.size();) {
+      if (i + 1 < symbols.size() && symbols[i] == left &&
+          symbols[i + 1] == right) {
+        merged.push_back(left + right);
+        i += 2;
+      } else {
+        merged.push_back(symbols[i]);
+        i += 1;
+      }
+    }
+    symbols = std::move(merged);
+  }
+
+  std::vector<int> ids;
+  ids.reserve(symbols.size());
+  for (auto& s : symbols) {
+    auto it = t.vocab.find(s);
+    ids.push_back(it == t.vocab.end() ? t.unk_id : it->second);
+  }
+  if (t.cache.size() < 65536) t.cache.emplace(pretoken, ids);
+  return ids;
+}
+
 }  // namespace
 
 // ---------------------------------------------------------------------------
@@ -382,12 +587,15 @@ const char* wp_id_to_token(void* handle, int id) {
 
 // Encode text; returns number of tokens. Fetch results with wp_get_ids /
 // wp_get_tokens (valid until the next encode on this handle).
-int wp_encode(void* handle, const char* text) {
+// len-aware so embedded NUL bytes don't truncate the input (they are
+// control chars the normalizer drops, but the text AFTER them must survive).
+int wp_encode(void* handle, const char* text, int len) {
   auto* t = static_cast<Tokenizer*>(handle);
   t->last_ids.clear();
   t->last_tokens_joined.clear();
   std::vector<std::string> tokens;
-  for (const auto& word : basic_tokenize(*t, text))
+  for (const auto& word :
+       basic_tokenize(*t, std::string(text, static_cast<size_t>(len))))
     wordpiece(*t, word, t->last_ids, tokens);
   for (size_t i = 0; i < tokens.size(); i++) {
     if (i) t->last_tokens_joined.push_back('\n');
@@ -402,6 +610,90 @@ const int* wp_get_ids(void* handle) {
 
 const char* wp_get_tokens(void* handle) {
   return static_cast<Tokenizer*>(handle)->last_tokens_joined.c_str();
+}
+
+// --- byte-level BPE ---------------------------------------------------------
+
+// vocab_lines: '\n'-joined tokens in id order (byte-mapped strings contain
+// no raw whitespace, so the framing is safe); merges_lines: '\n'-joined
+// "left right" pairs in rank order (the merges.txt body).
+void* bpe_create(const char* vocab_lines, const char* merges_lines,
+                 int lowercase) {
+  auto* t = new BpeTokenizer();
+  t->lowercase = lowercase != 0;
+  init_byte_map(*t);
+  std::stringstream vs(vocab_lines);
+  std::string line;
+  while (std::getline(vs, line, '\n')) {
+    t->vocab.emplace(line, static_cast<int>(t->id_to_token.size()));
+    t->id_to_token.push_back(line);
+  }
+  auto unk = t->vocab.find("<unk>");
+  t->unk_id = unk == t->vocab.end() ? 0 : unk->second;
+  std::stringstream ms(merges_lines);
+  int rank = 0;
+  bool first_line = true;
+  while (std::getline(ms, line, '\n')) {
+    // Only the leading "#version: ..." header is a comment — a merge whose
+    // left symbol starts with '#' (e.g. "# #") is legitimate data.
+    bool header = first_line && line.rfind("#version", 0) == 0;
+    first_line = false;
+    if (line.empty() || header) continue;
+    size_t sp = line.find(' ');
+    if (sp == std::string::npos) continue;
+    t->merges.emplace(line.substr(0, sp) + '\x01' + line.substr(sp + 1),
+                      rank++);
+  }
+  return t;
+}
+
+void bpe_free(void* handle) { delete static_cast<BpeTokenizer*>(handle); }
+
+int bpe_vocab_size(void* handle) {
+  return static_cast<int>(
+      static_cast<BpeTokenizer*>(handle)->id_to_token.size());
+}
+
+int bpe_token_to_id(void* handle, const char* token) {
+  auto* t = static_cast<BpeTokenizer*>(handle);
+  auto it = t->vocab.find(token);
+  return it == t->vocab.end() ? -1 : it->second;
+}
+
+const char* bpe_id_to_token(void* handle, int id) {
+  auto* t = static_cast<BpeTokenizer*>(handle);
+  if (id < 0 || id >= static_cast<int>(t->id_to_token.size())) return "";
+  return t->id_to_token[id].c_str();
+}
+
+int bpe_encode(void* handle, const char* text_c, int len) {
+  auto* t = static_cast<BpeTokenizer*>(handle);
+  t->last_ids.clear();
+  t->last_tokens_joined.clear();
+  std::string text(text_c, static_cast<size_t>(len));
+  if (t->lowercase) {
+    std::string lowered;
+    lowered.reserve(text.size());
+    size_t i = 0;
+    while (i < text.size()) encode_utf8(simple_lower(decode_utf8(text, i)), lowered);
+    text = std::move(lowered);
+  }
+  for (const auto& pre : bpe_pretokenize(text)) {
+    for (int id : bpe_word(*t, pre)) t->last_ids.push_back(id);
+  }
+  for (size_t i = 0; i < t->last_ids.size(); i++) {
+    if (i) t->last_tokens_joined.push_back('\n');
+    t->last_tokens_joined += t->id_to_token[t->last_ids[i]];
+  }
+  return static_cast<int>(t->last_ids.size());
+}
+
+const int* bpe_get_ids(void* handle) {
+  return static_cast<BpeTokenizer*>(handle)->last_ids.data();
+}
+
+const char* bpe_get_tokens(void* handle) {
+  return static_cast<BpeTokenizer*>(handle)->last_tokens_joined.c_str();
 }
 
 // Train a WordPiece vocab from newline-delimited text files.

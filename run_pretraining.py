@@ -293,7 +293,10 @@ def prepare_dataset(args, config, checkpoint):
         tok = (get_wordpiece_tokenizer(vocab_file, uppercase=not lowercase)
                if kind == "wordpiece"
                else get_bpe_tokenizer(vocab_file, uppercase=not lowercase))
+        # WordPiece convention first, then the BPE/RoBERTa one.
         mask_token_id = tok.token_to_id("[MASK]")
+        if mask_token_id is None:
+            mask_token_id = tok.token_to_id("<mask>")
     if mask_token_id is None:
         mask_token_id = 4  # synthetic-data default
         logger.info("No vocab_file/mask_token_id in model config; "

@@ -144,3 +144,119 @@ def test_get_wordpiece_tokenizer_prefers_cpp(vocab_file):
 
     tok = get_wordpiece_tokenizer(vocab_file)
     assert isinstance(tok, CppWordPieceTokenizer)
+
+
+# ---------------------------------------------------------------------------
+# Byte-level BPE (C++ core vs HF ByteLevelBPETokenizer)
+# ---------------------------------------------------------------------------
+
+BPE_SENTENCES = [
+    "Hello world",
+    "hello world",
+    "The quick brown fox jumps over 1234 lazy dogs!",
+    "  leading and   multiple  spaces ",
+    "don't stop, we'll go; they've said I'm he'd 're",
+    "tabs\tand\nnewlines\n\nhere",
+    "punctuation!!! (parens) [brackets] {braces} #hash @at",
+    "numbers 007 3.14159 1,000,000",
+    "unicode café naïve über straße",
+    "mixed CJK 中文 text",
+    "emoji \U0001f600 ok",
+    "",
+    "a",
+    " ",
+    "trailing space ",
+]
+
+
+@pytest.fixture(scope="module")
+def bpe_files(tmp_path_factory):
+    """Train a small byte-level BPE with HF (the oracle) on sample text."""
+    tokenizers = pytest.importorskip("tokenizers")
+    d = tmp_path_factory.mktemp("bpe")
+    corpus = d / "corpus.txt"
+    corpus.write_text("\n".join(BPE_SENTENCES * 8) + "\n")
+    tok = tokenizers.ByteLevelBPETokenizer()
+    tok.train([str(corpus)], vocab_size=400, min_frequency=1,
+              special_tokens=["<s>", "<pad>", "</s>", "<unk>", "<mask>"])
+    tok.save_model(str(d))
+    return str(d / "vocab.json"), str(d / "merges.txt")
+
+
+def test_cpp_bpe_matches_hf(bpe_files):
+    """Bit parity of the C++ byte-level BPE against the HF Rust oracle:
+    same pre-tokenization (GPT-2 regex incl. contractions and the
+    whitespace-lookahead rule), same ranked merges, same ids."""
+    tokenizers = pytest.importorskip("tokenizers")
+    vocab_json, merges_txt = bpe_files
+    hf = tokenizers.ByteLevelBPETokenizer(vocab_json, merges_txt)
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppByteLevelBPETokenizer
+
+    cpp = CppByteLevelBPETokenizer(vocab_json, merges_txt)
+    assert cpp.get_vocab_size() == hf.get_vocab_size()
+    for sentence in BPE_SENTENCES:
+        hf_enc = hf.encode(sentence)
+        enc = cpp.encode(sentence)
+        assert enc.tokens == hf_enc.tokens, repr(sentence)
+        assert enc.ids == hf_enc.ids, repr(sentence)
+
+
+def test_cpp_bpe_lowercase_mode(bpe_files):
+    tokenizers = pytest.importorskip("tokenizers")
+    vocab_json, merges_txt = bpe_files
+    hf = tokenizers.ByteLevelBPETokenizer(vocab_json, merges_txt,
+                                          lowercase=True)
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppByteLevelBPETokenizer
+
+    cpp = CppByteLevelBPETokenizer(vocab_json, merges_txt, lowercase=True)
+    for sentence in ["Hello World", "ALL CAPS 123", "MiXeD CaSe!"]:
+        assert cpp.encode(sentence).ids == hf.encode(sentence).ids, sentence
+
+
+def test_get_bpe_tokenizer_routes_to_cpp(bpe_files):
+    from bert_pytorch_tpu.data.tokenization import get_bpe_tokenizer
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppByteLevelBPETokenizer
+
+    tok = get_bpe_tokenizer(bpe_files[0], uppercase=True, backend="cpp")
+    assert isinstance(tok, CppByteLevelBPETokenizer)
+    assert tok.encode("hello world").ids
+
+
+def test_cpp_bpe_hash_merges_and_scripts(tmp_path):
+    """Review-hardened corner cases: merges whose left symbol begins with
+    '#' (only the '#version' header is a comment), the katakana interpunct
+    (punctuation inside the kana block, excluded from \\p{L}), and Latin
+    Extended-A lowercase where the upper/lower pairing parity flips."""
+    import json
+
+    tokenizers = pytest.importorskip("tokenizers")
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppByteLevelBPETokenizer
+
+    alphabet = [chr(c) for c in range(33, 127)] + ["Ġ"]
+    vocab = {t: i for i, t in enumerate(alphabet)}
+    vocab["##"] = len(vocab)
+    vocab["Ġ#"] = len(vocab)
+    vj, mt = str(tmp_path / "vocab.json"), str(tmp_path / "merges.txt")
+    json.dump(vocab, open(vj, "w"))
+    open(mt, "w").write("#version: 0.2\n# #\nĠ #\n")
+    hf = tokenizers.ByteLevelBPETokenizer(vj, mt)
+    cpp = CppByteLevelBPETokenizer(vj, mt)
+    for s in ["##", "# ##x", "a ## b", "####"]:
+        assert cpp.encode(s).ids == hf.encode(s).ids, s
+
+    d = tmp_path / "trained"
+    d.mkdir()
+    corpus = d / "c.txt"
+    corpus.write_text("łódź ľahko デ・ニーロ ĽAHKO test\n" * 40)
+    tok = tokenizers.ByteLevelBPETokenizer()
+    tok.train([str(corpus)], vocab_size=400, min_frequency=1)
+    tok.save_model(str(d))
+    vj2, mt2 = str(d / "vocab.json"), str(d / "merges.txt")
+    hf2 = tokenizers.ByteLevelBPETokenizer(vj2, mt2)
+    cpp2 = CppByteLevelBPETokenizer(vj2, mt2)
+    hf_low = tokenizers.ByteLevelBPETokenizer(vj2, mt2, lowercase=True)
+    cpp_low = CppByteLevelBPETokenizer(vj2, mt2, lowercase=True)
+    for s in ["デ・ニーロ", "カタカナー", "łódź ĽAHKO"]:
+        assert cpp2.encode(s).ids == hf2.encode(s).ids, s
+    for s in ["ŁÓDŹ Ľahko Ĺ", "Ÿ ŶĵĶ", "Źle Žba Ŵ", "ĿL ŊAname"]:
+        assert cpp_low.encode(s).ids == hf_low.encode(s).ids, s
