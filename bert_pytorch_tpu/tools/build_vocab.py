@@ -29,8 +29,30 @@ def build_wordpiece_vocab(input_files, output_file: str, vocab_size: int,
 
 
 def build_bpe_vocab(input_files, output_dir: str, vocab_size: int,
-                    lowercase: bool = True, min_frequency: int = 2) -> str:
-    from tokenizers import ByteLevelBPETokenizer
+                    lowercase: bool = True, min_frequency: int = 2,
+                    backend: str = "auto") -> str:
+    """'auto' prefers the HF trainer when installed — its incremental pair
+    bookkeeping trains a 30k vocab in minutes where the in-repo C++
+    trainer's per-merge rescan (native/tokenizer.cpp bpe_train_impl, a
+    reference implementation like the WordPiece trainer beside it) is only
+    suitable for small/test vocabs — and falls back to C++ without it.
+    backend='cpp' forces the native trainer."""
+    if backend == "cpp":
+        from bert_pytorch_tpu.tools.tokenizer_cpp import train_bpe_vocab
+
+        return train_bpe_vocab(
+            list(input_files), vocab_size, output_dir,
+            special_tokens=tuple(SPECIAL_TOKENS),
+            min_frequency=min_frequency, lowercase=lowercase)
+    try:
+        from tokenizers import ByteLevelBPETokenizer
+    except ImportError:
+        from bert_pytorch_tpu.tools.tokenizer_cpp import train_bpe_vocab
+
+        return train_bpe_vocab(
+            list(input_files), vocab_size, output_dir,
+            special_tokens=tuple(SPECIAL_TOKENS),
+            min_frequency=min_frequency, lowercase=lowercase)
 
     tok = ByteLevelBPETokenizer(lowercase=lowercase)
     tok.train(files=list(input_files), vocab_size=vocab_size,

@@ -88,6 +88,11 @@ def _load_library() -> ctypes.CDLL:
         lib.bpe_get_ids.restype = ctypes.POINTER(ctypes.c_int)
         lib.bpe_get_tokens.argtypes = [ctypes.c_void_p]
         lib.bpe_get_tokens.restype = ctypes.c_char_p
+        lib.bpe_train.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.bpe_train.restype = ctypes.c_int
         _lib = lib
         return lib
 
@@ -207,6 +212,33 @@ class CppByteLevelBPETokenizer:
 
     def encode_batch(self, texts: List[str]) -> List[Encoding]:
         return [self.encode(t) for t in texts]
+
+
+def train_bpe_vocab(
+    files: List[str],
+    vocab_size: int,
+    out_dir: str,
+    special_tokens=("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"),
+    min_frequency: int = 2,
+    lowercase: bool = False,
+) -> str:
+    """Train a byte-level BPE (vocab.json + merges.txt into ``out_dir``) —
+    the ByteLevelBPETokenizer.train role of reference
+    utils/build_vocab.py:39-58. The output files load interchangeably into
+    HF's ByteLevelBPETokenizer and :class:`CppByteLevelBPETokenizer`."""
+    lib = _load_library()
+    os.makedirs(out_dir, exist_ok=True)
+    rc = lib.bpe_train(
+        "\n".join(files).encode("utf-8"),
+        "\n".join(special_tokens).encode("utf-8"),
+        vocab_size,
+        min_frequency,
+        1 if lowercase else 0,
+        out_dir.encode("utf-8"),
+    )
+    if rc != 0:
+        raise RuntimeError(f"bpe_train failed with code {rc}")
+    return os.path.join(out_dir, "vocab.json")
 
 
 def train_wordpiece_vocab(

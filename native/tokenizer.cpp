@@ -383,9 +383,45 @@ uint32_t simple_lower(uint32_t cp) {
   if (cp >= 0x014A && cp <= 0x0177 && cp % 2 == 0) return cp + 1;
   if (cp == 0x0178) return 0x00FF;
   if (cp >= 0x0179 && cp <= 0x017E && cp % 2 == 1) return cp + 1;
+  if (cp == 0x0386) return 0x03AC;                       // accented Greek
+  if (cp >= 0x0388 && cp <= 0x038A) return cp + 0x25;
+  if (cp == 0x038C) return 0x03CC;
+  if (cp == 0x038E || cp == 0x038F) return cp + 0x3F;
+  if (cp == 0x03AA || cp == 0x03AB) return cp + 0x20;
   if (cp >= 0x0391 && cp <= 0x03A9 && cp != 0x03A2) return cp + 32;
+  if (cp >= 0x0400 && cp <= 0x040F) return cp + 0x50;    // Ѐ-Џ -> ѐ-џ
   if (cp >= 0x0410 && cp <= 0x042F) return cp + 32;
   return cp;
+}
+
+// Lowercase a UTF-8 string via simple_lower (shared by bpe_encode and the
+// trainer so training and encoding segment words identically).
+std::string lower_utf8(const std::string& s) {
+  std::string out;
+  out.reserve(s.size());
+  size_t i = 0;
+  while (i < s.size()) encode_utf8(simple_lower(decode_utf8(s, i)), out);
+  return out;
+}
+
+// Merge every adjacent (left, right) occurrence left-to-right — THE merge
+// semantics; bpe_word and the trainer must agree on it exactly.
+std::vector<std::string> apply_merge(const std::vector<std::string>& symbols,
+                                     const std::string& left,
+                                     const std::string& right) {
+  std::vector<std::string> out;
+  out.reserve(symbols.size());
+  for (size_t i = 0; i < symbols.size();) {
+    if (i + 1 < symbols.size() && symbols[i] == left &&
+        symbols[i + 1] == right) {
+      out.push_back(left + right);
+      i += 2;
+    } else {
+      out.push_back(symbols[i]);
+      i += 1;
+    }
+  }
+  return out;
 }
 
 struct BpeTokenizer {
@@ -394,7 +430,6 @@ struct BpeTokenizer {
   // merge pair "left\x01right" -> rank (lower merges first)
   std::unordered_map<std::string, int> merges;
   bool lowercase = false;
-  int unk_id = 0;
   std::string byte_to_uni[256];  // UTF-8 of each byte's mapped codepoint
   std::unordered_map<std::string, std::vector<int>> cache;  // pretoken -> ids
 
@@ -512,31 +547,115 @@ std::vector<int> bpe_word(BpeTokenizer& t, const std::string& pretoken) {
       }
     }
     if (best_rank == INT32_MAX) break;
-    const std::string left = symbols[best_i], right = symbols[best_i + 1];
-    // merge ALL adjacent (left, right) occurrences left-to-right
-    std::vector<std::string> merged;
-    merged.reserve(symbols.size());
-    for (size_t i = 0; i < symbols.size();) {
-      if (i + 1 < symbols.size() && symbols[i] == left &&
-          symbols[i + 1] == right) {
-        merged.push_back(left + right);
-        i += 2;
-      } else {
-        merged.push_back(symbols[i]);
-        i += 1;
-      }
-    }
-    symbols = std::move(merged);
+    symbols = apply_merge(symbols, symbols[best_i], symbols[best_i + 1]);
   }
 
   std::vector<int> ids;
   ids.reserve(symbols.size());
   for (auto& s : symbols) {
     auto it = t.vocab.find(s);
-    ids.push_back(it == t.vocab.end() ? t.unk_id : it->second);
+    // HF ByteLevelBPE has no unk token: out-of-vocab symbols are DROPPED
+    // (only reachable when the vocab lacks part of the byte alphabet).
+    if (it != t.vocab.end()) ids.push_back(it->second);
   }
   if (t.cache.size() < 65536) t.cache.emplace(pretoken, ids);
   return ids;
+}
+
+// ---------------------------------------------------------------------------
+// Byte-level BPE trainer (the ByteLevelBPETokenizer.train role:
+// reference utils/build_vocab.py:39-58's BPE branch)
+// ---------------------------------------------------------------------------
+
+// Minimal JSON string escape for vocab.json keys (symbols are printable
+// mapped-unicode; only quote/backslash need escaping).
+std::string json_escape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    if (c == '"' || c == '\\') out.push_back('\\');
+    out.push_back(c);
+  }
+  return out;
+}
+
+int bpe_train_impl(const std::string& files, const std::string& specials,
+                   size_t vocab_size, long min_frequency, bool lowercase,
+                   const std::string& out_dir) {
+  BpeTokenizer map_only;
+  init_byte_map(map_only);
+
+  // 1. Pre-token counts across all files (GPT-2 pre-tokenizer, same as
+  //    encode — training and encoding must agree on word boundaries).
+  std::unordered_map<std::string, long> counts;
+  std::stringstream fs(files);
+  std::string path;
+  while (std::getline(fs, path, '\n')) {
+    if (path.empty()) continue;
+    std::ifstream in(path);
+    if (!in) return 2;
+    std::string line;
+    while (std::getline(in, line)) {
+      if (lowercase) line = lower_utf8(line);
+      for (auto& pre : bpe_pretokenize(line)) counts[pre] += 1;
+    }
+  }
+
+  // 2. Words as byte-mapped symbol sequences.
+  std::vector<std::pair<std::vector<std::string>, long>> words;
+  words.reserve(counts.size());
+  for (auto& kv : counts) {
+    std::vector<std::string> symbols;
+    for (unsigned char b : kv.first) symbols.push_back(map_only.byte_to_uni[b]);
+    words.emplace_back(std::move(symbols), kv.second);
+  }
+
+  // 3. Vocab: specials, then the full 256-byte alphabet sorted by mapped
+  //    codepoint (HF ByteLevel.alphabet() semantics), then merges in order.
+  std::vector<std::string> vocab;
+  std::stringstream ss(specials);
+  std::string sp;
+  while (std::getline(ss, sp, '\n'))
+    if (!sp.empty()) vocab.push_back(sp);
+  {
+    std::vector<std::string> alphabet(map_only.byte_to_uni,
+                                      map_only.byte_to_uni + 256);
+    std::sort(alphabet.begin(), alphabet.end());
+    vocab.insert(vocab.end(), alphabet.begin(), alphabet.end());
+  }
+
+  std::vector<std::pair<std::string, std::string>> merges_out;
+  while (vocab.size() < vocab_size) {
+    std::map<std::pair<std::string, std::string>, long> pair_counts;
+    for (auto& [symbols, count] : words)
+      for (size_t i = 0; i + 1 < symbols.size(); i++)
+        pair_counts[{symbols[i], symbols[i + 1]}] += count;
+    if (pair_counts.empty()) break;
+    // Highest count; ties break to the lexicographically smallest pair
+    // (std::map iteration order), deterministically.
+    auto best = pair_counts.begin();
+    for (auto it = pair_counts.begin(); it != pair_counts.end(); ++it)
+      if (it->second > best->second) best = it;
+    if (best->second < min_frequency) break;
+    const auto [left, right] = best->first;
+    merges_out.emplace_back(left, right);
+    vocab.push_back(left + right);
+    for (auto& [symbols, count] : words)
+      symbols = apply_merge(symbols, left, right);
+  }
+
+  std::ofstream vout(out_dir + "/vocab.json");
+  if (!vout) return 1;
+  vout << "{";
+  for (size_t i = 0; i < vocab.size(); i++) {
+    if (i) vout << ",";
+    vout << "\"" << json_escape(vocab[i]) << "\":" << i;
+  }
+  vout << "}\n";
+  std::ofstream mout(out_dir + "/merges.txt");
+  if (!mout) return 1;
+  mout << "#version: 0.2\n";
+  for (auto& [l, r] : merges_out) mout << l << " " << r << "\n";
+  return 0;
 }
 
 }  // namespace
@@ -628,8 +747,6 @@ void* bpe_create(const char* vocab_lines, const char* merges_lines,
     t->vocab.emplace(line, static_cast<int>(t->id_to_token.size()));
     t->id_to_token.push_back(line);
   }
-  auto unk = t->vocab.find("<unk>");
-  t->unk_id = unk == t->vocab.end() ? 0 : unk->second;
   std::stringstream ms(merges_lines);
   int rank = 0;
   bool first_line = true;
@@ -671,13 +788,7 @@ int bpe_encode(void* handle, const char* text_c, int len) {
   t->last_ids.clear();
   t->last_tokens_joined.clear();
   std::string text(text_c, static_cast<size_t>(len));
-  if (t->lowercase) {
-    std::string lowered;
-    lowered.reserve(text.size());
-    size_t i = 0;
-    while (i < text.size()) encode_utf8(simple_lower(decode_utf8(text, i)), lowered);
-    text = std::move(lowered);
-  }
+  if (t->lowercase) text = lower_utf8(text);
   for (const auto& pre : bpe_pretokenize(text)) {
     for (int id : bpe_word(*t, pre)) t->last_ids.push_back(id);
   }
@@ -694,6 +805,14 @@ const int* bpe_get_ids(void* handle) {
 
 const char* bpe_get_tokens(void* handle) {
   return static_cast<BpeTokenizer*>(handle)->last_tokens_joined.c_str();
+}
+
+// Train a byte-level BPE; writes vocab.json + merges.txt into out_dir.
+// Returns 0 on success, 1 on write failure, 2 on unreadable input.
+int bpe_train(const char* files, const char* specials, int vocab_size,
+              int min_frequency, int lowercase, const char* out_dir) {
+  return bpe_train_impl(files, specials, static_cast<size_t>(vocab_size),
+                        min_frequency, lowercase != 0, out_dir);
 }
 
 // Train a WordPiece vocab from newline-delimited text files.

@@ -260,3 +260,68 @@ def test_cpp_bpe_hash_merges_and_scripts(tmp_path):
         assert cpp2.encode(s).ids == hf2.encode(s).ids, s
     for s in ["ŁÓDŹ Ľahko Ĺ", "Ÿ ŶĵĶ", "Źle Žba Ŵ", "ĿL ŊAname"]:
         assert cpp_low.encode(s).ids == hf_low.encode(s).ids, s
+
+
+def test_cpp_bpe_trainer_roundtrip(tmp_path):
+    """The C++ BPE trainer's vocab.json/merges.txt load interchangeably
+    into HF and the C++ encoder, and both encode the training corpus
+    identically (training tie-breaks may differ from HF's trainer, but the
+    artifact format and encode semantics are the contract)."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from bert_pytorch_tpu.tools.tokenizer_cpp import (
+        CppByteLevelBPETokenizer,
+        train_bpe_vocab,
+    )
+
+    corpus = tmp_path / "c.txt"
+    text = "the quick brown fox jumps over the lazy dog 123 don't\n" * 30
+    corpus.write_text(text)
+    out = tmp_path / "bpe"
+    vocab_json = train_bpe_vocab([str(corpus)], 330, str(out),
+                                 min_frequency=1)
+    merges_txt = str(out / "merges.txt")
+    hf = tokenizers.ByteLevelBPETokenizer(vocab_json, merges_txt)
+    cpp = CppByteLevelBPETokenizer(vocab_json, merges_txt)
+    assert cpp.get_vocab_size() == hf.get_vocab_size() > 261  # merges happened
+    for s in ["the quick brown fox", "don't jump 123", "unseen words here"]:
+        hf_enc, enc = hf.encode(s), cpp.encode(s)
+        assert enc.ids == hf_enc.ids, s
+        assert enc.tokens == hf_enc.tokens, s
+    # merges actually compress: fewer tokens than bytes
+    assert len(cpp.encode("the quick brown fox").ids) < len("the quick brown fox")
+    # specials sit at the front, [PAD] first (reference build_vocab.py:64-75)
+    assert cpp.token_to_id("[PAD]") == 0 and cpp.token_to_id("[MASK]") == 4
+
+
+def test_cpp_bpe_oov_dropped_and_cyrillic_greek_lower(tmp_path):
+    """Two oracle-verified regressions: (1) symbols missing from a partial
+    vocab are DROPPED like HF (byte-level BPE has no unk token), not
+    substituted; (2) lowercase covers accented Greek capitals and the
+    Cyrillic U+0400-040F row (Ё et al.)."""
+    import json
+
+    tokenizers = pytest.importorskip("tokenizers")
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppByteLevelBPETokenizer
+
+    alphabet = [chr(c) for c in range(33, 127)] + ["Ġ"]
+    vj = str(tmp_path / "vocab.json")
+    mt = str(tmp_path / "merges.txt")
+    json.dump({t: i for i, t in enumerate(alphabet)}, open(vj, "w"))
+    open(mt, "w").write("#version: 0.2\n")
+    hf = tokenizers.ByteLevelBPETokenizer(vj, mt)
+    cpp = CppByteLevelBPETokenizer(vj, mt)
+    for s in ["aéb", "héllo wörld", "ascii only"]:
+        assert cpp.encode(s).ids == hf.encode(s).ids, s
+
+    d = tmp_path / "cyr"
+    d.mkdir()
+    corpus = d / "c.txt"
+    corpus.write_text("Ёлка ёлка Άθήνα αθήνα Ђуро Џак ЀЍ test\n" * 40)
+    tok = tokenizers.ByteLevelBPETokenizer()
+    tok.train([str(corpus)], vocab_size=450, min_frequency=1)
+    tok.save_model(str(d))
+    vj2, mt2 = str(d / "vocab.json"), str(d / "merges.txt")
+    hf_low = tokenizers.ByteLevelBPETokenizer(vj2, mt2, lowercase=True)
+    cpp_low = CppByteLevelBPETokenizer(vj2, mt2, lowercase=True)
+    for s in ["Ёлка", "Άθήνα", "Ђуро Џак", "ЀЍЉЊ", "Ϊ Ϋ Ό Ύ Ώ Έ Ή Ί"]:
+        assert cpp_low.encode(s).ids == hf_low.encode(s).ids, s
