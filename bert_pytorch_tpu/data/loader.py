@@ -52,6 +52,21 @@ class DataLoader:
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
         stop = threading.Event()
 
+        def put(item) -> bool:
+            """Bounded put that aborts when the consumer is gone — a plain
+            q.put() blocks forever once the consumer breaks out of the
+            iterator with the queue full (the finally-block's stop.set()
+            can't unblock a thread already inside q.put), leaking one
+            producer thread and its buffered batches per abandoned
+            iteration (e.g. every early-stopped validation pass)."""
+            while True:
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    if stop.is_set():
+                        return False
+
         def produce():
             samples = []
             try:
@@ -60,14 +75,16 @@ class DataLoader:
                         return
                     samples.append(self.dataset[idx])
                     if len(samples) == self.batch_size:
-                        q.put(self._collate(samples))
+                        if not put(self._collate(samples)):
+                            return
                         samples = []
                 if samples and not self.drop_last:
-                    q.put(self._collate(samples))
+                    if not put(self._collate(samples)):
+                        return
             except BaseException as e:  # surface worker errors to the consumer
-                q.put(e)
+                put(e)
                 return
-            q.put(None)
+            put(None)
 
         worker = threading.Thread(target=produce, daemon=True)
         worker.start()

@@ -37,16 +37,13 @@ def build_bpe_vocab(input_files, output_dir: str, vocab_size: int,
     reference implementation like the WordPiece trainer beside it) is only
     suitable for small/test vocabs — and falls back to C++ without it.
     backend='cpp' forces the native trainer."""
-    if backend == "cpp":
-        from bert_pytorch_tpu.tools.tokenizer_cpp import train_bpe_vocab
-
-        return train_bpe_vocab(
-            list(input_files), vocab_size, output_dir,
-            special_tokens=tuple(SPECIAL_TOKENS),
-            min_frequency=min_frequency, lowercase=lowercase)
-    try:
-        from tokenizers import ByteLevelBPETokenizer
-    except ImportError:
+    use_cpp = backend == "cpp"
+    if not use_cpp:
+        try:
+            from tokenizers import ByteLevelBPETokenizer
+        except ImportError:
+            use_cpp = True
+    if use_cpp:
         from bert_pytorch_tpu.tools.tokenizer_cpp import train_bpe_vocab
 
         return train_bpe_vocab(

@@ -70,6 +70,15 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     # masking
     parser.add_argument("--max_predictions_per_seq", type=int, default=20)
     parser.add_argument("--masked_token_fraction", type=float, default=0.15)
+    # held-out evaluation (beyond the reference, which never evaluates
+    # during pretraining; uses pretrain.make_eval_step)
+    parser.add_argument("--val_input_dir", type=str, default=None,
+                        help="directory of held-out HDF5 shards; enables a "
+                             "validation MLM-loss pass")
+    parser.add_argument("--num_steps_per_eval", type=int, default=200,
+                        help="optimizer steps between validation passes")
+    parser.add_argument("--eval_batches", type=int, default=16,
+                        help="validation batches per pass")
     # checkpoint / logging cadence
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
     parser.add_argument("--keep_checkpoints", type=int, default=3)
@@ -280,8 +289,11 @@ def prepare_dataset(args, config, checkpoint):
     if os.path.isfile(args.input_dir):
         input_files.append(args.input_dir)
     elif os.path.isdir(args.input_dir):
-        input_files = [str(p) for p in Path(args.input_dir).rglob("*.hdf5")
-                       if p.is_file()]
+        # sorted: rglob order is filesystem-dependent, and multi-host runs
+        # must agree on the index space the sampler chunks over.
+        input_files = sorted(
+            str(p) for p in Path(args.input_dir).rglob("*.hdf5")
+            if p.is_file())
 
     mask_token_id = getattr(config, "mask_token_id", None)
     vocab_file = getattr(config, "vocab_file", None)
@@ -315,14 +327,31 @@ def prepare_dataset(args, config, checkpoint):
     logger.info(f"Samples in dataset: {len(dataset)}")
     logger.info(f"Samples per process: {len(sampler)}")
     logger.info(f"Sampler starting index: {sampler.index}")
-    return loader, sampler
+
+    val_loader = None
+    if args.val_input_dir:
+        val_files = sorted(
+            str(p) for p in Path(args.val_input_dir).rglob("*.hdf5")
+            if p.is_file())
+        val_dataset = ShardedPretrainingDataset(
+            val_files, int(mask_token_id), args.max_predictions_per_seq,
+            args.masked_token_fraction, vocab_size=int(config.vocab_size),
+            seed=args.seed + 7919 + get_rank())
+        val_sampler = DistributedSampler(
+            val_dataset, num_replicas=jax.process_count(),
+            rank=jax.process_index())
+        val_loader = DataLoader(val_dataset, val_sampler,
+                                batch_size=args.host_batch_per_step,
+                                drop_last=True)
+        logger.info(f"Validation samples: {len(val_dataset)}")
+    return loader, sampler, val_loader
 
 
 def main(args) -> dict:
     args, mesh = setup_training(args)
     model, config, checkpoint, global_step = prepare_model(args, mesh)
     tx, schedule = prepare_optimizer(args)
-    loader, sampler = prepare_dataset(args, config, checkpoint)
+    loader, sampler, val_loader = prepare_dataset(args, config, checkpoint)
 
     rules = logical_axis_rules(args.parallel_strategy)
     seq_len = config.max_position_embeddings
@@ -426,6 +455,46 @@ def main(args) -> dict:
                 max_pred_per_seq=args.max_predictions_per_seq,
                 kfac=kfac_obj, kfac_shardings=kfac_shardings)
 
+        eval_step = None
+        if val_loader is not None:
+            from bert_pytorch_tpu.parallel import batch_sharding
+
+            eval_step = pretrain.make_eval_step(
+                model, next_sentence=bool(config.next_sentence))
+            eval_bsh = {k: batch_sharding(mesh) for k in (
+                "input_ids", "segment_ids", "input_mask",
+                "masked_lm_labels", "next_sentence_labels")}
+
+            # Every pass evaluates the SAME deterministic slice: the sampler
+            # is reset to 0 first (the loader's prefetch over-advances it by
+            # a race-dependent amount otherwise), and the batch count is a
+            # pure function of the dataset size — so multi-host runs execute
+            # the same number of collective eval steps on every host, and
+            # logged val losses are comparable across passes and reruns.
+            eval_n_batches = min(
+                args.eval_batches,
+                len(val_loader.sampler) // args.host_batch_per_step)
+
+            def run_validation(params, step_no, epoch_no):
+                """Held-out MLM(+NSP) loss (the reference never evaluates
+                during pretraining)."""
+                if eval_n_batches == 0:
+                    return
+                val_loader.sampler.index = 0
+                loss_sum = acc_sum = 0.0
+                n = 0
+                for vb in val_loader:
+                    vloss, vacc = eval_step(
+                        params, pretrain.put_batch(vb, eval_bsh))
+                    loss_sum += float(vloss)
+                    acc_sum += float(vacc)
+                    n += 1
+                    if n >= eval_n_batches:
+                        break
+                logger.log(tag="val", step=step_no, epoch=epoch_no,
+                           average_loss=loss_sum / n,
+                           mlm_accuracy=acc_sum / n)
+
         steps_this_run = args.steps or (args.max_steps - global_step)
         steps_this_run = min(steps_this_run, args.max_steps - global_step)
         logger.info(f"Starting at global step {global_step}; running "
@@ -522,6 +591,10 @@ def main(args) -> dict:
                         samples_per_second=samples_seen / max(elapsed, 1e-9),
                         mlm_accuracy=last_metrics.get("mlm_accuracy", 0.0),
                         grad_norm=last_metrics.get("grad_norm", 0.0))
+
+                if (eval_step is not None
+                        and global_step % args.num_steps_per_eval == 0):
+                    run_validation(state.params, global_step, epoch)
 
                 if global_step % args.num_steps_per_checkpoint == 0:
                     save_step = global_step + args.previous_phase_end_step

@@ -173,3 +173,35 @@ def test_loader_propagates_worker_errors(shards):
     loader = DataLoader(ds, BadSampler(), batch_size=1)
     with pytest.raises(RuntimeError, match="boom"):
         list(loader)
+
+
+def test_loader_producer_exits_on_abandoned_iteration(tmp_path):
+    """Breaking out of a DataLoader iteration must not strand the producer
+    thread blocked in q.put (one leak per abandoned pass — e.g. every
+    early-stopped validation pass — grows threads/memory for the run)."""
+    import threading
+    import time
+
+    from bert_pytorch_tpu.data.dataset import ShardedPretrainingDataset
+    from bert_pytorch_tpu.data.loader import DataLoader
+    from bert_pytorch_tpu.data.sampler import DistributedSampler
+    from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
+
+    path = tmp_path / "s.hdf5"
+    make_shard(str(path), 64, 16, 100, seed=0)
+    ds = ShardedPretrainingDataset([str(path)], 4, 4, 0.15, vocab_size=100)
+    sampler = DistributedSampler(ds, num_replicas=1, rank=0)
+    loader = DataLoader(ds, sampler, batch_size=4, drop_last=True)
+
+    before = {t.ident for t in threading.enumerate()}
+    for i, _ in enumerate(loader):
+        if i == 1:
+            break  # abandon with the queue full
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, [t.name for t in leaked]

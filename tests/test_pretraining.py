@@ -271,3 +271,20 @@ def test_convergence_memorization():
                 float(metrics["loss"])  # collective queue shallow
     assert float(metrics["mlm_accuracy"]) > 0.95
     assert float(metrics["loss"]) < 1.0
+
+
+def test_validation_pass(workdir, tmp_path):
+    """--val_input_dir runs a held-out MLM eval at the configured cadence
+    and logs tag=val records (beyond the reference, which never evaluates
+    during pretraining)."""
+    val_dir = tmp_path / "valdata"
+    val_dir.mkdir()
+    make_shard(str(val_dir / "val_0.hdf5"), 32, 32, VOCAB, seed=99)
+    log_prefix = str(tmp_path / "vallog")
+    result = run_pretraining.main(_args(
+        workdir, steps=2, val_input_dir=str(val_dir),
+        num_steps_per_eval=1, eval_batches=2, log_prefix=log_prefix))
+    assert np.isfinite(result["loss"])
+    text = open(log_prefix + ".txt").read()
+    assert "tag: val" in text
+    assert "mlm_accuracy" in text
