@@ -215,3 +215,38 @@ def test_glue_partial_batch_padding():
     last_batch, valid = out[-1]
     assert last_batch["labels"].shape == (4,)
     assert valid.tolist() == [True, True, False, False]
+
+
+@pytest.mark.parametrize("task,header,row,expect", [
+    ("cola", None,
+     ["gj04", "1", "", "They drank the pub dry."],
+     ("They drank the pub dry.", None, "1")),
+    ("qqp", ["id", "qid1", "qid2", "question1", "question2", "is_duplicate"],
+     ["1", "10", "11", "Is this a question?", "Is that a question?", "1"],
+     ("Is this a question?", "Is that a question?", "1")),
+    ("mnli", ["index"] + ["c"] * 7 + ["sentence1", "sentence2", "x",
+                                     "gold_label"],
+     ["0"] + ["?"] * 7 + ["A premise.", "A hypothesis.", "x", "entailment"],
+     ("A premise.", "A hypothesis.", "entailment")),
+    ("qnli", ["index", "question", "sentence", "label"],
+     ["0", "What is it?", "It is a thing.", "entailment"],
+     ("What is it?", "It is a thing.", "entailment")),
+    ("rte", ["index", "sentence1", "sentence2", "label"],
+     ["0", "A statement.", "Another statement.", "not_entailment"],
+     ("A statement.", "Another statement.", "not_entailment")),
+    ("wnli", ["index", "sentence1", "sentence2", "label"],
+     ["0", "The trophy fits.", "It fits.", "1"],
+     ("The trophy fits.", "It fits.", "1")),
+])
+def test_remaining_processors_column_layouts(tmp_path, task, header, row,
+                                             expect):
+    """Column-index regression net for the GLUE tasks without dedicated
+    fixtures (the dumps' layouts are easy to silently mis-index)."""
+    from bert_pytorch_tpu.data import glue
+
+    d = tmp_path / task
+    d.mkdir()
+    _write_tsv(d / "train.tsv", [row, row], header=header)
+    ex = glue.PROCESSORS[task]().get_train_examples(str(d))
+    assert len(ex) == 2
+    assert (ex[0].text_a, ex[0].text_b, ex[0].label) == expect
