@@ -250,3 +250,57 @@ def test_macro_f1_perfect_and_zero():
     logits2 = np.zeros((1, 4, 3))
     logits2[0, :, 0] = 5  # predict reserved class everywhere
     assert macro_f1(logits2, labels) == 0.0
+
+
+def test_squad_v2_null_answers(tokenizer, tmp_path):
+    """SQuAD v2.0: unanswerable questions decode to the empty string when
+    the null score beats the best span by more than the threshold
+    (reference run_squad.py's version_2_with_negative path)."""
+    from bert_pytorch_tpu import squad
+
+    context = "The capital of France is Paris"
+    data = {"version": "v2.0", "data": [{"title": "t", "paragraphs": [{
+        "context": context, "qas": [
+            {"id": "a1", "question": "What is the capital of France",
+             "is_impossible": False,
+             "answers": [{"text": "Paris",
+                          "answer_start": context.index("Paris")}]},
+            {"id": "na1", "question": "Who wrote Hamlet",
+             "is_impossible": True, "answers": []},
+        ]}]}]}
+    path = tmp_path / "v2.json"
+    path.write_text(json.dumps(data))
+
+    examples = squad.read_squad_examples(str(path), True, True)
+    assert [e.is_impossible for e in examples] == [False, True]
+
+    examples = squad.read_squad_examples(str(path), False, True)
+    features = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=32, doc_stride=8,
+        max_query_length=16, is_training=False)
+
+    class Args:
+        n_best_size = 5
+        max_answer_length = 10
+        version_2_with_negative = True
+        null_score_diff_threshold = 0.0
+        do_lower_case = True
+
+    results = []
+    for f in features:
+        start = np.full(32, -5.0)
+        end = np.full(32, -5.0)
+        qid = examples[f.example_index].qas_id
+        if qid == "a1":
+            pos = f.tokens.index("paris", f.tokens.index("[SEP]"))
+            start[pos] = 5.0
+            end[pos] = 5.0
+        else:
+            # null score = start[0] + end[0] ([CLS]) dominating any span
+            start[0] = 8.0
+            end[0] = 8.0
+        results.append(
+            squad.RawResult(f.unique_id, start.tolist(), end.tolist()))
+    answers, _ = squad.get_answers(examples, features, results, Args())
+    assert answers["a1"] == "Paris"
+    assert answers["na1"] == ""
