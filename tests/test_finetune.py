@@ -121,13 +121,8 @@ def test_get_final_text_realignment():
     assert get_final_text("zzz", "Steve Smith's", True) == "Steve Smith's"
 
 
-def test_get_answers_decodes_correct_span(squad_json, tokenizer):
-    from bert_pytorch_tpu import squad
-
-    examples = squad.read_squad_examples(squad_json, False, False)
-    features = squad.convert_examples_to_features(
-        examples, tokenizer, max_seq_length=32, doc_stride=8,
-        max_query_length=16, is_training=False)
+def _decode_args(**overrides):
+    """Answer-decoding knobs shared by the get_answers tests."""
 
     class Args:
         n_best_size = 5
@@ -135,6 +130,19 @@ def test_get_answers_decodes_correct_span(squad_json, tokenizer):
         version_2_with_negative = False
         null_score_diff_threshold = 0.0
         do_lower_case = True
+
+    for key, value in overrides.items():
+        setattr(Args, key, value)
+    return Args()
+
+
+def test_get_answers_decodes_correct_span(squad_json, tokenizer):
+    from bert_pytorch_tpu import squad
+
+    examples = squad.read_squad_examples(squad_json, False, False)
+    features = squad.convert_examples_to_features(
+        examples, tokenizer, max_seq_length=32, doc_stride=8,
+        max_query_length=16, is_training=False)
 
     results = []
     for f in features:
@@ -145,7 +153,8 @@ def test_get_answers_decodes_correct_span(squad_json, tokenizer):
         start[paris_pos] = 5.0
         end[paris_pos] = 5.0
         results.append(squad.RawResult(f.unique_id, start.tolist(), end.tolist()))
-    answers, nbest = squad.get_answers(examples, features, results, Args())
+    answers, nbest = squad.get_answers(
+        examples, features, results, _decode_args())
     assert answers["q1"] == "Paris"
     assert answers["q2"] == "Paris"
     assert nbest["q1"][0]["probability"] > 0.3
@@ -279,13 +288,6 @@ def test_squad_v2_null_answers(tokenizer, tmp_path):
         examples, tokenizer, max_seq_length=32, doc_stride=8,
         max_query_length=16, is_training=False)
 
-    class Args:
-        n_best_size = 5
-        max_answer_length = 10
-        version_2_with_negative = True
-        null_score_diff_threshold = 0.0
-        do_lower_case = True
-
     results = []
     for f in features:
         start = np.full(32, -5.0)
@@ -296,11 +298,27 @@ def test_squad_v2_null_answers(tokenizer, tmp_path):
             start[pos] = 5.0
             end[pos] = 5.0
         else:
-            # null score = start[0] + end[0] ([CLS]) dominating any span
-            start[0] = 8.0
+            # A REAL candidate span must exist and LOSE to the null score
+            # through the threshold comparison (squad.py's score_diff path)
+            # — with no surviving span at all, get_answers short-circuits
+            # and the threshold logic would be dead to this test.
+            pos = f.tokens.index("paris", f.tokens.index("[SEP]"))
+            start[pos] = 2.0
+            end[pos] = 2.0
+            start[0] = 8.0  # null score = start[0] + end[0] ([CLS])
             end[0] = 8.0
         results.append(
             squad.RawResult(f.unique_id, start.tolist(), end.tolist()))
-    answers, _ = squad.get_answers(examples, features, results, Args())
+    answers, nbest = squad.get_answers(
+        examples, features, results, _decode_args(
+            version_2_with_negative=True))
     assert answers["a1"] == "Paris"
     assert answers["na1"] == ""
+    # the competing span is present in the n-best list — the null verdict
+    # came from the threshold comparison, not from an empty candidate set
+    assert any(e["text"] == "Paris" for e in nbest["na1"])
+    # and with a huge threshold the span wins instead
+    answers_hi, _ = squad.get_answers(
+        examples, features, results, _decode_args(
+            version_2_with_negative=True, null_score_diff_threshold=50.0))
+    assert answers_hi["na1"] == "Paris"
