@@ -7,23 +7,34 @@ LAMB with poly-warmup schedule) on synthetic phase-1-shaped data
 
 Prints ONE JSON line:
   {"metric": "bert_large_phase1_seq_per_sec", "value": N,
-   "unit": "seq/s/chip", "vs_baseline": N}
+   "unit": "seq/s/chip", "vs_baseline": N, "mfu": N}
 
 The reference repo publishes no numbers (BASELINE.md); ``vs_baseline``
 normalizes against the NVIDIA DeepLearningExamples BERT-large phase-1
 per-A100 throughput (~360 seq/s, fp16 + LAMB) that the reference's configs
-are tuned for — the closest external anchor the reference offers.
+are tuned for — the closest external anchor the reference offers. ``mfu``
+(model-FLOPs utilisation, utils/flops.py) is the hardware-normalised
+number that does not depend on that anchor.
+
+Capture hardening: the TPU backend behind the tunnel can hang or fail
+transiently at init (round 1 lost its entire perf capture to exactly
+that). The parent process therefore never touches JAX itself: it probes
+the backend in a short-timeout subprocess, runs the real benchmark in a
+second subprocess (so a hung init is killed, not waited on), retries
+with backoff, and on final failure still prints the one-line JSON with
+an ``"error"`` field so the driver always records something parseable.
+Set BENCH_CHILD=1 to run the benchmark body directly (what the parent
+spawns); knobs: BENCH_ATTEMPTS, BENCH_BACKOFF_S, BENCH_PROBE_TIMEOUT_S,
+BENCH_ATTEMPT_TIMEOUT_S, BENCH_BUDGET_S.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
 
 A100_PHASE1_SEQ_PER_SEC = 360.0
 # Phase-2 anchor: same NVIDIA recipe at seq 512 runs ~72 seq/s/A100 (the
@@ -90,11 +101,15 @@ SEQ_LEN = LONG_SEQ or (512 if _P2 else 128)
 MAX_PRED = (max(20, SEQ_LEN * 80 // 512) if LONG_SEQ
             else (80 if _P2 else 20))  # max_predictions_per_seq (BASELINE.md)
 ACCUM = 1
-WARMUP_STEPS = 3
-MEASURE_STEPS = 20
+WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", "3"))
+MEASURE_STEPS = int(os.environ.get("BENCH_MEASURE_STEPS", "20"))
 
 
-def main():
+def _child_main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     jax.config.update("jax_default_prng_impl", RNG_IMPL)
     from bert_pytorch_tpu import optim, pretrain
     from bert_pytorch_tpu.config import BertConfig
@@ -216,20 +231,133 @@ def main():
 
     seq_per_sec = MEASURE_STEPS * global_batch / elapsed
     seq_per_sec_chip = seq_per_sec / n_chips
+    from bert_pytorch_tpu.utils import flops as flops_util
+    flops_per_seq = flops_util.bert_train_flops_per_seq(
+        config, SEQ_LEN, MAX_PRED, next_sentence=True)
+    model_flops_util = flops_util.mfu(
+        seq_per_sec_chip, flops_per_seq, jax.devices()[0].device_kind)
+    print(json.dumps(_result_json(seq_per_sec_chip, mfu=model_flops_util)))
+
+
+def _metric_name_and_anchor():
     kfac_tag = "_kfac" if KFAC else ""
     if LONG_SEQ:
-        anchor = A100_PHASE2_SEQ_PER_SEC * 512.0 / SEQ_LEN
-        name = f"bert_large_seq{SEQ_LEN}{kfac_tag}_seq_per_sec"
-    else:
-        anchor = A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC
-        name = f"bert_large_phase{PHASE}{kfac_tag}_seq_per_sec"
-    print(json.dumps({
+        return (f"bert_large_seq{SEQ_LEN}{kfac_tag}_seq_per_sec",
+                A100_PHASE2_SEQ_PER_SEC * 512.0 / SEQ_LEN)
+    return (f"bert_large_phase{PHASE}{kfac_tag}_seq_per_sec",
+            A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC)
+
+
+def _result_json(seq_per_sec_chip, mfu=None, error=None):
+    name, anchor = _metric_name_and_anchor()
+    out = {
         "metric": name,
         "value": round(seq_per_sec_chip, 2),
         "unit": "seq/s/chip",
         "vs_baseline": round(seq_per_sec_chip / anchor, 4),
-    }))
+    }
+    if mfu is not None:
+        out["mfu"] = round(mfu, 4)
+    if error is not None:
+        out["error"] = error
+    return out
+
+
+_PROBE_SRC = ("import jax; ds = jax.devices(); "
+              "print('BENCH_PROBE_OK', len(ds), ds[0].device_kind)")
+
+
+def _run_attempt(cmd, timeout_s, env):
+    """Run ``cmd``; return (ok, full_output). A hang is killed at
+    ``timeout_s``. The FULL output is returned — the JSON result line must
+    stay findable even under kilobytes of runtime teardown logging after it.
+    """
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout_s,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        # Keep everything printed BEFORE the hang: a child that printed the
+        # metric line and then hung in runtime teardown is still a capture.
+        return False, out + f"\n[killed: timeout after {timeout_s}s]"
+    return proc.returncode == 0, proc.stdout or ""
+
+
+def main():
+    """Parent: probe backend, run the benchmark child, retry, never crash."""
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "5"))
+    backoff_s = float(os.environ.get("BENCH_BACKOFF_S", "60"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "1200"))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "3600"))
+    deadline = time.monotonic() + budget_s
+
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    last_err = "no attempts ran"
+    for attempt in range(1, attempts + 1):
+        remaining = deadline - time.monotonic()
+        if remaining <= 5:
+            last_err += " (wall-clock budget exhausted)"
+            break
+        if attempt > 1:
+            # Cheap short-timeout probe before each RETRY: after a failure,
+            # don't burn the long child timeout on a backend that is still
+            # down. Attempt 1 skips it — on a healthy backend the probe
+            # would just double the (tens of seconds) TPU init cost, and
+            # the child has its own kill timeout anyway.
+            ok, out = _run_attempt(
+                [sys.executable, "-c", _PROBE_SRC],
+                min(probe_timeout, remaining), env)
+            if not ok or "BENCH_PROBE_OK" not in out:
+                last_err = (f"backend probe failed (attempt {attempt}): "
+                            f"{out[-400:]}")
+                print(last_err, file=sys.stderr)
+                if attempt < attempts:
+                    time.sleep(
+                        min(backoff_s, max(0, deadline - time.monotonic())))
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 5:
+                last_err = "backend probe ok but wall-clock budget exhausted"
+                break
+        ok, out = _run_attempt(
+            [sys.executable, os.path.abspath(__file__)],
+            min(attempt_timeout, remaining), env)
+        result = None
+        for line in reversed(out.splitlines()):
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(cand, dict) and "metric" in cand:
+                result = cand
+                break
+        if result is not None:
+            # A parsed metric line is a successful capture even if the
+            # child's rc is non-zero (e.g. the TPU runtime crashing during
+            # process TEARDOWN, after the measurement printed).
+            if not ok:
+                result.setdefault(
+                    "note", "child exited non-zero after printing result")
+            print(json.dumps(result))
+            return
+        last_err = f"bench child failed (attempt {attempt}): {out[-400:]}"
+        print(last_err, file=sys.stderr)
+        if attempt < attempts:
+            time.sleep(min(backoff_s, max(0, deadline - time.monotonic())))
+    # Final failure: the driver still gets one parseable JSON line on
+    # stdout; the non-zero exit preserves the shell-level failure signal
+    # for ``set -e`` callers (scripts/smoke_tpu.sh).
+    print(json.dumps(_result_json(0.0, error=last_err[-500:])))
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        _child_main()
+    else:
+        main()

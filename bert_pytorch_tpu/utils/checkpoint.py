@@ -11,7 +11,11 @@ retention (:525-528), resume by scanning the output dir for the max step
 Storage is msgpack via flax.serialization: param/optimizer pytrees are
 fetched to host (fully materialized — fine at BERT scale) and restored with
 ``from_state_dict`` onto the target tree, so the same checkpoint loads under
-any mesh/sharding layout. Writes are atomic (tmp + rename).
+any mesh/sharding layout. Multi-host sharded state (fsdp/tp across
+processes) is gathered with ``multihost_utils.process_allgather`` — a
+collective all processes join — before rank 0 writes; restore reads the
+full file on every process and re-shards via the caller's device_put.
+Writes are atomic (tmp + rename).
 """
 
 from __future__ import annotations
@@ -39,6 +43,24 @@ _pending_error: list = []
 _pending_lock = threading.Lock()
 
 
+def _join_pending_save() -> Optional[BaseException]:
+    """Join any in-flight async write; return its error instead of raising
+    (the collective save path must delay the raise until after the gather —
+    see :func:`save_checkpoint`)."""
+    global _pending_save
+    with _pending_lock:
+        thread = _pending_save
+        _pending_save = None
+    if thread is not None:
+        thread.join()
+    with _pending_lock:
+        if _pending_error:
+            error = _pending_error.pop()
+            _pending_error.clear()
+            return error
+    return None
+
+
 def wait_for_pending_save() -> None:
     """Block until any in-flight async checkpoint write has finished; raise
     if it failed.
@@ -50,17 +72,9 @@ def wait_for_pending_save() -> None:
     (disk full, permissions) re-raises here / at the next save rather than
     letting training run on while no checkpoints land.
     """
-    global _pending_save
-    with _pending_lock:
-        thread = _pending_save
-        _pending_save = None
-    if thread is not None:
-        thread.join()
-    with _pending_lock:
-        if _pending_error:
-            error = _pending_error.pop()
-            _pending_error.clear()
-            raise RuntimeError("async checkpoint write failed") from error
+    error = _join_pending_save()
+    if error is not None:
+        raise RuntimeError("async checkpoint write failed") from error
 
 
 def checkpoint_path(output_dir: str, step: int) -> str:
@@ -107,8 +121,40 @@ def load_latest_checkpoint(output_dir: str):
     return None
 
 
+def _leaf_needs_collective(x: Any) -> bool:
+    """True when ``x``'s full value is NOT locally readable: shards live on
+    devices this process can't address AND the array isn't fully replicated.
+
+    That is the multi-host fsdp/tp case: ``jax.device_get`` raises on such
+    arrays, so the save path must run a cross-process gather — which is a
+    collective every process has to join (reference behavior being replaced:
+    rank-0 ``torch.save`` of replicated DDP state, run_pretraining.py:513-523;
+    with sharded state the TPU-native analog is an all-gather first).
+    Multi-host dp-REPLICATED state stays on the cheap path: every process
+    already holds the full value, so rank 0 reads it locally with no
+    collective and no host copies on the other ranks.
+    """
+    if getattr(x, "is_fully_addressable", True):
+        return False
+    sharding = getattr(x, "sharding", None)
+    return not (sharding is not None and sharding.is_fully_replicated)
+
+
+def _needs_collective_gather(tree: Any) -> bool:
+    return any(map(_leaf_needs_collective, jax.tree_util.tree_leaves(tree)))
+
+
 def _to_host(tree: Any) -> Any:
     """Device arrays -> host numpy (gathering sharded arrays).
+
+    Locally-readable arrays (single-host meshes; multi-host dp-REPLICATED
+    state, where every process holds the full value) fetch with
+    ``jax.device_get``. Arrays whose shards this process cannot read
+    (multi-host fsdp/tp) go through ``multihost_utils.process_allgather`` — a
+    collective, so when any such leaf exists EVERY process must call
+    ``_to_host`` with an identically-structured tree (``save_checkpoint``
+    arranges this; tree_map traversal order is deterministic, so the
+    per-leaf collectives line up across processes).
 
     Always returns buffers the caller owns: async writes serialize after this
     function returns, so a view into a host array (or a CPU-backend jax
@@ -120,6 +166,10 @@ def _to_host(tree: Any) -> Any:
     def get(x):
         if not hasattr(x, "dtype"):
             return x
+        if _leaf_needs_collective(x):
+            from jax.experimental import multihost_utils
+            out = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            return out if out.flags.owndata else out.copy()
         out = np.asarray(jax.device_get(x))
         # A plain-numpy leaf comes back as the caller's own object (owndata
         # True but still aliased) — copy it; a view copies too. Only a fresh
@@ -168,14 +218,25 @@ def save_checkpoint(
     flight; a newer save (or :func:`wait_for_pending_save`) joins it first.
     """
     global _pending_save
+    # Multi-host sharded state: the gather below is a COLLECTIVE, so every
+    # process must run it (with the same tree) before non-main processes
+    # bail out. Single-host / replicated state skips straight to rank 0.
+    collective = _needs_collective_gather(contents)
+    if not collective and not is_main_process():
+        return None
+    # Join any in-flight write BEFORE gathering the next snapshot — gathering
+    # first would hold two multi-GB host copies exactly when the disk is
+    # slow (the one-extra-copy invariant of the module comment). A failed
+    # write re-raises only AFTER the gather: raising rank-0-only first would
+    # abandon a collective the other ranks have already entered, turning a
+    # clean disk error into a whole-job rendezvous hang.
+    pending_error = _join_pending_save()
+    state = serialization.to_state_dict(_to_host(contents))
+    if pending_error is not None:
+        raise RuntimeError("async checkpoint write failed") from pending_error
     if not is_main_process():
         return None
     os.makedirs(output_dir, exist_ok=True)
-    # Join any in-flight write BEFORE gathering the next snapshot — gathering
-    # first would hold two multi-GB host copies exactly when the disk is
-    # slow (the one-extra-copy invariant of the module comment).
-    wait_for_pending_save()
-    state = serialization.to_state_dict(_to_host(contents))
     path = checkpoint_path(output_dir, step)
     if not async_write:
         _write_and_prune(state, output_dir, step, keep)

@@ -1,0 +1,86 @@
+"""Analytic model-FLOP accounting for MFU reporting.
+
+The reference repo reports raw sequences/second only
+(run_pretraining.py:597-599); judging a TPU number against an A100 anchor
+then needs a hardware-normalised metric. Model FLOPs Utilisation (MFU)
+divides the *model* FLOPs actually required per step (forward + backward,
+NOT counting rematerialisation recompute) by the chip's peak matmul
+throughput — the convention from the PaLM appendix.
+
+Matmul FLOP accounting per sequence of length S, hidden H, layers L,
+intermediate F, masked positions M, vocab V (a matmul of (m,k)x(k,n)
+costs 2mkn FLOPs):
+
+  per layer, forward:
+    QKV + output projections:  4 * 2*S*H*H
+    attention scores QK^T:     2 * S*S*H
+    attention context AV:      2 * S*S*H
+    FFN (two mats):            2 * 2*S*H*F
+  encoder forward  = L * (8*S*H^2 + 4*S^2*H + 4*S*H*F)
+  heads forward:
+    pooler:                    2*H*H
+    NSP classifier:            2*H*2
+    MLM transform:             M * 2*H*H
+    MLM decoder (tied vocab):  M * 2*H*V
+  training multiplier: 3x forward (one backward pass costs ~2x forward
+  in matmul FLOPs — dL/dW and dL/dx per matmul).
+
+Embedding lookups, layernorms, biases, softmax and activations are
+omitted (sub-1% and not MXU work).
+"""
+
+from __future__ import annotations
+
+# Peak dense bf16 matmul TFLOP/s per chip, by PJRT ``device_kind``
+# substring (lowercased). Public numbers from cloud.google.com/tpu/docs.
+_PEAK_TFLOPS_BY_KIND = (
+    # Order matters: the "lite" spellings must match before the generic
+    # generation entries (libtpu reports e.g. "TPU v5 lite" for v5e but
+    # plain "TPU v5" for v5p, and "TPU v6 lite" for v6e/Trillium).
+    ("v6e", 918.0),
+    ("v6 lite", 918.0),
+    ("trillium", 918.0),
+    ("v5p", 459.0),
+    ("v5e", 197.0),
+    ("v5 lite", 197.0),
+    ("v5litepod", 197.0),
+    ("v5", 459.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+    # CPU fallback: no meaningful peak; callers should treat 0 as "unknown".
+)
+
+
+def peak_tflops(device_kind: str) -> float:
+    """Peak bf16 TFLOP/s for a device kind string, or 0.0 if unknown."""
+    kind = device_kind.lower()
+    for sub, tf in _PEAK_TFLOPS_BY_KIND:
+        if sub in kind:
+            return tf
+    return 0.0
+
+
+def bert_train_flops_per_seq(config, seq_len: int, max_pred_per_seq: int,
+                             next_sentence: bool = True) -> float:
+    """Model FLOPs (fwd+bwd) for ONE sequence of the pretraining objective."""
+    h = config.hidden_size
+    f = config.intermediate_size
+    ll = config.num_hidden_layers
+    v = config.vocab_size
+    s = seq_len
+    m = max_pred_per_seq
+    encoder = ll * (8 * s * h * h + 4 * s * s * h + 4 * s * h * f)
+    heads = m * (2 * h * h + 2 * h * v)
+    if next_sentence:
+        heads += 2 * h * h + 2 * h * 2  # pooler + NSP classifier
+    return 3.0 * (encoder + heads)
+
+
+def mfu(seq_per_sec_per_chip: float, flops_per_seq: float,
+        device_kind: str) -> float:
+    """Fraction of the chip's peak used by model FLOPs; 0.0 if peak unknown."""
+    peak = peak_tflops(device_kind)
+    if peak <= 0:
+        return 0.0
+    return seq_per_sec_per_chip * flops_per_seq / (peak * 1e12)

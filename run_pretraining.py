@@ -366,10 +366,15 @@ def main(args) -> dict:
         state = init_fn(jax.random.PRNGKey(args.seed))
 
         if checkpoint is not None:
-            params = ckpt.restore_tree(
-                jax.device_get(state.params), checkpoint["model"])
+            # Restore onto an ABSTRACT template (shapes/dtypes only), not a
+            # device_get of the live state: on a multi-host fsdp/tp mesh the
+            # live state has non-addressable shards that device_get cannot
+            # fetch. Every process reads the full file and device_put slices
+            # out its addressable shards of the target sharding.
+            abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(args.seed))
+            params = ckpt.restore_tree(abstract.params, checkpoint["model"])
             opt_state = ckpt.restore_tree(
-                jax.device_get(state.opt_state), checkpoint["optimizer"])
+                abstract.opt_state, checkpoint["optimizer"])
             state = pretrain.TrainState(
                 params=jax.device_put(params, shardings.params),
                 opt_state=jax.device_put(opt_state, shardings.opt_state),
@@ -508,6 +513,9 @@ def main(args) -> dict:
         samples_seen = 0
         last_metrics = {}
         done = False
+        # The DATA sequence length (what the FLOP/MFU accounting must use;
+        # phase-1 data is 128 tokens while max_position_embeddings stays 512).
+        data_seq_len = None
         # Position of the last TRAINED sample this epoch. The sampler's live
         # ``index`` runs ahead of training by the loader queue plus the
         # device_prefetch depth (the reference's checkpoints have the same
@@ -552,6 +560,8 @@ def main(args) -> dict:
                 global_step += 1
                 step_in_run += 1
                 trained_index += args.host_batch_per_step
+                if data_seq_len is None:
+                    data_seq_len = int(batch["input_ids"].shape[-1])
                 if step_in_run > 1:  # skip step-0 compile in throughput
                     samples_seen += args.global_batch_size
                 if step_in_run == 1:
@@ -629,6 +639,19 @@ def main(args) -> dict:
         seq_per_sec = samples_seen / max(train_time, 1e-9)
         logger.info(f"Total time: {train_time:.2f} s")
         logger.info(f"training_seq_per_sec = {seq_per_sec:.2f}")
+        # MFU: hardware-normalised counterpart of seq/s (the reference
+        # reports raw seq/s only, run_pretraining.py:597-599); 0.0 when the
+        # device kind has no known peak (e.g. the CPU test mesh).
+        from bert_pytorch_tpu.utils import flops as flops_util
+        train_mfu = flops_util.mfu(
+            seq_per_sec / max(jax.device_count(), 1),
+            flops_util.bert_train_flops_per_seq(
+                config, data_seq_len or seq_len,
+                args.max_predictions_per_seq,
+                next_sentence=bool(config.next_sentence)),
+            jax.devices()[0].device_kind)
+        if train_mfu:
+            logger.info(f"training_mfu = {train_mfu:.4f}")
         # Final checkpoint so short runs resume exactly.
         save_step = global_step + args.previous_phase_end_step
         contents = {"model": state.params, "optimizer": state.opt_state,
@@ -642,6 +665,7 @@ def main(args) -> dict:
         logger.close()
         return {"global_step": global_step,
                 "training_seq_per_sec": seq_per_sec,
+                "training_mfu": train_mfu,
                 **last_metrics}
 
 

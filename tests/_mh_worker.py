@@ -1,4 +1,19 @@
-"""Worker for the 2-process multi-host integration test."""
+"""Worker for the 2-process multi-host integration tests.
+
+Modes (argv[4], default "dp"):
+  dp    — data-parallel train steps; both ranks must agree on losses.
+  fsdp  — fully-sharded params over both processes, then the multi-host
+          checkpoint leg: train 2 steps, save (the process_allgather
+          collective path of utils/checkpoint.py — params are sharded
+          across processes, so each rank holds NON-addressable shards of
+          the other's), restore onto a fresh state, and verify the next
+          step from the restored state matches the next step from the
+          live state exactly (SURVEY §5.4's multi-host sharded
+          checkpoint; reference rank-0 torch.save
+          run_pretraining.py:513-523).
+  pp    — GPipe pipeline over a 2-stage 'pipe' axis spanning the two
+          processes; both ranks must agree on losses.
+"""
 import os
 import sys
 
@@ -10,17 +25,20 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 coordinator, n_proc, rank = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+mode = sys.argv[4] if len(sys.argv) > 4 else "dp"
 jax.distributed.initialize(
     coordinator_address=coordinator, num_processes=n_proc, process_id=rank)
 
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import multihost_utils
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bert_pytorch_tpu import optim, pretrain
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu.models import BertForPreTraining
 from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
+from bert_pytorch_tpu.utils import checkpoint as ckpt
 
 assert jax.process_count() == n_proc, jax.process_count()
 assert len(jax.devices()) == 4 * n_proc, len(jax.devices())
@@ -29,12 +47,20 @@ config = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=2,
                     num_attention_heads=2, intermediate_size=32,
                     max_position_embeddings=16, next_sentence=True)
 model = BertForPreTraining(config, dtype=jnp.float32)
-mesh = create_mesh(MeshConfig(data=-1))
-rules = logical_axis_rules("dp")
+if mode == "fsdp":
+    mesh = create_mesh(MeshConfig(data=-1, fsdp=4 * n_proc))
+    rules = logical_axis_rules("fsdp")
+elif mode == "pp":
+    mesh = create_mesh(MeshConfig(data=-1, pipe=2))
+    rules = logical_axis_rules("pp")
+else:
+    mesh = create_mesh(MeshConfig(data=-1))
+    rules = logical_axis_rules("dp")
 schedule = optim.warmup_poly_schedule(1e-3, 0.1, 50)
 tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
 S = 16
 local_b = 8  # per process; global batch 16
+accum = 2 if mode == "pp" else 1  # pp needs >= n_stages microbatches
 sample = (jnp.zeros((1, S), jnp.int32),) * 3
 
 rng = np.random.default_rng(rank)
@@ -51,13 +77,59 @@ with mesh:
     sh = pretrain.state_shardings(mesh, model, rules, sample)
     bs = pretrain.batch_shardings(mesh, {"input_ids": 3, "segment_ids": 3,
         "input_mask": 3, "masked_lm_labels": 3, "next_sentence_labels": 2})
-    state = pretrain.make_init_fn(model, tx, sample, sh)(jax.random.PRNGKey(0))
-    step = pretrain.make_train_step(model, tx, schedule=schedule,
-        next_sentence=True, shardings=sh, batch_shardings_=bs)
+    init_fn = pretrain.make_init_fn(model, tx, sample, sh)
+    state = init_fn(jax.random.PRNGKey(0))
+    if mode == "pp":
+        step = pretrain.make_pp_train_step(model, tx, mesh, schedule=schedule,
+            next_sentence=True, shardings=sh, batch_shardings_=bs)
+    else:
+        step = pretrain.make_train_step(model, tx, schedule=schedule,
+            next_sentence=True, shardings=sh, batch_shardings_=bs)
     # multi-host path of put_batch: each process contributes its local slice
-    batch = pretrain.put_batch(pretrain.stack_microbatches(host, 1), bs)
+    batch = pretrain.put_batch(pretrain.stack_microbatches(host, accum), bs)
     losses = []
-    for _ in range(3):
+    for _ in range(2 if mode == "fsdp" else 3):
         state, metrics = step(state, batch)
         losses.append(float(metrics["loss"]))
+
+    if mode == "fsdp":
+        # The params really are sharded across the two processes — the
+        # checkpoint save MUST exercise the collective gather path.
+        p0 = jax.tree_util.tree_leaves(state.params)[0]
+        assert not p0.is_fully_addressable, p0.sharding
+        out_dir = sys.argv[5]
+        ckpt.save_checkpoint(out_dir, 2, {
+            "model": state.params,
+            "optimizer": state.opt_state,
+            "rng": state.rng,
+        })
+        ckpt.wait_for_pending_save()
+        # Rank 1 must not read before rank 0's atomic rename lands.
+        multihost_utils.sync_global_devices("mh_ckpt_written")
+
+        state, metrics = step(state, batch)  # live continuation
+        losses.append(float(metrics["loss"]))
+
+        step_no, loaded = ckpt.load_latest_checkpoint(out_dir)
+        assert step_no == 2, step_no
+        # Restore exactly as run_pretraining.py does: onto an ABSTRACT
+        # template (a device_get of live fsdp state would fail — the
+        # non-addressable-shards defect this test exists to catch).
+        abstract = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        restored = pretrain.TrainState(
+            params=jax.device_put(
+                ckpt.restore_tree(abstract.params, loaded["model"]),
+                sh.params),
+            opt_state=jax.device_put(
+                ckpt.restore_tree(abstract.opt_state, loaded["optimizer"]),
+                sh.opt_state),
+            rng=jax.device_put(
+                ckpt.restore_tree(abstract.rng, loaded["rng"]), sh.rng),
+        )
+        restored, r_metrics = step(restored, batch)
+        live, resumed = losses[-1], float(r_metrics["loss"])
+        assert abs(live - resumed) < 1e-6, (live, resumed)
+        print(f"RANK{rank} CKPT OK live={live:.6f} resumed={resumed:.6f}",
+              flush=True)
+
 print(f"RANK{rank} OK losses={['%.4f' % l for l in losses]}", flush=True)

