@@ -128,17 +128,28 @@ def whitespace_tokenize(text: str) -> list[str]:
 class BasicTokenizer:
     """Whitespace/punctuation splitting + lowercase/accent-strip + CJK
     isolation (reference tokenization.py:60-173). SQuAD's character-level
-    answer realignment assumes exactly these semantics."""
+    answer realignment assumes exactly these semantics.
 
-    def __init__(self, do_lower_case: bool = True):
+    ``never_split`` tokens (the special tokens, reference
+    tokenization.py:64-75) pass through verbatim: no lowercasing, no
+    accent-stripping, no punctuation split — "[MASK]" must stay one token,
+    not become "[", "mask", "]".
+    """
+
+    def __init__(
+        self,
+        do_lower_case: bool = True,
+        never_split=("[UNK]", "[SEP]", "[PAD]", "[CLS]", "[MASK]"),
+    ):
         self.do_lower_case = do_lower_case
+        self.never_split = never_split
 
     def tokenize(self, text: str) -> list[str]:
         text = self._clean_text(text)
         text = self._pad_cjk(text)
         tokens = []
         for token in whitespace_tokenize(text):
-            if self.do_lower_case:
+            if self.do_lower_case and token not in self.never_split:
                 token = token.lower()
                 token = self._strip_accents(token)
             tokens.extend(self._split_on_punc(token))
@@ -169,8 +180,9 @@ class BasicTokenizer:
         text = unicodedata.normalize("NFD", text)
         return "".join(c for c in text if unicodedata.category(c) != "Mn")
 
-    @staticmethod
-    def _split_on_punc(token: str) -> list[str]:
+    def _split_on_punc(self, token: str) -> list[str]:
+        if token in self.never_split:
+            return [token]
         pieces: list[list[str]] = []
         start_new = True
         for char in token:
@@ -193,7 +205,7 @@ class WordpieceTokenizer:
         self,
         vocab,
         unk_token: str = "[UNK]",
-        max_input_chars_per_word: int = 200,
+        max_input_chars_per_word: int = 100,
     ):
         self.vocab = vocab
         self.unk_token = unk_token

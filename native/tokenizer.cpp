@@ -8,11 +8,12 @@
 // (src/tokenization.py:60-229 ≙ bert_pytorch_tpu/data/tokenization.py).
 //
 // Pipeline: UTF-8 decode -> clean (drop control/NUL/replacement chars,
-// canonicalize whitespace) -> CJK isolation -> optional lowercase +
-// accent strip (precomputed Latin fold table; full NFD is out of scope,
-// the fold table covers Latin-1 Supplement + Latin Extended-A which is
-// what BERT's English corpora contain) -> punctuation split -> greedy
-// longest-match WordPiece against a prefix-keyed hash vocab.
+// canonicalize whitespace) -> CJK isolation -> never_split passthrough for
+// special tokens -> optional lowercase + accent strip (full-Unicode
+// lower()+NFD+drop-Mn fold tables generated from Python unicodedata by
+// gen_unicode_tables.py, plus algorithmic Hangul decomposition and the
+// Final_Sigma rule) -> punctuation split -> greedy longest-match WordPiece
+// against a prefix-keyed hash vocab.
 //
 // Exposed as a C ABI for ctypes (see tools/tokenizer_cpp.py). A WordPiece
 // vocab trainer (pair-merge algorithm over word counts) lives here too,
@@ -26,6 +27,7 @@
 #include <sstream>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -76,20 +78,36 @@ void encode_utf8(uint32_t cp, std::string& out) {
 }
 
 // ---------------------------------------------------------------------------
-// Character classes (the subset of Unicode the BERT normalizer needs)
+// Character classes — range tables generated from Python unicodedata by
+// gen_unicode_tables.py (the behavioral spec is the pure-Python
+// BasicTokenizer's unicodedata.category calls, reference
+// src/tokenization.py:120-173). Full Unicode coverage, no ICU dependency.
 // ---------------------------------------------------------------------------
 
+struct CpRange { uint32_t lo, hi; };
+#include "unicode_tables.inc"
+
+bool in_ranges(uint32_t cp, const CpRange* table, size_t count) {
+  size_t lo = 0, hi = count;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (cp < table[mid].lo) hi = mid;
+    else if (cp > table[mid].hi) lo = mid + 1;
+    else return true;
+  }
+  return false;
+}
+
 bool is_whitespace(uint32_t cp) {
-  return cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r' ||
-         cp == 0x00A0 || cp == 0x2000 || (cp >= 0x2000 && cp <= 0x200A) ||
-         cp == 0x202F || cp == 0x205F || cp == 0x3000 || cp == 0x1680;
+  if (cp == ' ' || cp == '\t' || cp == '\n' || cp == '\r') return true;
+  if (cp < 0x80) return false;
+  return in_ranges(cp, kWhitespace, kWhitespaceCount);
 }
 
 bool is_control(uint32_t cp) {
   if (cp == '\t' || cp == '\n' || cp == '\r') return false;
-  return cp < 0x20 || cp == 0x7F || (cp >= 0x80 && cp <= 0x9F) ||
-         (cp >= 0x200B && cp <= 0x200F) ||  // zero-width + direction marks
-         (cp >= 0x202A && cp <= 0x202E);
+  if (cp < 0x80) return cp < 0x20 || cp == 0x7F;
+  return in_ranges(cp, kControl, kControlCount);
 }
 
 bool is_ascii_punct(uint32_t cp) {
@@ -98,13 +116,41 @@ bool is_ascii_punct(uint32_t cp) {
 }
 
 bool is_punct(uint32_t cp) {
-  if (is_ascii_punct(cp)) return true;
-  // General Punctuation, Supplemental, CJK symbols, fullwidth forms.
-  return (cp >= 0x2010 && cp <= 0x2027) || (cp >= 0x2030 && cp <= 0x205E) ||
-         (cp >= 0x3001 && cp <= 0x303F) || (cp >= 0xFF01 && cp <= 0xFF0F) ||
-         (cp >= 0xFF1A && cp <= 0xFF20) || (cp >= 0xFF3B && cp <= 0xFF40) ||
-         (cp >= 0xFF5B && cp <= 0xFF65) || cp == 0x00A1 || cp == 0x00BF ||
-         cp == 0x00AB || cp == 0x00BB;
+  // ASCII non-alphanumerics count as punctuation even where Unicode
+  // disagrees ('$', '`'), matching the spec's explicit override.
+  if (cp < 0x80) return is_ascii_punct(cp);
+  return in_ranges(cp, kPunct, kPunctCount);
+}
+
+bool is_cased_cp(uint32_t cp) {
+  return in_ranges(cp, kCased, kCasedCount);
+}
+
+bool is_case_ignorable_cp(uint32_t cp) {
+  return in_ranges(cp, kCaseIgnorable, kCaseIgnorableCount);
+}
+
+// Final_Sigma per CPython str.lower() (whose Cased/Case_Ignorable sets the
+// generated tables reproduce exactly — probed, not approximated): the
+// capital sigma at cps[j] takes the final form iff a cased character
+// precedes it (skipping case-ignorables) and no cased character follows it
+// (skipping case-ignorables). The scan is bounded to the word because the
+// spec lower()s one whitespace token at a time.
+bool sigma_is_final(const std::vector<uint32_t>& cps, size_t j) {
+  bool preceded = false;
+  for (size_t k = j; k > 0;) {
+    uint32_t c = cps[--k];
+    if (is_case_ignorable_cp(c)) continue;
+    preceded = is_cased_cp(c);
+    break;
+  }
+  if (!preceded) return false;
+  for (size_t k = j + 1; k < cps.size(); k++) {
+    uint32_t c = cps[k];
+    if (is_case_ignorable_cp(c)) continue;
+    return !is_cased_cp(c);
+  }
+  return true;
 }
 
 bool is_cjk(uint32_t cp) {
@@ -114,45 +160,39 @@ bool is_cjk(uint32_t cp) {
          (cp >= 0xF900 && cp <= 0xFAFF) || (cp >= 0x2F800 && cp <= 0x2FA1F);
 }
 
-// Latin fold: lowercase + accent strip for Latin-1 Supplement and Latin
-// Extended-A. Returns 0 when the character should be dropped (combining
-// marks), the folded codepoint otherwise.
-uint32_t latin_fold(uint32_t cp, bool lower) {
-  // Spec: reference tokenization.py BasicTokenizer — lower() then NFD with
-  // combining marks (category Mn) dropped, applied only in lowercase mode.
-  if (!lower) return cp;
-  if (cp >= 'A' && cp <= 'Z') return cp + 32;
-  if (cp >= 0x0300 && cp <= 0x036F) return 0;  // combining marks (post-NFD)
-  // Exact lower()+NFD+strip-Mn folds for Latin-1 Supplement and Latin
-  // Extended-A, generated from Python unicodedata (the behavioral spec).
-  static const uint16_t kLatin1[64] = {
-      0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x00E6, 0x0063, 0x0065, 0x0065,
-      0x0065, 0x0065, 0x0069, 0x0069, 0x0069, 0x0069, 0x00F0, 0x006E, 0x006F, 0x006F,
-      0x006F, 0x006F, 0x006F, 0x00D7, 0x00F8, 0x0075, 0x0075, 0x0075, 0x0075, 0x0079,
-      0x00FE, 0x00DF, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x00E6, 0x0063,
-      0x0065, 0x0065, 0x0065, 0x0065, 0x0069, 0x0069, 0x0069, 0x0069, 0x00F0, 0x006E,
-      0x006F, 0x006F, 0x006F, 0x006F, 0x006F, 0x00F7, 0x00F8, 0x0075, 0x0075, 0x0075,
-      0x0075, 0x0079, 0x00FE, 0x0079,
-  };
-  static const uint16_t kExtA[128] = {
-      0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0061, 0x0063, 0x0063, 0x0063, 0x0063,
-      0x0063, 0x0063, 0x0063, 0x0063, 0x0064, 0x0064, 0x0111, 0x0111, 0x0065, 0x0065,
-      0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0065, 0x0067, 0x0067,
-      0x0067, 0x0067, 0x0067, 0x0067, 0x0067, 0x0067, 0x0068, 0x0068, 0x0127, 0x0127,
-      0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0069, 0x0131,
-      0x0133, 0x0133, 0x006A, 0x006A, 0x006B, 0x006B, 0x0138, 0x006C, 0x006C, 0x006C,
-      0x006C, 0x006C, 0x006C, 0x0140, 0x0140, 0x0142, 0x0142, 0x006E, 0x006E, 0x006E,
-      0x006E, 0x006E, 0x006E, 0x0149, 0x014B, 0x014B, 0x006F, 0x006F, 0x006F, 0x006F,
-      0x006F, 0x006F, 0x0153, 0x0153, 0x0072, 0x0072, 0x0072, 0x0072, 0x0072, 0x0072,
-      0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0073, 0x0074, 0x0074,
-      0x0074, 0x0074, 0x0167, 0x0167, 0x0075, 0x0075, 0x0075, 0x0075, 0x0075, 0x0075,
-      0x0075, 0x0075, 0x0075, 0x0075, 0x0075, 0x0075, 0x0077, 0x0077, 0x0079, 0x0079,
-      0x0079, 0x007A, 0x007A, 0x007A, 0x007A, 0x007A, 0x007A, 0x017F,
-  };
-  if (cp >= 0x00C0 && cp <= 0x00FF) return kLatin1[cp - 0x00C0];
-  if (cp >= 0x0100 && cp <= 0x017F) return kExtA[cp - 0x0100];
-  if (cp >= 0x0391 && cp <= 0x03A9 && cp != 0x03A2) return cp + 32;  // Greek
-  return cp;
+// Per-codepoint fold: lower() + NFD + drop category-Mn, the spec's
+// do_lower_case normalization (reference tokenization.py:94-102). Appends
+// the 0..3 output codepoints to `out`. ``sigma_final`` is the Final_Sigma
+// context for U+03A3 (sigma_is_final, computed by the caller which holds
+// the whole word).
+void fold_cp(uint32_t cp, bool sigma_final, std::vector<uint32_t>& out) {
+  if (cp < 0x80) {
+    out.push_back(cp >= 'A' && cp <= 'Z' ? cp + 32 : cp);
+    return;
+  }
+  if (cp == 0x03A3) {  // GREEK CAPITAL SIGMA: context-sensitive lower()
+    out.push_back(sigma_final ? 0x03C2 : 0x03C3);
+    return;
+  }
+  if (cp >= 0xAC00 && cp <= 0xD7A3) {  // Hangul syllable: algorithmic NFD
+    uint32_t s = cp - 0xAC00;
+    out.push_back(0x1100 + s / 588);
+    out.push_back(0x1161 + (s % 588) / 28);
+    if (s % 28) out.push_back(0x11A7 + s % 28);
+    return;
+  }
+  size_t lo = 0, hi = kFoldCount;
+  while (lo < hi) {
+    size_t mid = (lo + hi) / 2;
+    if (kFoldKeys[mid] < cp) lo = mid + 1; else hi = mid;
+  }
+  if (lo < kFoldCount && kFoldKeys[lo] == cp) {
+    // An all-zero entry means "drop" (standalone combining marks).
+    for (int j = 0; j < 3 && kFoldVals[lo][j]; j++)
+      out.push_back(kFoldVals[lo][j]);
+    return;
+  }
+  out.push_back(cp);
 }
 
 // ---------------------------------------------------------------------------
@@ -164,50 +204,85 @@ struct Tokenizer {
   std::vector<std::string> id_to_token;
   bool lowercase = true;
   int unk_id = 0;
-  size_t max_chars_per_word = 200;
+  // Spec: reference tokenization.py:181 (chars = CODEPOINTS, not bytes).
+  size_t max_chars_per_word = 100;
   size_t max_token_len = 0;  // longest vocab entry (bytes), bounds matching
+  // Special tokens pass through basic_tokenize verbatim — no lowercase,
+  // no accent strip, no punctuation split (reference tokenization.py:64-75).
+  std::unordered_set<std::string> never_split{
+      "[UNK]", "[SEP]", "[PAD]", "[CLS]", "[MASK]"};
 
   std::vector<int> last_ids;           // result buffers for the C API
   std::string last_tokens_joined;      // '\n'-joined token strings
 };
 
-// Normalize + split into word/punct chunks (BasicTokenizer semantics).
+// Normalize + split into word/punct chunks (BasicTokenizer semantics:
+// clean -> CJK isolation -> whitespace split -> per-token never_split
+// passthrough OR lower+NFD-strip -> punctuation split).
 std::vector<std::string> basic_tokenize(const Tokenizer& t,
                                         const std::string& text) {
-  std::vector<std::string> out;
+  // Pass 1: clean + CJK isolation + whitespace split. No case folding yet:
+  // never_split matching and the Final_Sigma rule need the whole raw token.
+  std::vector<std::string> words;
   std::string current;
-  auto flush = [&]() {
-    if (!current.empty()) { out.push_back(current); current.clear(); }
+  auto flush_word = [&]() {
+    if (!current.empty()) { words.push_back(current); current.clear(); }
   };
   size_t i = 0;
   while (i < text.size()) {
     uint32_t cp = decode_utf8(text, i);
     if (cp == 0 || cp == 0xFFFD || is_control(cp)) continue;
-    if (is_whitespace(cp)) { flush(); continue; }
+    if (is_whitespace(cp)) { flush_word(); continue; }
     if (is_cjk(cp)) {  // CJK chars become standalone tokens
-      flush();
-      std::string c; encode_utf8(cp, c); out.push_back(c);
-      continue;
-    }
-    if (t.lowercase) {
-      cp = latin_fold(cp, true);
-      if (cp == 0) continue;  // stripped combining mark
-    }
-    if (is_punct(cp)) {
-      flush();
-      std::string c; encode_utf8(cp, c); out.push_back(c);
+      flush_word();
+      std::string c; encode_utf8(cp, c); words.push_back(c);
       continue;
     }
     encode_utf8(cp, current);
   }
-  flush();
+  flush_word();
+
+  // Pass 2: per whitespace token, fold + punctuation split.
+  std::vector<std::string> out;
+  std::vector<uint32_t> cps, folded;
+  for (const auto& word : words) {
+    if (t.never_split.count(word)) { out.push_back(word); continue; }
+    cps.clear();
+    for (size_t j = 0; j < word.size();) cps.push_back(decode_utf8(word, j));
+    folded.clear();
+    if (t.lowercase) {
+      for (size_t j = 0; j < cps.size(); j++)
+        fold_cp(cps[j],
+                cps[j] == 0x03A3 && sigma_is_final(cps, j), folded);
+    } else {
+      folded = cps;
+    }
+    std::string chunk;
+    auto flush_chunk = [&]() {
+      if (!chunk.empty()) { out.push_back(chunk); chunk.clear(); }
+    };
+    for (uint32_t cp : folded) {
+      if (is_punct(cp)) {
+        flush_chunk();
+        std::string c; encode_utf8(cp, c); out.push_back(c);
+      } else {
+        encode_utf8(cp, chunk);
+      }
+    }
+    flush_chunk();
+  }
   return out;
 }
 
 // Greedy longest-match WordPiece on one word (already normalized).
 void wordpiece(const Tokenizer& t, const std::string& word,
                std::vector<int>& ids, std::vector<std::string>& tokens) {
-  if (word.size() > t.max_chars_per_word) {
+  size_t n_chars = 0;
+  for (size_t i = 0; i < word.size();) {
+    decode_utf8(word, i);
+    n_chars++;
+  }
+  if (n_chars > t.max_chars_per_word) {
     ids.push_back(t.unk_id);
     tokens.push_back(t.id_to_token[t.unk_id]);
     return;

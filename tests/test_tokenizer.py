@@ -325,3 +325,127 @@ def test_cpp_bpe_oov_dropped_and_cyrillic_greek_lower(tmp_path):
     cpp_low = CppByteLevelBPETokenizer(vj2, mt2, lowercase=True)
     for s in ["Ёлка", "Άθήνα", "Ђуро Џак", "ЀЍЉЊ", "Ϊ Ϋ Ό Ύ Ώ Έ Ή Ί"]:
         assert cpp_low.encode(s).ids == hf_low.encode(s).ids, s
+
+
+# ---------------------------------------------------------------------------
+# Adversarial Unicode parity: the C++ core vs the pure-Python spec
+# (reference src/tokenization.py:60-229) on text far outside BERT's
+# English comfort zone. The generated range/fold tables
+# (native/gen_unicode_tables.py) must make these byte-identical.
+# ---------------------------------------------------------------------------
+
+ADVERSARIAL_TEXTS = [
+    "Élan naïve façade CAFÉ Ångström søster œuvre",   # Latin accents
+    "ΒΑΣ σαλάμι Σ ΚΟΣΜΟΣ ΑΣΦΑΛΗΣ ΣΣ",                 # Greek + Final_Sigma
+    "Ο'Σ ΟΣ́Α אΣ Α.Σ. Σ' ΑΣ:",  # Final_Sigma with case-ignorables/uncased
+    "Привет МИР Ёлка ЙОД",                             # Cyrillic (Ё->е, Й->и)
+    "한국어 조선말 한",                                  # Hangul (NFD decomposes)
+    "Tiếng Việt Đà-Nẵng ở đâu",                        # stacked accents
+    "中文 and 日本語テキストです",                       # CJK + kana mix
+    "[MASK] [CLS] x [SEP] x[MASK]y ([MASK]) [PAD]. [UNK]",  # never_split
+    "İstanbul DİYARBAKIR ʼn ǅungla ẞ groß",            # multi-char lower()
+    "“curly” — em…dash ¡olé! ¿qué? «guillemets» ׳״",   # Unicode punct
+    "zero​width­shy écombining ́alone",
+    "�replacement \x00nul\x07bell tab\tsplit",
+    "⁠⁢invisible \U0001D400math \U0001F600emoji",
+]
+
+
+def test_cpp_matches_python_spec_adversarial(vocab_file, tmp_path):
+    """Byte-identical tokens on adversarial Unicode, on a vocab built to
+    exercise real subword splits for these scripts."""
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    spec = BasicTokenizer(do_lower_case=True)
+    pieces = dict.fromkeys(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"])
+    for text in ADVERSARIAL_TEXTS:
+        for word in spec.tokenize(text):
+            # whole word, first char, and every continuation char: gives the
+            # greedy matcher both one-shot and char-by-char paths.
+            chars = list(word)
+            pieces.setdefault(word)
+            pieces.setdefault(chars[0])
+            for c in chars[1:]:
+                pieces.setdefault("##" + c)
+    vocab_path = tmp_path / "adv_vocab.txt"
+    vocab_path.write_text("\n".join(pieces) + "\n")
+
+    py = BertTokenizer(str(vocab_path), do_lower_case=True)
+    cpp = CppWordPieceTokenizer(str(vocab_path), lowercase=True)
+    for text in ADVERSARIAL_TEXTS:
+        py_tokens = py.tokenize(text)
+        enc = cpp.encode(text)
+        assert enc.tokens == py_tokens, (text, enc.tokens, py_tokens)
+        assert enc.ids == py.convert_tokens_to_ids(py_tokens), text
+
+
+def test_never_split_special_tokens():
+    """Reference tokenization.py:64-75,106-108: special tokens pass through
+    basic tokenization verbatim — no lowercase, no punct split."""
+    bt = BasicTokenizer(do_lower_case=True)
+    assert bt.tokenize("a [MASK] b") == ["a", "[MASK]", "b"]
+    assert bt.tokenize("[CLS] Hi [SEP]") == ["[CLS]", "hi", "[SEP]"]
+    # Attached punctuation means the whitespace token is NOT the special
+    # token, so it splits like any other text (reference behavior).
+    assert bt.tokenize("([MASK])") == ["(", "[", "mask", "]", ")"]
+    assert bt.tokenize("x[MASK]y") == ["x", "[", "mask", "]", "y"]
+
+
+def test_max_input_chars_per_word_is_100_codepoints(tmp_path):
+    """Reference tokenization.py:181: words over 100 CHARS (not bytes)
+    become [UNK]."""
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    vocab_path = tmp_path / "v.txt"
+    vocab_path.write_text(
+        "\n".join(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+                   "a", "##a", "é", "##é"]) + "\n")
+    wp = WordpieceTokenizer(load_vocab(str(vocab_path)))
+    assert wp.max_input_chars_per_word == 100
+    assert wp.tokenize("a" * 100) == ["a"] + ["##a"] * 99
+    assert wp.tokenize("a" * 101) == ["[UNK]"]
+    cpp = CppWordPieceTokenizer(str(vocab_path), lowercase=True)
+    assert cpp.encode("a" * 100).tokens == ["a"] + ["##a"] * 99
+    assert cpp.encode("a" * 101).tokens == ["[UNK]"]
+    # 100 codepoints of 'é' is 200 UTF-8 bytes — still under the limit
+    # (uppercase mode so the accent survives and 'é' stays in-vocab).
+    cpp_u = CppWordPieceTokenizer(str(vocab_path), lowercase=False)
+    assert cpp_u.encode("é" * 100).tokens == ["é"] + ["##é"] * 99
+    assert cpp_u.encode("é" * 101).tokens == ["[UNK]"]
+
+
+def test_final_sigma_matches_cpython_lower(vocab_file, tmp_path):
+    """CPython str.lower() maps trailing capital sigma to the final form;
+    the C++ fold must agree (SQuAD's get_final_text realigns on it)."""
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    words = ["ΚΟΣΜΟΣ", "Σ", "ΑΣ", "ΣΑ", "ΟΔΥΣΣΕΑΣ"]
+    spec = BasicTokenizer(do_lower_case=True)
+    pieces = dict.fromkeys(["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"])
+    for w in words:
+        for t in spec.tokenize(w):
+            pieces.setdefault(t)
+    vocab_path = tmp_path / "sigma.txt"
+    vocab_path.write_text("\n".join(pieces) + "\n")
+    cpp = CppWordPieceTokenizer(str(vocab_path), lowercase=True)
+    for w in words:
+        assert cpp.encode(w).tokens == spec.tokenize(w) == [w.lower()], w
+
+
+def test_unicode_tables_match_runtime_unidata_version():
+    """The C++ range/fold tables are frozen at the unidata version of the
+    Python that generated them; the parity contract only holds when the
+    runtime's unicodedata agrees. Regenerate on mismatch:
+    cd native && make unicode_tables.inc && make."""
+    import re
+    import unicodedata
+
+    inc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "unicode_tables.inc")
+    with open(inc) as f:
+        head = f.read(4096)
+    m = re.search(r'kUnidataVersion\[\] = "([^"]+)"', head)
+    assert m, "unicode_tables.inc missing kUnidataVersion"
+    assert m.group(1) == unicodedata.unidata_version, (
+        f"tables generated for unidata {m.group(1)} but runtime has "
+        f"{unicodedata.unidata_version}; regenerate (see docstring)")
