@@ -43,10 +43,15 @@ LEGACY_FORMAT_KEYS = (
 class ShardedPretrainingDataset:
     """Streams sorted HDF5 shards keeping <= 2 files in memory.
 
-    ``__getitem__`` must be called with sequential indices (per rank); use
-    :class:`bert_pytorch_tpu.data.sampler.DistributedSampler` which chunks
-    contiguously. Out-of-order access raises, mirroring the invariant check at
-    reference dataset.py:161-169.
+    ``__getitem__`` expects forward-moving indices (per reader); use
+    :class:`bert_pytorch_tpu.data.sampler.DistributedSampler`, which chunks
+    contiguously. Forward skips (strided DataLoader workers) and cyclic
+    wrap-around (epoch restarts, including mid-dataset chunk starts for
+    ranks > 0) are supported; a genuinely random access pattern (shuffling
+    sampler) is not an error but reloads shard files pathologically — the
+    contiguity contract lives in the sampler (cf. the invariant check at
+    reference dataset.py:161-169, which also rejected the legal multi-rank
+    epoch restart).
     """
 
     def __init__(
@@ -98,6 +103,30 @@ class ShardedPretrainingDataset:
         self._next_file_data = None
         self._next_file_thread: Optional[threading.Thread] = None
 
+    # -- pickling (DataLoader worker processes) ------------------------------
+
+    def __getstate__(self):
+        """Drop the streaming runtime (loaded shard data, prefetch thread):
+        a worker process re-streams from its own file handles. The RNG is
+        dropped too — workers must be re-seeded (see DataLoader) so they
+        don't all replay identical masking draws."""
+        state = self.__dict__.copy()
+        for k in ("data", "_next_file_data", "_next_file_thread", "_rng"):
+            state[k] = None
+        state["file_idx"] = None
+        state["next_file_idx"] = None
+        state["file_sample_start_idx"] = -1
+        state["file_sample_end_idx"] = -1
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._rng = np.random.default_rng(self.seed)
+
+    def reseed(self, seed: Optional[int]) -> None:
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+
     # -- epoch / size --------------------------------------------------------
 
     def set_epoch(self, epoch: int) -> None:
@@ -115,25 +144,27 @@ class ShardedPretrainingDataset:
             self._next_file_thread = self._async_load_file(self.next_file_idx)
 
         if not (self.file_sample_start_idx <= idx < self.file_sample_end_idx):
-            # Exhausted the current file: swap in the prefetched one and start
-            # loading its successor in the background.
-            del self.data  # drop the old shard before holding two new ones
-            self._next_file_thread.join()
-            self.data = self._next_file_data
-            self.file_idx = self.next_file_idx
-            self.next_file_idx = (self.next_file_idx + 1) % len(self.files)
-            self._next_file_thread = self._async_load_file(self.next_file_idx)
-            self.file_sample_start_idx, self.file_sample_end_idx = self.file_idxs[
-                self.file_idx
-            ]
-
-        if not (self.file_sample_start_idx <= idx < self.file_sample_end_idx):
-            raise RuntimeError(
-                f"idx ({idx}) out of range ({self.file_sample_start_idx}, "
-                f"{self.file_sample_end_idx}) for current file. This happens "
-                "when __getitem__ is called with out-of-order indices (e.g. a "
-                "shuffling sampler)."
-            )
+            # Walk the cyclic file sequence forward to the file holding idx.
+            # Multiple swaps: a strided reader (a DataLoader worker taking
+            # every Nth batch) may skip past an entire small shard in one
+            # step. Cyclic: an epoch restart (rank-chunk end -> chunk start,
+            # possibly mid-dataset for ranks > 0) walks through the wrap —
+            # the previous one-swap-only logic raised on exactly that legal
+            # multi-rank restart. The access contract (contiguous forward
+            # chunks) is owned by DistributedSampler; a shuffling sampler
+            # here degrades to pathological full-file reloads per access
+            # rather than an error.
+            target = self._file_idx_for(idx)  # raises if idx >= len(self)
+            while self.file_idx != target:
+                # Swap in the prefetched file; start loading its successor.
+                del self.data  # drop the old shard before holding two new
+                self._next_file_thread.join()
+                self.data = self._next_file_data
+                self.file_idx = self.next_file_idx
+                self.next_file_idx = (self.next_file_idx + 1) % len(self.files)
+                self._next_file_thread = self._async_load_file(self.next_file_idx)
+                (self.file_sample_start_idx,
+                 self.file_sample_end_idx) = self.file_idxs[self.file_idx]
 
         local = idx - self.file_sample_start_idx
         input_ids = np.array(self.data["input_ids"][local])
@@ -217,30 +248,41 @@ class ShardedPretrainingDataset:
         """Dynamic masking (dataset.py:277-296): choose up to
         min(max_pred, max(1, round-down of len*prob)) non-special positions;
         each keeps its token w.p. original_token_prob, becomes random w.p.
-        random_token_prob, else [MASK]."""
+        random_token_prob, else [MASK].
+
+        Fully vectorized: this runs per sample on the host data path and was
+        the pipeline's hot spot as a Python loop (~80% of __getitem__; the
+        numpy form is ~10x faster, which is what lets one producer feed
+        multiple chips — see tools/bench_loader.py for measured rates).
+        """
         masked_lm_labels = np.full_like(input_ids, -1)
-        special = set(int(p) for p in special_token_positions)
-        candidates = [
-            i for i in range(int(special_token_positions[-1])) if i not in special
+        candidates = np.arange(int(special_token_positions[-1]))
+        candidates = candidates[
+            ~np.isin(candidates, np.asarray(special_token_positions))
         ]
-        if not candidates:
+        if candidates.size == 0:
             return input_ids, masked_lm_labels
         mask_count = min(
             self.max_pred_per_seq,
-            max(1, int(len(candidates) * self.masked_lm_prob)),
+            max(1, int(candidates.size * self.masked_lm_prob)),
         )
         mask_indices = self._rng.choice(
-            candidates, size=min(mask_count, len(candidates)), replace=False
+            candidates, size=min(mask_count, candidates.size), replace=False
         )
         masked_lm_labels[mask_indices] = input_ids[mask_indices]
-        draws = self._rng.random(len(mask_indices))
-        for idx, draw in zip(mask_indices, draws):
-            if draw < self.original_token_prob:
-                continue
-            elif draw < self.original_token_prob + self.random_token_prob:
-                input_ids[idx] = self._rng.integers(0, self.vocab_size - 1)
-            else:
-                input_ids[idx] = self.mask_token_index
+        draws = self._rng.random(mask_indices.size)
+        rand_sel = mask_indices[
+            (draws >= self.original_token_prob)
+            & (draws < self.original_token_prob + self.random_token_prob)
+        ]
+        mask_sel = mask_indices[
+            draws >= self.original_token_prob + self.random_token_prob
+        ]
+        if rand_sel.size:
+            input_ids[rand_sel] = self._rng.integers(
+                0, self.vocab_size - 1, size=rand_sel.size
+            )
+        input_ids[mask_sel] = self.mask_token_index
         return input_ids, masked_lm_labels
 
     # -- shard verification (dataset.py:298-338) -----------------------------

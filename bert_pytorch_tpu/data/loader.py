@@ -1,11 +1,31 @@
 """Torch-free batching loader with background prefetch.
 
 Replaces the reference's ``torch.utils.data.DataLoader(num_workers=4,
-pin_memory=True)`` (run_pretraining.py:394-395): a producer thread walks the
+pin_memory=True)`` (run_pretraining.py:394-395): a producer walks the
 sampler, pulls samples from the dataset (whose own background thread streams
 shard files), collates numpy batches, and keeps a small queue ahead of the
 training loop so host-side dynamic masking overlaps device compute — the
 TPU-feeding strategy called out in SURVEY.md §7 "hard parts".
+
+``num_workers=0`` (default) produces on one background THREAD. With the
+vectorized masking path this measures 13.1k seq/s at the phase-1 shape
+(seq 128, batch 64) and 11.1k seq/s at phase-2 (seq 512) on this image —
+32x / 132x one v5e chip's consumption, i.e. enough for a full 8-chip
+host (tools/bench_loader.py reproduces the numbers).
+``num_workers=N`` matches the reference's multi-worker process scaling:
+N spawned PROCESSES each produce every Nth batch (torch's round-robin
+batch assignment), and the parent interleaves their queues back into
+exact sampler order — sample-to-step assignment and the dataset's
+forward-moving access pattern (strictly increasing indices per worker;
+forward skips allowed) match the thread path, and the live sampler.index
+tracks DELIVERED batches exactly (the thread path's runs ahead by the
+prefetch queue; resume goes through the runner's trained_index either
+way). Workers re-seed their dataset replica RNG with
+``seed + worker_id + epoch`` so masking draws neither correlate across
+workers nor repeat across epochs. NB: each strided
+worker re-reads every shard file, so with the cheap vectorized masking
+the thread path is FASTER at BERT shapes; processes pay off only if
+per-sample featurization grows to dominate file IO.
 
 ``drop_last`` defaults to True: XLA-jitted steps want static batch shapes, so
 ragged tail batches (which the reference tolerates, SURVEY §2.1) would force
@@ -14,6 +34,7 @@ a recompile for one step.
 
 from __future__ import annotations
 
+import multiprocessing as mp
 import queue
 import threading
 from typing import Iterator, Optional
@@ -29,6 +50,50 @@ BATCH_KEYS = (
 )
 
 
+def _bounded_put(q, item, stop_event) -> bool:
+    """Put that aborts when the consumer is gone — a plain q.put() blocks
+    forever once the consumer stops draining with the queue full (the
+    abandoning side's stop_event.set() can't unblock a producer already
+    inside q.put). Shared by the thread producer and the worker processes;
+    both queue flavors raise queue.Full on timeout."""
+    while True:
+        try:
+            q.put(item, timeout=0.1)
+            return True
+        except queue.Full:
+            if stop_event.is_set():
+                return False
+
+
+def _worker_main(dataset, index_batches, out_queue, stop_event, worker_id,
+                 base_seed):
+    """Producer process: featurize+collate its assigned batches in order.
+
+    ``index_batches`` is the ordered list of (batch_number, [dataset indices])
+    this worker owns. Results go out as (batch_number, batch_dict); errors as
+    (batch_number, RuntimeError) so the parent re-raises at the right step.
+    """
+    # Seed folds in the EPOCH (pickled into the worker via set_epoch before
+    # iteration): without it, respawned workers would replay byte-identical
+    # masking draws every epoch, silently making dynamic masking static.
+    dataset.reseed((base_seed if base_seed is not None else 0)
+                   + 1_000_003 * (worker_id + 1)
+                   + getattr(dataset, "epoch", 0))
+    for bno, idxs in index_batches:
+        if stop_event.is_set():
+            return
+        try:
+            batch = DataLoader._collate([dataset[i] for i in idxs])
+        except BaseException as e:
+            _bounded_put(out_queue, (bno, RuntimeError(
+                f"DataLoader worker {worker_id} failed on batch {bno}: "
+                f"{type(e).__name__}: {e}")), stop_event)
+            return
+        if not _bounded_put(out_queue, (bno, batch), stop_event):
+            return
+    _bounded_put(out_queue, (None, None), stop_event)
+
+
 class DataLoader:
     def __init__(
         self,
@@ -37,35 +102,102 @@ class DataLoader:
         batch_size: int,
         drop_last: bool = True,
         prefetch_batches: int = 2,
+        num_workers: int = 0,
     ):
         self.dataset = dataset
         self.sampler = sampler
         self.batch_size = int(batch_size)
         self.drop_last = drop_last
         self.prefetch_batches = prefetch_batches
+        self.num_workers = int(num_workers)
 
     def __len__(self) -> int:
         n = len(self.sampler)
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
 
     def __iter__(self) -> Iterator[dict]:
+        if self.num_workers > 0:
+            return self._iter_multiprocess()
+        return self._iter_thread()
+
+    def _iter_multiprocess(self) -> Iterator[dict]:
+        """Spawned workers, round-robin over batches, in-order delivery.
+
+        The sampler is consumed up front (it is a cheap index mapping), and
+        its live ``index`` is advanced per DELIVERED batch below — exact,
+        unlike the thread path whose live index runs AHEAD of training by
+        the prefetch queue (the skew run_pretraining.py works around with
+        its trained_index counter; both paths resume correctly through
+        that counter). Spawn — not fork — because the parent has a live
+        JAX runtime.
+        """
+        start = self.sampler.index  # nonzero on mid-epoch resume
+        positions = list(self.sampler)  # drains; resets sampler.index to 0
+        n_batches = len(positions) // self.batch_size
+        tail = positions[n_batches * self.batch_size:]
+        batches = [
+            (b, positions[b * self.batch_size:(b + 1) * self.batch_size])
+            for b in range(n_batches)
+        ]
+        if tail and not self.drop_last:
+            batches.append((n_batches, tail))
+        ctx = mp.get_context("spawn")
+        stop = ctx.Event()
+        n_workers = max(1, min(self.num_workers, max(1, len(batches))))
+        out_queues = [
+            ctx.Queue(maxsize=max(2, self.prefetch_batches))
+            for _ in range(n_workers)
+        ]
+        procs = [
+            ctx.Process(
+                target=_worker_main,
+                args=(self.dataset, batches[w::n_workers], out_queues[w],
+                      stop, w, getattr(self.dataset, "seed", None)),
+                daemon=True)
+            for w in range(n_workers)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            for b in range(len(batches)):
+                q = out_queues[b % n_workers]
+                while True:
+                    try:
+                        bno, item = q.get(timeout=5.0)
+                        break
+                    except queue.Empty:
+                        dead = procs[b % n_workers]
+                        if not dead.is_alive():
+                            raise RuntimeError(
+                                f"DataLoader worker {b % n_workers} died "
+                                f"(exit code {dead.exitcode}) before "
+                                f"producing batch {b}")
+                if isinstance(item, BaseException):
+                    raise item
+                assert bno == b, (bno, b)
+                self.sampler.index = min(
+                    len(self.sampler), start + (b + 1) * self.batch_size)
+                yield item
+            self.sampler.index = 0  # epoch complete, like __next__'s reset
+        finally:
+            stop.set()
+            for p in procs:
+                p.join(timeout=5.0)
+                if p.is_alive():
+                    p.terminate()
+            for q in out_queues:
+                q.close()
+                q.cancel_join_thread()
+
+    def _iter_thread(self) -> Iterator[dict]:
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_batches)
         stop = threading.Event()
 
         def put(item) -> bool:
-            """Bounded put that aborts when the consumer is gone — a plain
-            q.put() blocks forever once the consumer breaks out of the
-            iterator with the queue full (the finally-block's stop.set()
-            can't unblock a thread already inside q.put), leaking one
-            producer thread and its buffered batches per abandoned
-            iteration (e.g. every early-stopped validation pass)."""
-            while True:
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    if stop.is_set():
-                        return False
+            # Without the abort, an abandoned iteration (e.g. every
+            # early-stopped validation pass) leaks one producer thread and
+            # its buffered batches.
+            return _bounded_put(q, item, stop)
 
         def produce():
             samples = []

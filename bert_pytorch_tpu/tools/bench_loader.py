@@ -1,0 +1,96 @@
+"""Host input-pipeline throughput bench: is the loader fast enough to feed
+the chips?
+
+The reference feeds GPUs with 4 DataLoader worker processes
+(run_pretraining.py:394-395). The TPU-side question is concrete: a host
+with N chips needs ``N x per-chip seq/s`` sustained from the loader
+(e.g. ~400 seq/s/chip for BERT-large phase-1 on v5e, BENCH numbers).
+This tool measures the real pipeline — ShardedPretrainingDataset streaming
++ dynamic masking + collate through DataLoader — on synthetic shards and
+prints one JSON line per worker setting, so headroom claims are
+reproducible instead of asserted.
+
+Usage:
+  python -m bert_pytorch_tpu.tools.bench_loader [--seq_len 128]
+      [--batch_size 64] [--workers 0 1 2 4] [--samples 16384]
+      [--input_dir DIR]       # measure real shards instead of synthetic
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+from pathlib import Path
+
+
+def bench_one(files, num_workers: int, batch_size: int, vocab: int,
+              warmup_batches: int = 4) -> dict:
+    from bert_pytorch_tpu.data import (
+        DataLoader,
+        DistributedSampler,
+        ShardedPretrainingDataset,
+    )
+
+    ds = ShardedPretrainingDataset(
+        files, 4, max_pred_per_seq=76, masked_lm_prob=0.15,
+        vocab_size=vocab, seed=0)
+    sampler = DistributedSampler(ds, 1, 0)
+    loader = DataLoader(ds, sampler, batch_size=batch_size,
+                        num_workers=num_workers)
+    total_batches = len(loader)
+    if total_batches < warmup_batches + 2:
+        raise ValueError(
+            f"need at least {warmup_batches + 2} batches to measure "
+            f"(warmup {warmup_batches} + a timing window), got "
+            f"{total_batches}; lower --batch_size or raise --samples")
+    n, start = 0, None
+    for i, batch in enumerate(loader):
+        if i == warmup_batches:  # spawn/prefetch startup out of the window
+            start = time.perf_counter()
+        elif i > warmup_batches:
+            n += batch["input_ids"].shape[0]
+    elapsed = time.perf_counter() - start
+    return {
+        "metric": "loader_seq_per_sec",
+        "num_workers": num_workers,
+        "batch_size": batch_size,
+        "seq_len": int(batch["input_ids"].shape[1]),
+        "value": round(n / elapsed, 1),
+        "unit": "seq/s/host",
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq_len", type=int, default=128)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--samples", type=int, default=16384)
+    p.add_argument("--vocab_size", type=int, default=30528)
+    p.add_argument("--workers", type=int, nargs="+", default=[0, 1, 2, 4])
+    p.add_argument("--input_dir", default=None,
+                   help="existing HDF5 shard dir (default: synthesize)")
+    args = p.parse_args()
+
+    if args.input_dir:
+        files = sorted(
+            str(f) for f in Path(args.input_dir).rglob("*.hdf5"))
+    else:
+        from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
+
+        d = tempfile.mkdtemp(prefix="bench_loader_")
+        per_shard = args.samples // 4
+        files = [
+            make_shard(os.path.join(d, f"s{i}.hdf5"), per_shard,
+                       args.seq_len, args.vocab_size, seed=i)
+            for i in range(4)
+        ]
+    for w in args.workers:
+        print(json.dumps(bench_one(
+            files, w, args.batch_size, args.vocab_size)), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,12 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     # masking
     parser.add_argument("--max_predictions_per_seq", type=int, default=20)
     parser.add_argument("--masked_token_fraction", type=float, default=0.15)
+    parser.add_argument(
+        "--num_workers", type=int, default=0,
+        help="DataLoader producer processes (reference run_pretraining.py:"
+             "394-395 num_workers=4). 0 = single background thread, which "
+             "the vectorized masking path makes sufficient for several "
+             "chips (tools/bench_loader.py); use >0 on many-chip hosts.")
     # held-out evaluation (beyond the reference, which never evaluates
     # during pretraining; uses pretrain.make_eval_step)
     parser.add_argument("--val_input_dir", type=str, default=None,
@@ -323,7 +329,8 @@ def prepare_dataset(args, config, checkpoint):
     if checkpoint is not None and "sampler" in checkpoint:
         sampler.load_state_dict(checkpoint["sampler"])
     loader = DataLoader(dataset, sampler,
-                        batch_size=args.host_batch_per_step, drop_last=True)
+                        batch_size=args.host_batch_per_step, drop_last=True,
+                        num_workers=args.num_workers)
     logger.info(f"Samples in dataset: {len(dataset)}")
     logger.info(f"Samples per process: {len(sampler)}")
     logger.info(f"Sampler starting index: {sampler.index}")

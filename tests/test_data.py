@@ -53,11 +53,34 @@ def test_sequential_iteration_crosses_files(shards):
     assert seen == 96
 
 
-def test_out_of_order_access_raises(shards):
+def test_index_past_dataset_end_raises(shards):
     ds = _dataset(shards)
     ds[0]
-    with pytest.raises(RuntimeError, match="out of range"):
-        ds[70]  # skips into the third file out of order
+    with pytest.raises(ValueError, match="exceeds dataset size"):
+        ds[96]
+
+
+def test_forward_skip_across_files_allowed(shards):
+    """Strided readers (multi-process DataLoader workers take every Nth
+    batch) may skip whole shards going forward."""
+    ds = _dataset(shards)
+    first = ds[0]
+    third_file = ds[70]  # skips the entire second file
+    assert len(first) == len(third_file) == 5
+    last = ds[95]
+    assert len(last) == 5
+
+
+def test_epoch_wrap_mid_dataset_chunk(shards):
+    """A rank whose contiguous chunk starts mid-dataset must be able to
+    restart its chunk after an epoch (chunk end -> chunk start walks the
+    cyclic file sequence through the wrap). The reference's one-swap
+    invariant check rejected exactly this legal restart."""
+    ds = _dataset(shards)
+    sampler = DistributedSampler(ds, 2, 1)  # chunk = indices 48..95
+    epoch1 = [ds[i] for i in sampler]
+    epoch2 = [ds[i] for i in sampler]  # restart at 48 from file 2
+    assert len(epoch1) == len(epoch2) == 48
 
 
 def test_segment_and_mask_derivation():
@@ -205,3 +228,68 @@ def test_loader_producer_exits_on_abandoned_iteration(tmp_path):
             break
         time.sleep(0.05)
     assert not leaked, [t.name for t in leaked]
+
+
+# ---------------------------------------------------------------------------
+# Multi-process DataLoader (num_workers > 0): order-exact vs the thread path
+# (reference run_pretraining.py:394-395 num_workers=4 parity)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def legacy_shards(tmp_path_factory):
+    """Legacy pre-masked shards: __getitem__ is fully DETERMINISTIC (no
+    masking RNG), so thread and process paths must be byte-identical."""
+    d = tmp_path_factory.mktemp("legacy_mp")
+    return [
+        make_shard(str(d / f"l{i}.hdf5"), 24, 32, VOCAB, seed=50 + i,
+                   legacy=True)
+        for i in range(3)
+    ]
+
+
+def test_loader_multiprocess_matches_thread_order(legacy_shards):
+    ds = _dataset(legacy_shards)
+    sampler = DistributedSampler(ds, 1, 0)
+    expect = list(DataLoader(ds, sampler, batch_size=8, num_workers=0))
+    ds2 = _dataset(legacy_shards)
+    sampler2 = DistributedSampler(ds2, 1, 0)
+    got = list(DataLoader(ds2, sampler2, batch_size=8, num_workers=2))
+    assert len(got) == len(expect) == 9
+    for b_t, b_p in zip(expect, got):
+        for key in b_t:
+            np.testing.assert_array_equal(b_t[key], b_p[key], err_msg=key)
+    # epoch completed: live index reset exactly like the thread path
+    assert sampler2.index == 0
+
+
+def test_loader_multiprocess_sampler_index_tracks_delivery(legacy_shards):
+    ds = _dataset(legacy_shards)
+    sampler = DistributedSampler(ds, 1, 0)
+    loader = DataLoader(ds, sampler, batch_size=8, num_workers=2)
+    it = iter(loader)
+    next(it)
+    next(it)
+    assert sampler.index == 16  # 2 delivered batches x 8
+    it.close()  # abandon mid-epoch; workers must shut down
+
+
+def test_loader_multiprocess_propagates_worker_errors(legacy_shards):
+    ds = _dataset(legacy_shards)
+
+    class OutOfRangeSampler:
+        def __init__(self, n):
+            self.n = n
+            self.index = 0
+
+        def __iter__(self):
+            # dataset has 72 samples; index 10_000 explodes in the worker
+            yield from list(range(8)) + [10_000] * 8
+
+        def __len__(self):
+            return self.n
+
+    loader = DataLoader(ds, OutOfRangeSampler(16), batch_size=8,
+                        num_workers=2)
+    with pytest.raises(RuntimeError, match="worker"):
+        list(loader)
