@@ -138,6 +138,20 @@ _STRATEGY_RULES = {
         ("kv", None),
         ("mlp", "model"),
     ],
+    # pipeline + tensor parallel composed: stage blocks over 'pipe', each
+    # stage's matmuls split over 'model'. The pipeline engine runs 'pipe'
+    # manually (explicit ppermute) and leaves 'model' to the compiler
+    # (shard_map axis_names={'pipe'}), so these are the tp rules plus the
+    # pipe-stacked layer axis.
+    "pp_tp": [
+        ("layers", "pipe"),
+        ("embed", None),
+        ("embed_out", "model"),
+        ("vocab", "model"),
+        ("heads", "model"),
+        ("kv", None),
+        ("mlp", "model"),
+    ],
 }
 
 

@@ -15,10 +15,14 @@ ticks; every stage applies its layer block each tick (bubble fraction
 which reverses the rotation into the symmetric backward pipeline. Combine
 with ``remat`` so each stage keeps only block boundaries alive.
 
-Composition: 'pipe' composes with 'data'/'fsdp' batch sharding (specs carry
-the batch axis through shard_map untouched). 'seq' (ring attention) and
-'model' (tensor parallel) inside a pipeline stage are not supported in this
-version — the engine raises rather than silently densify/replicate.
+Composition: only 'pipe' is MANUAL (``shard_map(axis_names={'pipe'})``) —
+every other mesh axis stays automatic, so 'data'/'fsdp' batch sharding and
+'model' tensor parallelism inside a stage compose for free: the stage's
+matmuls see model-sharded weights (the 'pp_tp' rules) and GSPMD inserts the
+tensor-parallel collectives, while the stage-to-stage rotation stays an
+explicit ``ppermute``. 'seq' (ring attention) is the one exception — its
+own manual collective would nest inside this one — and the engine raises
+rather than silently densify/replicate.
 """
 
 from __future__ import annotations
@@ -49,7 +53,6 @@ def gpipe(
     mesh: Mesh,
     replicated: Any = None,
     axis: str = "pipe",
-    batch_spec=("data", "fsdp"),
 ) -> jax.Array:
     """Run ``x`` microbatches through the pipelined layer stack.
 
@@ -59,9 +62,10 @@ def gpipe(
         ``mb_idx`` is the microbatch index (for PRNG folding); during bubble
         ticks it is clipped garbage and the result is discarded.
       stacked_params: pytree with leaves ``[L, ...]``, sharded over ``axis``
-        on dim 0 (the 'pp' rules in parallel/mesh.py).
-      x: ``[M, B, ...]`` microbatched activations, batch sharded over
-        ``batch_spec``, replicated over ``axis``.
+        on dim 0 (the 'pp'/'pp_tp' rules in parallel/mesh.py); any 'model'
+        sharding on other dims flows through the automatic axes.
+      x: ``[M, B, ...]`` microbatched activations, replicated over ``axis``;
+        batch sharding over 'data'/'fsdp' flows through automatically.
       consts: pytree of per-microbatch side inputs (e.g. the attention bias),
         leaves ``[M, B, ...]``, sharded like ``x``.
       mesh: the device mesh; ``mesh.shape[axis]`` is the stage count.
@@ -80,31 +84,35 @@ def gpipe(
             f"need at least as many microbatches as pipeline stages: "
             f"{n_mb} < {n_stages} (the bubble would dominate anyway)"
         )
-    for off_axis in ("seq", "model"):
-        if mesh.shape.get(off_axis, 1) > 1:
-            raise ValueError(
-                f"pipeline parallelism does not compose with the '{off_axis}' "
-                "mesh axis in this version"
-            )
+    if mesh.shape.get("seq", 1) > 1:
+        raise ValueError(
+            "pipeline parallelism does not compose with the 'seq' mesh axis "
+            "(ring attention is its own manual collective; it cannot nest "
+            "inside the pipeline's shard_map)"
+        )
 
+    # Only 'pipe' is manual: specs mention nothing but the stacked-layer
+    # axis, and every other mesh axis (data/fsdp batch sharding, 'model'
+    # tensor parallelism) keeps flowing through GSPMD automatically.
     def param_spec(leaf):
         return P(axis, *(None,) * (leaf.ndim - 1))
 
-    def act_spec(leaf):
-        return P(None, batch_spec, *(None,) * (leaf.ndim - 2))
+    def rep_spec(leaf):
+        return P(*(None,) * leaf.ndim)
 
     in_specs = (
         jax.tree_util.tree_map(param_spec, stacked_params),
-        act_spec(x),
-        jax.tree_util.tree_map(act_spec, consts),
-        jax.tree_util.tree_map(lambda r: P(*(None,) * r.ndim), replicated),
+        rep_spec(x),
+        jax.tree_util.tree_map(rep_spec, consts),
+        jax.tree_util.tree_map(rep_spec, replicated),
     )
 
     @partial(
         shard_map,
         mesh=mesh,
+        axis_names=frozenset({axis}),
         in_specs=in_specs,
-        out_specs=act_spec(x),
+        out_specs=rep_spec(x),
     )
     def run(local_params, x_local, consts_local, replicated_local):
         stage = jax.lax.axis_index(axis)

@@ -148,14 +148,15 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     parser.add_argument("--mesh_data", type=int, default=-1)
     parser.add_argument("--mesh_fsdp", type=int, default=1)
     parser.add_argument("--mesh_pipe", type=int, default=1,
-                        help="pipeline stages (with --parallel_strategy pp; "
+                        help="pipeline stages (with --parallel_strategy "
+                             "pp/pp_tp; "
                              "accumulation microbatches become the GPipe "
                              "microbatches, so accumulation_steps must be "
                              ">= stages)")
     parser.add_argument("--mesh_seq", type=int, default=1)
     parser.add_argument("--mesh_model", type=int, default=1)
     parser.add_argument("--parallel_strategy", type=str, default="dp",
-                        choices=["dp", "fsdp", "tp", "tp_fsdp", "sp", "pp"])
+                        choices=["dp", "fsdp", "tp", "tp_fsdp", "sp", "pp", "pp_tp"])
     parser.add_argument("--seed", type=int, default=42)
 
     args = parse_args_with_config_file(parser, argv)
@@ -212,11 +213,12 @@ def setup_training(args):
             f"local_batch_size*data_shards={global_microbatch}"
         )
     args.accumulation_steps = args.global_batch_size // global_microbatch
-    if args.mesh_pipe > 1 and args.parallel_strategy != "pp":
+    if args.mesh_pipe > 1 and args.parallel_strategy not in ("pp", "pp_tp"):
         # Without the pp rules the layer stack REPLICATES over the pipe axis
         # and those devices duplicate work — never what anyone wants.
         raise ValueError(
-            f"--mesh_pipe {args.mesh_pipe} requires --parallel_strategy pp")
+            f"--mesh_pipe {args.mesh_pipe} requires --parallel_strategy "
+            "pp or pp_tp")
     if (args.parallel_strategy == "sp" and mesh.shape["seq"] > 1
             and args.attention_backend != "ring"):
         # sp exists to avoid O(S^2) dense attention; never silently densify
@@ -441,14 +443,26 @@ def main(args) -> dict:
                 f"factor_interval={args.kfac_factor_interval}, "
                 f"inv_interval={args.kfac_inv_interval}")
 
-        if args.parallel_strategy == "pp":
+        if args.parallel_strategy in ("pp", "pp_tp"):
             if kfac_obj is not None:
                 raise ValueError(
                     "K-FAC does not compose with pipeline parallelism")
             if mesh.shape["pipe"] < 2:
                 raise ValueError(
-                    "--parallel_strategy pp needs --mesh_pipe >= 2 (a "
+                    "--parallel_strategy pp/pp_tp needs --mesh_pipe >= 2 (a "
                     "1-stage pipeline is just dp with schedule overhead)")
+            if args.parallel_strategy == "pp_tp" and mesh.shape["model"] < 2:
+                raise ValueError(
+                    "--parallel_strategy pp_tp needs --mesh_model >= 2 "
+                    "(with one model shard use plain pp)")
+            if args.parallel_strategy == "pp" and mesh.shape["model"] > 1:
+                # The engine would run, but the 'pp' rules replicate every
+                # weight over the model axis: identical work on every model
+                # shard at 1/model throughput — never what anyone wants.
+                raise ValueError(
+                    f"--mesh_model {mesh.shape['model']} with "
+                    "--parallel_strategy pp replicates all stage weights "
+                    "over the model axis; use --parallel_strategy pp_tp")
             if args.accumulation_steps < mesh.shape["pipe"]:
                 raise ValueError(
                     f"pp needs accumulation_steps >= pipeline stages "

@@ -73,7 +73,10 @@ echo "== 6. pretrain"
 # per-chip batch is PRETRAIN_BATCH/8).
 NDEV=$(python -c "import jax; print(len(jax.devices()))")
 LOCAL_BATCH=$((PRETRAIN_BATCH / NDEV))
-if [ "$LOCAL_BATCH" -lt 1 ]; then LOCAL_BATCH=1; PRETRAIN_BATCH=$NDEV; fi
+if [ "$LOCAL_BATCH" -lt 1 ]; then LOCAL_BATCH=1; fi
+# round the global batch to LOCAL*NDEV so the divisibility check always
+# holds (e.g. 16 samples on 6 devices -> local 2, global 12)
+PRETRAIN_BATCH=$((LOCAL_BATCH * NDEV))
 python run_pretraining.py --input_dir "$W/encoded" \
     --output_dir "$W/pretrain" \
     --model_config_file "$W/model.json" \

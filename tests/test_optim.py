@@ -152,3 +152,123 @@ def test_adamw_converges_quadratic():
     for _ in range(200):
         params, state = step(params, state)
     np.testing.assert_allclose(np.asarray(params["x"]), np.full(2, 3.0), atol=0.05)
+
+
+# ---------------------------------------------------------------------------
+# LAMB cross-validation against an INDEPENDENT implementation (optax.lamb)
+# — not the in-repo numpy re-derivation — plus the trust-ratio edge cases
+# where large-batch runs go wrong (apex FusedLAMB semantics,
+# reference run_pretraining.py:295).
+# ---------------------------------------------------------------------------
+
+
+def _lamb_tree():
+    rng = np.random.default_rng(3)
+    params = {
+        "dense": {
+            "kernel": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32),
+            "bias": jnp.asarray(rng.normal(size=(4,)), jnp.float32),
+        },
+        "zero_init": {"kernel": jnp.zeros((4, 4), jnp.float32)},
+        "layer_norm": {"scale": jnp.ones((4,), jnp.float32)},
+    }
+    def grads_for(step):
+        g = np.random.default_rng(100 + step)
+        return jax.tree_util.tree_map(
+            lambda p: jnp.asarray(g.normal(size=p.shape), jnp.float32), params)
+    return params, grads_for
+
+
+def test_lamb_matches_optax_lamb_multi_step():
+    """Same updates as optax.lamb (independent implementation: its own
+    scale_by_adam / add_decayed_weights / scale_by_trust_ratio chain) over
+    several steps, including a zero-initialized param and a masked
+    (no-decay) LayerNorm scale."""
+    import optax as ox
+
+    from bert_pytorch_tpu import optim
+
+    params, grads_for = _lamb_tree()
+    mask = optim.no_decay_mask(params)
+    wd, lr = 0.01, 3e-3
+
+    ours = optim.lamb(lr, weight_decay=wd, weight_decay_mask=mask,
+                      max_grad_norm=None)
+    theirs = ox.lamb(lr, weight_decay=wd, mask=mask)
+
+    p_a, p_b = params, params
+    s_a, s_b = ours.init(params), theirs.init(params)
+    for step in range(5):
+        g = grads_for(step)
+        u_a, s_a = ours.update(g, s_a, p_a)
+        u_b, s_b = theirs.update(g, s_b, p_b)
+        for path_a, path_b in zip(
+                jax.tree_util.tree_leaves_with_path(u_a),
+                jax.tree_util.tree_leaves_with_path(u_b)):
+            np.testing.assert_allclose(
+                path_a[1], path_b[1], rtol=2e-5, atol=1e-7,
+                err_msg=f"step {step} {path_a[0]}")
+        p_a = ox.apply_updates(p_a, u_a)
+        p_b = ox.apply_updates(p_b, u_b)
+
+
+def test_lamb_trust_ratio_zero_param_norm():
+    """A zero-initialized parameter has ||p||=0: the trust ratio must be 1
+    (not 0, which would freeze the parameter forever)."""
+    from bert_pytorch_tpu import optim
+
+    params = {"w": jnp.zeros((4,), jnp.float32)}
+    grads = {"w": jnp.ones((4,), jnp.float32)}
+    tx = optim.lamb(1.0, weight_decay=0.0, max_grad_norm=None,
+                    bias_correction=True)
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    # step 1, bias-corrected adam of constant grad = g/(|g|+eps) ~= sign(g);
+    # ratio 1 => update = -lr * 1 * ~1
+    np.testing.assert_allclose(updates["w"], -np.ones(4), rtol=1e-4)
+
+
+def test_lamb_trust_ratio_zero_update_norm():
+    """Zero gradient + zero moments + no decay => zero update norm: ratio
+    must be 1 and the update exactly zero (no NaN from 0/0)."""
+    from bert_pytorch_tpu import optim
+
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    grads = {"w": jnp.zeros((4,), jnp.float32)}
+    tx = optim.lamb(1.0, weight_decay=0.0, max_grad_norm=None)
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    assert np.all(np.isfinite(updates["w"]))
+    np.testing.assert_array_equal(updates["w"], np.zeros(4))
+
+
+def test_lamb_excluded_group_gets_no_decay():
+    """The no-decay group (bias/LayerNorm) must see pure Adam+trust-ratio:
+    with zero grads, a decayed param moves and an excluded one does not."""
+    from bert_pytorch_tpu import optim
+
+    params = {"dense": {"kernel": jnp.ones((3,), jnp.float32),
+                        "bias": jnp.ones((3,), jnp.float32)}}
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+    tx = optim.lamb(1e-2, weight_decay=0.1,
+                    weight_decay_mask=optim.no_decay_mask(params),
+                    max_grad_norm=None)
+    state = tx.init(params)
+    updates, _ = tx.update(grads, state, params)
+    assert np.all(updates["dense"]["kernel"] != 0)  # wd-driven update
+    np.testing.assert_array_equal(updates["dense"]["bias"], np.zeros(3))
+
+
+def test_lamb_global_norm_clip_scales_to_max():
+    from bert_pytorch_tpu import optim
+    from bert_pytorch_tpu.ops.grad_utils import global_norm
+
+    params = {"w": jnp.ones((16,), jnp.float32)}
+    grads = {"w": jnp.full((16,), 100.0, jnp.float32)}  # norm 400
+    tx = optim.lamb(1e-3, max_grad_norm=1.0, weight_decay=0.0)
+    state = tx.init(params)
+    _, new_state = tx.update(grads, state, params)
+    # the clipped gradient (norm 1.0) is what enters the moments:
+    # mu = (1-b1) * g_clipped => ||mu|| = 0.1 * 1.0
+    np.testing.assert_allclose(
+        float(global_norm(new_state.mu)), 0.1, rtol=1e-4)

@@ -213,6 +213,9 @@ def test_pp_train_step_matches_dp(tiny_config, devices):
     for name, meshcfg, strategy in [
         ("dp", MeshConfig(data=4), "dp"),
         ("pp", MeshConfig(data=2, pipe=2), "pp"),
+        # pipeline x tensor parallel: 'pipe' manual, 'model' automatic
+        # (each stage's matmuls split over 2 model shards)
+        ("pp_tp", MeshConfig(data=1, pipe=2, model=2), "pp_tp"),
     ]:
         mesh = create_mesh(meshcfg, devices=jax.devices()[: 4])
         rules = logical_axis_rules(strategy)
@@ -227,7 +230,7 @@ def test_pp_train_step_matches_dp(tiny_config, devices):
             state = pretrain.make_init_fn(model, tx, sample, shardings)(
                 jax.random.PRNGKey(5)
             )
-            if name == "pp":
+            if name.startswith("pp"):
                 step = pretrain.make_pp_train_step(
                     model, tx, mesh, schedule=schedule, next_sentence=True,
                     shardings=shardings, batch_shardings_=b_shardings,
@@ -245,19 +248,20 @@ def test_pp_train_step_matches_dp(tiny_config, devices):
             )
 
     loss_dp, params_dp = results["dp"]
-    loss_pp, params_pp = results["pp"]
+    flat_dp = jax.tree_util.tree_leaves_with_path(params_dp)
     # Dropout draws differ between the paths (different rng folding), so
     # compare with dropout effectively disabled via the config used here:
-    np.testing.assert_allclose(loss_pp, loss_dp, rtol=1e-5)
-    flat_dp = jax.tree_util.tree_leaves_with_path(params_dp)
-    flat_pp = dict(
-        (jax.tree_util.keystr(kp), leaf)
-        for kp, leaf in jax.tree_util.tree_leaves_with_path(params_pp)
-    )
-    for kp, leaf in flat_dp:
-        np.testing.assert_allclose(
-            np.asarray(flat_pp[jax.tree_util.keystr(kp)]),
-            np.asarray(leaf),
-            atol=2e-5,
-            err_msg=jax.tree_util.keystr(kp),
+    for name in ("pp", "pp_tp"):
+        loss_x, params_x = results[name]
+        np.testing.assert_allclose(loss_x, loss_dp, rtol=1e-5, err_msg=name)
+        flat_x = dict(
+            (jax.tree_util.keystr(kp), leaf)
+            for kp, leaf in jax.tree_util.tree_leaves_with_path(params_x)
         )
+        for kp, leaf in flat_dp:
+            np.testing.assert_allclose(
+                np.asarray(flat_x[jax.tree_util.keystr(kp)]),
+                np.asarray(leaf),
+                atol=2e-5,
+                err_msg=f"{name} {jax.tree_util.keystr(kp)}",
+            )
