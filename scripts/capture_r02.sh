@@ -1,0 +1,51 @@
+#!/bin/bash
+# One-command on-chip evidence capture for round 2 (VERDICT r1 next-steps
+# 1, 2, 6, 8): bench JSONs with MFU, the LAMB-vs-K-FAC convergence CSV, the
+# hardware smoke, and the full offline pretrain->finetune->eval chain.
+#
+#   bash scripts/capture_r02.sh [logdir]
+#
+# No `set -e`: each leg runs regardless of earlier failures so a transient
+# backend drop costs one artifact, not the whole capture. Exit code is the
+# number of failed legs.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+LOGS=${1:-/tmp/capture_r02}
+mkdir -p "$LOGS"
+failures=0
+
+leg () {  # name, cmd...
+  local name=$1; shift
+  echo "== capture leg: $name"
+  if "$@" > "$LOGS/$name.log" 2>&1; then
+    echo "   OK ($name)"
+  else
+    echo "   FAILED ($name) — tail:"; tail -5 "$LOGS/$name.log"
+    failures=$((failures + 1))
+  fi
+}
+
+bench_leg () {  # name, env pairs...
+  local name=$1; shift
+  echo "== capture leg: $name"
+  if env "$@" python bench.py > "$LOGS/$name.json" 2> "$LOGS/$name.log"; then
+    echo "   $(cat "$LOGS/$name.json")"
+  else
+    echo "   FAILED ($name) — $(tail -2 "$LOGS/$name.log" | head -1)"
+    failures=$((failures + 1))
+  fi
+}
+
+bench_leg bench_phase1 BENCH_PHASE=1
+bench_leg bench_phase2 BENCH_PHASE=2
+bench_leg bench_kfac BENCH_KFAC=1
+bench_leg bench_seq1024 BENCH_SEQ=1024
+cp "$LOGS"/bench_*.json . 2>/dev/null || true
+
+leg convergence bash scripts/convergence_r02.sh /tmp/bert_conv_r02 \
+    CONVERGENCE_r02.csv
+leg smoke_and_e2e bash scripts/smoke_tpu.sh /tmp/bert_tpu_smoke_r02
+
+echo "capture_r02 done: $failures failed legs; logs in $LOGS"
+ls -la BENCH*.json bench_*.json CONVERGENCE_r02.csv E2E_r02.json 2>/dev/null
+exit "$failures"
