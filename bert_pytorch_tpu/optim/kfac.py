@@ -96,6 +96,26 @@ def _flat_key(path: Tuple[str, ...]) -> str:
     return "/".join(path)
 
 
+def _retain_shardings(new_state, like_state):
+    """Pin ``new_state``'s leaves to ``like_state``'s shardings.
+
+    The factor/inverse updates are jitted WITHOUT out_shardings, so GSPMD
+    propagation chooses their output layouts — which can drift from the
+    :func:`kfac_state_shardings` layout the (separately jitted) train step
+    declares for its kfac_state argument: a hard in_shardings mismatch
+    error under meshes with extra axes (e.g. K-FAC x pipeline). The
+    device_put back to the input's sharding is a no-op when layouts agree.
+    """
+
+    def put(n, like):
+        s = getattr(like, "sharding", None)
+        if s is None or not hasattr(n, "sharding") or n.sharding == s:
+            return n
+        return jax.device_put(n, s)
+
+    return jax.tree_util.tree_map(put, new_state, like_state)
+
+
 def _unwrap_sown(leaf):
     """sow() stores values as a tuple per call-site; taps fire once."""
     if isinstance(leaf, tuple):
@@ -274,7 +294,8 @@ class KFAC:
             self._update_cache[key] = jax.jit(
                 self._build_update_impl(tap_shapes)
             )
-        return self._update_cache[key](state, params, batch, rng)
+        return _retain_shardings(
+            self._update_cache[key](state, params, batch, rng), state)
 
     def _build_update_impl(self, tap_shapes):
         def impl(state, params, batch, rng):
@@ -374,7 +395,7 @@ class KFAC:
                 return state.replace(qa=qa, la=la, qg=qg, lg=lg)
 
             self._inv_jit = jax.jit(impl)
-        return self._inv_jit(state)
+        return _retain_shardings(self._inv_jit(state), state)
 
     # --------------------------------------------------------- precondition
 

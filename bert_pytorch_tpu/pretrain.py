@@ -150,6 +150,23 @@ def make_kfac_fns(
     return apply_loss, tap_shape_fn
 
 
+def _jit_train_step(step_fn, shardings, batch_shardings_, kfac,
+                    kfac_shardings):
+    """Shared jit dispatch for the train-step builders: donated state,
+    declared shardings, and the optional kfac_state third argument."""
+    if shardings is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    in_shardings = (shardings, batch_shardings_)
+    if kfac is not None:
+        in_shardings = in_shardings + (kfac_shardings,)
+    return jax.jit(
+        step_fn,
+        donate_argnums=(0,),
+        in_shardings=in_shardings,
+        out_shardings=(shardings, None),
+    )
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -242,21 +259,8 @@ def make_train_step(
             metrics["learning_rate"] = schedule(state.opt_state.count)
         return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
 
-    if shardings is None:
-        return jax.jit(step_fn, donate_argnums=(0,))
-    if kfac is None:
-        return jax.jit(
-            step_fn,
-            donate_argnums=(0,),
-            in_shardings=(shardings, batch_shardings_),
-            out_shardings=(shardings, None),
-        )
-    return jax.jit(
-        step_fn,
-        donate_argnums=(0,),
-        in_shardings=(shardings, batch_shardings_, kfac_shardings),
-        out_shardings=(shardings, None),
-    )
+    return _jit_train_step(
+        step_fn, shardings, batch_shardings_, kfac, kfac_shardings)
 
 
 def make_pp_train_step(
@@ -268,9 +272,20 @@ def make_pp_train_step(
     shardings: Optional[TrainState] = None,
     batch_shardings_: Optional[dict] = None,
     max_pred_per_seq: Optional[int] = None,
+    kfac=None,
+    kfac_shardings=None,
 ):
     """Train step with the encoder executed as a GPipe pipeline over the
     mesh 'pipe' axis (parallel/pipeline.py).
+
+    When ``kfac`` is given the step takes a third ``kfac_state`` argument
+    and preconditions the pipeline-accumulated gradients before the
+    optimizer update, exactly as in :func:`make_train_step` — the
+    preconditioner is a pure per-layer solve over the stacked factors, so
+    it composes with the pipe-sharded gradient layout (XLA reshards). The
+    factor/inverse cadence runs OUTSIDE this step on the tapped non-pp
+    model (the runner's pattern), which under automatic sharding reads the
+    pipe-sharded params directly.
 
     The accumulation microbatches ([A, B, ...] stacked batch) ARE the
     pipeline microbatches: instead of ``lax.scan``-ing them sequentially
@@ -415,11 +430,18 @@ def make_pp_train_step(
         accs = jax.vmap(mlm_accuracy)(unflat(mlm_logits), unflat(labels))
         return jnp.mean(losses), jnp.mean(accs)
 
-    def step_fn(state: TrainState, batch: dict):
+    if kfac is not None and schedule is None:
+        raise ValueError("kfac preconditioning requires a schedule")
+
+    def step_fn(state: TrainState, batch: dict, kfac_state=None):
         step_rng, new_rng = jax.random.split(state.rng)
         (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, batch, step_rng
         )
+        if kfac is not None:
+            grads = kfac.precondition(
+                kfac_state, grads, schedule(state.opt_state.count)
+            )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {
@@ -431,14 +453,8 @@ def make_pp_train_step(
             metrics["learning_rate"] = schedule(state.opt_state.count)
         return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
 
-    if shardings is None:
-        return jax.jit(step_fn, donate_argnums=(0,))
-    return jax.jit(
-        step_fn,
-        donate_argnums=(0,),
-        in_shardings=(shardings, batch_shardings_),
-        out_shardings=(shardings, None),
-    )
+    return _jit_train_step(
+        step_fn, shardings, batch_shardings_, kfac, kfac_shardings)
 
 
 def make_eval_step(model, next_sentence: bool = True):

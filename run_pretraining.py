@@ -444,9 +444,6 @@ def main(args) -> dict:
                 f"inv_interval={args.kfac_inv_interval}")
 
         if args.parallel_strategy in ("pp", "pp_tp"):
-            if kfac_obj is not None:
-                raise ValueError(
-                    "K-FAC does not compose with pipeline parallelism")
             if mesh.shape["pipe"] < 2:
                 raise ValueError(
                     "--parallel_strategy pp/pp_tp needs --mesh_pipe >= 2 (a "
@@ -472,7 +469,8 @@ def main(args) -> dict:
                 model, tx, mesh, schedule=schedule,
                 next_sentence=bool(config.next_sentence),
                 shardings=shardings, batch_shardings_=b_shardings,
-                max_pred_per_seq=args.max_predictions_per_seq)
+                max_pred_per_seq=args.max_predictions_per_seq,
+                kfac=kfac_obj, kfac_shardings=kfac_shardings)
         else:
             train_step = pretrain.make_train_step(
                 model, tx, schedule=schedule,

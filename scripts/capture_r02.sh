@@ -30,6 +30,9 @@ bench_leg () {  # name, env pairs...
   echo "== capture leg: $name"
   if env "$@" python bench.py > "$LOGS/$name.json" 2> "$LOGS/$name.log"; then
     echo "   $(cat "$LOGS/$name.json")"
+    # Only successful legs become repo-root artifacts: a failed leg's
+    # error JSON must never clobber a previously captured good number.
+    cp "$LOGS/$name.json" .
   else
     echo "   FAILED ($name) — $(tail -2 "$LOGS/$name.log" | head -1)"
     failures=$((failures + 1))
@@ -40,7 +43,6 @@ bench_leg bench_phase1 BENCH_PHASE=1
 bench_leg bench_phase2 BENCH_PHASE=2
 bench_leg bench_kfac BENCH_KFAC=1
 bench_leg bench_seq1024 BENCH_SEQ=1024
-cp "$LOGS"/bench_*.json . 2>/dev/null || true
 
 leg convergence bash scripts/convergence_r02.sh /tmp/bert_conv_r02 \
     CONVERGENCE_r02.csv

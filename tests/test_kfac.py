@@ -230,3 +230,75 @@ def test_eigen_and_cholesky_agree_on_direction(setup):
         # ill-conditioned factors — this guards against sign flips and
         # garbage, not exact agreement.
         assert cos > 0.7, (spec.kernel_path, cos)
+
+
+def test_pp_train_step_with_kfac_matches_dp(setup, devices):
+    """K-FAC x pipeline: the preconditioned pp step must produce the same
+    loss and updated params as the preconditioned dp step from identical
+    initial state, factors, and data. Dropout is disabled for the
+    comparison (the two paths fold step PRNGs differently). Closes the
+    K-FAC composition asterisk (PARITY §2.2)."""
+    config, _, _, mb, _, _ = setup
+    cfg_dict = config.to_dict()
+    cfg_dict["hidden_dropout_prob"] = 0.0
+    cfg_dict["attention_probs_dropout_prob"] = 0.0
+    cfg = BertConfig.from_dict(cfg_dict)
+    model = BertForPreTraining(cfg, dtype=jnp.float32)
+    tapped = BertForPreTraining(cfg, dtype=jnp.float32, kfac_tap=True)
+    apply_loss, tap_shape_fn = pretrain.make_kfac_fns(tapped, True)
+    schedule = optim.warmup_poly_schedule(1e-3, 0.1, 100)
+    sample = (jnp.zeros((1, 16), jnp.int32),) * 3
+    n_mb = 2
+    host = pretrain.stack_microbatches(mb, n_mb)  # [2, 4, S] microbatches
+
+    results = {}
+    for name, meshcfg, strategy in [
+        ("dp", MeshConfig(data=4), "dp"),
+        ("pp", MeshConfig(data=2, pipe=2), "pp"),
+        ("pp_tp", MeshConfig(data=1, pipe=2, model=2), "pp_tp"),
+    ]:
+        mesh = create_mesh(meshcfg, devices=jax.devices()[:4])
+        rules = logical_axis_rules(strategy)
+        kfac = optim.KFAC(apply_loss, tap_shape_fn)
+        tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+        with mesh:
+            shardings = pretrain.state_shardings(mesh, model, rules, sample)
+            b_shardings = pretrain.batch_shardings(
+                mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+                       "masked_lm_labels": 3, "next_sentence_labels": 2})
+            state = pretrain.make_init_fn(model, tx, sample, shardings)(
+                jax.random.PRNGKey(7))
+            kstate = kfac.init(jax.device_get(state.params), mb)
+            kshard = kfac_state_shardings(mesh, kstate)
+            kstate = jax.device_put(kstate, kshard)
+            kstate = kfac.update_factors(
+                kstate, state.params, mb, jax.random.PRNGKey(13))
+            kstate = kfac.update_inverses(kstate)
+            if name.startswith("pp"):
+                step = pretrain.make_pp_train_step(
+                    model, tx, mesh, schedule=schedule, next_sentence=True,
+                    shardings=shardings, batch_shardings_=b_shardings,
+                    max_pred_per_seq=8, kfac=kfac, kfac_shardings=kshard)
+            else:
+                step = pretrain.make_train_step(
+                    model, tx, schedule=schedule, next_sentence=True,
+                    shardings=shardings, batch_shardings_=b_shardings,
+                    max_pred_per_seq=8, kfac=kfac, kfac_shardings=kshard)
+            batch = pretrain.put_batch(host, b_shardings)
+            new_state, metrics = step(state, batch, kstate)
+            results[name] = (float(metrics["loss"]),
+                             jax.device_get(new_state.params))
+
+    loss_dp, params_dp = results["dp"]
+    flat_dp = jax.tree_util.tree_leaves_with_path(params_dp)
+    for name in ("pp", "pp_tp"):
+        loss_x, params_x = results[name]
+        np.testing.assert_allclose(loss_x, loss_dp, rtol=1e-5, err_msg=name)
+        flat_x = dict(
+            (jax.tree_util.keystr(kp), leaf)
+            for kp, leaf in jax.tree_util.tree_leaves_with_path(params_x))
+        for kp, leaf in flat_dp:
+            np.testing.assert_allclose(
+                np.asarray(flat_x[jax.tree_util.keystr(kp)]),
+                np.asarray(leaf),
+                atol=2e-5, err_msg=f"{name} {jax.tree_util.keystr(kp)}")
