@@ -293,3 +293,22 @@ def test_loader_multiprocess_propagates_worker_errors(legacy_shards):
                         num_workers=2)
     with pytest.raises(RuntimeError, match="worker"):
         list(loader)
+
+
+def test_loader_multiprocess_epoch_changes_masking(shards):
+    """Respawned workers must fold the EPOCH into their masking RNG seed:
+    without it every epoch replays identical masking draws (silently static
+    masking — defeating dynamic masking's purpose)."""
+    ds = _dataset(shards)
+    sampler = DistributedSampler(ds, 1, 0)
+    loader = DataLoader(ds, sampler, batch_size=8, num_workers=2)
+    sampler.set_epoch(0)
+    epoch0 = list(loader)
+    sampler.set_epoch(1)
+    epoch1 = list(loader)
+    # same underlying samples, different masked positions/replacements
+    assert len(epoch0) == len(epoch1)
+    same = all(
+        np.array_equal(a["masked_lm_labels"], b["masked_lm_labels"])
+        for a, b in zip(epoch0, epoch1))
+    assert not same, "masking draws repeated across epochs"
