@@ -40,15 +40,16 @@ def _ring_shard(
     rng: Optional[jnp.ndarray],
     *,
     axis_name: str,
-    rng_axes: tuple = (),
     dropout_rate: float = 0.0,
 ) -> jnp.ndarray:
-    """Per-shard body (runs inside shard_map).
+    """Per-shard body (runs inside shard_map, manual over ``axis_name``
+    ONLY — batch/head dims are global here, their sharding flows through
+    the automatic axes).
 
     q/k/v: [B, S_local, H, D]; kbias: [B, S_local] additive key bias.
-    ``rng_axes`` are the other mesh axes the inputs are sharded over —
-    folded into the dropout stream so every (batch shard, head shard,
-    q shard, k block) draws an independent mask.
+    The dropout stream folds in the seq-shard index so every (q shard,
+    k block) draws an independent mask; across the automatic batch/head
+    shards the partitionable PRNG decorrelates draws by position.
     """
     n = jax.lax.psum(1, axis_name)
     batch, s_q, heads, depth = q.shape
@@ -57,8 +58,6 @@ def _ring_shard(
 
     if dropout_rate > 0.0 and rng is not None:
         rng = jax.random.fold_in(rng, jax.lax.axis_index(axis_name))
-        for ax in rng_axes:
-            rng = jax.random.fold_in(rng, jax.lax.axis_index(ax))
 
     def block(k, v, kb, m, num, den, step):
         scores = jnp.einsum("bqhd,bkhd->bhqk", qs, k).astype(jnp.float32)
@@ -111,14 +110,22 @@ def ring_attention(
     dropout_rate: float = 0.0,
     mesh=None,
     seq_axis: str = "seq",
-    batch_axes=("data", "fsdp"),
-    heads_axis: str = "model",
 ) -> jnp.ndarray:
     """Sequence-sharded attention over global [B, S, H, D] tensors.
 
     ``bias`` is the [B, 1, 1, S] (or [B, S]) additive key mask from
     :func:`make_attention_bias`. Requires an ambient (or explicit) mesh with
     ``seq_axis`` size > 1; S must divide by that size.
+
+    Only ``seq_axis`` is manual: batch/head sharding flows through the
+    automatic axes. In principle that lets this collective nest inside
+    another partial-manual region over a different axis (the pipeline
+    engine's 'pipe' shard_map) — the nesting type-checks, but Shardy's
+    lowering currently rejects the composed BACKWARD pass, so
+    parallel/pipeline.py still refuses 'seq' meshes; see the guard there.
+    Inside a non-empty mesh context shard_map must infer the context mesh
+    (after consistency-checking it against the validation mesh); at top
+    level the concrete mesh is passed explicitly.
     """
     from bert_pytorch_tpu.parallel.mesh import current_mesh
 
@@ -139,28 +146,42 @@ def ring_attention(
     else:
         kbias = bias.reshape(batch, seq).astype(jnp.float32)
 
-    # Shard batch/heads only when they divide (model init traces at batch 1;
-    # replication there is free — it never runs real data).
-    n_batch = 1
-    for ax in batch_axes:
-        n_batch *= mesh.shape.get(ax, 1)
-    b_spec = batch_axes if n_batch > 1 and batch % n_batch == 0 else None
-    h_spec = (heads_axis
-              if heads % mesh.shape.get(heads_axis, 1) == 0 else None)
+    ctx = jax.sharding.get_abstract_mesh()
+    if not ctx.empty:
+        # shard_map must infer the (abstract) context mesh here; the
+        # explicit mesh was only used for validation above, so they must
+        # agree on the seq axis or the guards above checked the wrong mesh.
+        ctx_seq = dict(getattr(ctx, "shape", {})).get(seq_axis, 1)
+        if ctx_seq != mesh.shape[seq_axis]:
+            raise ValueError(
+                f"ring attention: the active mesh context has "
+                f"'{seq_axis}'={ctx_seq} but the explicit/ambient mesh has "
+                f"{mesh.shape[seq_axis]}; pass a consistent mesh")
+        fn = _ring_fn(None, seq_axis, dropout_rate, jitted=False)
+    else:
+        fn = _ring_fn(mesh, seq_axis, dropout_rate, jitted=True)
+    return fn(q, k, v, kbias, dropout_rng)
 
-    rng_axes = tuple(batch_axes) if b_spec is not None else ()
-    if h_spec is not None and mesh.shape.get(heads_axis, 1) > 1:
-        rng_axes = rng_axes + (heads_axis,)
 
-    qkv_spec = P(b_spec, seq_axis, h_spec, None)
+@functools.lru_cache(maxsize=16)
+def _ring_fn(mesh, seq_axis: str, dropout_rate: float, jitted: bool):
+    """Cached shard_map wrapper: rebuilding (and re-jitting) it per call
+    would recompile the identical computation on every EAGER invocation
+    (e.g. each of a 24-layer model.init's attention calls).
+
+    ``jitted=True`` wraps in jax.jit — partial-manual shard_map needs it
+    when invoked eagerly outside a trace; inside an outer trace the
+    wrapper is inlined. check_vma stays ON: disabling it erases the
+    varying-axes types autodiff needs for cotangents under nesting.
+    """
+    qkv_spec = P(None, seq_axis, None, None)
     fn = jax.shard_map(
         functools.partial(
-            _ring_shard, axis_name=seq_axis, rng_axes=rng_axes,
-            dropout_rate=dropout_rate
+            _ring_shard, axis_name=seq_axis, dropout_rate=dropout_rate
         ),
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(b_spec, seq_axis), P()),
+        axis_names=frozenset({seq_axis}),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, P(None, seq_axis), P()),
         out_specs=qkv_spec,
-        check_vma=False,
     )
-    return fn(q, k, v, kbias, dropout_rng)
+    return jax.jit(fn) if jitted else fn

@@ -20,9 +20,10 @@ every other mesh axis stays automatic, so 'data'/'fsdp' batch sharding and
 'model' tensor parallelism inside a stage compose for free: the stage's
 matmuls see model-sharded weights (the 'pp_tp' rules) and GSPMD inserts the
 tensor-parallel collectives, while the stage-to-stage rotation stays an
-explicit ``ppermute``. 'seq' (ring attention) is the one exception — its
-own manual collective would nest inside this one — and the engine raises
-rather than silently densify/replicate.
+explicit ``ppermute``. 'seq' (ring attention) remains unsupported: the
+nested partial-manual composition type-checks but Shardy's lowering
+rejects the backward (see the guard below) — the engine raises rather
+than fail deep inside compilation.
 """
 
 from __future__ import annotations
@@ -85,10 +86,17 @@ def gpipe(
             f"{n_mb} < {n_stages} (the bubble would dominate anyway)"
         )
     if mesh.shape.get("seq", 1) > 1:
+        # Nesting ring attention's 'seq'-manual shard_map inside this
+        # region type-checks (disjoint manual axis sets, varying-axes
+        # cotangents flow), but Shardy's lowering verifier rejects the
+        # backward pass today: propagation shards a residual dimension as
+        # {pipe, seq} and "manual axes must come before free axes" within
+        # a dim sharding. Until the compiler lifts that, refuse rather
+        # than fail deep inside lowering.
         raise ValueError(
-            "pipeline parallelism does not compose with the 'seq' mesh axis "
-            "(ring attention is its own manual collective; it cannot nest "
-            "inside the pipeline's shard_map)"
+            "pipeline parallelism does not compose with the 'seq' mesh "
+            "axis (Shardy rejects the nested-manual backward; see "
+            "parallel/pipeline.py)"
         )
 
     # Only 'pipe' is manual: specs mention nothing but the stacked-layer
