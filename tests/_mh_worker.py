@@ -11,8 +11,14 @@ Modes (argv[4], default "dp"):
           live state exactly (SURVEY §5.4's multi-host sharded
           checkpoint; reference rank-0 torch.save
           run_pretraining.py:513-523).
-  pp    — GPipe pipeline over a 2-stage 'pipe' axis spanning the two
-          processes; both ranks must agree on losses.
+  pp    — GPipe pipeline over a 2-stage 'pipe' axis laid out so stage 0
+          lives in process 0 and stage 1 in process 1: the stage-to-stage
+          ppermute CROSSES the process boundary (the default id-ordered
+          mesh would keep pipe partners intra-process — later mesh axes
+          vary fastest). Both ranks must agree on losses.
+  pp_tp — pipeline x tensor parallelism, same cross-process pipe layout;
+          the per-stage tensor-parallel collectives stay intra-process
+          (one binary process boundary cannot straddle both axes).
 """
 import os
 import sys
@@ -51,8 +57,23 @@ if mode == "fsdp":
     mesh = create_mesh(MeshConfig(data=-1, fsdp=4 * n_proc))
     rules = logical_axis_rules("fsdp")
 elif mode == "pp":
-    mesh = create_mesh(MeshConfig(data=-1, pipe=2))
+    # Reorder devices so 'pipe' is the slowest-varying axis: stage p gets
+    # process p's devices, so the ppermute crosses the process boundary.
+    # create_mesh reshapes the list into (data,fsdp,pipe,seq,model) in C
+    # order; for shape (4,1,2,1,1) flat = d*2 + p, so put devs[p*4+d] there.
+    devs = jax.devices()
+    order = [devs[p * 4 + d] for d in range(4) for p in range(2)]
+    mesh = create_mesh(MeshConfig(data=-1, pipe=2), devices=order)
     rules = logical_axis_rules("pp")
+elif mode == "pp_tp":
+    # Same cross-process pipe layout; shape (2,1,2,1,2) has
+    # flat = d*4 + p*2 + m, so position [d,p,m] gets devs[p*4 + d*2 + m]
+    # (model partners stay intra-process — flat diff 1 inside a process).
+    devs = jax.devices()
+    order = [devs[p * 4 + d * 2 + m]
+             for d in range(2) for p in range(2) for m in range(2)]
+    mesh = create_mesh(MeshConfig(data=-1, pipe=2, model=2), devices=order)
+    rules = logical_axis_rules("pp_tp")
 else:
     mesh = create_mesh(MeshConfig(data=-1))
     rules = logical_axis_rules("dp")
@@ -60,18 +81,29 @@ schedule = optim.warmup_poly_schedule(1e-3, 0.1, 50)
 tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
 S = 16
 local_b = 8  # per process; global batch 16
-accum = 2 if mode == "pp" else 1  # pp needs >= n_stages microbatches
+accum = 2 if mode.startswith("pp") else 1  # pp needs >= stages microbatches
 sample = (jnp.zeros((1, S), jnp.int32),) * 3
 
-rng = np.random.default_rng(rank)
+# dp/fsdp: every process's devices own DISTINCT global batch rows, so each
+# rank contributes its own (rank-seeded) local slice. pp modes: the
+# cross-process pipe layout makes each process a pipe-REPLICA of every
+# batch row — put_batch then requires the full global batch, byte-identical
+# on both ranks (a real constraint of host-spanning pipeline stages: every
+# stage host must see the same input feed).
+if mode.startswith("pp"):
+    rng = np.random.default_rng(0)
+    n_rows = local_b * n_proc
+else:
+    rng = np.random.default_rng(rank)
+    n_rows = local_b
 host = {
-    "input_ids": rng.integers(0, 64, (local_b, S)).astype(np.int32),
-    "segment_ids": np.zeros((local_b, S), np.int32),
-    "input_mask": np.ones((local_b, S), np.int32),
-    "masked_lm_labels": np.where(rng.random((local_b, S)) < 0.2,
-                                 rng.integers(0, 64, (local_b, S)),
+    "input_ids": rng.integers(0, 64, (n_rows, S)).astype(np.int32),
+    "segment_ids": np.zeros((n_rows, S), np.int32),
+    "input_mask": np.ones((n_rows, S), np.int32),
+    "masked_lm_labels": np.where(rng.random((n_rows, S)) < 0.2,
+                                 rng.integers(0, 64, (n_rows, S)),
                                  -1).astype(np.int32),
-    "next_sentence_labels": rng.integers(0, 2, (local_b,)).astype(np.int32),
+    "next_sentence_labels": rng.integers(0, 2, (n_rows,)).astype(np.int32),
 }
 with mesh:
     sh = pretrain.state_shardings(mesh, model, rules, sample)
@@ -79,7 +111,7 @@ with mesh:
         "input_mask": 3, "masked_lm_labels": 3, "next_sentence_labels": 2})
     init_fn = pretrain.make_init_fn(model, tx, sample, sh)
     state = init_fn(jax.random.PRNGKey(0))
-    if mode == "pp":
+    if mode.startswith("pp"):
         step = pretrain.make_pp_train_step(model, tx, mesh, schedule=schedule,
             next_sentence=True, shardings=sh, batch_shardings_=bs)
     else:

@@ -64,6 +64,14 @@ def test_two_process_fsdp_checkpoint_roundtrip(tmp_path):
 
 
 def test_two_process_pipeline():
-    """GPipe 'pipe' axis spanning two real processes (ppermute over the
-    process boundary), not just the virtual single-process mesh."""
+    """GPipe 'pipe' axis spanning two real processes: the worker lays the
+    mesh out so stage 0 is process 0 and stage 1 is process 1, making the
+    stage-to-stage ppermute cross the process boundary."""
     _run_workers("pp")
+
+
+def test_two_process_pipeline_tensor_parallel():
+    """pp_tp with the cross-process pipe layout: the pipe ppermute crosses
+    the process boundary while each stage's compiler-inserted
+    tensor-parallel collectives run intra-process."""
+    _run_workers("pp_tp")
