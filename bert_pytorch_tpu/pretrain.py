@@ -478,6 +478,39 @@ def make_eval_step(model, next_sentence: bool = True):
     return jax.jit(eval_fn)
 
 
+def check_batch_process_locality(mesh: Mesh) -> None:
+    """Raise if any batch shard's replica set spans processes.
+
+    The multi-host input path feeds each process ITS OWN loader slice
+    (per-rank DataLoaders + ``make_array_from_process_local_data``). That
+    is only correct when every (data, fsdp) batch shard — including its
+    replicas over the pipe/seq/model axes — lives within one process;
+    otherwise two processes would supply DIFFERENT host data for the same
+    global rows and training silently diverges across ranks. The default
+    id-ordered mesh satisfies this whenever pipe*seq*model divides the
+    per-host device count (model parallelism inside the host, data across
+    hosts — the layout you want on ICI anyway); reordered meshes that
+    stripe pipe/model across hosts need a replicated input feed instead.
+    """
+    if jax.process_count() == 1:
+        return
+    devs = mesh.devices  # [data, fsdp, pipe, seq, model]
+    d, f = devs.shape[0], devs.shape[1]
+    for di in range(d):
+        for fi in range(f):
+            procs = {dev.process_index for dev in devs[di, fi].flat}
+            if len(procs) > 1:
+                raise ValueError(
+                    f"batch shard (data={di}, fsdp={fi}) is replicated "
+                    f"across processes {sorted(procs)} via the "
+                    "pipe/seq/model axes; the per-process input pipeline "
+                    "would feed it conflicting data. Keep pipe*seq*model "
+                    "within one host (the default device order does this "
+                    "when it divides the per-host chip count), or feed "
+                    "every replica host identical batches."
+                )
+
+
 def put_batch(batch: dict, shardings: dict) -> dict:
     """Host numpy batch -> global sharded device arrays.
 

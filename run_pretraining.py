@@ -174,6 +174,9 @@ def setup_training(args):
         data=args.mesh_data, fsdp=args.mesh_fsdp, pipe=args.mesh_pipe,
         seq=args.mesh_seq, model=args.mesh_model,
     ))
+    # Fail fast if any batch shard's pipe/seq/model replicas span hosts:
+    # the per-process loaders would feed the same global rows different data.
+    pretrain.check_batch_process_locality(mesh)
     args.model_output_dir = os.path.join(args.output_dir, "pretrain_ckpts")
     if is_main_process():
         os.makedirs(args.model_output_dir, exist_ok=True)

@@ -288,3 +288,38 @@ def test_validation_pass(workdir, tmp_path):
     text = open(log_prefix + ".txt").read()
     assert "tag: val" in text
     assert "mlm_accuracy" in text
+
+
+def test_check_batch_process_locality(monkeypatch):
+    """Batch shards whose pipe/model replicas span processes must be
+    rejected: the per-process loaders would feed the same global rows
+    different data (silent cross-rank divergence)."""
+    import dataclasses
+
+    import jax
+
+    from bert_pytorch_tpu import pretrain
+
+    @dataclasses.dataclass(frozen=True)
+    class Dev:
+        process_index: int
+
+    def mesh_of(proc_grid):
+        # proc_grid: nested list shaped [data, fsdp, pipe, seq, model]
+        class FakeMesh:
+            pass
+        m = FakeMesh()
+        m.devices = np.vectorize(Dev)(np.asarray(proc_grid))
+        return m
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # 2 hosts, pipe intra-host: data axis splits hosts -> OK
+    ok = [[[[[0]], [[0]]]], [[[[1]], [[1]]]]]  # [2,1,2,1,1]
+    pretrain.check_batch_process_locality(mesh_of(ok))
+    # pipe spans hosts: shard (0,0) replicated on processes 0 and 1 -> raise
+    bad = [[[[[0]], [[1]]]], [[[[0]], [[1]]]]]
+    with pytest.raises(ValueError, match="conflicting data"):
+        pretrain.check_batch_process_locality(mesh_of(bad))
+    # single process: never raises
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    pretrain.check_batch_process_locality(mesh_of(bad))
