@@ -19,6 +19,10 @@ Modes (argv[4], default "dp"):
   pp_tp — pipeline x tensor parallelism, same cross-process pipe layout;
           the per-stage tensor-parallel collectives stay intra-process
           (one binary process boundary cannot straddle both axes).
+  sp    — multi-host long context in the production layout: 'data' splits
+          the hosts (per-rank loader slices stay valid), 'seq' shards the
+          sequence WITHIN each host (ring attention's ppermute rides the
+          intra-host links), ring attention backend end to end.
 """
 import os
 import sys
@@ -52,7 +56,9 @@ assert len(jax.devices()) == 4 * n_proc, len(jax.devices())
 config = BertConfig(vocab_size=64, hidden_size=16, num_hidden_layers=2,
                     num_attention_heads=2, intermediate_size=32,
                     max_position_embeddings=16, next_sentence=True)
-model = BertForPreTraining(config, dtype=jnp.float32)
+model = BertForPreTraining(
+    config, dtype=jnp.float32,
+    attention_backend="ring" if mode == "sp" else "xla")
 if mode == "fsdp":
     mesh = create_mesh(MeshConfig(data=-1, fsdp=4 * n_proc))
     rules = logical_axis_rules("fsdp")
@@ -74,6 +80,11 @@ elif mode == "pp_tp":
              for d in range(2) for p in range(2) for m in range(2)]
     mesh = create_mesh(MeshConfig(data=-1, pipe=2, model=2), devices=order)
     rules = logical_axis_rules("pp_tp")
+elif mode == "sp":
+    # id-ordered: 'data' (slowest) splits the processes, 'seq' stays
+    # intra-process — check_batch_process_locality's supported layout.
+    mesh = create_mesh(MeshConfig(data=-1, seq=4))
+    rules = logical_axis_rules("sp")
 else:
     mesh = create_mesh(MeshConfig(data=-1))
     rules = logical_axis_rules("dp")
@@ -107,8 +118,15 @@ host = {
 }
 with mesh:
     sh = pretrain.state_shardings(mesh, model, rules, sample)
-    bs = pretrain.batch_shardings(mesh, {"input_ids": 3, "segment_ids": 3,
-        "input_mask": 3, "masked_lm_labels": 3, "next_sentence_labels": 2})
+    bs = pretrain.batch_shardings(
+        mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+               "masked_lm_labels": 3, "next_sentence_labels": 2},
+        seq_sharded=(mode == "sp"))
+    if not mode.startswith("pp"):
+        # pp modes deliberately violate locality (cross-process pipe) and
+        # compensate with a byte-identical replicated feed; the sliced-feed
+        # modes must satisfy the guard the runner enforces.
+        pretrain.check_batch_process_locality(mesh)
     init_fn = pretrain.make_init_fn(model, tx, sample, sh)
     state = init_fn(jax.random.PRNGKey(0))
     if mode.startswith("pp"):

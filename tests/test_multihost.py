@@ -75,3 +75,10 @@ def test_two_process_pipeline_tensor_parallel():
     the process boundary while each stage's compiler-inserted
     tensor-parallel collectives run intra-process."""
     _run_workers("pp_tp")
+
+
+def test_two_process_sequence_parallel():
+    """Multi-host long context, production layout: per-rank loader slices
+    over the host-splitting 'data' axis, ring attention over the
+    intra-host 'seq' axis, locality check green."""
+    _run_workers("sp")
