@@ -23,6 +23,10 @@ Modes (argv[4], default "dp"):
           the hosts (per-rank loader slices stay valid), 'seq' shards the
           sequence WITHIN each host (ring attention's ppermute rides the
           intra-host links), ring attention backend end to end.
+  kfac  — K-FAC across both processes on the dp mesh: tapped-stats factor
+          update, batched inverse update, preconditioned train steps; both
+          ranks must agree on losses (the factor statistics and the
+          preconditioned gradient reductions are global collectives).
 """
 import os
 import sys
@@ -129,18 +133,40 @@ with mesh:
         pretrain.check_batch_process_locality(mesh)
     init_fn = pretrain.make_init_fn(model, tx, sample, sh)
     state = init_fn(jax.random.PRNGKey(0))
+    kfac_obj = kstate = None
+    if mode == "kfac":
+        tapped = BertForPreTraining(config, dtype=jnp.float32, kfac_tap=True)
+        apply_loss, tap_shape_fn = pretrain.make_kfac_fns(tapped, True)
+        kfac_obj = optim.KFAC(apply_loss, tap_shape_fn)
     if mode.startswith("pp"):
         step = pretrain.make_pp_train_step(model, tx, mesh, schedule=schedule,
             next_sentence=True, shardings=sh, batch_shardings_=bs)
+    elif mode == "kfac":
+        pass  # built after kstate shardings below
     else:
         step = pretrain.make_train_step(model, tx, schedule=schedule,
             next_sentence=True, shardings=sh, batch_shardings_=bs)
     # multi-host path of put_batch: each process contributes its local slice
     batch = pretrain.put_batch(pretrain.stack_microbatches(host, accum), bs)
     losses = []
-    for _ in range(2 if mode == "fsdp" else 3):
-        state, metrics = step(state, batch)
-        losses.append(float(metrics["loss"]))
+    if mode == "kfac":
+        mb0 = {k: v[0] for k, v in batch.items()}
+        kstate = kfac_obj.init(state.params, host)
+        kshard = optim.kfac_state_shardings(mesh, kstate)
+        kstate = jax.device_put(kstate, kshard)
+        step = pretrain.make_train_step(model, tx, schedule=schedule,
+            next_sentence=True, shardings=sh, batch_shardings_=bs,
+            kfac=kfac_obj, kfac_shardings=kshard)
+        for i in range(3):
+            kstate = kfac_obj.update_factors(
+                kstate, state.params, mb0, jax.random.PRNGKey(i))
+            kstate = kfac_obj.update_inverses(kstate)
+            state, metrics = step(state, batch, kstate)
+            losses.append(float(metrics["loss"]))
+    else:
+        for _ in range(2 if mode == "fsdp" else 3):
+            state, metrics = step(state, batch)
+            losses.append(float(metrics["loss"]))
 
     if mode == "fsdp":
         # The params really are sharded across the two processes — the

@@ -82,3 +82,9 @@ def test_two_process_sequence_parallel():
     over the host-splitting 'data' axis, ring attention over the
     intra-host 'seq' axis, locality check green."""
     _run_workers("sp")
+
+
+def test_two_process_kfac():
+    """Distributed K-FAC across two real processes: factor statistics,
+    batched inverses, and preconditioned steps all agree across ranks."""
+    _run_workers("kfac")
