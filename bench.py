@@ -103,6 +103,10 @@ MAX_PRED = (max(20, SEQ_LEN * 80 // 512) if LONG_SEQ
 ACCUM = 1
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", "3"))
 MEASURE_STEPS = int(os.environ.get("BENCH_MEASURE_STEPS", "20"))
+# BENCH_DEVICES=N restricts the mesh to the first N local devices: sweeping
+# N over 8/16/.../256 on a pod gives the BASELINE.md scaling-efficiency
+# curve (seq/s/chip at N vs at 8). 0 = all devices.
+N_DEVICES = int(os.environ.get("BENCH_DEVICES", "0"))
 
 
 def _child_main():
@@ -124,7 +128,13 @@ def _child_main():
     if LONG_SEQ:
         config.max_position_embeddings = SEQ_LEN
 
-    n_chips = len(jax.devices())
+    devices = jax.devices()
+    if N_DEVICES:
+        if N_DEVICES > len(devices):
+            raise ValueError(
+                f"BENCH_DEVICES={N_DEVICES} > available {len(devices)}")
+        devices = devices[:N_DEVICES]
+    n_chips = len(devices)
     if ATTN == "ring":
         # Context parallelism: the sequence axis shards across the chips
         # and K/V blocks rotate over ICI (ops/ring.py). Single-chip runs
@@ -133,10 +143,10 @@ def _child_main():
             raise ValueError(
                 "BENCH_ATTN=ring needs >=2 chips (the sequence axis shards "
                 "across the mesh); on one chip use the fused 'pallas' kernel")
-        mesh = create_mesh(MeshConfig(data=1, seq=n_chips))
+        mesh = create_mesh(MeshConfig(data=1, seq=n_chips), devices=devices)
         rules = logical_axis_rules("sp")
     else:
-        mesh = create_mesh(MeshConfig(data=-1))
+        mesh = create_mesh(MeshConfig(data=-1), devices=devices)
         rules = logical_axis_rules("dp")
     model = BertForPreTraining(config, dtype=jnp.bfloat16, remat=REMAT,
                                attention_backend=ATTN)
@@ -235,8 +245,9 @@ def _child_main():
     flops_per_seq = flops_util.bert_train_flops_per_seq(
         config, SEQ_LEN, MAX_PRED, next_sentence=True)
     model_flops_util = flops_util.mfu(
-        seq_per_sec_chip, flops_per_seq, jax.devices()[0].device_kind)
-    print(json.dumps(_result_json(seq_per_sec_chip, mfu=model_flops_util)))
+        seq_per_sec_chip, flops_per_seq, devices[0].device_kind)
+    print(json.dumps(_result_json(
+        seq_per_sec_chip, mfu=model_flops_util, n_chips=n_chips)))
 
 
 def _metric_name_and_anchor():
@@ -248,7 +259,7 @@ def _metric_name_and_anchor():
             A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC)
 
 
-def _result_json(seq_per_sec_chip, mfu=None, error=None):
+def _result_json(seq_per_sec_chip, mfu=None, error=None, n_chips=None):
     name, anchor = _metric_name_and_anchor()
     out = {
         "metric": name,
@@ -258,6 +269,8 @@ def _result_json(seq_per_sec_chip, mfu=None, error=None):
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    if n_chips is not None and n_chips > 1:
+        out["n_chips"] = n_chips  # scaling sweeps (BENCH_DEVICES) read this
     if error is not None:
         out["error"] = error
     return out
