@@ -103,9 +103,10 @@ MAX_PRED = (max(20, SEQ_LEN * 80 // 512) if LONG_SEQ
 ACCUM = 1
 WARMUP_STEPS = int(os.environ.get("BENCH_WARMUP_STEPS", "3"))
 MEASURE_STEPS = int(os.environ.get("BENCH_MEASURE_STEPS", "20"))
-# BENCH_DEVICES=N restricts the mesh to the first N local devices: sweeping
-# N over 8/16/.../256 on a pod gives the BASELINE.md scaling-efficiency
-# curve (seq/s/chip at N vs at 8). 0 = all devices.
+# BENCH_DEVICES=N restricts the mesh to the first N devices of a
+# SINGLE-PROCESS run (an intra-host sweep; multi-host pods sweep by
+# launching with fewer hosts), giving the BASELINE.md scaling-efficiency
+# curve (seq/s/chip at N vs at the base size). 0 = all devices.
 N_DEVICES = int(os.environ.get("BENCH_DEVICES", "0"))
 
 
@@ -130,9 +131,21 @@ def _child_main():
 
     devices = jax.devices()
     if N_DEVICES:
-        if N_DEVICES > len(devices):
-            raise ValueError(
-                f"BENCH_DEVICES={N_DEVICES} > available {len(devices)}")
+        # Config errors print a marker and exit 2 so the parent stops
+        # retrying immediately (they are deterministic, unlike backend
+        # failures).
+        if N_DEVICES < 0 or N_DEVICES > len(devices):
+            print(f"BENCH_CONFIG_ERROR: BENCH_DEVICES={N_DEVICES} outside "
+                  f"[1, {len(devices)}]")
+            sys.exit(2)
+        if jax.process_count() > 1:
+            # Slicing the global device list would hand some processes a
+            # mesh with none of their addressable chips; pod scaling
+            # sweeps should vary the JOB size (hosts) instead.
+            print("BENCH_CONFIG_ERROR: BENCH_DEVICES only supports "
+                  "single-process runs; on a multi-host pod, sweep by "
+                  "launching with fewer hosts")
+            sys.exit(2)
         devices = devices[:N_DEVICES]
     n_chips = len(devices)
     if ATTN == "ring":
@@ -269,7 +282,7 @@ def _result_json(seq_per_sec_chip, mfu=None, error=None, n_chips=None):
     }
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
-    if n_chips is not None and n_chips > 1:
+    if n_chips is not None:
         out["n_chips"] = n_chips  # scaling sweeps (BENCH_DEVICES) read this
     if error is not None:
         out["error"] = error
@@ -349,6 +362,11 @@ def main():
             if isinstance(cand, dict) and "metric" in cand:
                 result = cand
                 break
+        if "BENCH_CONFIG_ERROR" in out:
+            # Deterministic misconfiguration: retrying cannot help.
+            last_err = out[out.index("BENCH_CONFIG_ERROR"):][:400]
+            print(last_err, file=sys.stderr)
+            break
         if result is not None:
             # A parsed metric line is a successful capture even if the
             # child's rc is non-zero (e.g. the TPU runtime crashing during
