@@ -1,0 +1,30 @@
+#!/bin/bash
+# Intra-host scaling-efficiency sweep (BASELINE.md north star: seq/s/chip
+# at N chips vs at the base size). Runs bench.py at each power-of-two
+# device count up to the host's chip count and appends one JSON line per
+# point to the output file; efficiency(N) = value(N) / value(base).
+#
+#   bash scripts/bench_scaling.sh [out_file] [base_n]
+#
+# Multi-host pods sweep by launching with fewer hosts instead (bench.py
+# refuses BENCH_DEVICES under multi-process — see the config guard).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+OUT=${1:-SCALING.jsonl}
+BASE=${2:-1}
+N_AVAIL=$(python -c "import jax; print(len(jax.devices()))")
+: > "$OUT"
+failures=0
+n=$BASE
+while [ "$n" -le "$N_AVAIL" ]; do
+  echo "== scaling point: $n devices"
+  if BENCH_DEVICES=$n python bench.py >> "$OUT" 2> /dev/null; then
+    tail -1 "$OUT"
+  else
+    echo "   FAILED at $n devices"
+    failures=$((failures + 1))
+  fi
+  n=$((n * 2))
+done
+echo "bench_scaling done: $(wc -l < "$OUT") points in $OUT ($failures failed)"
+exit "$failures"
