@@ -68,6 +68,28 @@ def dot_product_attention(
             return flash_attention(
                 q, k, v, bias=bias,
                 dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+    if backend == "ring_manual":
+        # Ring attention's per-shard body, for callers ALREADY inside a
+        # region that is manual over the mesh 'seq' axis (the pipeline
+        # engine's {pipe, seq} shard_map). q/k/v here are the LOCAL
+        # [B, S/n, H, D] sequence shards and bias is the local
+        # [B, 1, 1, S/n] key-bias slice; the K/V rotation happens via
+        # ppermute over the ambient manual axis, with no nested shard_map
+        # (Shardy rejects the nested-manual backward — parallel/pipeline.py).
+        from bert_pytorch_tpu.ops.ring import _ring_shard
+
+        batch, s_local = q.shape[0], q.shape[1]
+        if bias is None:
+            kbias = jnp.zeros((batch, s_local), jnp.float32)
+        else:
+            kbias = bias.reshape(batch, s_local).astype(jnp.float32)
+        active = not deterministic and dropout_rate > 0.0
+        return _ring_shard(
+            q, k, v, kbias,
+            dropout_rng if active else None,
+            axis_name="seq",
+            dropout_rate=dropout_rate if active else 0.0,
+        )
     if backend == "ring":
         # Context parallelism: sequence sharded over the mesh 'seq' axis
         # with K/V ring rotation (ops/ring.py). Falls back to dense when no

@@ -118,11 +118,13 @@ def ring_attention(
     ``seq_axis`` size > 1; S must divide by that size.
 
     Only ``seq_axis`` is manual: batch/head sharding flows through the
-    automatic axes. In principle that lets this collective nest inside
-    another partial-manual region over a different axis (the pipeline
-    engine's 'pipe' shard_map) — the nesting type-checks, but Shardy's
-    lowering currently rejects the composed BACKWARD pass, so
-    parallel/pipeline.py still refuses 'seq' meshes; see the guard there.
+    automatic axes. This collective cannot NEST inside another
+    partial-manual region over a different axis (the nesting type-checks,
+    but Shardy's lowering rejects the composed backward pass) — which is
+    why the pipeline composes with 'seq' differently: its shard_map goes
+    manual over {pipe, seq} and calls :func:`_ring_shard` directly
+    (``dot_product_attention(backend='ring_manual')``), one manual region,
+    no nesting. See parallel/pipeline.py ``gpipe(seq_axis=...)``.
     Inside a non-empty mesh context shard_map must infer the context mesh
     (after consistency-checking it against the validation mesh); at top
     level the concrete mesh is passed explicitly.

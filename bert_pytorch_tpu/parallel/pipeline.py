@@ -20,10 +20,13 @@ every other mesh axis stays automatic, so 'data'/'fsdp' batch sharding and
 'model' tensor parallelism inside a stage compose for free: the stage's
 matmuls see model-sharded weights (the 'pp_tp' rules) and GSPMD inserts the
 tensor-parallel collectives, while the stage-to-stage rotation stays an
-explicit ``ppermute``. 'seq' (ring attention) remains unsupported: the
-nested partial-manual composition type-checks but Shardy's lowering
-rejects the backward (see the guard below) — the engine raises rather
-than fail deep inside compilation.
+explicit ``ppermute``. 'seq' (ring attention) composes too, but not by
+nesting (the nested partial-manual backward is rejected by Shardy's
+lowering): pass ``seq_axis`` and the SAME shard_map goes manual over
+{pipe, seq}, activations arrive sequence-sharded, and the stage body runs
+the manual ring-attention collective (ops/attention.py
+``backend='ring_manual'``) so K/V rotate over 'seq' inside this region —
+pp x sp x tp in one step (tests/test_pipeline.py equivalence vs dp).
 """
 
 from __future__ import annotations
@@ -54,6 +57,9 @@ def gpipe(
     mesh: Mesh,
     replicated: Any = None,
     axis: str = "pipe",
+    seq_axis: str = None,
+    x_seq_dim: int = 2,
+    consts_seq_dims: Any = None,
 ) -> jax.Array:
     """Run ``x`` microbatches through the pipelined layer stack.
 
@@ -74,9 +80,23 @@ def gpipe(
         (fully replicated — e.g. a PRNG key). Traced values must come in
         this way rather than by closure: ``shard_map`` rejects closed-over
         tracers.
+      seq_axis: if set (the 'pp_sp' composition), that mesh axis joins the
+        manual set and activations/consts are SHARDED over it — each device
+        holds an S/n sequence slice and ``stage_fn`` must run the manual
+        ring-attention body (attention ``backend='ring_manual'``) so K/V
+        rotate over ``seq_axis`` inside this same region. One shard_map
+        manual over {pipe, seq} sidesteps the nested-manual backward that
+        Shardy rejects (the reason pp x sp was previously refused). Must
+        be the mesh axis literally named 'seq': the ring_manual attention
+        body and the stage dropout folding hardcode that axis name.
+      x_seq_dim: dimension of ``x`` carrying the sequence (default 2:
+        ``[M, B, S, ...]``).
+      consts_seq_dims: pytree matching ``consts`` giving each leaf's
+        sequence dimension (-1 = replicated over ``seq_axis``).
 
     Returns ``[M, B, ...]`` outputs, replicated over ``axis`` (every stage
-    ends up with the full result — heads after the pipeline run replicated).
+    ends up with the full result — heads after the pipeline run replicated)
+    and, when ``seq_axis`` is set, still sequence-sharded over it.
     """
     n_stages = mesh.shape[axis]
     n_mb = x.shape[0]
@@ -85,47 +105,95 @@ def gpipe(
             f"need at least as many microbatches as pipeline stages: "
             f"{n_mb} < {n_stages} (the bubble would dominate anyway)"
         )
-    if mesh.shape.get("seq", 1) > 1:
-        # Nesting ring attention's 'seq'-manual shard_map inside this
-        # region type-checks (disjoint manual axis sets, varying-axes
-        # cotangents flow), but Shardy's lowering verifier rejects the
-        # backward pass today: propagation shards a residual dimension as
-        # {pipe, seq} and "manual axes must come before free axes" within
-        # a dim sharding. Until the compiler lifts that, refuse rather
-        # than fail deep inside lowering.
+    if seq_axis is not None and seq_axis != "seq":
+        # The ring_manual attention body (ops/attention.py) and the stage
+        # dropout folding (pretrain.make_pp_train_step) hardcode the axis
+        # name 'seq'; a differently-named axis would shard the activations
+        # here but trace an unbound axis name deep inside the stage body.
         raise ValueError(
-            "pipeline parallelism does not compose with the 'seq' mesh "
-            "axis (Shardy rejects the nested-manual backward; see "
-            "parallel/pipeline.py)"
+            f"gpipe seq_axis must be the mesh axis named 'seq' "
+            f"(got {seq_axis!r})")
+    if seq_axis is None and mesh.shape.get("seq", 1) > 1:
+        # Without the manual-ring composition, a seq>1 mesh would need ring
+        # attention's own 'seq'-manual shard_map NESTED inside this region;
+        # that type-checks, but Shardy's lowering verifier rejects the
+        # backward pass today (propagation shards a residual dimension as
+        # {pipe, seq} and "manual axes must come before free axes" within a
+        # dim sharding). Callers compose pp with 'seq' by passing
+        # ``seq_axis`` instead (pretrain.make_pp_train_step does).
+        raise ValueError(
+            "pipeline parallelism with a 'seq' mesh axis requires the "
+            "manual ring composition: pass seq_axis='seq' (and a "
+            "ring_manual stage_fn); see parallel/pipeline.py"
         )
 
-    # Only 'pipe' is manual: specs mention nothing but the stacked-layer
-    # axis, and every other mesh axis (data/fsdp batch sharding, 'model'
-    # tensor parallelism) keeps flowing through GSPMD automatically.
+    # 'pipe' (and 'seq' under pp_sp) are manual: specs mention only the
+    # stacked-layer axis and the activation sequence axis, and every other
+    # mesh axis (data/fsdp batch sharding, 'model' tensor parallelism)
+    # keeps flowing through GSPMD automatically.
+    manual = frozenset({axis}) if seq_axis is None else frozenset({axis, seq_axis})
+
     def param_spec(leaf):
         return P(axis, *(None,) * (leaf.ndim - 1))
 
     def rep_spec(leaf):
         return P(*(None,) * leaf.ndim)
 
+    def seq_spec(leaf, seq_dim):
+        if seq_axis is None or seq_dim < 0:
+            return rep_spec(leaf)
+        names = [None] * leaf.ndim
+        names[seq_dim] = seq_axis
+        return P(*names)
+
+    # XLA's CPU AllReducePromotion pass crashes ("Invalid binary
+    # instruction opcode copy") cloning bf16 all-reduces, and this region
+    # implies two: the forward's last-stage psum and the transpose-inserted
+    # psum for the cotangent of ``x`` (replicated over 'pipe' in its
+    # in-spec). On the CPU test/dryrun path widen the boundary to f32 —
+    # the TPU path keeps the half-width bf16 collectives over ICI.
+    cpu_bf16 = x.dtype == jnp.bfloat16 and jax.default_backend() == "cpu"
+    orig_dtype = x.dtype
+    if cpu_bf16:
+        x = x.astype(jnp.float32)
+
+    x_spec = seq_spec(x, x_seq_dim if x_seq_dim is not None else -1)
+    if consts_seq_dims is None:
+        consts_specs = jax.tree_util.tree_map(rep_spec, consts)
+    else:
+        consts_specs = jax.tree_util.tree_map(seq_spec, consts, consts_seq_dims)
+
     in_specs = (
         jax.tree_util.tree_map(param_spec, stacked_params),
-        rep_spec(x),
-        jax.tree_util.tree_map(rep_spec, consts),
+        x_spec,
+        consts_specs,
         jax.tree_util.tree_map(rep_spec, replicated),
     )
 
     @partial(
         shard_map,
         mesh=mesh,
-        axis_names=frozenset({axis}),
+        axis_names=manual,
         in_specs=in_specs,
-        out_specs=rep_spec(x),
+        out_specs=x_spec,
     )
     def run(local_params, x_local, consts_local, replicated_local):
         stage = jax.lax.axis_index(axis)
         ticks = n_mb + n_stages - 1
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        if seq_axis is not None:
+            # Mark the (fp32 master) stage params varying over 'seq' HERE,
+            # before any bf16 cast inside the stage body: the transpose of
+            # this explicit pvary is the cross-shard cotangent psum, so it
+            # runs on the fp32 cotangents (better gradient-reduction
+            # numerics, and it sidesteps an XLA CPU AllReducePromotion
+            # crash on the bf16 psums the auto-inserted invariance
+            # conversions would otherwise create — Shardy leaks sharding
+            # custom-calls into those reductions' to_apply computations).
+            local_params = jax.tree_util.tree_map(
+                lambda p: jax.lax.pcast(p, seq_axis, to="varying"),
+                local_params)
 
         def tick(carry, t):
             outs, act = carry
@@ -159,8 +227,10 @@ def gpipe(
         )
         # Only the last stage holds real outputs; give every stage the full
         # result so the (replicated) heads can run without a reshard.
-        return jax.lax.psum(
-            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)), axis
-        )
+        # (On the CPU path this psum — and the transpose-psum of x's
+        # cotangent — run in f32 via the cpu_bf16 boundary cast above.)
+        masked = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
+        return jax.lax.psum(masked, axis)
 
-    return run(stacked_params, x, consts, replicated)
+    out = run(stacked_params, x, consts, replicated)
+    return out.astype(orig_dtype) if cpu_bf16 else out

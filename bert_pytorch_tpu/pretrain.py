@@ -316,9 +316,17 @@ def make_pp_train_step(
     n_stages = mesh.shape["pipe"]
     stage_layer_count(cfg.num_hidden_layers, n_stages)  # validate divisibility
 
+    # pp x sp: with a 'seq' mesh axis the pipeline's shard_map goes manual
+    # over {pipe, seq} and the layers run the manual ring-attention body
+    # (ops/attention.py backend='ring_manual') — K/V rotate over 'seq'
+    # inside the SAME manual region, sidestepping the nested-manual
+    # backward Shardy rejects (parallel/pipeline.py docstring).
+    seq_manual = mesh.shape.get("seq", 1) > 1
+    layer_backend = "ring_manual" if seq_manual else model.attention_backend
+
     emb_mod = BertEmbeddings(cfg, dtype=model.dtype)
     layer_mod = BertLayer(
-        cfg, dtype=model.dtype, attention_backend=model.attention_backend
+        cfg, dtype=model.dtype, attention_backend=layer_backend
     )
     head_mod = BertLMPredictionHead(cfg, dtype=model.dtype)
     pooler_mod = BertPooler(cfg, dtype=model.dtype) if next_sentence else None
@@ -341,6 +349,10 @@ def make_pp_train_step(
 
     def loss_fn(params, batch, rng):
         n_mb, b, seq = batch["input_ids"].shape
+        if seq_manual and seq % mesh.shape["seq"] != 0:
+            raise ValueError(
+                f"pp x sp: sequence length {seq} is not divisible by the "
+                f"mesh 'seq' axis ({mesh.shape['seq']})")
         # Two streams: embeddings dropout + the per-(layer, microbatch)
         # folding inside the pipeline. The heads are dropout-free.
         emb_rng, pipe_rng = jax.random.split(rng)
@@ -370,6 +382,14 @@ def make_pp_train_step(
 
         def stage_fn(local_params, h, bias_mb, rng_rep, stage, mb):
             n_local = jax.tree_util.tree_leaves(local_params)[0].shape[0]
+            if seq_manual:
+                # Decorrelate the hidden-state dropouts across sequence
+                # shards: with a replicated key each shard would draw the
+                # IDENTICAL mask for its local block of tokens. (The
+                # attention-probability dropout decorrelates itself —
+                # _ring_shard folds in the seq index too.)
+                rng_rep = jax.random.fold_in(
+                    rng_rep, jax.lax.axis_index("seq"))
 
             def body(carry, xs):
                 lp, j = xs
@@ -390,6 +410,9 @@ def make_pp_train_step(
             bias,
             mesh,
             replicated=pipe_rng,
+            seq_axis="seq" if seq_manual else None,
+            x_seq_dim=2,
+            consts_seq_dims=4 if seq_manual else None,
         )
 
         seq_out = hidden.reshape(n_mb * b, seq, -1)

@@ -153,7 +153,11 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "accumulation microbatches become the GPipe "
                              "microbatches, so accumulation_steps must be "
                              ">= stages)")
-    parser.add_argument("--mesh_seq", type=int, default=1)
+    parser.add_argument("--mesh_seq", type=int, default=1,
+                        help="context-parallel shards (with --parallel_"
+                             "strategy sp: ring attention; with pp/pp_tp: "
+                             "the pipeline runs manual over {pipe, seq} "
+                             "with the ring body inside each stage)")
     parser.add_argument("--mesh_model", type=int, default=1)
     parser.add_argument("--parallel_strategy", type=str, default="dp",
                         choices=["dp", "fsdp", "tp", "tp_fsdp", "sp", "pp", "pp_tp"])
@@ -373,7 +377,8 @@ def main(args) -> dict:
         b_shardings = pretrain.batch_shardings(
             mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
                    "masked_lm_labels": 3, "next_sentence_labels": 2},
-            seq_sharded=(args.parallel_strategy == "sp" and mesh.shape["seq"] > 1))
+            seq_sharded=(mesh.shape["seq"] > 1 and
+                         args.parallel_strategy in ("sp", "pp", "pp_tp")))
         init_fn = pretrain.make_init_fn(model, tx, sample, shardings)
         state = init_fn(jax.random.PRNGKey(args.seed))
 

@@ -145,6 +145,44 @@ def test_pp_no_nsp_and_remat(tiny_config, devices):
         assert np.isfinite(float(metrics["loss"]))
 
 
+def test_pp_sp_bf16_dropout_step(tiny_config, devices):
+    """pp x sp in bf16 with dropout ON: one step runs and is finite.
+
+    Regression coverage for two things the fp32 equivalence test cannot
+    see: (1) the XLA CPU AllReducePromotion crash on bf16 all-reduces in
+    the pipeline region (parallel/pipeline.py promotes the boundary and
+    the param pvary to f32 on CPU), and (2) the ring_manual dropout path
+    with its per-seq-shard rng folding."""
+    model = BertForPreTraining(tiny_config, dtype=jnp.bfloat16)
+    schedule = optim.warmup_poly_schedule(1e-3, 0.25, 100)
+    tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+    seq, b, n_mb = 32, 2, 4
+    sample = (jnp.zeros((1, seq), jnp.int32),) * 3
+    host = _batch(np.random.default_rng(7), n_mb, b, seq,
+                  tiny_config.vocab_size)
+    mesh = create_mesh(MeshConfig(data=1, pipe=2, seq=2),
+                       devices=jax.devices()[:4])
+    rules = logical_axis_rules("pp")
+    with mesh:
+        shardings = pretrain.state_shardings(mesh, model, rules, sample)
+        b_shardings = pretrain.batch_shardings(
+            mesh,
+            {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+             "masked_lm_labels": 3, "next_sentence_labels": 2},
+            seq_sharded=True,
+        )
+        state = pretrain.make_init_fn(model, tx, sample, shardings)(
+            jax.random.PRNGKey(8)
+        )
+        step = pretrain.make_pp_train_step(
+            model, tx, mesh, schedule=schedule, next_sentence=True,
+            shardings=shardings, batch_shardings_=b_shardings,
+            max_pred_per_seq=8)
+        batch = pretrain.put_batch(host, b_shardings)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+
 def test_pp_runner_end_to_end(tmp_path, devices):
     """run_pretraining with --parallel_strategy pp: smoke + resume compat
     (pp and dp share one parameter tree, so the checkpoint layout is
@@ -210,14 +248,23 @@ def test_pp_train_step_matches_dp(tiny_config, devices):
     host = _batch(np.random.default_rng(2), n_mb, b, seq, vocab)
 
     results = {}
-    for name, meshcfg, strategy in [
-        ("dp", MeshConfig(data=4), "dp"),
-        ("pp", MeshConfig(data=2, pipe=2), "pp"),
+    for name, meshcfg, strategy, seq_sharded, n_dev in [
+        ("dp", MeshConfig(data=4), "dp", False, 4),
+        ("pp", MeshConfig(data=2, pipe=2), "pp", False, 4),
         # pipeline x tensor parallel: 'pipe' manual, 'model' automatic
         # (each stage's matmuls split over 2 model shards)
-        ("pp_tp", MeshConfig(data=1, pipe=2, model=2), "pp_tp"),
+        ("pp_tp", MeshConfig(data=1, pipe=2, model=2), "pp_tp", False, 4),
+        # pipeline x sequence parallel: ONE shard_map manual over
+        # {pipe, seq}, attention runs the manual ring body inside it
+        # (parallel/pipeline.py gpipe(seq_axis=...)); activations are
+        # sequence-sharded end to end
+        ("pp_sp", MeshConfig(data=1, pipe=2, seq=2), "pp", True, 4),
+        # all three composed in one step: {pipe, seq} manual, 'model'
+        # automatic (GSPMD shards each stage's matmuls)
+        ("pp_sp_tp", MeshConfig(data=1, pipe=2, seq=2, model=2),
+         "pp_tp", True, 8),
     ]:
-        mesh = create_mesh(meshcfg, devices=jax.devices()[: 4])
+        mesh = create_mesh(meshcfg, devices=jax.devices()[:n_dev])
         rules = logical_axis_rules(strategy)
         tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
         with mesh:
@@ -226,6 +273,7 @@ def test_pp_train_step_matches_dp(tiny_config, devices):
                 mesh,
                 {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
                  "masked_lm_labels": 3, "next_sentence_labels": 2},
+                seq_sharded=seq_sharded,
             )
             state = pretrain.make_init_fn(model, tx, sample, shardings)(
                 jax.random.PRNGKey(5)
@@ -251,7 +299,7 @@ def test_pp_train_step_matches_dp(tiny_config, devices):
     flat_dp = jax.tree_util.tree_leaves_with_path(params_dp)
     # Dropout draws differ between the paths (different rng folding), so
     # compare with dropout effectively disabled via the config used here:
-    for name in ("pp", "pp_tp"):
+    for name in ("pp", "pp_tp", "pp_sp", "pp_sp_tp"):
         loss_x, params_x = results[name]
         np.testing.assert_allclose(loss_x, loss_dp, rtol=1e-5, err_msg=name)
         flat_x = dict(
