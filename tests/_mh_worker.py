@@ -23,6 +23,10 @@ Modes (argv[4], default "dp"):
           the hosts (per-rank loader slices stay valid), 'seq' shards the
           sequence WITHIN each host (ring attention's ppermute rides the
           intra-host links), ring attention backend end to end.
+  pp_sp — pipeline x sequence parallelism: the cross-process pipe layout
+          of 'pp' with 'seq' sharded intra-process — the {pipe, seq}
+          manual region's stage ppermute crosses the process boundary
+          while the ring K/V rotation stays intra-process.
   kfac  — K-FAC across both processes on the dp mesh: tapped-stats factor
           update, batched inverse update, preconditioned train steps; both
           ranks must agree on losses (the factor statistics and the
@@ -89,6 +93,16 @@ elif mode == "sp":
     # intra-process — check_batch_process_locality's supported layout.
     mesh = create_mesh(MeshConfig(data=-1, seq=4))
     rules = logical_axis_rules("sp")
+elif mode == "pp_sp":
+    # Cross-process pipe with intra-process seq: shape (2,1,2,2,1) has
+    # flat = d*4 + p*2 + s, so position [d,p,s] gets devs[p*4 + d*2 + s]
+    # (seq partners differ by flat 1 inside a process; pipe partners
+    # differ by 4 — the process stride).
+    devs = jax.devices()
+    order = [devs[p * 4 + d * 2 + s]
+             for d in range(2) for p in range(2) for s in range(2)]
+    mesh = create_mesh(MeshConfig(data=-1, pipe=2, seq=2), devices=order)
+    rules = logical_axis_rules("pp")
 else:
     mesh = create_mesh(MeshConfig(data=-1))
     rules = logical_axis_rules("dp")
@@ -125,7 +139,7 @@ with mesh:
     bs = pretrain.batch_shardings(
         mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
                "masked_lm_labels": 3, "next_sentence_labels": 2},
-        seq_sharded=(mode == "sp"))
+        seq_sharded=(mode in ("sp", "pp_sp")))
     if not mode.startswith("pp"):
         # pp modes deliberately violate locality (cross-process pipe) and
         # compensate with a byte-identical replicated feed; the sliced-feed

@@ -252,10 +252,15 @@ def test_pp_train_step_with_kfac_matches_dp(setup, devices):
     host = pretrain.stack_microbatches(mb, n_mb)  # [2, 4, S] microbatches
 
     results = {}
-    for name, meshcfg, strategy in [
-        ("dp", MeshConfig(data=4), "dp"),
-        ("pp", MeshConfig(data=2, pipe=2), "pp"),
-        ("pp_tp", MeshConfig(data=1, pipe=2, model=2), "pp_tp"),
+    for name, meshcfg, strategy, seq_sharded in [
+        ("dp", MeshConfig(data=4), "dp", False),
+        ("pp", MeshConfig(data=2, pipe=2), "pp", False),
+        ("pp_tp", MeshConfig(data=1, pipe=2, model=2), "pp_tp", False),
+        # K-FAC x pp x sp: the preconditioner solve is a pure per-layer
+        # function over the stacked factors, so it composes with the
+        # {pipe, seq} manual region's gradients the same way it does with
+        # pipe-only (the factor/inverse cadence runs outside the region).
+        ("pp_sp", MeshConfig(data=1, pipe=2, seq=2), "pp", True),
     ]:
         mesh = create_mesh(meshcfg, devices=jax.devices()[:4])
         rules = logical_axis_rules(strategy)
@@ -265,7 +270,8 @@ def test_pp_train_step_with_kfac_matches_dp(setup, devices):
             shardings = pretrain.state_shardings(mesh, model, rules, sample)
             b_shardings = pretrain.batch_shardings(
                 mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
-                       "masked_lm_labels": 3, "next_sentence_labels": 2})
+                       "masked_lm_labels": 3, "next_sentence_labels": 2},
+                seq_sharded=seq_sharded)
             state = pretrain.make_init_fn(model, tx, sample, shardings)(
                 jax.random.PRNGKey(7))
             kstate = kfac.init(jax.device_get(state.params), mb)
@@ -291,7 +297,7 @@ def test_pp_train_step_with_kfac_matches_dp(setup, devices):
 
     loss_dp, params_dp = results["dp"]
     flat_dp = jax.tree_util.tree_leaves_with_path(params_dp)
-    for name in ("pp", "pp_tp"):
+    for name in ("pp", "pp_tp", "pp_sp"):
         loss_x, params_x = results[name]
         np.testing.assert_allclose(loss_x, loss_dp, rtol=1e-5, err_msg=name)
         flat_x = dict(

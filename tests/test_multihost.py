@@ -84,6 +84,13 @@ def test_two_process_sequence_parallel():
     _run_workers("sp")
 
 
+def test_two_process_pipeline_sequence_parallel():
+    """pp x sp across two real processes: the {pipe, seq} manual region's
+    stage-to-stage ppermute crosses the process boundary while the ring
+    K/V rotation stays intra-process (the ICI-friendly layout)."""
+    _run_workers("pp_sp")
+
+
 def test_two_process_kfac():
     """Distributed K-FAC across two real processes: factor statistics,
     batched inverses, and preconditioned steps all agree across ranks."""
