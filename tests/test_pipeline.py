@@ -228,6 +228,14 @@ def test_pp_runner_end_to_end(tmp_path, devices):
         run_pretraining.parse_arguments(argv_dp + ["--steps", "2"]))
     assert result2["global_step"] == 4
     assert np.isfinite(result2["loss"])
+    # pp x sp through the CLI glue: --mesh_seq composes with pp (the
+    # runner seq-shards the batch and the pp step runs the manual ring
+    # region); fresh output dir so it starts from step 0.
+    argv_sp = [a for a in argv]
+    argv_sp[argv_sp.index(str(tmp_path / "out"))] = str(tmp_path / "out_sp")
+    result3 = run_pretraining.main(run_pretraining.parse_arguments(
+        argv_sp + ["--mesh_seq", "2", "--mesh_data", "2"]))
+    assert np.isfinite(result3["loss"])
 
 
 def test_pp_train_step_matches_dp(tiny_config, devices):
