@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import math
 import os
+import signal
 import time
 from pathlib import Path
 
@@ -89,6 +90,14 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
     parser.add_argument("--keep_checkpoints", type=int, default=3)
     parser.add_argument("--log_steps", type=int, default=1)
+    parser.add_argument("--term_check_steps", type=int, default=10,
+                        help="how often (in optimizer steps) to act on a "
+                             "received SIGTERM/SIGUSR1: checkpoint and exit "
+                             "cleanly. TPU VMs / SLURM preemption send "
+                             "SIGTERM with a short grace period; the check "
+                             "runs at a fixed step cadence so multi-host "
+                             "jobs agree collectively on when to stop. "
+                             "0 disables graceful termination")
     parser.add_argument("--profile_steps", type=int, default=0,
                         help="capture a JAX profiler trace of this many "
                              "steps (after the compile step) into "
@@ -540,6 +549,27 @@ def main(args) -> dict:
         samples_seen = 0
         last_metrics = {}
         done = False
+        # Graceful preemption (beyond the reference, whose only fault model
+        # is die-and-resubmit, SURVEY §5.3): TPU-VM maintenance events and
+        # SLURM preemption deliver SIGTERM/SIGUSR1 with a short grace
+        # period. The handler only sets a flag; the loop acts on it at a
+        # fixed step cadence so every host of a multi-host job reaches the
+        # agreement collective at the same step, then the normal
+        # end-of-run epilogue writes the final checkpoint.
+        terminated = False
+        term_flag = {"received": False}
+        old_handlers = {}
+        if args.term_check_steps:
+            def _on_term(signum, frame):
+                term_flag["received"] = True
+            for sig in (signal.SIGTERM,
+                        getattr(signal, "SIGUSR1", None)):
+                if sig is None:
+                    continue
+                try:
+                    old_handlers[sig] = signal.signal(sig, _on_term)
+                except (ValueError, OSError):
+                    pass  # non-main thread (in-process tests) or platform
         # The DATA sequence length (what the FLOP/MFU accounting must use;
         # phase-1 data is 128 tokens while max_position_embeddings stays 512).
         data_seq_len = None
@@ -556,143 +586,172 @@ def main(args) -> dict:
             s["index"] = trained_index
             return s
 
-        while not done:
-            sampler.set_epoch(epoch)
-            for batch in pretrain.device_prefetch(
-                    loader, args.accumulation_steps, b_shardings):
-                if kfac_obj is not None:
-                    # kfac_pytorch cadence: factors (EMA) every
-                    # factor_interval steps from the current data, inverses
-                    # every inv_interval steps; both fire on the first step.
-                    if global_step % args.kfac_factor_interval == 0:
-                        n_stats = args.kfac_stats_batch
-                        if n_stats and n_stats < batch["input_ids"].shape[1]:
-                            # Strided rows: every data shard of the global
-                            # batch contributes to the statistics (a [:n]
-                            # head-slice would sample only shard 0's data).
-                            stride = batch["input_ids"].shape[1] // n_stats
-                            mb0 = {k: v[0][::stride][:n_stats]
-                                   for k, v in batch.items()}
-                        else:
-                            mb0 = {k: v[0] for k, v in batch.items()}
-                        kfac_state = kfac_obj.update_factors(
-                            kfac_state, state.params, mb0,
-                            jax.random.fold_in(
-                                jax.random.PRNGKey(args.seed + 17), global_step))
-                    if global_step % args.kfac_inv_interval == 0:
-                        kfac_state = kfac_obj.update_inverses(kfac_state)
-                    state, metrics = train_step(state, batch, kfac_state)
-                else:
-                    state, metrics = train_step(state, batch)
-                global_step += 1
-                step_in_run += 1
-                trained_index += args.host_batch_per_step
-                if data_seq_len is None:
-                    data_seq_len = int(batch["input_ids"].shape[-1])
-                if step_in_run > 1:  # skip step-0 compile in throughput
-                    samples_seen += args.global_batch_size
-                if step_in_run == 1:
-                    # Wait for the first step to EXECUTE before starting the
-                    # clock (reference skips step 0 the same way, its
-                    # run_pretraining.py:494-495). Dispatch of step 1 returns
-                    # as soon as compilation ends; on remote-attached TPUs the
-                    # executable upload still congests the link for a while,
-                    # and without this barrier that tail lands inside the
-                    # measured window (observed: 280 vs 400 seq/s reported
-                    # for identical steady-state device throughput).
-                    jax.block_until_ready(metrics)
-                    train_start = time.perf_counter()
-                # Profiler window: steps [2, 2+profile_steps) — after the
-                # compile step (metrics already blocked on above), so the
-                # trace holds steady-state device work.
-                if args.profile_steps > 0 and is_main_process():
+        # Handlers stay installed through the final checkpoint write:
+        # preemption re-delivers SIGTERM during the grace period, and
+        # the default disposition would kill the write mid-file. The
+        # finally also un-installs them on exceptions (in-process
+        # callers must not inherit a handler over a dead flag).
+        try:
+            while not done:
+                sampler.set_epoch(epoch)
+                for batch in pretrain.device_prefetch(
+                        loader, args.accumulation_steps, b_shardings):
+                    if kfac_obj is not None:
+                        # kfac_pytorch cadence: factors (EMA) every
+                        # factor_interval steps from the current data, inverses
+                        # every inv_interval steps; both fire on the first step.
+                        if global_step % args.kfac_factor_interval == 0:
+                            n_stats = args.kfac_stats_batch
+                            if n_stats and n_stats < batch["input_ids"].shape[1]:
+                                # Strided rows: every data shard of the global
+                                # batch contributes to the statistics (a [:n]
+                                # head-slice would sample only shard 0's data).
+                                stride = batch["input_ids"].shape[1] // n_stats
+                                mb0 = {k: v[0][::stride][:n_stats]
+                                       for k, v in batch.items()}
+                            else:
+                                mb0 = {k: v[0] for k, v in batch.items()}
+                            kfac_state = kfac_obj.update_factors(
+                                kfac_state, state.params, mb0,
+                                jax.random.fold_in(
+                                    jax.random.PRNGKey(args.seed + 17), global_step))
+                        if global_step % args.kfac_inv_interval == 0:
+                            kfac_state = kfac_obj.update_inverses(kfac_state)
+                        state, metrics = train_step(state, batch, kfac_state)
+                    else:
+                        state, metrics = train_step(state, batch)
+                    global_step += 1
+                    step_in_run += 1
+                    trained_index += args.host_batch_per_step
+                    if data_seq_len is None:
+                        data_seq_len = int(batch["input_ids"].shape[-1])
+                    if step_in_run > 1:  # skip step-0 compile in throughput
+                        samples_seen += args.global_batch_size
                     if step_in_run == 1:
-                        jax.profiler.start_trace(
-                            os.path.join(args.output_dir, "profile"))
-                        profiling = True
-                    elif profiling and step_in_run == 1 + args.profile_steps:
+                        # Wait for the first step to EXECUTE before starting the
+                        # clock (reference skips step 0 the same way, its
+                        # run_pretraining.py:494-495). Dispatch of step 1 returns
+                        # as soon as compilation ends; on remote-attached TPUs the
+                        # executable upload still congests the link for a while,
+                        # and without this barrier that tail lands inside the
+                        # measured window (observed: 280 vs 400 seq/s reported
+                        # for identical steady-state device throughput).
                         jax.block_until_ready(metrics)
-                        jax.profiler.stop_trace()
-                        profiling = False
-                        logger.info("profiler trace written to "
-                                    f"{args.output_dir}/profile")
+                        train_start = time.perf_counter()
+                    # Profiler window: steps [2, 2+profile_steps) — after the
+                    # compile step (metrics already blocked on above), so the
+                    # trace holds steady-state device work.
+                    if args.profile_steps > 0 and is_main_process():
+                        if step_in_run == 1:
+                            jax.profiler.start_trace(
+                                os.path.join(args.output_dir, "profile"))
+                            profiling = True
+                        elif profiling and step_in_run == 1 + args.profile_steps:
+                            jax.block_until_ready(metrics)
+                            jax.profiler.stop_trace()
+                            profiling = False
+                            logger.info("profiler trace written to "
+                                        f"{args.output_dir}/profile")
 
-                if global_step % args.log_steps == 0:
-                    last_metrics = {k: float(v) for k, v in metrics.items()}
-                    elapsed = time.perf_counter() - train_start
-                    logger.log(
-                        tag="train", step=global_step, epoch=epoch,
-                        average_loss=last_metrics["loss"],
-                        step_loss=last_metrics["loss"],
-                        learning_rate=last_metrics.get("learning_rate", 0.0),
-                        samples_per_second=samples_seen / max(elapsed, 1e-9),
-                        mlm_accuracy=last_metrics.get("mlm_accuracy", 0.0),
-                        grad_norm=last_metrics.get("grad_norm", 0.0))
+                    if global_step % args.log_steps == 0:
+                        last_metrics = {k: float(v) for k, v in metrics.items()}
+                        elapsed = time.perf_counter() - train_start
+                        logger.log(
+                            tag="train", step=global_step, epoch=epoch,
+                            average_loss=last_metrics["loss"],
+                            step_loss=last_metrics["loss"],
+                            learning_rate=last_metrics.get("learning_rate", 0.0),
+                            samples_per_second=samples_seen / max(elapsed, 1e-9),
+                            mlm_accuracy=last_metrics.get("mlm_accuracy", 0.0),
+                            grad_norm=last_metrics.get("grad_norm", 0.0))
 
-                if (eval_step is not None
-                        and global_step % args.num_steps_per_eval == 0):
-                    run_validation(state.params, global_step, epoch)
+                    if (eval_step is not None
+                            and global_step % args.num_steps_per_eval == 0):
+                        run_validation(state.params, global_step, epoch)
 
-                if global_step % args.num_steps_per_checkpoint == 0:
-                    save_step = global_step + args.previous_phase_end_step
-                    contents = {"model": state.params,
-                                "optimizer": state.opt_state,
-                                "sampler": sampler_checkpoint_state(),
-                                "epoch": epoch}
-                    if kfac_state is not None:
-                        contents["preconditioner"] = kfac_state
-                    # Async: the loop pays only the device->host gather; the
-                    # msgpack+disk write overlaps the next training steps.
-                    ckpt.save_checkpoint(
-                        args.model_output_dir, save_step, contents,
-                        keep=args.keep_checkpoints, async_write=True)
-                    logger.info(f"Saved checkpoint at step {save_step}")
+                    if global_step % args.num_steps_per_checkpoint == 0:
+                        save_step = global_step + args.previous_phase_end_step
+                        contents = {"model": state.params,
+                                    "optimizer": state.opt_state,
+                                    "sampler": sampler_checkpoint_state(),
+                                    "epoch": epoch}
+                        if kfac_state is not None:
+                            contents["preconditioner"] = kfac_state
+                        # Async: the loop pays only the device->host gather; the
+                        # msgpack+disk write overlaps the next training steps.
+                        ckpt.save_checkpoint(
+                            args.model_output_dir, save_step, contents,
+                            keep=args.keep_checkpoints, async_write=True)
+                        logger.info(f"Saved checkpoint at step {save_step}")
 
-                if step_in_run >= steps_this_run or global_step >= args.max_steps:
-                    done = True
-                    break
-            else:
-                epoch += 1
-                trained_index = 0
-                continue
-            break
+                    if (args.term_check_steps
+                            and global_step % args.term_check_steps == 0):
+                        flagged = term_flag["received"]
+                        if jax.process_count() > 1:
+                            # Any-host semantics: the scheduler may signal hosts
+                            # at different times; stop only when agreed, at the
+                            # same step on every host (this allgather is the
+                            # agreement point — all hosts reach it).
+                            from jax.experimental import multihost_utils
+                            flagged = bool(multihost_utils.process_allgather(
+                                np.asarray([flagged])).any())
+                        if flagged:
+                            logger.info(
+                                "termination signal received; writing the final "
+                                "checkpoint and exiting cleanly")
+                            terminated = True
+                            done = True
+                            break
 
-        if profiling:  # run ended inside the profile window
-            jax.block_until_ready(metrics)
-            jax.profiler.stop_trace()
-            logger.info(f"profiler trace written to {args.output_dir}/profile")
+                    if step_in_run >= steps_this_run or global_step >= args.max_steps:
+                        done = True
+                        break
+                else:
+                    epoch += 1
+                    trained_index = 0
+                    continue
+                break
 
-        train_time = time.perf_counter() - train_start
-        seq_per_sec = samples_seen / max(train_time, 1e-9)
-        logger.info(f"Total time: {train_time:.2f} s")
-        logger.info(f"training_seq_per_sec = {seq_per_sec:.2f}")
-        # MFU: hardware-normalised counterpart of seq/s (the reference
-        # reports raw seq/s only, run_pretraining.py:597-599); 0.0 when the
-        # device kind has no known peak (e.g. the CPU test mesh).
-        from bert_pytorch_tpu.utils import flops as flops_util
-        train_mfu = flops_util.mfu(
-            seq_per_sec / max(jax.device_count(), 1),
-            flops_util.bert_train_flops_per_seq(
-                config, data_seq_len or seq_len,
-                args.max_predictions_per_seq,
-                next_sentence=bool(config.next_sentence)),
-            jax.devices()[0].device_kind)
-        if train_mfu:
-            logger.info(f"training_mfu = {train_mfu:.4f}")
-        # Final checkpoint so short runs resume exactly.
-        save_step = global_step + args.previous_phase_end_step
-        contents = {"model": state.params, "optimizer": state.opt_state,
-                    "sampler": sampler_checkpoint_state(), "epoch": epoch}
-        if kfac_state is not None:
-            contents["preconditioner"] = kfac_state
-        ckpt.save_checkpoint(
-            args.model_output_dir, save_step, contents,
-            keep=args.keep_checkpoints)
-        ckpt.wait_for_pending_save()
-        logger.close()
+            if profiling:  # run ended inside the profile window
+                jax.block_until_ready(metrics)
+                jax.profiler.stop_trace()
+                logger.info(f"profiler trace written to {args.output_dir}/profile")
+
+            train_time = time.perf_counter() - train_start
+            seq_per_sec = samples_seen / max(train_time, 1e-9)
+            logger.info(f"Total time: {train_time:.2f} s")
+            logger.info(f"training_seq_per_sec = {seq_per_sec:.2f}")
+            # MFU: hardware-normalised counterpart of seq/s (the reference
+            # reports raw seq/s only, run_pretraining.py:597-599); 0.0 when the
+            # device kind has no known peak (e.g. the CPU test mesh).
+            from bert_pytorch_tpu.utils import flops as flops_util
+            train_mfu = flops_util.mfu(
+                seq_per_sec / max(jax.device_count(), 1),
+                flops_util.bert_train_flops_per_seq(
+                    config, data_seq_len or seq_len,
+                    args.max_predictions_per_seq,
+                    next_sentence=bool(config.next_sentence)),
+                jax.devices()[0].device_kind)
+            if train_mfu:
+                logger.info(f"training_mfu = {train_mfu:.4f}")
+            # Final checkpoint so short runs resume exactly.
+            save_step = global_step + args.previous_phase_end_step
+            contents = {"model": state.params, "optimizer": state.opt_state,
+                        "sampler": sampler_checkpoint_state(), "epoch": epoch}
+            if kfac_state is not None:
+                contents["preconditioner"] = kfac_state
+            ckpt.save_checkpoint(
+                args.model_output_dir, save_step, contents,
+                keep=args.keep_checkpoints)
+            ckpt.wait_for_pending_save()
+            logger.close()
+        finally:
+            for sig, handler in old_handlers.items():
+                signal.signal(sig, handler)
         return {"global_step": global_step,
                 "training_seq_per_sec": seq_per_sec,
                 "training_mfu": train_mfu,
+                "terminated_by_signal": terminated,
                 **last_metrics}
 
 

@@ -290,6 +290,68 @@ def test_validation_pass(workdir, tmp_path):
     assert "mlm_accuracy" in text
 
 
+def test_sigterm_graceful_checkpoint(workdir):
+    """Preemption handling (beyond the reference's die-and-resubmit fault
+    model): SIGTERM mid-run makes the runner stop at the next
+    term-check step, write the normal final checkpoint, and exit 0 —
+    and the checkpoint resumes."""
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    # Drop PYTHONPATH: the axon sitecustomize on it force-selects the TPU
+    # platform at interpreter startup, overriding JAX_PLATFORMS (see
+    # tests/conftest.py, which solves this in-process via jax.config).
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "PYTHONPATH")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    argv = [
+        sys.executable,
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "run_pretraining.py"),
+        "--input_dir", workdir["data"],
+        "--output_dir", workdir["out"],
+        "--model_config_file", workdir["model"],
+        "--global_batch_size", "4", "--local_batch_size", "4",
+        "--max_steps", "100000", "--steps", "100000",
+        "--learning_rate", "1e-3", "--warmup_proportion", "0.25",
+        "--num_steps_per_checkpoint", "100000",
+        "--term_check_steps", "1", "--log_steps", "1",
+        "--dtype", "float32", "--seed", "7",
+    ]
+    proc = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    log_path = os.path.join(workdir["out"], "pretraining_metrics.csv")
+    deadline = _time.monotonic() + 240
+    try:
+        # Wait until a couple of steps have actually trained.
+        while _time.monotonic() < deadline:
+            if os.path.exists(log_path) and sum(
+                    1 for _ in open(log_path)) >= 3:
+                break
+            _time.sleep(1.0)
+        else:
+            raise AssertionError("runner never reached step 2")
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=180)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    assert proc.returncode == 0, out[-2000:]
+    assert "termination signal received" in out, out[-2000:]
+    ckpt_dir = os.path.join(workdir["out"], "pretrain_ckpts")
+    stopped_at = ckpt.find_resume_step(ckpt_dir)
+    assert stopped_at is not None and 1 <= stopped_at < 100000
+    # The checkpoint is a normal one: a resume run continues from it.
+    result = run_pretraining.main(_args(
+        workdir, steps=1, max_steps=100000, term_check_steps=0))
+    assert result["global_step"] == stopped_at + 1
+    assert not result["terminated_by_signal"]
+
+
 def test_check_batch_process_locality(monkeypatch):
     """Batch shards whose pipe/model replicas span processes must be
     rejected: the per-process loaders would feed the same global rows
