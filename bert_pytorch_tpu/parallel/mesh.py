@@ -26,6 +26,16 @@ class MeshConfig:
     The product must equal the device count. The default is the reference's
     capability: pure data parallelism over every chip (§2.2). ``pipe`` is the
     pipeline-stage axis (parallel/pipeline.py).
+
+    ``dcn_data`` > 1 builds a HYBRID mesh for multi-slice pods: that many
+    data-parallel replicas span slices over DCN while every other axis
+    (and the remaining data parallelism) stays within a slice on ICI —
+    the standard multi-slice recipe (gradient all-reduce decomposes into
+    a fast ICI phase and one small DCN phase per slice pair; XLA does the
+    decomposition once the device order encodes slice adjacency).
+    ``dcn_process_granule`` treats each PROCESS as the DCN granule instead
+    of each TPU slice — the CPU multi-process test analog, where "slice"
+    boundaries are process boundaries.
     """
 
     data: int = -1
@@ -33,20 +43,26 @@ class MeshConfig:
     pipe: int = 1
     seq: int = 1
     model: int = 1
+    dcn_data: int = 1
+    dcn_process_granule: bool = False
 
     def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        """Per-ICI-granule axis sizes (the full mesh's data axis is
+        ``resolve()[0] * dcn_data``)."""
         fixed = self.fsdp * self.pipe * self.seq * self.model
+        denom = fixed * self.dcn_data
         data = self.data
         if data == -1:
-            if n_devices % fixed != 0:
+            if n_devices % denom != 0:
                 raise ValueError(
                     f"{n_devices} devices not divisible by "
-                    f"fsdp*pipe*seq*model={fixed}"
+                    f"fsdp*pipe*seq*model*dcn_data={denom}"
                 )
-            data = n_devices // fixed
-        if data * fixed != n_devices:
+            data = n_devices // denom
+        if data * denom != n_devices:
             raise ValueError(
-                f"mesh {data}x{self.fsdp}x{self.pipe}x{self.seq}x{self.model}"
+                f"mesh {data}x{self.fsdp}x{self.pipe}x{self.seq}"
+                f"x{self.model} (x{self.dcn_data} dcn)"
                 f" != {n_devices} devices"
             )
         return (data, self.fsdp, self.pipe, self.seq, self.model)
@@ -60,12 +76,26 @@ def create_mesh(
 
     Device order comes from `jax.devices()`, which JAX already returns in
     ICI-topology order — nearest-neighbor axes (model/seq) get the fastest
-    links, matching the scaling-book layout recipe.
+    links, matching the scaling-book layout recipe. With ``dcn_data`` > 1
+    the device array instead comes from
+    ``mesh_utils.create_hybrid_device_mesh`` so the data axis's leading
+    dimension strides across DCN granules (slices, or processes under
+    ``dcn_process_granule``) and every other axis stays granule-local.
     """
     mesh_config = mesh_config or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     shape = mesh_config.resolve(len(devices))
-    device_array = np.asarray(devices).reshape(shape)
+    if mesh_config.dcn_data > 1:
+        from jax.experimental import mesh_utils
+
+        device_array = mesh_utils.create_hybrid_device_mesh(
+            shape,
+            (mesh_config.dcn_data, 1, 1, 1, 1),
+            devices,
+            process_is_granule=mesh_config.dcn_process_granule,
+        )
+    else:
+        device_array = np.asarray(devices).reshape(shape)
     return Mesh(device_array, MESH_AXES)
 
 

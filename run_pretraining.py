@@ -154,7 +154,11 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     parser.add_argument("--kfac_skip_layers", type=str, nargs="+",
                         default=["embeddings", "predictions"])
     # mesh
-    parser.add_argument("--mesh_data", type=int, default=-1)
+    parser.add_argument("--mesh_data", type=int, default=-1,
+                        help="data-parallel shards; -1 = all remaining "
+                             "devices. With --mesh_dcn_data > 1 this is "
+                             "the PER-SLICE size (total data parallelism "
+                             "= mesh_data * mesh_dcn_data)")
     parser.add_argument("--mesh_fsdp", type=int, default=1)
     parser.add_argument("--mesh_pipe", type=int, default=1,
                         help="pipeline stages (with --parallel_strategy "
@@ -167,6 +171,11 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "strategy sp: ring attention; with pp/pp_tp: "
                              "the pipeline runs manual over {pipe, seq} "
                              "with the ring body inside each stage)")
+    parser.add_argument("--mesh_dcn_data", type=int, default=1,
+                        help="multi-slice pods: data-parallel replicas "
+                             "spanning slices over DCN (hybrid device "
+                             "mesh); every other axis stays within a "
+                             "slice on ICI")
     parser.add_argument("--mesh_model", type=int, default=1)
     parser.add_argument("--parallel_strategy", type=str, default="dp",
                         choices=["dp", "fsdp", "tp", "tp_fsdp", "sp", "pp", "pp_tp"])
@@ -186,6 +195,7 @@ def setup_training(args):
     mesh = create_mesh(MeshConfig(
         data=args.mesh_data, fsdp=args.mesh_fsdp, pipe=args.mesh_pipe,
         seq=args.mesh_seq, model=args.mesh_model,
+        dcn_data=args.mesh_dcn_data,
     ))
     # Fail fast if any batch shard's pipe/seq/model replicas span hosts:
     # the per-process loaders would feed the same global rows different data.

@@ -27,6 +27,10 @@ Modes (argv[4], default "dp"):
           of 'pp' with 'seq' sharded intra-process — the {pipe, seq}
           manual region's stage ppermute crosses the process boundary
           while the ring K/V rotation stays intra-process.
+  dcn   — multi-slice hybrid mesh (MeshConfig(dcn_data=2) with process
+          granules — the CPU analog of slices): 2 DCN data replicas x 4
+          ICI data shards; the gradient all-reduce spans the process
+          boundary exactly once along the data axis.
   kfac  — K-FAC across both processes on the dp mesh: tapped-stats factor
           update, batched inverse update, preconditioned train steps; both
           ranks must agree on losses (the factor statistics and the
@@ -103,6 +107,17 @@ elif mode == "pp_sp":
              for d in range(2) for p in range(2) for s in range(2)]
     mesh = create_mesh(MeshConfig(data=-1, pipe=2, seq=2), devices=order)
     rules = logical_axis_rules("pp")
+elif mode == "dcn":
+    mesh = create_mesh(MeshConfig(
+        data=-1, dcn_data=2, dcn_process_granule=True))
+    # The hybrid layout puts the DCN granule stride on the data axis's
+    # SLOWEST dimension: each contiguous half must be one process's
+    # devices (the property that keeps every other axis granule-local).
+    flat = mesh.devices.reshape(-1)
+    assert {d.process_index for d in flat[:4]} in ({0}, {1}), flat[:4]
+    assert ({d.process_index for d in flat[:4]}
+            != {d.process_index for d in flat[4:]}), flat
+    rules = logical_axis_rules("dp")
 else:
     mesh = create_mesh(MeshConfig(data=-1))
     rules = logical_axis_rules("dp")

@@ -1,0 +1,36 @@
+"""MeshConfig / create_mesh unit coverage (the multi-process integration
+legs live in tests/test_multihost.py)."""
+
+import pytest
+
+from bert_pytorch_tpu.parallel import MeshConfig, create_mesh
+
+
+def test_resolve_dcn_divides_data_axis():
+    # 16 devices, dcn_data=2: the ICI granule holds 8-way data parallelism.
+    assert MeshConfig(dcn_data=2).resolve(16) == (8, 1, 1, 1, 1)
+    # explicit data size is the PER-GRANULE size
+    assert MeshConfig(data=4, dcn_data=2, model=2).resolve(16) == \
+        (4, 1, 1, 1, 2)
+
+
+def test_resolve_dcn_divisibility_errors():
+    with pytest.raises(ValueError, match="dcn_data"):
+        MeshConfig(dcn_data=3).resolve(16)
+    with pytest.raises(ValueError, match="dcn"):
+        MeshConfig(data=8, dcn_data=2).resolve(8)
+
+
+def test_create_mesh_dcn_needs_granules(devices):
+    # Single-process CPU: one process granule cannot satisfy dcn_data=2.
+    with pytest.raises(ValueError, match="[Nn]umber of slices"):
+        create_mesh(MeshConfig(dcn_data=2, dcn_process_granule=True))
+
+
+def test_create_mesh_plain_shapes(devices):
+    import jax
+
+    mesh = create_mesh(MeshConfig(data=2, seq=2, model=2),
+                       devices=jax.devices()[:8])
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "data": 2, "fsdp": 1, "pipe": 1, "seq": 2, "model": 2}

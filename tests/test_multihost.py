@@ -91,6 +91,13 @@ def test_two_process_pipeline_sequence_parallel():
     _run_workers("pp_sp")
 
 
+def test_two_process_dcn_hybrid_mesh():
+    """Multi-slice recipe on the CPU analog (process = slice granule):
+    MeshConfig(dcn_data=2) builds the hybrid device mesh, data parallelism
+    spans the DCN granule boundary, and both ranks agree on losses."""
+    _run_workers("dcn")
+
+
 def test_two_process_kfac():
     """Distributed K-FAC across two real processes: factor statistics,
     batched inverses, and preconditioned steps all agree across ranks."""
