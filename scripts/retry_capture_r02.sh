@@ -25,30 +25,37 @@ have_seq1024() { [ -f bench_seq1024.json ] && ! grep -q '"error"' bench_seq1024.
 have_convergence() { [ -f CONVERGENCE_r02.csv ]; }
 have_e2e() { [ -f E2E_r02.json ]; }
 
-have_sweep() { [ -f SWEEP_r02.jsonl ] && [ "$(wc -l < SWEEP_r02.jsonl)" -ge 5 ]; }
+have_sweep() { [ -f SWEEP_r02.jsonl ] && [ "$(wc -l < SWEEP_r02.jsonl)" -ge 7 ]; }
 
 run_sweep() {
   # Opportunistic phase-1 microbatch sweep once the evidence legs are in:
   # one captured line per batch size (the ARCHITECTURE.md tuning-surface
   # numbers, re-measured live). Short measure window keeps it ~2min/point.
   : > "$LOGS/sweep.tmp"
-  for b in 48 52 56 60 64; do
+  # batch points on the default XLA attention path, then the fused Pallas
+  # kernel at seq 128 (its bh-batched tiles postdate the recorded 366-vs-396
+  # XLA win — re-measure whether it closes the gap) at the two best batches.
+  for pt in 48: 52: 56: 60: 64: 56:pallas 64:pallas; do
+    b=${pt%%:*}; attn=${pt#*:}
+    tag="$b${attn:+_$attn}"
     # Resume-per-point: a pass interrupted by a tunnel drop keeps its
     # already-measured points on disk and only re-runs the missing ones.
-    if { [ -s "$LOGS/sweep_$b.json" ] && ! grep -q '"error"' "$LOGS/sweep_$b.json"; } \
-        || env BENCH_LOCAL_BATCH="$b" BENCH_MEASURE_STEPS=12 BENCH_ATTEMPTS=1 \
-        timeout 900 python bench.py > "$LOGS/sweep_$b.json" 2> "$LOGS/sweep_$b.log"
+    if { [ -s "$LOGS/sweep_$tag.json" ] && ! grep -q '"error"' "$LOGS/sweep_$tag.json"; } \
+        || env BENCH_LOCAL_BATCH="$b" ${attn:+BENCH_ATTN=$attn} \
+        BENCH_MEASURE_STEPS=12 BENCH_ATTEMPTS=1 \
+        timeout 900 python bench.py > "$LOGS/sweep_$tag.json" 2> "$LOGS/sweep_$tag.log"
     then
-      python - "$b" "$LOGS/sweep_$b.json" >> "$LOGS/sweep.tmp" <<'EOF'
+      python - "$b" "${attn:-xla}" "$LOGS/sweep_$tag.json" >> "$LOGS/sweep.tmp" <<'EOF'
 import json, sys
-b, path = sys.argv[1:3]
+b, attn, path = sys.argv[1:4]
 rec = json.load(open(path))
 rec["local_batch"] = int(b)
+rec["attention"] = attn
 print(json.dumps(rec))
 EOF
-      echo "   sweep b=$b: $(tail -1 "$LOGS/sweep.tmp")"
+      echo "   sweep $tag: $(tail -1 "$LOGS/sweep.tmp")"
     else
-      echo "   sweep b=$b FAILED; aborting sweep pass"
+      echo "   sweep $tag FAILED; aborting sweep pass"
       return 1
     fi
   done
