@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Summarize a CONVERGENCE_r*.csv (scripts/convergence_r02.sh output).
+
+Prints one JSON object with, per optimizer leg: loss/accuracy at step
+milestones and the end of the run, plus the K-FAC-vs-LAMB loss delta at
+equal steps — the quality-per-step comparison that justifies K-FAC's
+per-step cost (reference wires K-FAC for exactly this trade,
+run_pretraining.py:320-355; BASELINE.md north star is loss @ step).
+
+  python tools/summarize_convergence.py CONVERGENCE_r02.csv
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import sys
+
+
+def summarize(path: str) -> dict:
+    legs: dict[str, list[dict]] = {}
+    with open(path) as f:
+        for rec in csv.DictReader(f):
+            legs.setdefault(rec["optimizer"], []).append(rec)
+
+    out: dict = {"file": path, "legs": {}}
+    for name, rows in legs.items():
+        rows.sort(key=lambda r: int(r["step"]))
+        by_step = {int(r["step"]): r for r in rows}
+        last = rows[-1]
+        milestones = {}
+        for s in (10, 25, 50, 100, 150, 200):
+            if s in by_step:
+                milestones[str(s)] = round(float(by_step[s]["loss"]), 4)
+        out["legs"][name] = {
+            "steps": int(last["step"]),
+            "first_loss": round(float(rows[0]["loss"]), 4),
+            "final_loss": round(float(last["loss"]), 4),
+            "final_mlm_accuracy": round(float(last["mlm_accuracy"]), 4),
+            "loss_at_step": milestones,
+        }
+    if {"lamb", "kfac"} <= set(legs):
+        n = min(int(legs["lamb"][-1]["step"]), int(legs["kfac"][-1]["step"]))
+        l_loss = next(float(r["loss"]) for r in legs["lamb"]
+                      if int(r["step"]) == n)
+        k_loss = next(float(r["loss"]) for r in legs["kfac"]
+                      if int(r["step"]) == n)
+        out["kfac_vs_lamb"] = {
+            "equal_step": n,
+            "lamb_loss": round(l_loss, 4),
+            "kfac_loss": round(k_loss, 4),
+            # positive = K-FAC is ahead (lower loss) at equal steps
+            "kfac_advantage": round(l_loss - k_loss, 4),
+        }
+    return out
+
+
+if __name__ == "__main__":
+    print(json.dumps(summarize(sys.argv[1])))
