@@ -116,6 +116,13 @@ def _child_main():
     import numpy as np
 
     jax.config.update("jax_default_prng_impl", RNG_IMPL)
+    # Persistent compilation cache: a retry after a mid-compile tunnel drop
+    # (the seq-1024 leg once lost a >600s compile) resumes from the cached
+    # executable instead of recompiling from scratch.
+    from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE_DIR",
+                                        "/tmp/bert_tpu_jax_cache"))
     from bert_pytorch_tpu import optim, pretrain
     from bert_pytorch_tpu.config import BertConfig
     from bert_pytorch_tpu.models import BertForPreTraining

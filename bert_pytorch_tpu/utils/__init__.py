@@ -1,5 +1,6 @@
 """Cross-cutting utilities: dist helpers, logging, checkpointing."""
 
+from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 from bert_pytorch_tpu.utils.dist import (
     barrier,
     get_rank,
@@ -9,6 +10,7 @@ from bert_pytorch_tpu.utils.dist import (
 )
 
 __all__ = [
+    "enable_compile_cache",
     "barrier",
     "get_rank",
     "get_world_size",

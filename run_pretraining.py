@@ -36,6 +36,7 @@ from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rule
 from bert_pytorch_tpu.parallel import launcher
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 from bert_pytorch_tpu.utils.dist import (
     agree_on_resume_step,
     get_rank,
@@ -118,6 +119,12 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                         choices=["auto", "xla", "pallas", "ring"],
                         help="'auto' picks the measured winner by sequence "
                              "length: XLA <256, fused Pallas kernel >=256")
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="persistent XLA compilation cache directory; "
+                             "restarted/resumed jobs (and the bench retry "
+                             "harness) reuse compiled executables instead of "
+                             "recompiling (~minutes for BERT-large). Empty "
+                             "disables.")
     parser.add_argument("--rng_impl", type=str, default="rbg",
                         choices=["rbg", "threefry2x32"],
                         help="dropout PRNG: 'rbg' uses the TPU hardware "
@@ -191,6 +198,7 @@ def setup_training(args):
     """Mesh + logging + accumulation math (reference setup_training,
     run_pretraining.py:180-230)."""
     jax.config.update("jax_default_prng_impl", args.rng_impl)
+    enable_compile_cache(args.compile_cache_dir)
     launcher.initialize()
     mesh = create_mesh(MeshConfig(
         data=args.mesh_data, fsdp=args.mesh_fsdp, pipe=args.mesh_pipe,

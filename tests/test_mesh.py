@@ -1,9 +1,5 @@
 """MeshConfig / create_mesh unit coverage (the multi-process integration
-legs live in tests/test_multihost.py), plus shell-script syntax checks."""
-
-import glob
-import os
-import subprocess
+legs live in tests/test_multihost.py)."""
 
 import pytest
 
@@ -29,20 +25,6 @@ def test_create_mesh_dcn_needs_granules(devices):
     # Single-process CPU: one process granule cannot satisfy dcn_data=2.
     with pytest.raises(ValueError, match="[Nn]umber of slices"):
         create_mesh(MeshConfig(dcn_data=2, dcn_process_granule=True))
-
-
-def test_shell_scripts_parse():
-    """Every launcher/capture script must at least pass bash -n (the
-    cluster scripts themselves cannot execute here — SURVEY §2.1 #20)."""
-    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    scripts = [p for pat in ("scripts/*.sh", "scripts/*.slurm",
-                             "scripts/*.cobalt")
-               for p in glob.glob(os.path.join(root, pat))]
-    assert len(scripts) >= 10, scripts
-    for path in scripts:
-        res = subprocess.run(["bash", "-n", path], capture_output=True,
-                             text=True)
-        assert res.returncode == 0, f"{path}: {res.stderr}"
 
 
 def test_create_mesh_plain_shapes(devices):

@@ -1,0 +1,19 @@
+"""Shell-script syntax checks: every launcher/capture script must at least
+pass ``bash -n`` (the cluster scripts themselves cannot execute here —
+SURVEY §2.1 #20)."""
+
+import glob
+import os
+import subprocess
+
+
+def test_shell_scripts_parse():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    scripts = [p for pat in ("scripts/*.sh", "scripts/*.slurm",
+                             "scripts/*.cobalt")
+               for p in glob.glob(os.path.join(root, pat))]
+    assert len(scripts) >= 10, scripts
+    for path in scripts:
+        res = subprocess.run(["bash", "-n", path], capture_output=True,
+                             text=True)
+        assert res.returncode == 0, f"{path}: {res.stderr}"
