@@ -112,3 +112,36 @@ class TestFlops:
         # The round-1 claimed 396 seq/s/chip on v5e must land near 0.5 MFU.
         assert 0.4 < flops.mfu(396.0, per_seq, "TPU v5e") < 0.55
         assert flops.mfu(396.0, per_seq, "unknown-device") == 0.0
+
+
+class TestCompileCache:
+    """enable_compile_cache validates the directory up front (a failure at
+    compile time would only surface as a buried JAX warning)."""
+
+    def test_enables_and_creates_dir(self, tmp_path):
+        import jax
+
+        from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
+
+        target = tmp_path / "nested" / "cache"
+        before_dir = jax.config.jax_compilation_cache_dir
+        before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            assert enable_compile_cache(str(target)) is True
+            assert target.is_dir()
+            assert jax.config.jax_compilation_cache_dir == str(target)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", before_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", before_min)
+
+    def test_empty_disables(self):
+        from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
+
+        assert enable_compile_cache("") is False
+
+    def test_unwritable_dir_reports_and_degrades(self, capsys):
+        from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
+
+        assert enable_compile_cache("/proc/1/nonexistent/cache") is False
+        assert "compile cache disabled" in capsys.readouterr().out
