@@ -116,9 +116,11 @@ def _child_main():
     import numpy as np
 
     jax.config.update("jax_default_prng_impl", RNG_IMPL)
-    # Persistent compilation cache: a retry after a mid-compile tunnel drop
-    # (the seq-1024 leg once lost a >600s compile) resumes from the cached
-    # executable instead of recompiling from scratch.
+    # Persistent compilation cache: a retry (or a later capture pass) after
+    # a drop that happens once compilation has COMPLETED reuses the cached
+    # executable instead of recompiling. An interrupted compile writes no
+    # entry — long-seq legs additionally scale the parent's attempt timeout
+    # so the first compile gets to finish at all.
     from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE_DIR",
@@ -332,8 +334,14 @@ def main():
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
     backoff_s = float(os.environ.get("BENCH_BACKOFF_S", "30"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
-    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", "600"))
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "900"))
+    # Long-sequence compiles through the tunnel can alone exceed the default
+    # 600s attempt window (the seq-1024 leg measured >600s), and a killed
+    # compile leaves nothing in the persistent cache to resume from — scale
+    # the default with the sequence length so the first compile can finish.
+    seq_scale = max(1.0, (LONG_SEQ or 0) / 512.0)
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S",
+                                           str(600 * seq_scale)))
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", str(900 * seq_scale)))
     deadline = time.monotonic() + budget_s
 
     env = dict(os.environ)

@@ -38,6 +38,7 @@ from bert_pytorch_tpu.models.losses import _xent_ignore
 from bert_pytorch_tpu.ops.grad_utils import clip_by_global_norm
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 
 
 def parse_arguments(argv=None):
@@ -60,6 +61,8 @@ def parse_arguments(argv=None):
     parser.add_argument("--batch_size", type=int, default=32)
     parser.add_argument("--max_seq_len", type=int, default=128)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--skip_eval", action="store_true")
@@ -92,6 +95,7 @@ def batches(arrays: dict, batch_size: int, shuffle: bool, rng):
 
 
 def main(args):
+    enable_compile_cache(args.compile_cache_dir)
     processor = glue.PROCESSORS[args.task]()
     regression = processor.regression
     num_labels = 1 if regression else len(processor.labels)

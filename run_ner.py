@@ -33,6 +33,7 @@ from bert_pytorch_tpu.models.losses import token_classification_loss
 from bert_pytorch_tpu.ops.grad_utils import clip_by_global_norm
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 
 
 def parse_arguments(argv=None):
@@ -53,6 +54,8 @@ def parse_arguments(argv=None):
     parser.add_argument("--batch_size", type=int, default=32)
     parser.add_argument("--max_seq_len", type=int, default=128)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
     args = parser.parse_args(argv)
@@ -99,6 +102,7 @@ def batches(dataset, batch_size, shuffle, rng):
 
 
 def main(args):
+    enable_compile_cache(args.compile_cache_dir)
     rng = np.random.default_rng(args.seed)
     logger.init(handlers=[logger.StreamHandler()])
 

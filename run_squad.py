@@ -39,6 +39,7 @@ from bert_pytorch_tpu.ops.grad_utils import global_norm
 from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 from bert_pytorch_tpu.utils.dist import is_main_process
 
 
@@ -66,6 +67,8 @@ def parse_args(argv=None):
     parser.add_argument("--n_best_size", type=int, default=20)
     parser.add_argument("--max_answer_length", type=int, default=30)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--gradient_accumulation_steps", type=int, default=1)
     parser.add_argument("--do_lower_case", action="store_true")
     parser.add_argument("--version_2_with_negative", action="store_true")
@@ -172,6 +175,7 @@ def features_to_arrays(features, is_training):
 
 
 def main(args):
+    enable_compile_cache(args.compile_cache_dir)
     np.random.seed(args.seed)
     devices = None
     if args.mesh_data > 0:

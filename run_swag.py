@@ -34,6 +34,7 @@ from bert_pytorch_tpu.models import BertForMultipleChoice
 from bert_pytorch_tpu.ops.grad_utils import clip_by_global_norm
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 from run_glue import batches  # padded fixed-shape batches + valid mask
 
 
@@ -55,6 +56,8 @@ def parse_arguments(argv=None):
     parser.add_argument("--batch_size", type=int, default=16)
     parser.add_argument("--max_seq_len", type=int, default=128)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
     args = parser.parse_args(argv)
@@ -71,6 +74,7 @@ def parse_arguments(argv=None):
 
 
 def main(args):
+    enable_compile_cache(args.compile_cache_dir)
     logger.init(handlers=[logger.StreamHandler()])
     if args.tokenizer == "wordpiece":
         tokenizer = get_wordpiece_tokenizer(args.vocab_file,
