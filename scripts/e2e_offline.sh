@@ -14,6 +14,8 @@
 # Profile via E2E_PROFILE: "tiny" (default; CPU-runnable in ~5 min, 2-layer
 # model) or "chip" (BERT-base, a few hundred pretrain steps — run on TPU).
 set -euo pipefail
+# Same knob as bench.py; content-keyed, shared across capture legs.
+CACHE=${BENCH_COMPILE_CACHE_DIR:-/tmp/bert_tpu_jax_cache}
 cd "$(dirname "$0")/.."
 W=${1:-/tmp/bert_e2e}
 RESULT=${2:-$W/e2e_result.json}
@@ -84,7 +86,8 @@ python run_pretraining.py --input_dir "$W/encoded" \
     --steps "$PRETRAIN_STEPS" --max_steps "$PRETRAIN_STEPS" \
     --learning_rate "$LR" --warmup_proportion 0.1 \
     --max_predictions_per_seq 20 \
-    --log_prefix log --num_steps_per_checkpoint 10000
+    --log_prefix log --num_steps_per_checkpoint 10000 \
+    --compile_cache_dir "$CACHE"
 CKPT=$(ls -t "$W"/pretrain/pretrain_ckpts/ckpt_*.msgpack | head -1)
 echo "pretrained checkpoint: $CKPT"
 
@@ -108,7 +111,8 @@ python run_squad.py \
     --train_batch_size "$SQUAD_BATCH" --predict_batch_size "$SQUAD_BATCH" \
     --max_steps "$SQUAD_STEPS" --max_seq_length 128 \
     --doc_stride 64 --max_query_length 24 \
-    --learning_rate 5e-5 --skip_cache
+    --learning_rate 5e-5 --skip_cache \
+    --compile_cache_dir "$CACHE"
 
 echo "== 9. EM/F1 artifact (re-run the official metric on the dev set)"
 SCORES=$(python scripts/squad_evaluate_v11.py \

@@ -9,6 +9,8 @@
 # dropout, bf16 end-to-end pretraining with checkpoint + resume, the fused
 # attention backend at seq 512, and the three bench modes.
 set -euo pipefail
+# Same knob as bench.py; content-keyed, shared across capture legs.
+CACHE=${BENCH_COMPILE_CACHE_DIR:-/tmp/bert_tpu_jax_cache}
 cd "$(dirname "$0")/.."
 WORK=${1:-/tmp/bert_tpu_smoke}
 rm -rf "$WORK" && mkdir -p "$WORK"
@@ -33,14 +35,16 @@ python run_pretraining.py --input_dir "$WORK/seq128" \
     --global_batch_size 56 --local_batch_size 56 --steps 3 --max_steps 6 \
     --learning_rate 6e-3 --warmup_proportion 0.28 \
     --max_predictions_per_seq 20 --remat dots \
-    --log_prefix "$WORK/out128/log" --num_steps_per_checkpoint 1000
+    --log_prefix "$WORK/out128/log" --num_steps_per_checkpoint 1000 \
+    --compile_cache_dir "$CACHE"
 python run_pretraining.py --input_dir "$WORK/seq128" \
     --output_dir "$WORK/out128" \
     --model_config_file configs/bert_large_uncased_config.json \
     --global_batch_size 56 --local_batch_size 56 --steps 3 --max_steps 6 \
     --learning_rate 6e-3 --warmup_proportion 0.28 \
     --max_predictions_per_seq 20 --remat dots \
-    --log_prefix "$WORK/out128/log" --num_steps_per_checkpoint 1000
+    --log_prefix "$WORK/out128/log" --num_steps_per_checkpoint 1000 \
+    --compile_cache_dir "$CACHE"
 
 echo "== fused Pallas attention at seq 512"
 python run_pretraining.py --input_dir "$WORK/seq512" \
@@ -49,7 +53,8 @@ python run_pretraining.py --input_dir "$WORK/seq512" \
     --global_batch_size 28 --local_batch_size 28 --steps 3 --max_steps 3 \
     --learning_rate 4e-3 --warmup_proportion 0.1 \
     --max_predictions_per_seq 80 --remat dots --attention_backend pallas \
-    --log_prefix "$WORK/out512/log" --num_steps_per_checkpoint 5000
+    --log_prefix "$WORK/out512/log" --num_steps_per_checkpoint 5000 \
+    --compile_cache_dir "$CACHE"
 
 echo "== benches (phase 1, phase 2, K-FAC)"
 python bench.py
