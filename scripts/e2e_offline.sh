@@ -13,6 +13,13 @@
 #
 # Profile via E2E_PROFILE: "tiny" (default; CPU-runnable in ~5 min, 2-layer
 # model) or "chip" (BERT-base, a few hundred pretrain steps — run on TPU).
+#
+# RESUMABLE (same scheme as convergence_r02.sh): the data build is stamped
+# by profile and skipped when already complete; the pretrain leg is skipped
+# when its final checkpoint exists (and auto-resumes from any partial
+# checkpoint otherwise); the finetune leg is skipped when the dev-set
+# predictions exist, restarting from the pretrained checkpoint if
+# interrupted. The shared compile cache covers recompiles either way.
 set -euo pipefail
 # Same knob as bench.py; content-keyed, shared across capture legs.
 CACHE=${BENCH_COMPILE_CACHE_DIR:-/tmp/bert_tpu_jax_cache}
@@ -20,7 +27,7 @@ cd "$(dirname "$0")/.."
 W=${1:-/tmp/bert_e2e}
 RESULT=${2:-$W/e2e_result.json}
 PROFILE=${E2E_PROFILE:-tiny}
-rm -rf "$W" && mkdir -p "$W"
+mkdir -p "$W"
 
 if [ "$PROFILE" = "chip" ]; then
   ART_PER_FILE=2000; VOCAB=8192
@@ -34,28 +41,36 @@ else
   SQUAD_PARAS=40; SQUAD_STEPS=20; SQUAD_BATCH=8
 fi
 
-echo "== 1. synthesize corpus (shared fact world, seed 0)"
-python -m bert_pytorch_tpu.tools.make_synthetic_text corpus \
-    --output_dir "$W/formatted" --num_files 4 \
-    --articles_per_file "$ART_PER_FILE" --seed 0
+STAMP="profile=$PROFILE"
+if [ ! -f "$W/.data_ok" ] || [ "$(cat "$W/.data_ok")" != "$STAMP" ]; then
+  if [ -f "$W/.data_ok" ]; then
+    echo "!! profile stamp mismatch (have '$(cat "$W/.data_ok")', want" \
+         "'$STAMP') — REBUILDING $W from scratch"
+  fi
+  rm -rf "$W" && mkdir -p "$W"
 
-echo "== 2. shard on article boundaries"
-python -m bert_pytorch_tpu.tools.shard \
-    --input_glob "$W/formatted/*.txt" \
-    --output_dir "$W/sharded" --max_bytes_per_shard 200k
+  echo "== 1. synthesize corpus (shared fact world, seed 0)"
+  python -m bert_pytorch_tpu.tools.make_synthetic_text corpus \
+      --output_dir "$W/formatted" --num_files 4 \
+      --articles_per_file "$ART_PER_FILE" --seed 0
 
-echo "== 3. train WordPiece vocab (C++ trainer)"
-python -m bert_pytorch_tpu.tools.build_vocab \
-    --input_glob "$W/sharded/*.txt" \
-    --output "$W/vocab.txt" --vocab_size "$VOCAB" --min_frequency 1
+  echo "== 2. shard on article boundaries"
+  python -m bert_pytorch_tpu.tools.shard \
+      --input_glob "$W/formatted/*.txt" \
+      --output_dir "$W/sharded" --max_bytes_per_shard 200k
 
-echo "== 4. encode documents -> HDF5 pretraining shards"
-python -m bert_pytorch_tpu.tools.encode_data \
-    --input_dir "$W/sharded" --output_dir "$W/encoded" \
-    --vocab_file "$W/vocab.txt" --max_seq_len 128 --next_seq_prob 0.5
+  echo "== 3. train WordPiece vocab (C++ trainer)"
+  python -m bert_pytorch_tpu.tools.build_vocab \
+      --input_glob "$W/sharded/*.txt" \
+      --output "$W/vocab.txt" --vocab_size "$VOCAB" --min_frequency 1
 
-echo "== 5. model config sized to the trained vocab"
-python - "$W" "$HID" "$LAYERS" "$HEADS" "$FFN" <<'EOF'
+  echo "== 4. encode documents -> HDF5 pretraining shards"
+  python -m bert_pytorch_tpu.tools.encode_data \
+      --input_dir "$W/sharded" --output_dir "$W/encoded" \
+      --vocab_file "$W/vocab.txt" --max_seq_len 128 --next_seq_prob 0.5
+
+  echo "== 5. model config sized to the trained vocab"
+  python - "$W" "$HID" "$LAYERS" "$HEADS" "$FFN" <<'EOF'
 import json, sys
 w, hid, layers, heads, ffn = sys.argv[1], *map(int, sys.argv[2:])
 n_vocab = sum(1 for l in open(f"{w}/vocab.txt") if l.strip())
@@ -69,52 +84,69 @@ json.dump({
 print("vocab entries:", n_vocab)
 EOF
 
+  echo "== 5b. synthesize SQuAD train + HELD-OUT dev (same fact world)"
+  python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
+      --output "$W/squad_train.json" --paragraphs "$SQUAD_PARAS" \
+      --qas_per_paragraph 3 --seed 11 --fact_seed 0
+  python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
+      --output "$W/squad_dev.json" --paragraphs $((SQUAD_PARAS / 4)) \
+      --qas_per_paragraph 3 --seed 97 --fact_seed 0
+
+  echo "$STAMP" > "$W/.data_ok"
+else
+  echo "== corpus/vocab/encode/squad data reused from $W ('$STAMP')"
+fi
+
 echo "== 6. pretrain"
-# local batch = global / device count (run_pretraining requires the global
-# batch to divide by local_batch x data shards; on an 8-chip host the
-# per-chip batch is PRETRAIN_BATCH/8).
-NDEV=$(python -c "import jax; print(len(jax.devices()))")
-LOCAL_BATCH=$((PRETRAIN_BATCH / NDEV))
-if [ "$LOCAL_BATCH" -lt 1 ]; then LOCAL_BATCH=1; fi
-# round the global batch to LOCAL*NDEV so the divisibility check always
-# holds (e.g. 16 samples on 6 devices -> local 2, global 12)
-PRETRAIN_BATCH=$((LOCAL_BATCH * NDEV))
-python run_pretraining.py --input_dir "$W/encoded" \
-    --output_dir "$W/pretrain" \
-    --model_config_file "$W/model.json" \
-    --global_batch_size "$PRETRAIN_BATCH" --local_batch_size "$LOCAL_BATCH" \
-    --steps "$PRETRAIN_STEPS" --max_steps "$PRETRAIN_STEPS" \
-    --learning_rate "$LR" --warmup_proportion 0.1 \
-    --max_predictions_per_seq 20 \
-    --log_prefix log --num_steps_per_checkpoint 10000 \
-    --compile_cache_dir "$CACHE"
+if [ -f "$W/pretrain/pretrain_ckpts/ckpt_$PRETRAIN_STEPS.msgpack" ]; then
+  echo "   already complete (ckpt_$PRETRAIN_STEPS exists), skipping"
+else
+  # Partial checkpoints are NOT cleared: run_pretraining auto-resumes from
+  # the newest one (an interrupted 300-step chip leg redoes only the tail).
+  # local batch = global / device count (run_pretraining requires the
+  # global batch to divide by local_batch x data shards; on an 8-chip host
+  # the per-chip batch is PRETRAIN_BATCH/8). Device count is only probed
+  # when the leg actually runs — a skipped rerun stays tunnel-independent.
+  NDEV=$(python -c "import jax; print(len(jax.devices()))")
+  LOCAL_BATCH=$((PRETRAIN_BATCH / NDEV))
+  if [ "$LOCAL_BATCH" -lt 1 ]; then LOCAL_BATCH=1; fi
+  # round the global batch to LOCAL*NDEV so the divisibility check always
+  # holds (e.g. 16 samples on 6 devices -> local 2, global 12)
+  PRETRAIN_BATCH=$((LOCAL_BATCH * NDEV))
+  python run_pretraining.py --input_dir "$W/encoded" \
+      --output_dir "$W/pretrain" \
+      --model_config_file "$W/model.json" \
+      --global_batch_size "$PRETRAIN_BATCH" --local_batch_size "$LOCAL_BATCH" \
+      --steps "$PRETRAIN_STEPS" --max_steps "$PRETRAIN_STEPS" \
+      --learning_rate "$LR" --warmup_proportion 0.1 \
+      --max_predictions_per_seq 20 \
+      --log_prefix log --num_steps_per_checkpoint 10000 \
+      --compile_cache_dir "$CACHE"
+fi
 CKPT=$(ls -t "$W"/pretrain/pretrain_ckpts/ckpt_*.msgpack | head -1)
 echo "pretrained checkpoint: $CKPT"
 
-echo "== 7. synthesize SQuAD train + HELD-OUT dev (same fact world)"
-python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
-    --output "$W/squad_train.json" --paragraphs "$SQUAD_PARAS" \
-    --qas_per_paragraph 3 --seed 11 --fact_seed 0
-python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
-    --output "$W/squad_dev.json" --paragraphs $((SQUAD_PARAS / 4)) \
-    --qas_per_paragraph 3 --seed 97 --fact_seed 0
+echo "== 7. finetune from the pretraining checkpoint + official eval"
+if [ -f "$W/squad_out/predictions.json" ]; then
+  echo "   already complete (predictions.json exists), skipping"
+else
+  rm -rf "$W/squad_out"
+  python run_squad.py \
+      --output_dir "$W/squad_out" \
+      --config_file "$W/model.json" \
+      --init_checkpoint "$CKPT" \
+      --train_file "$W/squad_train.json" \
+      --predict_file "$W/squad_dev.json" \
+      --do_train --do_predict --do_eval --do_lower_case \
+      --eval_script scripts/squad_evaluate_v11.py \
+      --train_batch_size "$SQUAD_BATCH" --predict_batch_size "$SQUAD_BATCH" \
+      --max_steps "$SQUAD_STEPS" --max_seq_length 128 \
+      --doc_stride 64 --max_query_length 24 \
+      --learning_rate 5e-5 --skip_cache \
+      --compile_cache_dir "$CACHE"
+fi
 
-echo "== 8. finetune from the pretraining checkpoint + official eval"
-python run_squad.py \
-    --output_dir "$W/squad_out" \
-    --config_file "$W/model.json" \
-    --init_checkpoint "$CKPT" \
-    --train_file "$W/squad_train.json" \
-    --predict_file "$W/squad_dev.json" \
-    --do_train --do_predict --do_eval --do_lower_case \
-    --eval_script scripts/squad_evaluate_v11.py \
-    --train_batch_size "$SQUAD_BATCH" --predict_batch_size "$SQUAD_BATCH" \
-    --max_steps "$SQUAD_STEPS" --max_seq_length 128 \
-    --doc_stride 64 --max_query_length 24 \
-    --learning_rate 5e-5 --skip_cache \
-    --compile_cache_dir "$CACHE"
-
-echo "== 9. EM/F1 artifact (re-run the official metric on the dev set)"
+echo "== 8. EM/F1 artifact (re-run the official metric on the dev set)"
 SCORES=$(python scripts/squad_evaluate_v11.py \
     "$W/squad_dev.json" "$W/squad_out/predictions.json")
 python - "$RESULT" "$PROFILE" "$SCORES" <<'EOF'

@@ -13,7 +13,11 @@ set -euo pipefail
 CACHE=${BENCH_COMPILE_CACHE_DIR:-/tmp/bert_tpu_jax_cache}
 cd "$(dirname "$0")/.."
 WORK=${1:-/tmp/bert_tpu_smoke}
-rm -rf "$WORK" && mkdir -p "$WORK"
+# Clear only this script's own (cheap) legs; "$WORK/e2e" is e2e_offline.sh's
+# RESUMABLE workdir — wiping it would redo the full chip pretrain+finetune
+# chain after every tunnel-drop retry.
+rm -rf "$WORK/seq128" "$WORK/seq512" "$WORK/out128" "$WORK/out512"
+mkdir -p "$WORK"
 
 echo "== synthetic shards"
 python -m bert_pytorch_tpu.tools.make_synthetic_data \
