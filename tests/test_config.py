@@ -1,0 +1,83 @@
+"""Config-system unit tests: BertConfig merge semantics and the three-level
+CLI > JSON-config-file > argparse-defaults precedence (SURVEY §5.6;
+reference run_pretraining.py:75-177, src/modeling.py:188-295)."""
+
+import argparse
+import json
+
+import pytest
+
+from bert_pytorch_tpu.config import (
+    BertConfig,
+    parse_args_with_config_file,
+    require_args,
+)
+
+
+def _parser():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config_file", type=str, default=None)
+    p.add_argument("--learning_rate", type=float, default=1e-3)
+    p.add_argument("--max_steps", type=int, default=None)
+    p.add_argument("--optimizer", type=str, default="lamb")
+    p.add_argument("--kfac", action="store_true")
+    return p
+
+
+class TestPrecedence:
+    def test_defaults_when_no_config(self):
+        args = parse_args_with_config_file(_parser(), [])
+        assert args.learning_rate == 1e-3 and args.optimizer == "lamb"
+
+    def test_json_overrides_defaults(self, tmp_path):
+        cfg = tmp_path / "t.json"
+        cfg.write_text(json.dumps(
+            {"learning_rate": 6e-3, "max_steps": 7038, "kfac": True}))
+        args = parse_args_with_config_file(
+            _parser(), ["--config_file", str(cfg)])
+        assert args.learning_rate == 6e-3
+        assert args.max_steps == 7038
+        assert args.kfac is True  # store_true flag set from JSON
+        assert args.optimizer == "lamb"  # untouched default
+
+    def test_explicit_cli_beats_json(self, tmp_path):
+        cfg = tmp_path / "t.json"
+        cfg.write_text(json.dumps({"learning_rate": 6e-3, "max_steps": 7038}))
+        args = parse_args_with_config_file(
+            _parser(),
+            ["--config_file", str(cfg), "--learning_rate", "4e-3"])
+        # CLI wins over JSON; JSON still beats the default for other keys.
+        assert args.learning_rate == 4e-3
+        assert args.max_steps == 7038
+
+    def test_unknown_json_key_rejected(self, tmp_path):
+        cfg = tmp_path / "t.json"
+        cfg.write_text(json.dumps({"not_a_flag": 1}))
+        with pytest.raises(ValueError, match="not_a_flag"):
+            parse_args_with_config_file(_parser(), ["--config_file", str(cfg)])
+
+    def test_require_args_from_either_source(self, tmp_path):
+        cfg = tmp_path / "t.json"
+        cfg.write_text(json.dumps({"max_steps": 10}))
+        args = parse_args_with_config_file(
+            _parser(), ["--config_file", str(cfg)])
+        require_args(args, ["max_steps"])  # satisfied via JSON
+        args2 = parse_args_with_config_file(_parser(), [])
+        with pytest.raises(ValueError, match="max_steps"):
+            require_args(args2, ["max_steps"])
+
+
+class TestBertConfig:
+    def test_from_dict_merges_onto_defaults(self):
+        cfg = BertConfig.from_dict({"hidden_size": 1024, "vocab_file": "/v"})
+        assert cfg.hidden_size == 1024
+        assert cfg.num_hidden_layers == 12  # default survives
+        assert cfg.vocab_file == "/v"  # extra key rides along
+
+    def test_json_roundtrip(self, tmp_path):
+        path = tmp_path / "c.json"
+        BertConfig(hidden_size=256, tokenizer="wordpiece").to_json_file(
+            str(path))
+        cfg = BertConfig.from_json_file(str(path))
+        assert cfg.hidden_size == 256
+        assert cfg.tokenizer == "wordpiece"

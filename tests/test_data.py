@@ -295,6 +295,31 @@ def test_loader_multiprocess_propagates_worker_errors(legacy_shards):
         list(loader)
 
 
+class _DyingDataset(ShardedPretrainingDataset):
+    """Worker-death fixture: exits the PROCESS (no exception to catch) when
+    asked for an index past the first batch — the OOM-kill shape."""
+
+    def __getitem__(self, idx):
+        if idx >= 8:
+            import os
+
+            os._exit(3)
+        return super().__getitem__(idx)
+
+
+def test_loader_multiprocess_detects_silent_worker_death(legacy_shards):
+    ds = _DyingDataset(
+        legacy_shards, MASK_ID, max_pred_per_seq=20, masked_lm_prob=0.15,
+        vocab_size=VOCAB, seed=0)
+    sampler = DistributedSampler(ds, 1, 0)
+    loader = DataLoader(ds, sampler, batch_size=8, num_workers=1)
+    # os._exit can fire before the queue's feeder thread flushes batch 0,
+    # so the death may surface on the first OR second get — either way the
+    # loader must raise (exit code in message), never hang.
+    with pytest.raises(RuntimeError, match="died .exit code 3."):
+        list(loader)
+
+
 def test_loader_multiprocess_epoch_changes_masking(shards):
     """Respawned workers must fold the EPOCH into their masking RNG seed:
     without it every epoch replays identical masking draws (silently static

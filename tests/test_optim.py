@@ -38,6 +38,53 @@ def test_make_schedule_rejects_unknown():
         optim.make_schedule("exponential", 1e-3, 0.1, 100)
 
 
+def test_cosine_schedule_values():
+    # Reference formula (schedulers.py:66): past warmup the decay is
+    # 0.5*(1+cos(pi + progress)) — pi ADDED to progress, a reference quirk
+    # kept verbatim for parity.
+    import math
+
+    sched = optim.warmup_cosine_schedule(1e-3, warmup=0.1, total_steps=1000)
+    # warmup region: linear ramp progress/warmup with the +1 offset
+    t = 49
+    want = 1e-3 * ((t + 1) / 1000) / 0.1
+    assert np.isclose(float(sched(jnp.asarray(t))), want, rtol=1e-6)
+    # decay region
+    t = 600
+    progress = (t + 1) / 1000
+    want = 1e-3 * 0.5 * (1.0 + math.cos(math.pi + progress))
+    assert np.isclose(float(sched(jnp.asarray(t))), want, rtol=1e-5)
+
+
+def test_constant_schedule_values():
+    sched = optim.warmup_constant_schedule(2e-5, warmup=0.2, total_steps=500)
+    t = 59  # progress 0.12 < warmup
+    want = 2e-5 * ((t + 1) / 500) / 0.2
+    assert np.isclose(float(sched(jnp.asarray(t))), want, rtol=1e-6)
+    # past warmup: exactly base_lr, forever
+    for t in (100, 499, 5000):
+        assert np.isclose(float(sched(jnp.asarray(t))), 2e-5, rtol=1e-6)
+
+
+def test_exp_decay_exp_schedule_values():
+    # Reference warmup_exp_decay_exp (schedulers.py:144-158): NO +1 offset
+    # (driven with the raw global step), degree-2 polynomial warmup, then
+    # decay_rate**((step - warmup_end)/decay_steps).
+    sched = optim.warmup_exp_decay_exp_schedule(
+        1e-3, decay_rate=0.5, decay_steps=100, total_steps=1000,
+        warmup=0.01, degree=2.0)
+    t = 5  # x = 0.005 < warmup
+    want = 1e-3 * (0.005 / 0.01) ** 2.0
+    assert np.isclose(float(sched(jnp.asarray(t))), want, rtol=1e-6)
+    t = 300
+    want = 1e-3 * 0.5 ** ((300 - 10) / 100)
+    assert np.isclose(float(sched(jnp.asarray(t))), want, rtol=1e-5)
+    # warmup == 0 short-circuits to base_lr (reference returns 1.0)
+    flat = optim.warmup_exp_decay_exp_schedule(
+        1e-3, decay_rate=0.5, decay_steps=100, total_steps=1000, warmup=0.0)
+    assert np.isclose(float(flat(jnp.asarray(123))), 1e-3, rtol=1e-6)
+
+
 def _numpy_lamb_step(p, g, m, v, t, lr, b1, b2, eps, wd):
     """Independent LAMB reference (bias-corrected, trust ratio)."""
     m = b1 * m + (1 - b1) * g
