@@ -35,8 +35,8 @@ def legacy_shard(tmp_path_factory):
     return make_shard(str(d / "legacy.hdf5"), 16, 64, VOCAB, seed=9, legacy=True)
 
 
-def _dataset(shards, **kw):
-    return ShardedPretrainingDataset(
+def _dataset(shards, cls=ShardedPretrainingDataset, **kw):
+    return cls(
         shards, MASK_ID, max_pred_per_seq=20, masked_lm_prob=0.15,
         vocab_size=VOCAB, seed=0, **kw,
     )
@@ -308,9 +308,7 @@ class _DyingDataset(ShardedPretrainingDataset):
 
 
 def test_loader_multiprocess_detects_silent_worker_death(legacy_shards):
-    ds = _DyingDataset(
-        legacy_shards, MASK_ID, max_pred_per_seq=20, masked_lm_prob=0.15,
-        vocab_size=VOCAB, seed=0)
+    ds = _dataset(legacy_shards, cls=_DyingDataset)
     sampler = DistributedSampler(ds, 1, 0)
     loader = DataLoader(ds, sampler, batch_size=8, num_workers=1)
     # os._exit can fire before the queue's feeder thread flushes batch 0,
