@@ -22,6 +22,7 @@ EOF
 }
 
 have_seq1024() { [ -f bench_seq1024.json ] && ! grep -q '"error"' bench_seq1024.json; }
+have_seq2048() { [ -f bench_seq2048.json ] && ! grep -q '"error"' bench_seq2048.json; }
 have_convergence() { [ -f CONVERGENCE_r02.csv ]; }
 have_e2e() { [ -f E2E_r02.json ]; }
 
@@ -63,7 +64,7 @@ EOF
 }
 
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if have_seq1024 && have_convergence && have_e2e && have_sweep; then
+  if have_seq1024 && have_seq2048 && have_convergence && have_e2e && have_sweep; then
     echo "retry_capture_r02: all artifacts captured"
     exit 0
   fi
@@ -91,24 +92,32 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
       echo "   FAILED (smoke_and_e2e); tail:"; tail -3 "$LOGS/smoke.log"
     fi
   fi
-  if ! have_seq1024; then
-    echo "== leg: bench_seq1024"
-    # The seq-1024 compile through the tunnel blew the default 600s child
-    # timeout once; give it room.
-    if env BENCH_SEQ=1024 BENCH_ATTEMPT_TIMEOUT_S=1800 BENCH_BUDGET_S=2100 \
-        timeout 2400 python bench.py > "$LOGS/seq1024.json" 2> "$LOGS/seq1024.log"
+  # Long-sequence bench legs (the seq-1024 compile through the tunnel blew
+  # the default 600s child timeout once; the per-seq numbers give each
+  # compile room to finish, growing with the sequence length).
+  run_seq_leg() {  # seq, attempt_timeout_s, budget_s, hard_timeout_s
+    local seq=$1 at=$2 bs=$3 ht=$4
+    echo "== leg: bench_seq$seq"
+    if env BENCH_SEQ="$seq" BENCH_ATTEMPT_TIMEOUT_S="$at" BENCH_BUDGET_S="$bs" \
+        timeout "$ht" python bench.py \
+        > "$LOGS/seq$seq.json" 2> "$LOGS/seq$seq.log"
     then
-      cp "$LOGS/seq1024.json" bench_seq1024.json
-      echo "   $(cat bench_seq1024.json)"
+      cp "$LOGS/seq$seq.json" "bench_seq$seq.json"
+      echo "   $(cat "bench_seq$seq.json")"
     else
-      echo "   FAILED (seq1024); $(tail -1 "$LOGS/seq1024.log" 2>/dev/null)"
+      echo "   FAILED (seq$seq); $(tail -1 "$LOGS/seq$seq.log" 2>/dev/null)"
     fi
-  fi
-  if have_seq1024 && have_convergence && have_e2e && ! have_sweep; then
+  }
+  if ! have_seq1024; then run_seq_leg 1024 1800 2100 2400; fi
+  if ! have_seq2048; then run_seq_leg 2048 2400 2700 3000; fi
+  if have_seq1024 && have_seq2048 && have_convergence && have_e2e \
+      && ! have_sweep; then
     echo "== leg: batch sweep"
     run_sweep || true
   fi
 done
 echo "retry_capture_r02: deadline reached"
-have_seq1024; s=$?; have_convergence; c=$?; have_e2e; e=$?; have_sweep; w=$?
-echo "captured: seq1024=$((1-s)) convergence=$((1-c)) e2e=$((1-e)) sweep=$((1-w))"
+have_seq1024; s=$?; have_seq2048; s2=$?; have_convergence; c=$?
+have_e2e; e=$?; have_sweep; w=$?
+echo "captured: seq1024=$((1-s)) seq2048=$((1-s2)) convergence=$((1-c))" \
+     "e2e=$((1-e)) sweep=$((1-w))"
