@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Render a CONVERGENCE_r*.csv (scripts/convergence_r02.sh output) to a PNG
+loss-curve figure for the architecture notes.
+
+  python tools/plot_convergence.py CONVERGENCE_r02.csv docs/convergence.png
+
+One line per optimizer leg. Styling follows the repo-external dataviz
+conventions: thin 2px lines, categorical hues in fixed slot order
+(blue, orange — a validated colorblind-safe adjacent pair), recessive
+grid/axes, text in neutral ink, direct labels at line ends plus a legend
+when there is more than one series.
+"""
+
+from __future__ import annotations
+
+import csv
+import sys
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+
+SERIES_COLORS = ["#2a78d6", "#eb6834"]  # categorical slots 1-2, light mode
+INK = "#3d3d3a"
+MUTED = "#8a8a85"
+GRID = "#e7e7e4"
+
+
+def main(csv_path: str, out_path: str) -> None:
+    legs: dict[str, list[tuple[int, float]]] = {}
+    with open(csv_path) as f:
+        for rec in csv.DictReader(f):
+            legs.setdefault(rec["optimizer"], []).append(
+                (int(rec["step"]), float(rec["loss"]))
+            )
+
+    fig, ax = plt.subplots(figsize=(7.0, 4.0), dpi=160)
+    for i, (name, rows) in enumerate(legs.items()):
+        rows.sort()
+        steps = [s for s, _ in rows]
+        losses = [l for _, l in rows]
+        color = SERIES_COLORS[i % len(SERIES_COLORS)]
+        ax.plot(steps, losses, color=color, linewidth=2.0,
+                label=name.upper(), solid_capstyle="round")
+        # direct label at the line end
+        ax.annotate(
+            f" {name.upper()} {losses[-1]:.2f}", (steps[-1], losses[-1]),
+            color=INK, fontsize=9, va="center")
+
+    ax.set_xlabel("optimizer step", color=INK, fontsize=10)
+    ax.set_ylabel("MLM+NSP loss", color=INK, fontsize=10)
+    ax.set_title(
+        "BERT-large pretraining loss (gbs 512, recipe-shaped LR, one v5e chip)",
+        color=INK, fontsize=11, loc="left")
+    ax.grid(axis="y", color=GRID, linewidth=0.8)
+    ax.set_axisbelow(True)
+    for side in ("top", "right"):
+        ax.spines[side].set_visible(False)
+    for side in ("left", "bottom"):
+        ax.spines[side].set_color(MUTED)
+    ax.tick_params(colors=MUTED, labelsize=9)
+    ax.margins(x=0.12)  # room for the direct labels
+    if len(legs) > 1:
+        ax.legend(frameon=False, fontsize=9, labelcolor=INK)
+    fig.tight_layout()
+    fig.savefig(out_path, facecolor="white")
+    print(f"wrote {out_path} ({', '.join(legs)})")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], sys.argv[2])
