@@ -27,7 +27,11 @@ MUTED = "#8a8a85"
 GRID = "#e7e7e4"
 
 
-def main(csv_path: str, out_path: str) -> None:
+DEFAULT_TITLE = (
+    "BERT-large pretraining loss (gbs 512, recipe-shaped LR, one v5e chip)")
+
+
+def main(csv_path: str, out_path: str, title: str = DEFAULT_TITLE) -> None:
     legs: dict[str, list[tuple[int, float]]] = {}
     with open(csv_path) as f:
         for rec in csv.DictReader(f):
@@ -50,9 +54,7 @@ def main(csv_path: str, out_path: str) -> None:
 
     ax.set_xlabel("optimizer step", color=INK, fontsize=10)
     ax.set_ylabel("MLM+NSP loss", color=INK, fontsize=10)
-    ax.set_title(
-        "BERT-large pretraining loss (gbs 512, recipe-shaped LR, one v5e chip)",
-        color=INK, fontsize=11, loc="left")
+    ax.set_title(title, color=INK, fontsize=11, loc="left")
     ax.grid(axis="y", color=GRID, linewidth=0.8)
     ax.set_axisbelow(True)
     for side in ("top", "right"):
@@ -69,4 +71,4 @@ def main(csv_path: str, out_path: str) -> None:
 
 
 if __name__ == "__main__":
-    main(sys.argv[1], sys.argv[2])
+    main(sys.argv[1], sys.argv[2], *sys.argv[3:4])
