@@ -123,4 +123,12 @@ with open(out, "w", newline="") as fo:
 print(open(out).read().splitlines()[0])
 print(f"rows: {sum(1 for _ in open(out)) - 1}")
 EOF
+# Refresh the committed figure only for the real capture: the repo-root
+# artifact at the default BERT-large/200-step profile. CPU sanity runs
+# (different OUT, or CONV_MODEL/CONV_STEPS overrides with the default OUT)
+# must not clobber the chip plot with mislabeled data.
+if [ "$OUT" = "CONVERGENCE_r02.csv" ] && [ "$MODEL" = "bert_large_uncased" ] \
+    && [ "$STEPS" = "200" ]; then
+  python tools/plot_convergence.py "$OUT" docs/convergence_r02.png
+fi
 echo "convergence capture OK -> $OUT"
