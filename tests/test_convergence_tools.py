@@ -1,0 +1,54 @@
+"""Smoke tests for the repo-root convergence tools (summarizer + plotter) —
+the artifact post-processing behind scripts/convergence_r02.sh."""
+
+import csv
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_csv(path, legs=("lamb", "kfac"), steps=30):
+    with open(path, "w", newline="") as f:
+        wr = csv.writer(f)
+        wr.writerow(["optimizer", "step", "loss", "mlm_accuracy",
+                     "learning_rate"])
+        for leg in legs:
+            for s in range(1, steps + 1):
+                loss = 7.0 - 0.05 * s - (0.1 if leg == "kfac" else 0.0)
+                wr.writerow([leg, s, loss, 0.01 * s, 1e-3])
+
+
+def test_summarizer_two_legs(tmp_path):
+    path = tmp_path / "conv.csv"
+    _write_csv(path)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "summarize_convergence.py"), str(path)],
+        capture_output=True, text=True, check=True)
+    rec = json.loads(out.stdout)
+    assert set(rec["legs"]) == {"lamb", "kfac"}
+    assert rec["legs"]["lamb"]["steps"] == 30
+    # kfac runs 0.1 LOWER than lamb at every step in this fixture, so the
+    # advantage (lamb - kfac, positive = K-FAC ahead) is +0.1
+    cmp = rec["kfac_vs_lamb"]
+    assert cmp["equal_step"] == 30
+    assert abs(cmp["kfac_advantage"] - 0.1) < 1e-6
+
+
+def test_plotter_writes_png(tmp_path):
+    one = tmp_path / "one.csv"
+    _write_csv(one, legs=("lamb",))
+    two = tmp_path / "two.csv"
+    _write_csv(two)
+    for src, name in ((one, "one.png"), (two, "two.png")):
+        out_png = tmp_path / name
+        subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "plot_convergence.py"),
+             str(src), str(out_png), "test title"],
+            capture_output=True, text=True, check=True)
+        assert out_png.stat().st_size > 10_000  # a real rendered figure
+        assert out_png.read_bytes()[:8] == b"\x89PNG\r\n\x1a\n"
