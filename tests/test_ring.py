@@ -196,3 +196,25 @@ def test_ring_dropout_decorrelated_across_batch_shards(devices):
     first_shard = np.asarray(out)[:2]
     second_shard = np.asarray(out)[2:]
     assert not np.allclose(first_shard, second_shard)
+
+
+def test_ring_requires_seq_axis(qkv, devices):
+    q, k, v, bias = qkv
+    # no active mesh at all
+    with pytest.raises(ValueError, match="needs an active mesh"):
+        ring_attention(q, k, v, bias=bias)
+    # active mesh without a real seq axis
+    mesh = create_mesh(MeshConfig(data=8))
+    with mesh:
+        with pytest.raises(ValueError, match="'seq' axis"):
+            ring_attention(q, k, v, bias=bias)
+
+
+def test_ring_rejects_indivisible_sequence(qkv, devices):
+    q, k, v, bias = qkv
+    mesh = create_mesh(MeshConfig(seq=8))
+    # seq length 5 not divisible by the 8-way seq axis
+    q5, k5, v5 = (x[:, :5] for x in (q, k, v))
+    with mesh:
+        with pytest.raises(ValueError, match="not divisible"):
+            ring_attention(q5, k5, v5, bias=bias[..., :5])
