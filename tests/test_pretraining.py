@@ -75,6 +75,34 @@ def test_smoke_train_with_accumulation(workdir):
     assert os.path.exists(os.path.join(workdir["out"], "pretraining_metrics.csv"))
 
 
+def test_compile_cache_populates_and_restart_resumes(workdir, tmp_path,
+                                                    monkeypatch):
+    """--compile_cache_dir wires JAX's persistent cache into the runner:
+    the train-step executable lands in the directory (threshold dropped to
+    0 here — tiny-model compiles are under the production 10s bar) and a
+    restarted run against the same cache resumes cleanly."""
+    import jax
+
+    from bert_pytorch_tpu.utils import compile_cache
+
+    monkeypatch.setattr(compile_cache, "MIN_COMPILE_TIME_SECS", 0.0)
+    cache = tmp_path / "xla_cache"
+    before_dir = jax.config.jax_compilation_cache_dir
+    before_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        run_pretraining.main(
+            _args(workdir, compile_cache_dir=str(cache)))
+        entries = list(cache.iterdir())
+        assert entries, "no executables were persisted"
+        result = run_pretraining.main(
+            _args(workdir, steps=2, compile_cache_dir=str(cache)))
+        assert result["global_step"] == 5
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", before_min)
+
+
 def test_resume_continues_and_losses_drop(workdir):
     run_pretraining.main(_args(workdir))
     result2 = run_pretraining.main(_args(workdir, steps=2))
