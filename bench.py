@@ -26,6 +26,25 @@ an ``"error"`` field so the driver always records something parseable.
 Set BENCH_CHILD=1 to run the benchmark body directly (what the parent
 spawns); knobs: BENCH_ATTEMPTS, BENCH_BACKOFF_S, BENCH_PROBE_TIMEOUT_S,
 BENCH_ATTEMPT_TIMEOUT_S, BENCH_BUDGET_S.
+
+Cold-start survival (the round-1/round-2 failure mode): a BERT-large
+compile through the tunnel can take 10-30 min, far beyond any one attempt
+window, so a cold cache on a freshly started round could never produce a
+number. Three mitigations, in order:
+  1. The persistent XLA compile cache defaults to ``.jax_cache/`` INSIDE
+     the repo, and the capture harness commits the populated entries for
+     exactly the bench shapes — a later round starts warm and the full
+     bench completes in a couple of minutes.
+  2. When the cache directory is empty (truly cold), the parent spends
+     its whole budget on ONE long attempt instead of three short ones: a
+     killed compile writes no cache entry, so one long window is the only
+     configuration that can make *progress* across retries.
+  3. If the full-model attempts fail with the backend alive and
+     BENCH_DEGRADE != 0 (default auto), a last attempt runs BERT-base at
+     the same phase-1 shape (BENCH_DEGRADED=1): a smaller-but-real
+     measurement (metric name says ``bert_base``, ``"degraded": true``,
+     vs_baseline uses a FLOP-scaled anchor) beats another zero. The
+     harness pre-warms this entry too, as insurance.
 """
 
 from __future__ import annotations
@@ -73,6 +92,24 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 KFAC = os.environ.get("BENCH_KFAC", "0") == "1"
 PHASE = int(os.environ.get("BENCH_PHASE", "1"))
 _P2 = PHASE == 2
+# Degraded fallback (see module docstring): BERT-base geometry at the
+# phase-1 shape. Only meaningful for the driver's default invocation.
+DEGRADED = os.environ.get("BENCH_DEGRADED", "0") == "1"
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+CACHE_DIR = os.environ.get("BENCH_COMPILE_CACHE_DIR",
+                           os.path.join(REPO_ROOT, ".jax_cache"))
+
+
+def _cache_is_warm():
+    """True if the persistent compile cache has any entries at all.
+
+    Content-keyed, so this cannot prove the entry for *this* config is
+    present — but the committed cache ships exactly the bench shapes, and
+    the empty/non-empty distinction is what changes the retry strategy
+    (one long attempt cold vs several short ones warm). A missing or
+    unreadable directory walks as empty.
+    """
+    return any(fs for _, _, fs in os.walk(CACHE_DIR))
 # BENCH_SEQ overrides the sequence length for long-context runs (the
 # reference hard-caps at max_position_embeddings=512; this framework's
 # fused attention is O(S) memory, and 'sp' ring attention shards S across
@@ -81,7 +118,9 @@ _P2 = PHASE == 2
 LONG_SEQ = int(os.environ.get("BENCH_SEQ", "0"))
 LOCAL_BATCH = int(os.environ.get(
     "BENCH_LOCAL_BATCH",
-    str(max(1, 28 * 512 // LONG_SEQ)) if LONG_SEQ else ("28" if _P2 else "56")))
+    "64" if DEGRADED
+    else str(max(1, 28 * 512 // LONG_SEQ)) if LONG_SEQ
+    else ("28" if _P2 else "56")))
 REMAT = os.environ.get("BENCH_REMAT", "dots")
 RNG_IMPL = os.environ.get("BENCH_RNG_IMPL", "rbg")
 ATTN = os.environ.get("BENCH_ATTN", "pallas" if (_P2 or LONG_SEQ) else "xla")
@@ -123,16 +162,16 @@ def _child_main():
     # so the first compile gets to finish at all.
     from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 
-    enable_compile_cache(os.environ.get("BENCH_COMPILE_CACHE_DIR",
-                                        "/tmp/bert_tpu_jax_cache"))
+    enable_compile_cache(CACHE_DIR)
     from bert_pytorch_tpu import optim, pretrain
     from bert_pytorch_tpu.config import BertConfig
     from bert_pytorch_tpu.models import BertForPreTraining
     from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
 
     config = BertConfig.from_json_file(
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "configs", "bert_large_uncased_config.json"))
+        os.path.join(REPO_ROOT, "configs",
+                     "bert_base_config.json" if DEGRADED
+                     else "bert_large_uncased_config.json"))
     if config.vocab_size % 8 != 0:
         config.vocab_size += 8 - (config.vocab_size % 8)
     if LONG_SEQ:
@@ -268,12 +307,28 @@ def _child_main():
         config, SEQ_LEN, MAX_PRED, next_sentence=True)
     model_flops_util = flops_util.mfu(
         seq_per_sec_chip, flops_per_seq, devices[0].device_kind)
+    anchor = None
+    if DEGRADED:
+        # The A100 anchor is a BERT-large number; scale it by the exact
+        # train-FLOP ratio so vs_baseline still compares like with like.
+        large = BertConfig.from_json_file(os.path.join(
+            REPO_ROOT, "configs", "bert_large_uncased_config.json"))
+        if large.vocab_size % 8 != 0:
+            large.vocab_size += 8 - (large.vocab_size % 8)
+        anchor = A100_PHASE1_SEQ_PER_SEC * flops_util.bert_train_flops_per_seq(
+            large, SEQ_LEN, MAX_PRED, next_sentence=True) / flops_per_seq
     print(json.dumps(_result_json(
-        seq_per_sec_chip, mfu=model_flops_util, n_chips=n_chips)))
+        seq_per_sec_chip, mfu=model_flops_util, n_chips=n_chips,
+        anchor_override=anchor)))
 
 
 def _metric_name_and_anchor():
     kfac_tag = "_kfac" if KFAC else ""
+    if DEGRADED:
+        # Parent-side estimate only (error paths); the child overrides the
+        # anchor with the exactly FLOP-scaled value.
+        return ("bert_base_phase1_seq_per_sec",
+                A100_PHASE1_SEQ_PER_SEC * 3.0)
     if LONG_SEQ:
         return (f"bert_large_seq{SEQ_LEN}{kfac_tag}_seq_per_sec",
                 A100_PHASE2_SEQ_PER_SEC * 512.0 / SEQ_LEN)
@@ -281,14 +336,21 @@ def _metric_name_and_anchor():
             A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC)
 
 
-def _result_json(seq_per_sec_chip, mfu=None, error=None, n_chips=None):
+def _result_json(seq_per_sec_chip, mfu=None, error=None, n_chips=None,
+                 anchor_override=None):
     name, anchor = _metric_name_and_anchor()
+    if anchor_override is not None:
+        anchor = anchor_override
     out = {
         "metric": name,
         "value": round(seq_per_sec_chip, 2),
         "unit": "seq/s/chip",
         "vs_baseline": round(seq_per_sec_chip / anchor, 4),
     }
+    if DEGRADED:
+        out["degraded"] = True
+        out["note"] = ("BERT-base fallback at the phase-1 shape — NOT the "
+                       "headline BERT-large metric")
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
     if n_chips is not None:
@@ -300,6 +362,20 @@ def _result_json(seq_per_sec_chip, mfu=None, error=None, n_chips=None):
 
 _PROBE_SRC = ("import jax; ds = jax.devices(); "
               "print('BENCH_PROBE_OK', len(ds), ds[0].device_kind)")
+
+
+def _parse_metric_line(out):
+    """Last JSON object with a "metric" key in ``out``, or None. The
+    result line must stay findable under kilobytes of runtime teardown
+    logging printed after it."""
+    for line in reversed(out.splitlines()):
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict) and "metric" in cand:
+            return cand
+    return None
 
 
 def _run_attempt(cmd, timeout_s, env):
@@ -331,7 +407,6 @@ def main():
     exactly that). A healthy backend completes on the first attempt in a
     few minutes.
     """
-    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
     backoff_s = float(os.environ.get("BENCH_BACKOFF_S", "30"))
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
     # Long-sequence compiles through the tunnel can alone exceed the default
@@ -339,16 +414,32 @@ def main():
     # compile leaves nothing in the persistent cache to resume from — scale
     # the default with the sequence length so the first compile can finish.
     seq_scale = max(1.0, (LONG_SEQ or 0) / 512.0)
-    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S",
-                                           str(600 * seq_scale)))
     budget_s = float(os.environ.get("BENCH_BUDGET_S", str(900 * seq_scale)))
     deadline = time.monotonic() + budget_s
+    warm = _cache_is_warm()
+    # Cold cache: one long attempt (a killed compile caches nothing, so
+    # several short attempts can never make progress). Warm cache: the
+    # compiled step deserializes in seconds, so short attempts + retries
+    # maximize the chance of landing in a tunnel-up window.
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3" if warm else "1"))
+    attempt_timeout = float(os.environ.get(
+        "BENCH_ATTEMPT_TIMEOUT_S",
+        str(600 * seq_scale if warm else max(600.0, budget_s - 60))))
+    # Reserve a tail window for the degraded (BERT-base) fallback — only
+    # when the cache is warm: the fallback is only viable off its committed
+    # cache entry, and on a truly cold cache the reserve would shave the
+    # one long attempt that can make progress (mitigation #2 above).
+    degrade_ok = (warm and os.environ.get("BENCH_DEGRADE", "auto") != "0"
+                  and not DEGRADED and PHASE == 1 and not KFAC
+                  and not LONG_SEQ and not N_DEVICES)
+    reserve = min(240.0, 0.25 * budget_s) if degrade_ok else 0.0
+    normal_deadline = deadline - reserve
 
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
     last_err = "no attempts ran"
     for attempt in range(1, attempts + 1):
-        remaining = deadline - time.monotonic()
+        remaining = normal_deadline - time.monotonic()
         if remaining <= 5:
             last_err += " (wall-clock budget exhausted)"
             break
@@ -366,25 +457,17 @@ def main():
                             f"{out[-400:]}")
                 print(last_err, file=sys.stderr)
                 if attempt < attempts:
-                    time.sleep(
-                        min(backoff_s, max(0, deadline - time.monotonic())))
+                    time.sleep(min(
+                        backoff_s, max(0, normal_deadline - time.monotonic())))
                 continue
-            remaining = deadline - time.monotonic()
+            remaining = normal_deadline - time.monotonic()
             if remaining <= 5:
                 last_err = "backend probe ok but wall-clock budget exhausted"
                 break
         ok, out = _run_attempt(
             [sys.executable, os.path.abspath(__file__)],
             min(attempt_timeout, remaining), env)
-        result = None
-        for line in reversed(out.splitlines()):
-            try:
-                cand = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(cand, dict) and "metric" in cand:
-                result = cand
-                break
+        result = _parse_metric_line(out)
         if "BENCH_CONFIG_ERROR" in out:
             # Deterministic misconfiguration: retrying cannot help.
             last_err = out[out.index("BENCH_CONFIG_ERROR"):][:400]
@@ -402,7 +485,29 @@ def main():
         last_err = f"bench child failed (attempt {attempt}): {out[-400:]}"
         print(last_err, file=sys.stderr)
         if attempt < attempts:
-            time.sleep(min(backoff_s, max(0, deadline - time.monotonic())))
+            time.sleep(min(
+                backoff_s, max(0, normal_deadline - time.monotonic())))
+    if degrade_ok and deadline - time.monotonic() > 60:
+        # Last rung: BERT-base at the phase-1 shape. Probe first — a dead
+        # backend fails the small model exactly like the big one.
+        ok, out = _run_attempt(
+            [sys.executable, "-c", _PROBE_SRC],
+            min(probe_timeout, deadline - time.monotonic()), env)
+        if ok and "BENCH_PROBE_OK" in out:
+            denv = dict(env)
+            denv["BENCH_DEGRADED"] = "1"
+            ok, out = _run_attempt(
+                [sys.executable, os.path.abspath(__file__)],
+                max(30, deadline - time.monotonic()), denv)
+            result = _parse_metric_line(out)
+            if result is not None:
+                if not ok:
+                    result.setdefault(
+                        "child_exit", "non-zero after printing result")
+                print(json.dumps(result))
+                return
+            last_err = (f"degraded fallback also failed: {out[-300:]}; "
+                        f"after: {last_err}")
     # Final failure: the driver still gets one parseable JSON line on
     # stdout; the non-zero exit preserves the shell-level failure signal
     # for ``set -e`` callers (scripts/smoke_tpu.sh).

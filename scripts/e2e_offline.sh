@@ -21,8 +21,10 @@
 # predictions exist, restarting from the pretrained checkpoint if
 # interrupted. The shared compile cache covers recompiles either way.
 set -euo pipefail
-# Same knob as bench.py; content-keyed, shared across capture legs.
-CACHE=${BENCH_COMPILE_CACHE_DIR:-/tmp/bert_tpu_jax_cache}
+# Same knob as bench.py; content-keyed, shared across capture legs. The
+# default is per-user (not the world-shared /tmp, where another user could
+# pre-seed entries that JAX deserializes as executables).
+CACHE=${BENCH_COMPILE_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/bert_tpu_jax_cache}
 cd "$(dirname "$0")/.."
 W=${1:-/tmp/bert_e2e}
 RESULT=${2:-$W/e2e_result.json}
@@ -32,12 +34,12 @@ mkdir -p "$W"
 if [ "$PROFILE" = "chip" ]; then
   ART_PER_FILE=2000; VOCAB=8192
   HID=768; LAYERS=12; HEADS=12; FFN=3072
-  PRETRAIN_STEPS=300; PRETRAIN_BATCH=64; LR=1e-3
+  PRETRAIN_STEPS=300; PRETRAIN_BATCH=64; LR=1e-3; CKPT_EVERY=100
   SQUAD_PARAS=400; SQUAD_STEPS=300; SQUAD_BATCH=32
 else
   ART_PER_FILE=150; VOCAB=2048
   HID=128; LAYERS=2; HEADS=4; FFN=512
-  PRETRAIN_STEPS=20; PRETRAIN_BATCH=16; LR=1e-3
+  PRETRAIN_STEPS=20; PRETRAIN_BATCH=16; LR=1e-3; CKPT_EVERY=10
   SQUAD_PARAS=40; SQUAD_STEPS=20; SQUAD_BATCH=8
 fi
 
@@ -102,7 +104,9 @@ if [ -f "$W/pretrain/pretrain_ckpts/ckpt_$PRETRAIN_STEPS.msgpack" ]; then
   echo "   already complete (ckpt_$PRETRAIN_STEPS exists), skipping"
 else
   # Partial checkpoints are NOT cleared: run_pretraining auto-resumes from
-  # the newest one (an interrupted 300-step chip leg redoes only the tail).
+  # the newest one, and CKPT_EVERY is below the step count so mid-run
+  # checkpoints genuinely exist (an interrupted 300-step chip leg redoes
+  # at most the last 100 steps, not the whole run).
   # local batch = global / device count (run_pretraining requires the
   # global batch to divide by local_batch x data shards; on an 8-chip host
   # the per-chip batch is PRETRAIN_BATCH/8). Device count is only probed
@@ -120,7 +124,7 @@ else
       --steps "$PRETRAIN_STEPS" --max_steps "$PRETRAIN_STEPS" \
       --learning_rate "$LR" --warmup_proportion 0.1 \
       --max_predictions_per_seq 20 \
-      --log_prefix log --num_steps_per_checkpoint 10000 \
+      --log_prefix log --num_steps_per_checkpoint "$CKPT_EVERY" \
       --compile_cache_dir "$CACHE"
 fi
 CKPT=$(ls -t "$W"/pretrain/pretrain_ckpts/ckpt_*.msgpack | head -1)

@@ -10,7 +10,7 @@
 # attention backend at seq 512, and the three bench modes.
 set -euo pipefail
 # Same knob as bench.py; content-keyed, shared across capture legs.
-CACHE=${BENCH_COMPILE_CACHE_DIR:-/tmp/bert_tpu_jax_cache}
+CACHE=${BENCH_COMPILE_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/bert_tpu_jax_cache}
 cd "$(dirname "$0")/.."
 WORK=${1:-/tmp/bert_tpu_smoke}
 # Clear only this script's own (cheap) legs; "$WORK/e2e" is e2e_offline.sh's
@@ -66,6 +66,6 @@ BENCH_PHASE=2 python bench.py
 BENCH_KFAC=1 python bench.py
 
 echo "== full offline chain: corpus -> vocab -> encode -> pretrain -> SQuAD"
-E2E_PROFILE=chip bash scripts/e2e_offline.sh "$WORK/e2e" "$PWD/E2E_r02.json"
+E2E_PROFILE=chip bash scripts/e2e_offline.sh "$WORK/e2e" "$PWD/E2E_r03.json"
 
 echo "smoke_tpu OK"

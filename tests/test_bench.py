@@ -19,7 +19,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
 
 
-def _run_bench(extra_env, timeout=120):
+def _run_bench(extra_env, timeout=120, capture_stderr=False):
     env = dict(os.environ)
     # Neutralize any TPU plugin sitecustomize so the probe fails fast
     # (unknown backend) instead of hanging on a dead tunnel.
@@ -27,7 +27,9 @@ def _run_bench(extra_env, timeout=120):
     env.update(extra_env)
     return subprocess.run(
         [sys.executable, BENCH], env=env, timeout=timeout,
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE if capture_stderr else subprocess.DEVNULL,
+        text=True)
 
 
 def test_unavailable_backend_still_prints_parseable_json():
@@ -60,6 +62,48 @@ def test_budget_exhaustion_prints_parseable_json():
     out = json.loads(proc.stdout.strip())
     assert out["value"] == 0.0
     assert "error" in out
+
+
+def test_degraded_metric_name_and_note():
+    proc = _run_bench({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "BENCH_DEGRADED": "1",
+        "BENCH_BUDGET_S": "1",
+    }, timeout=60)
+    out = json.loads(proc.stdout.strip())
+    assert out["metric"] == "bert_base_phase1_seq_per_sec"
+    assert out["degraded"] is True
+
+
+def test_cold_cache_defaults_to_one_long_attempt(tmp_path):
+    # Empty cache dir => the parent must not split its budget into several
+    # short attempts (a killed compile caches nothing; only one long
+    # window can make progress).
+    proc = _run_bench({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "BENCH_COMPILE_CACHE_DIR": str(tmp_path / "empty"),
+        "BENCH_DEGRADE": "0",
+        "BENCH_BUDGET_S": "60",
+    }, timeout=120, capture_stderr=True)
+    assert proc.returncode == 1
+    assert "attempt 1" in proc.stderr
+    assert "attempt 2" not in proc.stderr
+
+
+def test_warm_cache_defaults_to_retries(tmp_path):
+    cache = tmp_path / "warm"
+    cache.mkdir()
+    (cache / "entry").write_bytes(b"x")
+    proc = _run_bench({
+        "JAX_PLATFORMS": "nonexistent_backend",
+        "BENCH_COMPILE_CACHE_DIR": str(cache),
+        "BENCH_DEGRADE": "0",
+        "BENCH_BACKOFF_S": "1",
+        "BENCH_PROBE_TIMEOUT_S": "30",
+        "BENCH_BUDGET_S": "90",
+    }, timeout=150, capture_stderr=True)
+    assert proc.returncode == 1
+    assert "attempt 2" in proc.stderr
 
 
 def test_metric_name_tracks_phase_env():

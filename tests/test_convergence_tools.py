@@ -10,32 +10,60 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _write_csv(path, legs=("lamb", "kfac"), steps=30):
+def _write_csv(path, legs=("lamb", "kfac"), steps=30, sps=None):
+    """sps: optional {leg: samples_per_second} for wallclock columns."""
     with open(path, "w", newline="") as f:
         wr = csv.writer(f)
-        wr.writerow(["optimizer", "step", "loss", "mlm_accuracy",
-                     "learning_rate"])
+        cols = ["optimizer", "step", "loss", "mlm_accuracy", "learning_rate"]
+        if sps:
+            cols.append("samples_per_second")
+        wr.writerow(cols)
         for leg in legs:
             for s in range(1, steps + 1):
-                loss = 7.0 - 0.05 * s - (0.1 if leg == "kfac" else 0.0)
-                wr.writerow([leg, s, loss, 0.01 * s, 1e-3])
+                loss = 7.0 - 0.05 * s - (0.1 if leg.startswith("kfac") else 0.0)
+                row = [leg, s, loss, 0.01 * s, 1e-3]
+                if sps:
+                    # the runner logs 0 on the first row (timer not yet
+                    # started); the summarizer must skip it, not crash
+                    row.append(0 if s == 1 else sps[leg])
+                wr.writerow(row)
+
+
+def _summarize(path):
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "summarize_convergence.py"), str(path)],
+        capture_output=True, text=True, check=True)
+    return json.loads(out.stdout)
 
 
 def test_summarizer_two_legs(tmp_path):
     path = tmp_path / "conv.csv"
     _write_csv(path)
-    out = subprocess.run(
-        [sys.executable, os.path.join(REPO, "tools",
-                                      "summarize_convergence.py"), str(path)],
-        capture_output=True, text=True, check=True)
-    rec = json.loads(out.stdout)
+    rec = _summarize(path)
     assert set(rec["legs"]) == {"lamb", "kfac"}
     assert rec["legs"]["lamb"]["steps"] == 30
     # kfac runs 0.1 LOWER than lamb at every step in this fixture, so the
     # advantage (lamb - kfac, positive = K-FAC ahead) is +0.1
-    cmp = rec["kfac_vs_lamb"]
+    cmp = rec["kfac_vs_lamb"]["kfac"]
     assert cmp["equal_step"] == 30
     assert abs(cmp["kfac_advantage"] - 0.1) < 1e-6
+    assert "equal_wallclock" not in cmp  # no samples_per_second column
+
+
+def test_summarizer_equal_wallclock(tmp_path):
+    # K-FAC leads by 0.1 at equal steps but runs at HALF the throughput:
+    # at LAMB's 30-step horizon K-FAC has only reached step 15, where its
+    # loss (7 - .05*15 - .1 = 6.15) trails LAMB's step-30 loss (5.5).
+    path = tmp_path / "conv.csv"
+    _write_csv(path, legs=("lamb", "kfac_ref"),
+               sps={"lamb": 100.0, "kfac_ref": 50.0})
+    cmp = _summarize(path)["kfac_vs_lamb"]["kfac_ref"]
+    assert abs(cmp["kfac_advantage"] - 0.1) < 1e-6
+    wc = cmp["equal_wallclock"]
+    assert wc["lamb_step"] == 30 and wc["kfac_step"] == 15
+    assert abs(wc["step_cost_ratio"] - 2.0) < 1e-6
+    assert abs(wc["kfac_advantage"] - (5.5 - 6.15)) < 1e-6  # negative
 
 
 def test_plotter_writes_png(tmp_path):

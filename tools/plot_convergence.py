@@ -27,17 +27,20 @@ MUTED = "#8a8a85"
 GRID = "#e7e7e4"
 
 
-DEFAULT_TITLE = (
-    "BERT-large pretraining loss (gbs 512, recipe-shaped LR, one v5e chip)")
-
-
-def main(csv_path: str, out_path: str, title: str = DEFAULT_TITLE) -> None:
+def main(csv_path: str, out_path: str, title: str | None = None) -> None:
     legs: dict[str, list[tuple[int, float]]] = {}
     with open(csv_path) as f:
         for rec in csv.DictReader(f):
             legs.setdefault(rec["optimizer"], []).append(
                 (int(rec["step"]), float(rec["loss"]))
             )
+    if title is None:
+        # Derived, claim-free default: hardware/recipe claims belong to the
+        # caller that knows them (a default asserting "one v5e chip" would
+        # mislabel CPU sanity CSVs run through the same tool).
+        import os
+        title = (f"{os.path.basename(csv_path)} — pretraining loss "
+                 f"({', '.join(sorted(legs))})")
 
     fig, ax = plt.subplots(figsize=(7.0, 4.0), dpi=160)
     for i, (name, rows) in enumerate(legs.items()):
