@@ -102,8 +102,17 @@ def _pick_bh_block(seq, bh):
     G=4 84.0, G=8 84.25 seq/s; G=16 exhausts VMEM (tile footprint scales
     with G x seq, hence the 4096 budget). At seq 128 G=16 is the best of
     the sweep (314 -> 366 seq/s), though the XLA path still wins there and
-    stays the router default (ops/attention.py)."""
-    target = min(16, max(1, 4096 // max(seq, 1)))
+    stays the router default (ops/attention.py).
+
+    PALLAS_ATTN_BH_BLOCK overrides the target cap (not the divisibility
+    walk) so the capture sweep can probe past the conservative VMEM
+    heuristic at short sequence lengths — e.g. G=32 at seq 128, where the
+    4096 budget leaves half of VMEM unused."""
+    import os
+
+    env = os.environ.get("PALLAS_ATTN_BH_BLOCK")
+    target = (int(env) if env
+              else min(16, max(1, 4096 // max(seq, 1))))
     g = 1
     while g * 2 <= target and bh % (g * 2) == 0:
         g *= 2

@@ -20,17 +20,23 @@ from bert_pytorch_tpu.optim.kfac import (
     kfac_state_shardings,
 )
 from bert_pytorch_tpu.optim.transforms import (
+    LossScaleState,
     OptState,
     adamw,
     bert_adam,
+    dynamic_loss_scale,
     lamb,
     no_decay_mask,
+    opt_step_count,
     reset_count,
 )
 
 __all__ = [
     "KFAC",
     "KFACState",
+    "LossScaleState",
+    "dynamic_loss_scale",
+    "opt_step_count",
     "kfac_state_shardings",
     "SCHEDULES",
     "make_schedule",

@@ -32,6 +32,17 @@ class OptState(NamedTuple):
     nu: optax.Params  # second moment
 
 
+class LossScaleState(NamedTuple):
+    """fp16 dynamic-loss-scaling wrapper state (reference GradScaler
+    analog, run_pretraining.py:314-318; checkpointed like its 'scaler'
+    entry at :519-523 — the whole tuple rides inside the checkpoint's
+    'optimizer' tree)."""
+
+    scale: jnp.ndarray         # f32 current loss scale
+    growth_count: jnp.ndarray  # i32 consecutive finite steps since growth
+    inner: OptState
+
+
 def _lr_at(learning_rate: ScalarOrSchedule, count):
     return learning_rate(count) if callable(learning_rate) else learning_rate
 
@@ -266,9 +277,77 @@ def no_decay_mask(params) -> optax.Params:
     return traverse_util.unflatten_dict(mask)
 
 
-def reset_count(state: OptState, count: int) -> OptState:
+def reset_count(state, count: int):
     """Phase-switch surgery: overwrite the optimizer step counter, keeping
     moments — the analog of rewriting 'step'/'t_total'/'warmup'/'lr' in the
     loaded checkpoint (run_pretraining.py:298-309). t_total/warmup/lr live in
-    the schedule closure here and are rebuilt from the new phase config."""
+    the schedule closure here and are rebuilt from the new phase config.
+    A loss-scaled (fp16) state keeps its scale across the phase switch,
+    exactly like the reference's GradScaler surviving the surgery."""
+    if isinstance(state, LossScaleState):
+        return state._replace(inner=reset_count(state.inner, count))
     return OptState(jnp.asarray(count, jnp.int32), state.mu, state.nu)
+
+
+def opt_step_count(state):
+    """The optimizer-step counter, whether or not the state is wrapped in
+    a :class:`LossScaleState` (fp16 mode)."""
+    if isinstance(state, LossScaleState):
+        return state.inner.count
+    return state.count
+
+
+def dynamic_loss_scale(
+    tx: optax.GradientTransformation,
+    init_scale: float = 2.0 ** 15,
+    growth_factor: float = 2.0,
+    backoff_factor: float = 0.5,
+    growth_interval: int = 2000,
+) -> optax.GradientTransformation:
+    """Wrap ``tx`` with torch.cuda.amp.GradScaler semantics for fp16.
+
+    The caller multiplies the LOSS by the current scale (read it off the
+    state with ``state.scale``) before differentiating; this transform
+    receives the scaled gradients, unscales them, and:
+
+    - finite grads: applies the inner update; after ``growth_interval``
+      consecutive finite steps the scale doubles;
+    - any inf/nan: the step is SKIPPED (zero updates, inner state kept,
+      its count not incremented) and the scale is halved.
+
+    bf16 needs none of this (same exponent range as f32) — the wrapper
+    exists as the reference-parity fp16 mode (SURVEY.md §2.3 "keep
+    optional fp16+scaler for parity testing"; reference
+    run_pretraining.py:314-318, 424-434).
+    """
+
+    def init(params):
+        return LossScaleState(
+            scale=jnp.asarray(init_scale, jnp.float32),
+            growth_count=jnp.asarray(0, jnp.int32),
+            inner=tx.init(params),
+        )
+
+    def update(grads, state, params=None):
+        inv = (1.0 / state.scale).astype(jnp.float32)
+        grads = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        finite = jnp.asarray(True)
+        for g in jax.tree_util.tree_leaves(grads):
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        updates, inner_new = tx.update(grads, state.inner, params)
+        updates = jax.tree_util.tree_map(
+            lambda u: jnp.where(finite, u, jnp.zeros_like(u)), updates)
+        inner = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(finite, n, o), inner_new, state.inner)
+        growth_count = jnp.where(finite, state.growth_count + 1, 0)
+        grew = growth_count >= growth_interval
+        scale = jnp.where(
+            finite,
+            jnp.where(grew, state.scale * growth_factor, state.scale),
+            state.scale * backoff_factor,
+        )
+        growth_count = jnp.where(grew, 0, growth_count)
+        return updates, LossScaleState(scale, growth_count, inner)
+
+    return optax.GradientTransformation(init, update)

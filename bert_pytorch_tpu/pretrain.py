@@ -28,7 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bert_pytorch_tpu.models.losses import mlm_accuracy, pretraining_loss
 from bert_pytorch_tpu.ops.grad_utils import global_norm
-from bert_pytorch_tpu.optim.transforms import OptState
+from bert_pytorch_tpu.optim.transforms import (LossScaleState, OptState,
+                                               opt_step_count)
 from bert_pytorch_tpu.parallel.sharding import params_shardings
 
 
@@ -43,19 +44,21 @@ def _replicated(mesh: Mesh):
     return NamedSharding(mesh, P())
 
 
-def state_shardings(mesh: Mesh, model, rules, sample_inputs) -> TrainState:
+def state_shardings(mesh: Mesh, model, rules, sample_inputs,
+                    loss_scaled: bool = False) -> TrainState:
     """Shardings for every leaf of TrainState, derived from the model's
-    logical axis annotations (no per-param code — the point of the design)."""
+    logical axis annotations (no per-param code — the point of the design).
+    ``loss_scaled`` matches an fp16 optimizer wrapped in
+    ``optim.dynamic_loss_scale`` (two extra replicated scalars)."""
     abstract = jax.eval_shape(
         lambda r: model.init(r, *sample_inputs), jax.random.PRNGKey(0)
     )
     p_shardings = params_shardings(mesh, abstract, rules)["params"]
     repl = _replicated(mesh)
-    return TrainState(
-        params=p_shardings,
-        opt_state=OptState(count=repl, mu=p_shardings, nu=p_shardings),
-        rng=repl,
-    )
+    opt = OptState(count=repl, mu=p_shardings, nu=p_shardings)
+    if loss_scaled:
+        opt = LossScaleState(scale=repl, growth_count=repl, inner=opt)
+    return TrainState(params=p_shardings, opt_state=opt, rng=repl)
 
 
 def batch_shardings(mesh: Mesh, batch_spec: dict, seq_sharded: bool = False) -> dict:
@@ -177,6 +180,7 @@ def make_train_step(
     max_pred_per_seq: Optional[int] = None,
     kfac=None,
     kfac_shardings=None,
+    loss_scale: bool = False,
 ):
     """Build the jitted train step.
 
@@ -195,9 +199,19 @@ def make_train_step(
     ``preconditioner.step()`` slot in the reference's
     ``take_optimizer_step``, run_pretraining.py:405-417). Requires
     ``schedule`` for the kl_clip learning-rate term.
+
+    ``loss_scale=True`` is the fp16 parity mode (reference GradScaler,
+    run_pretraining.py:314-318): ``tx`` must be wrapped in
+    ``optim.dynamic_loss_scale``; the step multiplies the loss by the
+    state's current scale before differentiating and the wrapper
+    unscales, finite-checks, and skips/backs off.
     """
     if kfac is not None and schedule is None:
         raise ValueError("kfac preconditioning requires a schedule")
+    if kfac is not None and loss_scale:
+        raise ValueError(
+            "loss_scale composes with first-order optimizers only; K-FAC "
+            "runs in bf16/f32 where no scaler is needed")
 
     def loss_fn(params, mb, rng):
         labels, masked_positions = _mlm_positions(
@@ -224,13 +238,21 @@ def make_train_step(
     def step_fn(state: TrainState, batch: dict, kfac_state=None):
         accum_steps = batch["input_ids"].shape[0]
         step_rng, new_rng = jax.random.split(state.rng)
+        scale = state.opt_state.scale if loss_scale else None
+
+        def scaled_loss_fn(params, mb, rng):
+            loss, acc = loss_fn(params, mb, rng)
+            return loss * scale, (loss, acc)
 
         def body(carry, mb):
             grads_acc, rng = carry
             rng, sub = jax.random.split(rng)
-            (loss, acc), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, mb, sub
-            )
+            if loss_scale:
+                (_, (loss, acc)), grads = jax.value_and_grad(
+                    scaled_loss_fn, has_aux=True)(state.params, mb, sub)
+            else:
+                (loss, acc), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(state.params, mb, sub)
             grads_acc = jax.tree_util.tree_map(
                 lambda a, g: a + g.astype(a.dtype), grads_acc, grads
             )
@@ -246,17 +268,21 @@ def make_train_step(
 
         if kfac is not None:
             grads = kfac.precondition(
-                kfac_state, grads, schedule(state.opt_state.count)
+                kfac_state, grads, schedule(opt_step_count(state.opt_state))
             )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         metrics = {
             "loss": jnp.mean(losses),
             "mlm_accuracy": jnp.mean(accs),
-            "grad_norm": global_norm(grads),
+            # grads carry the loss scale in fp16 mode; report the true norm
+            "grad_norm": (global_norm(grads) / scale if loss_scale
+                          else global_norm(grads)),
         }
+        if loss_scale:
+            metrics["loss_scale"] = scale
         if schedule is not None:
-            metrics["learning_rate"] = schedule(state.opt_state.count)
+            metrics["learning_rate"] = schedule(opt_step_count(state.opt_state))
         return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
 
     return _jit_train_step(
@@ -463,7 +489,7 @@ def make_pp_train_step(
         )
         if kfac is not None:
             grads = kfac.precondition(
-                kfac_state, grads, schedule(state.opt_state.count)
+                kfac_state, grads, schedule(opt_step_count(state.opt_state))
             )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -473,7 +499,7 @@ def make_pp_train_step(
             "grad_norm": global_norm(grads),
         }
         if schedule is not None:
-            metrics["learning_rate"] = schedule(state.opt_state.count)
+            metrics["learning_rate"] = schedule(opt_step_count(state.opt_state))
         return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
 
     return _jit_train_step(

@@ -406,7 +406,11 @@ def get_final_text(pred_text: str, orig_text: str, do_lower_case: bool) -> str:
 
 def get_answers(examples, features, results, args):
     """n-best decode over all windows of each question
-    (reference run_squad.py:427-506). Returns (answers, nbest_answers)."""
+    (reference run_squad.py:427-506). Returns (answers, nbest_answers,
+    null_odds); null_odds is empty unless version_2_with_negative, and
+    holds each question's null score diff (null score minus best non-null
+    span score — higher means more likely unanswerable), the score the
+    official v2.0 metric's best-threshold search consumes."""
     predictions = collections.defaultdict(list)
     null_vals = collections.defaultdict(lambda: (float("inf"), 0, 0))
 
@@ -446,6 +450,7 @@ def get_answers(examples, features, results, args):
 
     nbest_answers = collections.defaultdict(list)
     answers = {}
+    null_odds = {}
     for qas_id, preds in predictions.items():
         nbest = sorted(
             preds, key=lambda p: p.start_logit + p.end_logit, reverse=True
@@ -466,17 +471,21 @@ def get_answers(examples, features, results, args):
             )
         if args.version_2_with_negative:
             if best_non_null is None:
+                # No non-null candidate at all: definitively unanswerable
+                # (finite stand-in for +inf; null_odds must stay JSON).
                 answers[qas_id] = ""
+                null_odds[qas_id] = 1e9
                 continue
             score_diff = (
                 null_vals[qas_id][0]
                 - best_non_null.start_logit
                 - best_non_null.end_logit
             )
+            null_odds[qas_id] = score_diff
             answers[qas_id] = (
                 "" if score_diff > args.null_score_diff_threshold
                 else best_non_null.text
             )
         else:
             answers[qas_id] = nbest_answers[qas_id][0]["text"]
-    return answers, nbest_answers
+    return answers, nbest_answers, null_odds

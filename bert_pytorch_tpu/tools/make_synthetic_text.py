@@ -87,15 +87,26 @@ def write_corpus(out_dir, n_files, articles_per_file, seed):
 
 
 def write_squad(out_path, n_paragraphs, qas_per_paragraph, seed,
-                fact_seed):
-    """SQuAD v1.1-format JSON; answers are literal context spans."""
+                fact_seed, impossible_frac=0.0):
+    """SQuAD-format JSON; answers are literal context spans.
+
+    With impossible_frac > 0 the output is v2.0-format: that fraction of
+    questions ask about a relation whose fact sentence is NOT in the
+    paragraph (``is_impossible: true``, empty answers — the official
+    evaluate-v2.0 semantics; reference consumes the real v2.0 dev set the
+    same way, run_squad.py:131-206)."""
     rng = random.Random(seed)
     facts = _facts(random.Random(fact_seed))  # same world as the corpus
+    v2 = impossible_frac > 0
     data = []
     qid = 0
     for pi in range(n_paragraphs):
         a = rng.choice(ENTITIES)
-        rels = rng.sample(RELATIONS, k=min(qas_per_paragraph, len(RELATIONS)))
+        # keep at least one relation OUT of the context so impossible
+        # questions (about the held-out relations) exist to ask
+        k = min(qas_per_paragraph, len(RELATIONS) - 1 if v2 else len(RELATIONS))
+        rels = rng.sample(RELATIONS, k=k)
+        held_out = [r for r in RELATIONS if r not in rels]
         sentences, qas = [], []
         for rel, stmt, question in rels:
             b = facts[(a, rel)]
@@ -103,6 +114,17 @@ def write_squad(out_path, n_paragraphs, qas_per_paragraph, seed,
             sentences.append(rng.choice(FILLER))
         context = " ".join(sentences)
         for rel, stmt, question in rels:
+            if v2 and rng.random() < impossible_frac:
+                # Ask about a fact the paragraph does not state.
+                mrel, _mstmt, mquestion = rng.choice(held_out)
+                qas.append({
+                    "id": f"q{qid}",
+                    "question": mquestion.format(a=a),
+                    "answers": [],
+                    "is_impossible": True,
+                })
+                qid += 1
+                continue
             b = facts[(a, rel)]
             # the answer span is b's occurrence inside its own fact
             # sentence (b may also appear elsewhere in the context)
@@ -110,11 +132,14 @@ def write_squad(out_path, n_paragraphs, qas_per_paragraph, seed,
             sent_start = context.find(sent)
             start = sent_start + sent.find(b)
             assert context[start:start + len(b)] == b
-            qas.append({
+            qa = {
                 "id": f"q{qid}",
                 "question": question.format(a=a),
                 "answers": [{"text": b, "answer_start": start}],
-            })
+            }
+            if v2:
+                qa["is_impossible"] = False
+            qas.append(qa)
             qid += 1
         data.append({
             "title": f"article_{pi}",
@@ -122,7 +147,7 @@ def write_squad(out_path, n_paragraphs, qas_per_paragraph, seed,
         })
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
     with open(out_path, "w") as f:
-        json.dump({"version": "1.1", "data": data}, f)
+        json.dump({"version": "v2.0" if v2 else "1.1", "data": data}, f)
     return out_path
 
 
@@ -141,6 +166,9 @@ def main(argv=None):
     s.add_argument("--seed", type=int, default=1)
     s.add_argument("--fact_seed", type=int, default=0,
                    help="must match the corpus --seed for a shared world")
+    s.add_argument("--impossible_frac", type=float, default=0.0,
+                   help=">0 emits SQuAD v2.0 format with this fraction of "
+                        "unanswerable questions")
     args = p.parse_args(argv)
     if args.mode == "corpus":
         paths = write_corpus(args.output_dir, args.num_files,
@@ -148,7 +176,8 @@ def main(argv=None):
         print(f"wrote {len(paths)} corpus files to {args.output_dir}")
     else:
         path = write_squad(args.output, args.paragraphs,
-                           args.qas_per_paragraph, args.seed, args.fact_seed)
+                           args.qas_per_paragraph, args.seed, args.fact_seed,
+                           args.impossible_frac)
         print(f"wrote {path}")
 
 

@@ -75,9 +75,12 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     parser.add_argument(
         "--num_workers", type=int, default=0,
         help="DataLoader producer processes (reference run_pretraining.py:"
-             "394-395 num_workers=4). 0 = single background thread, which "
-             "the vectorized masking path makes sufficient for several "
-             "chips (tools/bench_loader.py); use >0 on many-chip hosts.")
+             "394-395 num_workers=4). 0 = single background thread — KEEP "
+             "THE DEFAULT at BERT shapes: the measured thread path is ~2x "
+             "FASTER than process workers (14.4k vs 7.2k seq/s, "
+             "LOADER_BENCH_r02.jsonl — strided workers re-read every "
+             "shard). >0 pays off only if per-sample featurization grows "
+             "to dominate IO (data/loader.py docstring).")
     # held-out evaluation (beyond the reference, which never evaluates
     # during pretraining; uses pretrain.make_eval_step)
     parser.add_argument("--val_input_dir", type=str, default=None,
@@ -105,7 +108,18 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "<output_dir>/profile; 0 disables (SURVEY §5.1)")
     # numerics / memory
     parser.add_argument("--dtype", type=str, default="bfloat16",
-                        choices=["bfloat16", "float32"])
+                        choices=["bfloat16", "float32", "float16"],
+                        help="activation dtype; bfloat16 is the TPU "
+                             "default (no loss scaling needed). float16 is "
+                             "the reference-parity AMP mode and enables a "
+                             "dynamic loss scaler (GradScaler analog, "
+                             "reference run_pretraining.py:314-318)")
+    parser.add_argument("--init_loss_scale", type=float, default=2.0 ** 15,
+                        help="fp16 only: initial dynamic loss scale")
+    parser.add_argument("--loss_scale_growth_interval", type=int,
+                        default=2000,
+                        help="fp16 only: consecutive finite steps before "
+                             "the loss scale doubles")
     parser.add_argument("--checkpoint_activations", action="store_true",
                         help="shorthand for --remat full (reference "
                              "checkpointed_forward, modeling.py:503-520)")
@@ -236,6 +250,16 @@ def setup_training(args):
             "reproducible across platforms/XLA versions — pass --rng_impl "
             "threefry2x32 for JAX's portable default)")
 
+    if args.dtype == "float16":
+        if args.kfac:
+            raise ValueError(
+                "--dtype float16 is the first-order parity mode; K-FAC "
+                "runs in bf16/f32 (no loss scaler needed on TPU)")
+        if args.parallel_strategy in ("pp", "pp_tp"):
+            raise ValueError(
+                "--dtype float16 is not supported with pipeline "
+                "parallelism; use bfloat16 (the TPU default)")
+
     # Accumulation math (reference :213-228), in global terms: one optimizer
     # step consumes global_batch_size sequences as accumulation_steps
     # microbatches of local_batch_size per data shard.
@@ -275,7 +299,8 @@ def prepare_model(args, mesh):
 
     model = BertForPreTraining(
         config,
-        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        dtype={"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+               "float32": jnp.float32}[args.dtype],
         remat=args.remat or ("full" if args.checkpoint_activations else "none"),
         attention_backend=args.attention_backend,
     )
@@ -321,6 +346,13 @@ def prepare_optimizer(args, params_example=None):
     else:
         tx = optim.adamw(schedule, weight_decay=args.weight_decay,
                          weight_decay_mask=mask)
+    if args.dtype == "float16":
+        # Reference-parity AMP: fp16 activations + dynamic loss scaling
+        # (GradScaler, run_pretraining.py:314-318); scaler state rides in
+        # the checkpoint's optimizer tree like the reference's 'scaler'.
+        tx = optim.dynamic_loss_scale(
+            tx, init_scale=args.init_loss_scale,
+            growth_interval=args.loss_scale_growth_interval)
     return tx, schedule
 
 
@@ -400,7 +432,9 @@ def main(args) -> dict:
     seq_len = config.max_position_embeddings
     sample = (jnp.zeros((1, seq_len), jnp.int32),) * 3
     with mesh:
-        shardings = pretrain.state_shardings(mesh, model, rules, sample)
+        fp16 = args.dtype == "float16"
+        shardings = pretrain.state_shardings(mesh, model, rules, sample,
+                                             loss_scaled=fp16)
         b_shardings = pretrain.batch_shardings(
             mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
                    "masked_lm_labels": 3, "next_sentence_labels": 2},
@@ -512,7 +546,8 @@ def main(args) -> dict:
                 next_sentence=bool(config.next_sentence),
                 shardings=shardings, batch_shardings_=b_shardings,
                 max_pred_per_seq=args.max_predictions_per_seq,
-                kfac=kfac_obj, kfac_shardings=kfac_shardings)
+                kfac=kfac_obj, kfac_shardings=kfac_shardings,
+                loss_scale=fp16)
 
         eval_step = None
         if val_loader is not None:

@@ -345,7 +345,7 @@ def main(args):
                             end_logits=end_logits[j].tolist()))
             summary["e2e_inference_time"] = time.perf_counter() - t_infer
 
-            answers, nbest = squad.get_answers(
+            answers, nbest, null_odds = squad.get_answers(
                 eval_examples, eval_features, results, args)
             output_prediction_file = os.path.join(
                 args.output_dir, "predictions.json")
@@ -354,13 +354,26 @@ def main(args):
             with open(os.path.join(args.output_dir,
                                    "nbest_predictions.json"), "w") as f:
                 f.write(json.dumps(nbest, indent=4) + "\n")
+            output_null_odds_file = None
+            if args.version_2_with_negative:
+                # The v2.0 official metric's best-threshold search
+                # consumes these (reference writes the same file,
+                # run_squad.py:1190-1194).
+                output_null_odds_file = os.path.join(
+                    args.output_dir, "null_odds.json")
+                with open(output_null_odds_file, "w") as f:
+                    f.write(json.dumps(null_odds, indent=4) + "\n")
 
             if args.do_eval and args.eval_script:
                 # Official-oracle evaluation (reference run_squad.py:1197-1204)
+                eval_cmd = [sys.executable, args.eval_script,
+                            args.predict_file, output_prediction_file]
+                if output_null_odds_file:
+                    eval_cmd += ["--na-prob-file", output_null_odds_file,
+                                 "--na-prob-thresh",
+                                 str(args.null_score_diff_threshold)]
                 proc = subprocess.run(
-                    [sys.executable, args.eval_script, args.predict_file,
-                     output_prediction_file],
-                    capture_output=True, text=True, check=True)
+                    eval_cmd, capture_output=True, text=True, check=True)
                 scores = json.loads(proc.stdout)
                 summary["exact_match"] = scores.get("exact_match")
                 summary["F1"] = scores.get("f1")

@@ -27,34 +27,8 @@ LR=${LONG_LR:-3.75e-4}
 CACHE=${BENCH_COMPILE_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/bert_tpu_jax_cache}
 mkdir -p "$W"
 
-STAMP="model=$MODEL long"
-if [ ! -f "$W/.data_ok" ] || [ "$(cat "$W/.data_ok")" != "$STAMP" ]; then
-  rm -rf "$W" && mkdir -p "$W"
-  echo "== corpus -> HDF5 (8 files, document-structured synthetic text)"
-  python -m bert_pytorch_tpu.tools.make_synthetic_text corpus \
-      --output_dir "$W/formatted" --num_files 8 --articles_per_file 2500 \
-      --seed 3
-  python -m bert_pytorch_tpu.tools.shard \
-      --input_glob "$W/formatted/*.txt" \
-      --output_dir "$W/sharded" --max_bytes_per_shard 2M
-  python -m bert_pytorch_tpu.tools.build_vocab \
-      --input_glob "$W/sharded/*.txt" \
-      --output "$W/vocab.txt" --vocab_size 8192 --min_frequency 1
-  python -m bert_pytorch_tpu.tools.encode_data \
-      --input_dir "$W/sharded" --output_dir "$W/encoded" \
-      --vocab_file "$W/vocab.txt" --max_seq_len 128 --next_seq_prob 0.5
-  python - "$W" "$MODEL" <<'EOF'
-import json, sys
-w, model = sys.argv[1:3]
-cfg = json.load(open(f"configs/{model}_config.json"))
-cfg["vocab_size"] = sum(1 for l in open(f"{w}/vocab.txt") if l.strip())
-cfg.update(vocab_file=f"{w}/vocab.txt", tokenizer="wordpiece",
-           lowercase=True)
-json.dump(cfg, open(f"{w}/model.json", "w"))
-print("vocab entries:", cfg["vocab_size"])
-EOF
-  echo "$STAMP" > "$W/.data_ok"
-fi
+source scripts/lib_synth_corpus.sh
+synth_corpus_build "$W" "$MODEL" 8 3
 
 # Milestones STATED IN ADVANCE (a pre-registration: written before any
 # training step runs, never overwritten). Grounded on the r02 on-chip

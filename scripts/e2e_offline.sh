@@ -21,9 +21,9 @@
 # predictions exist, restarting from the pretrained checkpoint if
 # interrupted. The shared compile cache covers recompiles either way.
 set -euo pipefail
-# Same knob as bench.py; content-keyed, shared across capture legs. The
-# default is per-user (not the world-shared /tmp, where another user could
-# pre-seed entries that JAX deserializes as executables).
+# Per-user scratch cache for the runner legs (not the world-shared /tmp,
+# where another user could pre-seed entries that JAX deserializes as
+# executables). bench.py itself uses the committed in-repo .jax_cache/.
 CACHE=${BENCH_COMPILE_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/bert_tpu_jax_cache}
 cd "$(dirname "$0")/.."
 W=${1:-/tmp/bert_e2e}
@@ -43,7 +43,7 @@ else
   SQUAD_PARAS=40; SQUAD_STEPS=20; SQUAD_BATCH=8
 fi
 
-STAMP="profile=$PROFILE"
+STAMP="profile=$PROFILE v2"
 if [ ! -f "$W/.data_ok" ] || [ "$(cat "$W/.data_ok")" != "$STAMP" ]; then
   if [ -f "$W/.data_ok" ]; then
     echo "!! profile stamp mismatch (have '$(cat "$W/.data_ok")', want" \
@@ -93,6 +93,14 @@ EOF
   python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
       --output "$W/squad_dev.json" --paragraphs $((SQUAD_PARAS / 4)) \
       --qas_per_paragraph 3 --seed 97 --fact_seed 0
+
+  echo "== 5c. synthesize SQuAD v2.0 train + dev (1/3 impossible questions)"
+  python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
+      --output "$W/squad_v2_train.json" --paragraphs "$SQUAD_PARAS" \
+      --qas_per_paragraph 3 --seed 23 --fact_seed 0 --impossible_frac 0.33
+  python -m bert_pytorch_tpu.tools.make_synthetic_text squad \
+      --output "$W/squad_v2_dev.json" --paragraphs $((SQUAD_PARAS / 4)) \
+      --qas_per_paragraph 3 --seed 131 --fact_seed 0 --impossible_frac 0.33
 
   echo "$STAMP" > "$W/.data_ok"
 else
@@ -150,14 +158,45 @@ else
       --compile_cache_dir "$CACHE"
 fi
 
-echo "== 8. EM/F1 artifact (re-run the official metric on the dev set)"
+echo "== 7b. SQuAD v2.0 finetune (impossible questions) + official v2 eval"
+if [ -f "$W/squad_v2_out/null_odds.json" ]; then
+  # null_odds.json is written AFTER predictions.json; gating on the
+  # last-written artifact keeps an interrupted leg re-runnable
+  echo "   already complete (v2 null_odds.json exists), skipping"
+else
+  rm -rf "$W/squad_v2_out"
+  python run_squad.py \
+      --output_dir "$W/squad_v2_out" \
+      --config_file "$W/model.json" \
+      --init_checkpoint "$CKPT" \
+      --train_file "$W/squad_v2_train.json" \
+      --predict_file "$W/squad_v2_dev.json" \
+      --do_train --do_predict --do_eval --do_lower_case \
+      --version_2_with_negative \
+      --eval_script scripts/squad_evaluate_v20.py \
+      --train_batch_size "$SQUAD_BATCH" --predict_batch_size "$SQUAD_BATCH" \
+      --max_steps "$SQUAD_STEPS" --max_seq_length 128 \
+      --doc_stride 64 --max_query_length 24 \
+      --learning_rate 5e-5 --skip_cache \
+      --compile_cache_dir "$CACHE"
+fi
+
+echo "== 8. EM/F1 artifact (re-run the official metrics on both dev sets)"
 SCORES=$(python scripts/squad_evaluate_v11.py \
     "$W/squad_dev.json" "$W/squad_out/predictions.json")
-python - "$RESULT" "$PROFILE" "$SCORES" <<'EOF'
+SCORES_V2=$(python scripts/squad_evaluate_v20.py \
+    "$W/squad_v2_dev.json" "$W/squad_v2_out/predictions.json" \
+    --na-prob-file "$W/squad_v2_out/null_odds.json")
+python - "$RESULT" "$PROFILE" "$SCORES" "$SCORES_V2" <<'EOF'
 import json, sys
-result, profile, scores = sys.argv[1], sys.argv[2], json.loads(sys.argv[3])
+result, profile = sys.argv[1], sys.argv[2]
+scores, v2 = json.loads(sys.argv[3]), json.loads(sys.argv[4])
 out = {"metric": "e2e_offline_squad", "profile": profile,
-       "exact_match": scores["exact_match"], "f1": scores["f1"]}
+       "exact_match": scores["exact_match"], "f1": scores["f1"],
+       "v2": {k: v2[k] for k in (
+           "exact", "f1", "total", "HasAns_exact", "HasAns_f1",
+           "NoAns_exact", "NoAns_f1", "best_exact", "best_exact_thresh",
+           "best_f1", "best_f1_thresh") if k in v2}}
 json.dump(out, open(result, "w"), indent=2)
 print(json.dumps(out))
 EOF

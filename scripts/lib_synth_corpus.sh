@@ -1,0 +1,45 @@
+# Shared corpus->shard->vocab->HDF5->model.json build for the capture
+# scripts (convergence_r03.sh, convergence_long_r03.sh). Source this file,
+# then call:
+#
+#   synth_corpus_build WORKDIR MODEL_CONFIG_NAME NUM_FILES SEED
+#
+# Deterministic and stamped: a workdir whose stamp matches is reused
+# as-is (tunnel-drop retries must not redo finished work); any mismatch
+# rebuilds from scratch. Produces $W/encoded (HDF5 shards) and
+# $W/model.json (the named configs/ geometry with the trained vocab).
+synth_corpus_build() {
+  local W=$1 MODEL=$2 NUM_FILES=$3 SEED=$4
+  local STAMP="model=$MODEL files=$NUM_FILES seed=$SEED"
+  if [ -f "$W/.data_ok" ] && [ "$(cat "$W/.data_ok")" = "$STAMP" ]; then
+    echo "== corpus/encode/config reused from $W (matching '$STAMP')"
+    return 0
+  fi
+  rm -rf "$W" && mkdir -p "$W"
+  echo "== corpus -> HDF5 ($NUM_FILES files, document-structured synthetic text)"
+  python -m bert_pytorch_tpu.tools.make_synthetic_text corpus \
+      --output_dir "$W/formatted" --num_files "$NUM_FILES" \
+      --articles_per_file 2500 --seed "$SEED"
+  python -m bert_pytorch_tpu.tools.shard \
+      --input_glob "$W/formatted/*.txt" \
+      --output_dir "$W/sharded" --max_bytes_per_shard 2M
+  python -m bert_pytorch_tpu.tools.build_vocab \
+      --input_glob "$W/sharded/*.txt" \
+      --output "$W/vocab.txt" --vocab_size 8192 --min_frequency 1
+  python -m bert_pytorch_tpu.tools.encode_data \
+      --input_dir "$W/sharded" --output_dir "$W/encoded" \
+      --vocab_file "$W/vocab.txt" --max_seq_len 128 --next_seq_prob 0.5
+
+  echo "== model config ($MODEL geometry, trained vocab)"
+  python - "$W" "$MODEL" <<'EOF'
+import json, sys
+w, model = sys.argv[1:3]
+cfg = json.load(open(f"configs/{model}_config.json"))
+cfg["vocab_size"] = sum(1 for l in open(f"{w}/vocab.txt") if l.strip())
+cfg.update(vocab_file=f"{w}/vocab.txt", tokenizer="wordpiece",
+           lowercase=True)
+json.dump(cfg, open(f"{w}/model.json", "w"))
+print("vocab entries:", cfg["vocab_size"])
+EOF
+  echo "$STAMP" > "$W/.data_ok"
+}

@@ -82,7 +82,7 @@ have_seq1024()  { good_json bench_seq1024.json; }
 have_seq2048()  { good_json bench_seq2048.json; }
 have_e2e()      { [ -f E2E_r03.json ]; }
 have_long()     { [ -f LONG_RUN_r03.json ]; }
-have_sweep()    { [ -f SWEEP_r03.jsonl ] && [ "$(wc -l < SWEEP_r03.jsonl)" -ge 7 ]; }
+have_sweep()    { [ -f SWEEP_r03.jsonl ] && [ "$(wc -l < SWEEP_r03.jsonl)" -ge 12 ]; }
 
 all_done() {
   have_phase1 && have_degraded && have_conv && have_phase2 && have_kfacb \
@@ -91,29 +91,51 @@ all_done() {
 
 run_sweep() {
   : > "$LOGS/sweep.tmp"
-  # XLA-attention batch points around the known peak, then the fused
-  # Pallas kernel at seq 128 (re-measure whether the bh-batched tiles
-  # close the 366-vs-396 gap the r02 verdict flagged).
-  for pt in 48: 52: 56: 60: 64: 56:pallas 64:pallas; do
-    b=${pt%%:*}; attn=${pt#*:}
-    tag="$b${attn:+_$attn}"
+  # Points are batch:attn:remat. Three families (VERDICT r2 #3):
+  #  - XLA-attention batch points around the known 56-peak;
+  #  - the fused Pallas kernel at seq 128 (re-measure whether the
+  #    bh-batched tiles close the 366-vs-396 gap the r02 verdict
+  #    flagged);
+  #  - remat=none legs: the fused kernel's O(S) memory may fit the
+  #    batch WITHOUT rematerialization — 'dots' recompute is pure
+  #    overhead if the activations fit, and r02 measured no-remat
+  #    winning at batch 32 (327 vs ~281).
+  # batch : attn : remat : pallas bh-block override (G)
+  for pt in 48::: 52::: 56::: 60::: 64::: 56:pallas:: 64:pallas:: \
+            56:pallas:none: 64:pallas:none: 56::none: \
+            56:pallas::32 64:pallas::32; do
+    IFS=: read -r b attn remat g <<< "$pt"
+    tag="$b${attn:+_$attn}${remat:+_remat_$remat}${g:+_g$g}"
     if { [ -s "$LOGS/sweep_$tag.json" ] && good_json "$LOGS/sweep_$tag.json"; } \
         || env BENCH_LOCAL_BATCH="$b" ${attn:+BENCH_ATTN=$attn} \
+        ${remat:+BENCH_REMAT=$remat} ${g:+PALLAS_ATTN_BH_BLOCK=$g} \
         BENCH_MEASURE_STEPS=12 BENCH_ATTEMPTS=1 BENCH_DEGRADE=0 \
         timeout 900 python bench.py > "$LOGS/sweep_$tag.json" 2> "$LOGS/sweep_$tag.log"
     then
-      python - "$b" "${attn:-xla}" "$LOGS/sweep_$tag.json" >> "$LOGS/sweep.tmp" <<'EOF'
+      python - "$b" "${attn:-xla}" "${remat:-dots}" "${g:-0}" \
+          "$LOGS/sweep_$tag.json" >> "$LOGS/sweep.tmp" <<'EOF'
 import json, sys
-b, attn, path = sys.argv[1:4]
+b, attn, remat, g, path = sys.argv[1:6]
 rec = json.load(open(path))
 rec["local_batch"] = int(b)
 rec["attention"] = attn
+rec["remat"] = remat
+if int(g):
+    rec["bh_block"] = int(g)
 print(json.dumps(rec))
 EOF
       echo "   sweep $tag: $(tail -1 "$LOGS/sweep.tmp")"
     else
-      echo "   sweep $tag FAILED; aborting sweep pass"
-      return 1
+      # An OOM (possible on the no-remat legs) is a data point, not a
+      # harness failure: record it and keep sweeping.
+      if grep -qi "resource exhausted\|out of memory" "$LOGS/sweep_$tag.log"; then
+        echo "{\"local_batch\": $b, \"attention\": \"${attn:-xla}\"," \
+             "\"remat\": \"${remat:-dots}\", \"oom\": true}" >> "$LOGS/sweep.tmp"
+        echo "   sweep $tag: OOM (recorded)"
+      else
+        echo "   sweep $tag FAILED; aborting sweep pass"
+        return 1
+      fi
     fi
   done
   mv "$LOGS/sweep.tmp" SWEEP_r03.jsonl
@@ -204,13 +226,13 @@ EOF
   if ! have_seq1024; then
     bench_warm bench_seq1024.json 2400 BENCH_SEQ=1024 \
       && commit_artifacts "Capture r03 seq-1024 long-context bench" \
-           bench_seq1024.json
+           .jax_cache bench_seq1024.json
     continue
   fi
   if ! have_seq2048; then
     bench_warm bench_seq2048.json 3000 BENCH_SEQ=2048 \
       && commit_artifacts "Capture r03 seq-2048 long-context bench" \
-           bench_seq2048.json
+           .jax_cache bench_seq2048.json
     continue
   fi
 

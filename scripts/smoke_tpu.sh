@@ -9,7 +9,9 @@
 # dropout, bf16 end-to-end pretraining with checkpoint + resume, the fused
 # attention backend at seq 512, and the three bench modes.
 set -euo pipefail
-# Same knob as bench.py; content-keyed, shared across capture legs.
+# Per-user scratch cache for the runner legs. bench.py uses its own
+# in-repo committed default (.jax_cache/) — see retry_capture_r03.sh
+# for the split rationale.
 CACHE=${BENCH_COMPILE_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/bert_tpu_jax_cache}
 cd "$(dirname "$0")/.."
 WORK=${1:-/tmp/bert_tpu_smoke}

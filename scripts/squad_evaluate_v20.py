@@ -1,0 +1,195 @@
+"""SQuAD v2.0 evaluation metric (EM + F1 with no-answer accounting).
+
+Implements the official v2.0 metric's published algorithm — answer
+normalization, max over gold answers, empty-string handling for
+impossible questions, HasAns/NoAns breakdowns, and the best-threshold
+search over no-answer scores — so the finetune runner's official-eval
+subprocess hook (run_squad.py --do_eval --eval_script, parity with
+reference run_squad.py:1197-1204; the reference fetches the upstream
+evaluate-v2.0.py at utils/download.py:119-120) works in this zero-egress
+environment.
+
+Usage (the interface run_squad.py invokes):
+    python squad_evaluate_v20.py <dataset.json> <predictions.json> \
+        [--na-prob-file null_odds.json] [--na-prob-thresh 0.0]
+
+Prints one JSON object with exact_match / f1 (percent, the keys the
+runner's summary parses) plus the official breakdown keys (total,
+HasAns_*, NoAns_*, and — when --na-prob-file is given — best_exact,
+best_exact_thresh, best_f1, best_f1_thresh).
+
+Note on no-answer scores: the runner's null_odds.json holds the decode's
+null score DIFF (null_score - best_non_null_score; higher = more likely
+unanswerable, threshold semantics of --null_score_diff_threshold). Any
+monotone unanswerability score works for the threshold search; only the
+*_thresh outputs are in the score's own units.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import re
+import string
+import sys
+
+
+def normalize_answer(s: str) -> str:
+    s = s.lower()
+    s = "".join(ch for ch in s if ch not in set(string.punctuation))
+    s = re.sub(r"\b(a|an|the)\b", " ", s)
+    return " ".join(s.split())
+
+
+def get_tokens(s: str) -> list:
+    return normalize_answer(s).split() if s else []
+
+
+def compute_exact(a_gold: str, a_pred: str) -> int:
+    return int(normalize_answer(a_gold) == normalize_answer(a_pred))
+
+
+def compute_f1(a_gold: str, a_pred: str) -> float:
+    gold_toks = get_tokens(a_gold)
+    pred_toks = get_tokens(a_pred)
+    common = collections.Counter(gold_toks) & collections.Counter(pred_toks)
+    num_same = sum(common.values())
+    if not gold_toks or not pred_toks:
+        # Either is a no-answer: F1 is 1 iff both are.
+        return float(gold_toks == pred_toks)
+    if num_same == 0:
+        return 0.0
+    precision = num_same / len(pred_toks)
+    recall = num_same / len(gold_toks)
+    return 2 * precision * recall / (precision + recall)
+
+
+def iter_qas(dataset):
+    for article in dataset:
+        for paragraph in article["paragraphs"]:
+            for qa in paragraph["qas"]:
+                yield qa
+
+
+def get_raw_scores(dataset, predictions):
+    exact, f1 = {}, {}
+    for qa in iter_qas(dataset):
+        qid = qa["id"]
+        golds = [a["text"] for a in qa["answers"]
+                 if normalize_answer(a["text"])]
+        if not golds:
+            golds = [""]  # unanswerable: the only correct answer is ""
+        if qid not in predictions:
+            print(f"Missing prediction for {qid}", file=sys.stderr)
+            continue
+        pred = predictions[qid]
+        exact[qid] = max(compute_exact(g, pred) for g in golds)
+        f1[qid] = max(compute_f1(g, pred) for g in golds)
+    return exact, f1
+
+
+def apply_no_ans_threshold(scores, na_probs, qid_to_has_ans, thresh):
+    out = {}
+    for qid, s in scores.items():
+        if na_probs[qid] > thresh:
+            out[qid] = float(not qid_to_has_ans[qid])
+        else:
+            out[qid] = s
+    return out
+
+
+def make_eval_dict(exact, f1, qid_list=None):
+    qids = list(exact) if qid_list is None else qid_list
+    total = len(qids)
+    if not total:
+        # No scored questions at all (e.g. empty predictions): a zero
+        # score, not a crash — the runner's eval subprocess must always
+        # get parseable output.
+        return collections.OrderedDict(
+            [("exact", 0.0), ("f1", 0.0), ("total", 0)])
+    return collections.OrderedDict([
+        ("exact", 100.0 * sum(exact[q] for q in qids) / total),
+        ("f1", 100.0 * sum(f1[q] for q in qids) / total),
+        ("total", total),
+    ])
+
+
+def find_best_thresh(preds, scores, na_probs, qid_to_has_ans):
+    """Sweep the no-answer threshold from -inf upward; at -inf every
+    question is predicted unanswerable (score = #no-answer questions)."""
+    if not scores:
+        return 0.0, 0.0
+    cur_score = best_score = sum(
+        1 for q in qid_to_has_ans if not qid_to_has_ans[q])
+    best_thresh = 0.0
+    for qid in sorted(na_probs, key=lambda q: na_probs[q]):
+        if qid not in scores:
+            continue
+        if qid_to_has_ans[qid]:
+            diff = scores[qid]
+        else:
+            diff = -1 if preds[qid] else 0
+        cur_score += diff
+        if cur_score > best_score:
+            best_score = cur_score
+            best_thresh = na_probs[qid]
+    return 100.0 * best_score / len(scores), best_thresh
+
+
+def evaluate(dataset, predictions, na_probs=None, na_prob_thresh=0.0):
+    qid_to_has_ans = {
+        qa["id"]: bool(
+            [a for a in qa["answers"] if normalize_answer(a["text"])])
+        for qa in iter_qas(dataset)}
+    exact_raw, f1_raw = get_raw_scores(dataset, predictions)
+    if na_probs is None:
+        exact, f1 = exact_raw, f1_raw
+    else:
+        exact = apply_no_ans_threshold(
+            exact_raw, na_probs, qid_to_has_ans, na_prob_thresh)
+        f1 = apply_no_ans_threshold(
+            f1_raw, na_probs, qid_to_has_ans, na_prob_thresh)
+    out = make_eval_dict(exact, f1)
+    has_ans = [q for q in exact if qid_to_has_ans[q]]
+    no_ans = [q for q in exact if not qid_to_has_ans[q]]
+    for prefix, qids in (("HasAns", has_ans), ("NoAns", no_ans)):
+        if qids:
+            sub = make_eval_dict(exact, f1, qids)
+            for k, v in sub.items():
+                out[f"{prefix}_{k}"] = v
+    if na_probs is not None:
+        best_exact, exact_thresh = find_best_thresh(
+            predictions, exact_raw, na_probs, qid_to_has_ans)
+        best_f1, f1_thresh = find_best_thresh(
+            predictions, f1_raw, na_probs, qid_to_has_ans)
+        out["best_exact"] = best_exact
+        out["best_exact_thresh"] = exact_thresh
+        out["best_f1"] = best_f1
+        out["best_f1_thresh"] = f1_thresh
+    # Keys the runner's summary parser reads (same contract as v1.1).
+    out["exact_match"] = out["exact"]
+    return dict(out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("dataset")
+    p.add_argument("predictions")
+    p.add_argument("--na-prob-file", default=None)
+    p.add_argument("--na-prob-thresh", type=float, default=0.0)
+    args = p.parse_args(argv)
+    with open(args.dataset) as f:
+        dataset = json.load(f)["data"]
+    with open(args.predictions) as f:
+        predictions = json.load(f)
+    na_probs = None
+    if args.na_prob_file:
+        with open(args.na_prob_file) as f:
+            na_probs = json.load(f)
+    print(json.dumps(evaluate(
+        dataset, predictions, na_probs, args.na_prob_thresh)))
+
+
+if __name__ == "__main__":
+    main()

@@ -75,13 +75,29 @@ def test_degraded_metric_name_and_note():
     assert out["degraded"] is True
 
 
+def _config_digest(env):
+    """The per-config warm-marker digest bench.py would compute under
+    ``env`` (module constants are env-derived, so ask a subprocess)."""
+    full = dict(os.environ)
+    full.update(env)
+    return subprocess.run(
+        [sys.executable, "-c",
+         "import bench; print(bench.CONFIG_DIGEST)"],
+        env=full, cwd=REPO, capture_output=True, text=True,
+        check=True).stdout.strip()
+
+
 def test_cold_cache_defaults_to_one_long_attempt(tmp_path):
-    # Empty cache dir => the parent must not split its budget into several
-    # short attempts (a killed compile caches nothing; only one long
-    # window can make progress).
+    # A cache without THIS config's warm marker => the parent must not
+    # split its budget into several short attempts (a killed compile
+    # caches nothing; only one long window can make progress). Entries
+    # for other shapes don't count as warm.
+    cache = tmp_path / "other_shapes"
+    cache.mkdir()
+    (cache / "warm_0000deadbeef").write_text("ok")  # some OTHER config
     proc = _run_bench({
         "JAX_PLATFORMS": "nonexistent_backend",
-        "BENCH_COMPILE_CACHE_DIR": str(tmp_path / "empty"),
+        "BENCH_COMPILE_CACHE_DIR": str(cache),
         "BENCH_DEGRADE": "0",
         "BENCH_BUDGET_S": "60",
     }, timeout=120, capture_stderr=True)
@@ -93,15 +109,16 @@ def test_cold_cache_defaults_to_one_long_attempt(tmp_path):
 def test_warm_cache_defaults_to_retries(tmp_path):
     cache = tmp_path / "warm"
     cache.mkdir()
-    (cache / "entry").write_bytes(b"x")
-    proc = _run_bench({
+    env = {
         "JAX_PLATFORMS": "nonexistent_backend",
         "BENCH_COMPILE_CACHE_DIR": str(cache),
         "BENCH_DEGRADE": "0",
         "BENCH_BACKOFF_S": "1",
         "BENCH_PROBE_TIMEOUT_S": "30",
         "BENCH_BUDGET_S": "90",
-    }, timeout=150, capture_stderr=True)
+    }
+    (cache / f"warm_{_config_digest(env)}").write_text("ok")
+    proc = _run_bench(env, timeout=150, capture_stderr=True)
     assert proc.returncode == 1
     assert "attempt 2" in proc.stderr
 
@@ -189,3 +206,17 @@ class TestCompileCache:
 
         assert enable_compile_cache("/proc/1/nonexistent/cache") is False
         assert "compile cache disabled" in capsys.readouterr().out
+
+
+class TestPallasBhBlockOverride:
+    def test_env_override_raises_cap(self, monkeypatch):
+        from bert_pytorch_tpu.ops.pallas.attention import _pick_bh_block
+
+        # default heuristic caps at 16 (the 4096 VMEM budget)
+        monkeypatch.delenv("PALLAS_ATTN_BH_BLOCK", raising=False)
+        assert _pick_bh_block(128, 896) == 16
+        # the sweep's override probes past the cap...
+        monkeypatch.setenv("PALLAS_ATTN_BH_BLOCK", "32")
+        assert _pick_bh_block(128, 896) == 32
+        # ...but the divisibility walk still rules: bh % g == 0
+        assert _pick_bh_block(128, 48) == 16

@@ -153,7 +153,7 @@ def test_get_answers_decodes_correct_span(squad_json, tokenizer):
         start[paris_pos] = 5.0
         end[paris_pos] = 5.0
         results.append(squad.RawResult(f.unique_id, start.tolist(), end.tolist()))
-    answers, nbest = squad.get_answers(
+    answers, nbest, _ = squad.get_answers(
         examples, features, results, _decode_args())
     assert answers["q1"] == "Paris"
     assert answers["q2"] == "Paris"
@@ -309,16 +309,20 @@ def test_squad_v2_null_answers(tokenizer, tmp_path):
             end[0] = 8.0
         results.append(
             squad.RawResult(f.unique_id, start.tolist(), end.tolist()))
-    answers, nbest = squad.get_answers(
+    answers, nbest, null_odds = squad.get_answers(
         examples, features, results, _decode_args(
             version_2_with_negative=True))
     assert answers["a1"] == "Paris"
     assert answers["na1"] == ""
+    # null_odds carries the decode's null-vs-span score diff for the
+    # official v2.0 best-threshold search: negative (span wins) for the
+    # answerable question, positive for the unanswerable one
+    assert null_odds["a1"] < 0 < null_odds["na1"]
     # the competing span is present in the n-best list — the null verdict
     # came from the threshold comparison, not from an empty candidate set
     assert any(e["text"] == "Paris" for e in nbest["na1"])
     # and with a huge threshold the span wins instead
-    answers_hi, _ = squad.get_answers(
+    answers_hi, _, _ = squad.get_answers(
         examples, features, results, _decode_args(
             version_2_with_negative=True, null_score_diff_threshold=50.0))
     assert answers_hi["na1"] == "Paris"

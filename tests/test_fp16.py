@@ -1,0 +1,221 @@
+"""fp16 + dynamic loss scaling — the reference-parity AMP mode.
+
+SURVEY.md §2.3 planned "keep optional fp16+scaler for parity testing"
+(reference GradScaler at run_pretraining.py:314-318, its state in
+checkpoints at :519-523). bf16 stays the TPU default; these tests pin the
+GradScaler-equivalent semantics: scaled-gradient unscaling, skip+backoff
+on inf/nan, growth after an interval, checkpointable wrapper state, and
+phase-surgery compatibility.
+"""
+
+import json
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from bert_pytorch_tpu import optim
+from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
+
+VOCAB = 128
+
+
+@pytest.fixture()
+def workdir(tmp_path):
+    data_dir = tmp_path / "data"
+    out_dir = tmp_path / "out"
+    data_dir.mkdir()
+    for i in range(2):
+        make_shard(str(data_dir / f"shard_{i}.hdf5"), 64, 32, VOCAB, seed=i)
+    model_config = {
+        "vocab_size": VOCAB, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 32, "type_vocab_size": 2,
+        "next_sentence": True, "mask_token_id": 4,
+    }
+    config_path = tmp_path / "model.json"
+    config_path.write_text(json.dumps(model_config))
+    return {"data": str(data_dir), "out": str(out_dir),
+            "model": str(config_path)}
+
+
+def _argv(workdir, *extra):
+    return [
+        "--input_dir", workdir["data"],
+        "--output_dir", workdir["out"],
+        "--model_config_file", workdir["model"],
+        "--global_batch_size", "32",
+        "--local_batch_size", "2",
+        "--max_steps", "8",
+        "--steps", "3",
+        "--learning_rate", "1e-3",
+        "--warmup_proportion", "0.25",
+        "--num_steps_per_checkpoint", "100",
+        "--dtype", "float16",
+        "--seed", "7",
+        *extra,
+    ]
+
+
+def _tree(x):
+    return {"a": jnp.asarray([x, 2.0 * x]), "b": {"c": jnp.asarray([3.0 * x])}}
+
+
+class TestDynamicLossScale:
+    def _tx(self, **kw):
+        return optim.dynamic_loss_scale(optax.sgd(0.1), **kw)
+
+    def test_finite_step_matches_inner_on_unscaled_grads(self):
+        tx = self._tx(init_scale=1024.0)
+        params = _tree(1.0)
+        state = tx.init(params)
+        grads = _tree(0.5)
+        scaled = jax.tree_util.tree_map(lambda g: g * state.scale, grads)
+        updates, new_state = tx.update(scaled, state, params)
+        ref_updates, _ = optax.sgd(0.1).init(params), None
+        ref_updates, _ = optax.sgd(0.1).update(
+            grads, optax.sgd(0.1).init(params), params)
+        for u, r in zip(jax.tree_util.tree_leaves(updates),
+                        jax.tree_util.tree_leaves(ref_updates)):
+            np.testing.assert_allclose(u, r, rtol=1e-6)
+        assert float(new_state.scale) == 1024.0
+        assert int(new_state.growth_count) == 1
+
+    def test_nonfinite_skips_and_backs_off(self):
+        tx = optim.dynamic_loss_scale(
+            optim.lamb(1e-2), init_scale=2.0 ** 10)
+        params = _tree(1.0)
+        state = tx.init(params)
+        bad = _tree(1.0)
+        bad["b"]["c"] = jnp.asarray([jnp.inf])
+        updates, new_state = tx.update(bad, state, params)
+        for u in jax.tree_util.tree_leaves(updates):
+            np.testing.assert_array_equal(u, np.zeros_like(u))
+        # inner optimizer state untouched: count not incremented
+        assert int(new_state.inner.count) == int(state.inner.count)
+        assert float(new_state.scale) == 2.0 ** 9
+        assert int(new_state.growth_count) == 0
+
+    def test_growth_after_interval(self):
+        tx = self._tx(init_scale=8.0, growth_interval=3)
+        params = _tree(1.0)
+        state = tx.init(params)
+        for i in range(3):
+            scaled = jax.tree_util.tree_map(
+                lambda g: g * state.scale, _tree(0.1))
+            _, state = tx.update(scaled, state, params)
+        assert float(state.scale) == 16.0
+        assert int(state.growth_count) == 0  # reset on growth
+
+    def test_reset_count_keeps_scale(self):
+        tx = optim.dynamic_loss_scale(optim.lamb(1e-2), init_scale=4096.0)
+        state = tx.init(_tree(1.0))
+        _, state = tx.update(_tree(1.0), state, _tree(1.0))
+        reset = optim.reset_count(state, 17)
+        assert int(reset.inner.count) == 17
+        assert float(reset.scale) == float(state.scale)
+
+    def test_opt_step_count_both_layouts(self):
+        plain = optim.lamb(1e-2).init(_tree(1.0))
+        wrapped = optim.dynamic_loss_scale(optim.lamb(1e-2)).init(_tree(1.0))
+        assert int(optim.opt_step_count(plain)) == 0
+        assert int(optim.opt_step_count(wrapped)) == 0
+
+
+class TestTrainStepFp16:
+    def _setup(self, loss_scale, dtype=jnp.float16, init_scale=2.0 ** 12):
+        from bert_pytorch_tpu import pretrain
+        from bert_pytorch_tpu.config import BertConfig
+        from bert_pytorch_tpu.models import BertForPreTraining
+
+        config = BertConfig(
+            vocab_size=256, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=32, next_sentence=True,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+        model = BertForPreTraining(config, dtype=dtype)
+        tx = optim.lamb(1e-3)
+        if loss_scale:
+            tx = optim.dynamic_loss_scale(tx, init_scale=init_scale)
+        rng = np.random.default_rng(0)
+        b, s = 4, 32
+        host = {
+            "input_ids": rng.integers(0, 256, (b, s)).astype(np.int32),
+            "segment_ids": rng.integers(0, 2, (b, s)).astype(np.int32),
+            "input_mask": np.ones((b, s), np.int32),
+            "masked_lm_labels": np.where(
+                rng.random((b, s)) < 0.15,
+                rng.integers(0, 256, (b, s)), -1).astype(np.int32),
+            "next_sentence_labels": rng.integers(0, 2, (b,)).astype(np.int32),
+        }
+        sample = (jnp.zeros((1, s), jnp.int32),) * 3
+        params = nn.unbox(
+            model.init(jax.random.PRNGKey(0), *sample))["params"]
+        state = pretrain.TrainState(
+            params=params, opt_state=tx.init(params),
+            rng=jax.random.PRNGKey(1))
+        step = pretrain.make_train_step(model, tx, loss_scale=loss_scale)
+        batch = pretrain.stack_microbatches(host, 2)
+        return step, state, batch
+
+    def test_fp16_step_runs_and_reports_scale(self):
+        step, state, batch = self._setup(loss_scale=True)
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert float(metrics["loss_scale"]) == 2.0 ** 12
+        assert np.isfinite(float(metrics["grad_norm"]))
+        state, metrics = step(state, batch)
+        assert int(optim.opt_step_count(state.opt_state)) == 2
+
+    def test_scaling_is_transparent_in_f32(self):
+        # Same model/dtype (f32), with and without the scaler: identical
+        # parameters after a step — scaling must be numerically neutral
+        # when nothing overflows.
+        step_a, state_a, batch = self._setup(loss_scale=False,
+                                             dtype=jnp.float32)
+        step_b, state_b, _ = self._setup(loss_scale=True, dtype=jnp.float32)
+        state_a, ma = step_a(state_a, batch)
+        state_b, mb = step_b(state_b, batch)
+        np.testing.assert_allclose(
+            float(ma["loss"]), float(mb["loss"]), rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(state_a.params),
+                        jax.tree_util.tree_leaves(state_b.params)):
+            np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-7)
+
+    def test_fp16_overflow_skips_then_recovers(self):
+        # A loss scale far beyond fp16 range overflows the backward pass;
+        # the step must be skipped (count stays 0) with the scale halved,
+        # not produce NaN parameters.
+        step, state, batch = self._setup(loss_scale=True, init_scale=2.0 ** 60)
+        before = jax.tree_util.tree_map(np.asarray, state.params)
+        state, metrics = step(state, batch)
+        assert int(optim.opt_step_count(state.opt_state)) == 0
+        assert float(state.opt_state.scale) == 2.0 ** 59
+        for b, a in zip(jax.tree_util.tree_leaves(before),
+                        jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(b, np.asarray(a))
+
+
+class TestRunnerFp16:
+    def test_runner_fp16_smoke_checkpoint_roundtrip(self, workdir):
+        import run_pretraining
+
+        result = run_pretraining.main(
+            run_pretraining.parse_arguments(_argv(workdir)))
+        assert result["global_step"] == 3
+        assert np.isfinite(result["loss"])
+        # resume from the checkpoint (scaler state rides in 'optimizer'):
+        # 5 more steps on top of the 3 already run
+        result = run_pretraining.main(run_pretraining.parse_arguments(
+            _argv(workdir, "--steps", "5")))
+        assert result["global_step"] == 8
+
+    def test_runner_rejects_fp16_with_kfac(self, workdir):
+        import run_pretraining
+
+        with pytest.raises(ValueError, match="float16"):
+            run_pretraining.main(run_pretraining.parse_arguments(
+                _argv(workdir, "--kfac")))
