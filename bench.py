@@ -106,7 +106,9 @@ def _config_digest():
     import hashlib
 
     key = repr((PHASE, KFAC, DEGRADED, LONG_SEQ, LOCAL_BATCH, REMAT,
-                RNG_IMPL, ATTN, N_DEVICES))
+                RNG_IMPL, ATTN, N_DEVICES,
+                # kernel-tuning env knobs also change the compiled program
+                os.environ.get("PALLAS_ATTN_BH_BLOCK", "")))
     return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 

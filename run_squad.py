@@ -82,7 +82,12 @@ def parse_args(argv=None):
                              "bert_adam = its fp32 path")
     parser.add_argument("--max_grad_norm", type=float, default=1.0)
     parser.add_argument("--dtype", type=str, default="bfloat16",
-                        choices=["bfloat16", "float32"])
+                        choices=["bfloat16", "float32", "float16"],
+                        help="bfloat16 is the TPU default (no loss scaling "
+                             "needed); float16 is the reference-parity AMP "
+                             "mode (apex O2 + GradScaler, reference "
+                             "run_squad.py:980-996) with a dynamic loss "
+                             "scaler")
     parser.add_argument("--log_freq", type=int, default=50)
     parser.add_argument("--json_summary", type=str, default="squad_log.json")
     parser.add_argument("--eval_script", type=str, default=None)
@@ -191,7 +196,8 @@ def main(args):
     config = BertConfig.from_json_file(args.config_file)
     if config.vocab_size % 8 != 0:
         config.vocab_size += 8 - (config.vocab_size % 8)
-    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    dtype = {"bfloat16": jnp.bfloat16, "float16": jnp.float16,
+             "float32": jnp.float32}[args.dtype]
     model = BertForQuestionAnswering(config, dtype=dtype)
     tokenizer = build_tokenizer(args)
     rules = logical_axis_rules("dp")
@@ -248,20 +254,33 @@ def main(args):
                     args.learning_rate, schedule="warmup_linear",
                     warmup=args.warmup_proportion, t_total=total_steps,
                     weight_decay_mask=mask)
+            fp16 = args.dtype == "float16"
+            if fp16:
+                # Reference-parity AMP (apex O2 + loss scaling,
+                # run_squad.py:980-996): the scaler state rides in
+                # opt_state like the reference's amp state.
+                tx = optim.dynamic_loss_scale(tx)
             opt_state = tx.init(params)
 
             def train_step(params, opt_state, batch, rng):
+                loss_scale = opt_state.scale if fp16 else 1.0
+
                 def loss_fn(p):
                     start_logits, end_logits = model.apply(
                         {"params": p}, batch["input_ids"],
                         batch["segment_ids"], batch["input_mask"],
                         False, rngs={"dropout": rng})
-                    return span_loss(start_logits, end_logits,
+                    loss = span_loss(start_logits, end_logits,
                                      batch["start_positions"],
                                      batch["end_positions"])
-                loss, grads = jax.value_and_grad(loss_fn)(params)
+                    return loss * loss_scale, loss
+                (_, loss), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
                 if args.optimizer == "adamw" and args.max_grad_norm > 0:
-                    gnorm = global_norm(grads)
+                    # grads carry loss_scale in fp16; clip on the TRUE norm
+                    # (the multiplicative clip commutes with the wrapper's
+                    # unscale)
+                    gnorm = global_norm(grads) / loss_scale
                     scale = jnp.minimum(1.0, args.max_grad_norm / (gnorm + 1e-6))
                     grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
                 updates, opt_state2 = tx.update(grads, opt_state, params)

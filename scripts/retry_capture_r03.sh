@@ -130,7 +130,8 @@ EOF
       # harness failure: record it and keep sweeping.
       if grep -qi "resource exhausted\|out of memory" "$LOGS/sweep_$tag.log"; then
         echo "{\"local_batch\": $b, \"attention\": \"${attn:-xla}\"," \
-             "\"remat\": \"${remat:-dots}\", \"oom\": true}" >> "$LOGS/sweep.tmp"
+             "\"remat\": \"${remat:-dots}\"${g:+, \"bh_block\": $g}," \
+             "\"oom\": true}" >> "$LOGS/sweep.tmp"
         echo "   sweep $tag: OOM (recorded)"
       else
         echo "   sweep $tag FAILED; aborting sweep pass"

@@ -193,6 +193,37 @@ def test_squad_end_to_end_tiny(tmp_path, squad_json, vocab_file):
     assert set(answers.keys()) == {"q1", "q2"}
 
 
+def test_squad_fp16_loss_scaled_tiny(tmp_path, squad_json, vocab_file):
+    """--dtype float16: the reference-parity AMP mode (apex O2 + scaler,
+    reference run_squad.py:980-996) on the SQuAD runner."""
+    import run_squad
+
+    model_config = {
+        "vocab_size": len(VOCAB_TOKENS), "hidden_size": 32,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "intermediate_size": 64, "max_position_embeddings": 64,
+        "type_vocab_size": 2, "next_sentence": True,
+        "vocab_file": vocab_file, "tokenizer": "wordpiece",
+        "lowercase": True,
+    }
+    config_path = tmp_path / "model.json"
+    config_path.write_text(json.dumps(model_config))
+    args = run_squad.parse_args([
+        "--output_dir", str(tmp_path / "out"),
+        "--config_file", str(config_path),
+        "--train_file", squad_json,
+        "--predict_file", squad_json,
+        "--do_train", "--do_predict", "--do_lower_case",
+        "--train_batch_size", "2", "--predict_batch_size", "2",
+        "--max_steps", "2", "--max_seq_length", "32",
+        "--doc_stride", "8", "--max_query_length", "16",
+        "--dtype", "float16", "--skip_cache", "--mesh_data", "2",
+    ])
+    summary = run_squad.main(args)
+    assert np.isfinite(summary["final_loss"])
+    assert (tmp_path / "out" / "predictions.json").exists()
+
+
 @pytest.fixture(scope="module")
 def conll_file(tmp_path_factory):
     d = tmp_path_factory.mktemp("ner")

@@ -75,9 +75,8 @@ class TestDynamicLossScale:
         grads = _tree(0.5)
         scaled = jax.tree_util.tree_map(lambda g: g * state.scale, grads)
         updates, new_state = tx.update(scaled, state, params)
-        ref_updates, _ = optax.sgd(0.1).init(params), None
-        ref_updates, _ = optax.sgd(0.1).update(
-            grads, optax.sgd(0.1).init(params), params)
+        ref = optax.sgd(0.1)
+        ref_updates, _ = ref.update(grads, ref.init(params), params)
         for u, r in zip(jax.tree_util.tree_leaves(updates),
                         jax.tree_util.tree_leaves(ref_updates)):
             np.testing.assert_allclose(u, r, rtol=1e-6)
