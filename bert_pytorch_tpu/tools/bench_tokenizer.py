@@ -1,0 +1,120 @@
+"""Tokenizer throughput: the in-repo C++ core vs HF's Rust tokenizers.
+
+The reference's entire offline pipeline throughput rests on the external
+Rust `tokenizers` crate (SURVEY.md §2.3: src/tokenization.py:42-57,
+utils/encode_data.py:280-293). This framework replaces it with the
+in-repo C++ core (`native/tokenizer.cpp`, ctypes-bound); bit-parity is
+pinned by tests/test_tokenizer.py — this harness measures whether the
+replacement also holds up on THROUGHPUT, the property the reference
+outsourced to Rust for. Prints one JSON line per backend:
+
+  {"metric": "wordpiece_encode_tokens_per_sec", "backend": ..., ...}
+
+  python -m bert_pytorch_tpu.tools.bench_tokenizer [--lines 20000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+
+def _build_corpus(n_lines: int, seed: int):
+    from bert_pytorch_tpu.tools.make_synthetic_text import write_corpus
+
+    d = tempfile.mkdtemp(prefix="bench_tok_")
+    paths = write_corpus(d, n_files=1,
+                         articles_per_file=max(1, n_lines // 10), seed=seed)
+    lines = []
+    with open(paths[0]) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                lines.append(ln)
+            if len(lines) >= n_lines:
+                break
+    return d, lines
+
+
+def _train_vocab(corpus_dir: str, out: str):
+    from bert_pytorch_tpu.tools.tokenizer_cpp import train_wordpiece_vocab
+
+    train_wordpiece_vocab(
+        [os.path.join(corpus_dir, f) for f in os.listdir(corpus_dir)
+         if f.endswith(".txt")],
+        4096, out, min_frequency=1)
+
+
+def bench_cpp(vocab_file: str, lines, repeat: int):
+    from bert_pytorch_tpu.tools.tokenizer_cpp import CppWordPieceTokenizer
+
+    tok = CppWordPieceTokenizer(vocab_file, lowercase=True)
+    # warmup + token count
+    n_tokens = sum(len(e.ids) for e in tok.encode_batch(lines))
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        tok.encode_batch(lines)
+    dt = (time.perf_counter() - t0) / repeat
+    return n_tokens, dt
+
+
+def bench_hf(vocab_file: str, lines, repeat: int):
+    try:
+        from tokenizers import BertWordPieceTokenizer
+    except ImportError:
+        return None
+    tok = BertWordPieceTokenizer(vocab_file, lowercase=True)
+    # no [CLS]/[SEP] so both backends do identical token work
+    n_tokens = sum(len(e.ids)
+                   for e in tok.encode_batch(lines, add_special_tokens=False))
+    t0 = time.perf_counter()
+    for _ in range(repeat):
+        tok.encode_batch(lines, add_special_tokens=False)
+    dt = (time.perf_counter() - t0) / repeat
+    return n_tokens, dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--lines", type=int, default=20000)
+    p.add_argument("--repeat", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    corpus_dir, lines = _build_corpus(args.lines, args.seed)
+    vocab = os.path.join(corpus_dir, "vocab.txt")
+    _train_vocab(corpus_dir, vocab)
+
+    results = {}
+    for backend, fn in (("cpp", bench_cpp), ("hf_rust", bench_hf)):
+        got = fn(vocab, lines, args.repeat)
+        if got is None:
+            print(json.dumps({"backend": backend, "skipped": "not installed"}))
+            continue
+        n_tokens, dt = got
+        results[backend] = n_tokens / dt
+        print(json.dumps({
+            "metric": "wordpiece_encode_tokens_per_sec",
+            "backend": backend,
+            "lines": len(lines),
+            "tokens": n_tokens,
+            "value": round(n_tokens / dt, 0),
+            "unit": "tokens/s",
+        }))
+    if "cpp" in results and "hf_rust" in results:
+        print(json.dumps({
+            "metric": "cpp_vs_hf_rust_ratio",
+            "value": round(results["cpp"] / results["hf_rust"], 3),
+            "note": ("identical token work (no specials), same vocab; cpp "
+                     "side is a SEQUENTIAL python loop over ctypes calls, "
+                     "hf_rust side is tokenizers' default encode_batch "
+                     "(rayon-parallel unless TOKENIZERS_PARALLELISM "
+                     "disables it); sentence-length synthetic English"),
+        }))
+
+
+if __name__ == "__main__":
+    main()

@@ -273,3 +273,22 @@ def test_weights_sha_verify(tmp_path):
                 str(tmp_path / "model"), "__test__")
     finally:
         del download.WEIGHTS_SHA["__test__"]
+
+
+def test_bench_tokenizer_smoke():
+    """The tokenizer throughput harness runs end to end and reports the
+    same token count for both backends (identical work — the fairness
+    property the ratio depends on)."""
+    import json
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "bert_pytorch_tpu.tools.bench_tokenizer",
+         "--lines", "200", "--repeat", "1"],
+        capture_output=True, text=True, check=True, timeout=300).stdout
+    recs = [json.loads(l) for l in out.splitlines() if l.strip()]
+    by_backend = {r["backend"]: r for r in recs if "backend" in r}
+    assert by_backend["cpp"]["value"] > 0
+    if "skipped" not in by_backend.get("hf_rust", {"skipped": 1}):
+        assert by_backend["cpp"]["tokens"] == by_backend["hf_rust"]["tokens"]
