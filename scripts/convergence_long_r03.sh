@@ -18,6 +18,10 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 W=${1:-/tmp/bert_conv_long_r03}
+# Artifact prefix: empty (default) writes the repo-root chip artifacts;
+# CPU sanity runs MUST set LONG_ARTIFACT_PREFIX to a scratch path so a
+# sanity pass can never masquerade as (or suppress) the chip capture.
+PREFIX=${LONG_ARTIFACT_PREFIX:-}
 MODEL=${LONG_MODEL:-bert_base}
 STEPS=${LONG_STEPS:-5000}
 LOCAL_BATCH=${LONG_LOCAL_BATCH:-64}
@@ -57,13 +61,13 @@ python run_pretraining.py --input_dir "$W/encoded" \
     --log_prefix log --log_steps 5 --num_steps_per_checkpoint 250 \
     --compile_cache_dir "$CACHE"
 
-echo "== artifact: CONVERGENCE_LONG_r03.csv + LONG_RUN_r03.json"
-python - "$W" "$STEPS" "$GLOBAL_BATCH" "$MODEL" "$LR" <<'EOF'
+echo "== artifact: ${PREFIX}CONVERGENCE_LONG_r03.csv + ${PREFIX}LONG_RUN_r03.json"
+python - "$W" "$STEPS" "$GLOBAL_BATCH" "$MODEL" "$LR" "$PREFIX" <<'EOF'
 import csv, json, sys
-w, steps, gbs, model, lr = sys.argv[1:6]
+w, steps, gbs, model, lr, prefix = sys.argv[1:7]
 rows = [r for r in csv.DictReader(open(f"{w}/run/log_metrics.csv"))
         if r["tag"] == "train"]
-with open("CONVERGENCE_LONG_r03.csv", "w", newline="") as fo:
+with open(f"{prefix}CONVERGENCE_LONG_r03.csv", "w", newline="") as fo:
     wr = csv.writer(fo)
     wr.writerow(["optimizer", "step", "loss", "mlm_accuracy",
                  "learning_rate", "samples_per_second"])
@@ -94,11 +98,16 @@ out = {
     "all_floors_pass": all(v["pass"] for k, v in checks.items()
                            if k.startswith("floor") or k.startswith("final")),
 }
-json.dump(out, open("LONG_RUN_r03.json", "w"), indent=1)
+json.dump(out, open(f"{prefix}LONG_RUN_r03.json", "w"), indent=1)
 print(json.dumps(out["checks"], indent=1))
 print("all floors pass:", out["all_floors_pass"])
 EOF
-python tools/plot_convergence.py CONVERGENCE_LONG_r03.csv \
-    docs/convergence_long_r03.png \
-    "BERT-base long run (gbs 256, LAMB, one v5e chip)"
+if [ -z "$PREFIX" ]; then
+  python tools/plot_convergence.py CONVERGENCE_LONG_r03.csv \
+      docs/convergence_long_r03.png \
+      "BERT-base long run (gbs 256, LAMB, one v5e chip)"
+else
+  python tools/plot_convergence.py "${PREFIX}CONVERGENCE_LONG_r03.csv" \
+      "${PREFIX}convergence_long_sanity.png"
+fi
 echo "long convergence OK"
