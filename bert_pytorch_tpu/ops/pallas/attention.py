@@ -107,12 +107,22 @@ def _pick_bh_block(seq, bh):
     PALLAS_ATTN_BH_BLOCK overrides the target cap (not the divisibility
     walk) so the capture sweep can probe past the conservative VMEM
     heuristic at short sequence lengths — e.g. G=32 at seq 128, where the
-    4096 budget leaves half of VMEM unused."""
+    4096 budget leaves half of VMEM unused. The env var is read at TRACE
+    time: changing it mid-process has no effect on shapes already
+    compiled, so sweeps must probe each value in a fresh subprocess (the
+    capture sweep does)."""
     import os
 
     env = os.environ.get("PALLAS_ATTN_BH_BLOCK")
-    target = (int(env) if env
-              else min(16, max(1, 4096 // max(seq, 1))))
+    if env:
+        try:
+            target = int(env)
+        except ValueError:
+            raise ValueError(
+                f"PALLAS_ATTN_BH_BLOCK must be an integer, got {env!r}"
+            ) from None
+    else:
+        target = min(16, max(1, 4096 // max(seq, 1)))
     g = 1
     while g * 2 <= target and bh % (g * 2) == 0:
         g *= 2

@@ -299,7 +299,7 @@ def opt_step_count(state):
 
 def dynamic_loss_scale(
     tx: optax.GradientTransformation,
-    init_scale: float = 2.0 ** 15,
+    init_scale: float = 2.0 ** 16,
     growth_factor: float = 2.0,
     backoff_factor: float = 0.5,
     growth_interval: int = 2000,
@@ -318,7 +318,9 @@ def dynamic_loss_scale(
     bf16 needs none of this (same exponent range as f32) — the wrapper
     exists as the reference-parity fp16 mode (SURVEY.md §2.3 "keep
     optional fp16+scaler for parity testing"; reference
-    run_pretraining.py:314-318, 424-434).
+    run_pretraining.py:314-318, 424-434). Defaults match
+    ``torch.cuda.amp.GradScaler()``: init 2**16, growth 2x / backoff 0.5x,
+    growth interval 2000.
     """
 
     def init(params):

@@ -114,8 +114,9 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "the reference-parity AMP mode and enables a "
                              "dynamic loss scaler (GradScaler analog, "
                              "reference run_pretraining.py:314-318)")
-    parser.add_argument("--init_loss_scale", type=float, default=2.0 ** 15,
-                        help="fp16 only: initial dynamic loss scale")
+    parser.add_argument("--init_loss_scale", type=float, default=2.0 ** 16,
+                        help="fp16 only: initial dynamic loss scale "
+                             "(default matches torch GradScaler's 2**16)")
     parser.add_argument("--loss_scale_growth_interval", type=int,
                         default=2000,
                         help="fp16 only: consecutive finite steps before "

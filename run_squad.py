@@ -88,6 +88,9 @@ def parse_args(argv=None):
                              "mode (apex O2 + GradScaler, reference "
                              "run_squad.py:980-996) with a dynamic loss "
                              "scaler")
+    parser.add_argument("--init_loss_scale", type=float, default=2.0 ** 16,
+                        help="fp16 only: initial dynamic loss scale "
+                             "(default matches torch GradScaler's 2**16)")
     parser.add_argument("--log_freq", type=int, default=50)
     parser.add_argument("--json_summary", type=str, default="squad_log.json")
     parser.add_argument("--eval_script", type=str, default=None)
@@ -259,7 +262,8 @@ def main(args):
                 # Reference-parity AMP (apex O2 + loss scaling,
                 # run_squad.py:980-996): the scaler state rides in
                 # opt_state like the reference's amp state.
-                tx = optim.dynamic_loss_scale(tx)
+                tx = optim.dynamic_loss_scale(
+                    tx, init_scale=args.init_loss_scale)
             opt_state = tx.init(params)
 
             def train_step(params, opt_state, batch, rng):
