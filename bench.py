@@ -91,10 +91,21 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 # cadence (factors every 10 steps, inverses every 100): the measured window
 # holds 2 factor passes + 1 Cholesky inverse update in 20 steps, so the
 # reported number is steady-state throughput with the inverse amortization
-# ~5x pessimistic. Measured: 236 seq/s/chip vs 397 first-order (1.7x
-# per-step cost: every-step preconditioning solves on the MXU + a 16-seq
-# stats fwd/bwd every 10 steps + a Cholesky inverse update).
+# ~5x pessimistic. Measured (round 2, stats capture): 236 seq/s/chip vs
+# 397 first-order (1.7x per-step cost: every-step preconditioning solves
+# on the MXU + a 16-seq stats fwd/bwd every 10 steps + a Cholesky inverse
+# update). BENCH_KFAC_CAPTURE selects the factor-capture mode: 'train'
+# (default) harvests factors from microbatch 0 of the step's own backward
+# (the fused hook-parity path, pretrain.make_train_step; CPU proxy at
+# factor_interval=1: 0.83x the step cost of an equal-statistics stats
+# pass, i.e. full-microbatch factor quality at the 16-row subsampled
+# pass's price — KFAC_CAPTURE_BENCH_r04.jsonl); 'stats' keeps the
+# round-3 decoupled stats pass for comparability with the round-2 number.
 KFAC = os.environ.get("BENCH_KFAC", "0") == "1"
+KFAC_CAPTURE = os.environ.get("BENCH_KFAC_CAPTURE", "train")
+if KFAC_CAPTURE not in ("train", "stats"):
+    raise ValueError(
+        f"BENCH_KFAC_CAPTURE must be train|stats, got {KFAC_CAPTURE!r}")
 PHASE = int(os.environ.get("BENCH_PHASE", "1"))
 _P2 = PHASE == 2
 # Degraded fallback (see module docstring): BERT-base geometry at the
@@ -118,7 +129,10 @@ def _config_digest(degraded=None, local_batch=None):
                 LOCAL_BATCH if local_batch is None else local_batch, REMAT,
                 RNG_IMPL, ATTN, N_DEVICES,
                 # kernel-tuning env knobs also change the compiled program
-                os.environ.get("PALLAS_ATTN_BH_BLOCK", "")))
+                os.environ.get("PALLAS_ATTN_BH_BLOCK", ""),
+                # kfac capture mode changes the train-step program; keep
+                # the digest stable for non-kfac configs
+                KFAC_CAPTURE if KFAC else ""))
     return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 
@@ -278,9 +292,14 @@ def _child_main():
             jax.random.PRNGKey(0))
 
         kfac_obj = kfac_state = kfac_shardings = None
+        kfac_fused = KFAC and KFAC_CAPTURE == "train"
         if KFAC:
+            # The fused-capture twin keeps the bench remat (its microbatch-0
+            # backward shares the training step's memory budget); the
+            # stats-pass twin runs a small decoupled batch.
             tapped = BertForPreTraining(
-                config, dtype=jnp.bfloat16, remat="none",
+                config, dtype=jnp.bfloat16,
+                remat=REMAT if kfac_fused else "none",
                 attention_backend=ATTN, kfac_tap=True)
             apply_loss, tap_shape_fn = pretrain.make_kfac_fns(
                 tapped, next_sentence=True, max_pred_per_seq=MAX_PRED)
@@ -295,13 +314,21 @@ def _child_main():
             model, tx, schedule=schedule, next_sentence=True,
             shardings=shardings, batch_shardings_=b_shardings,
             max_pred_per_seq=MAX_PRED,
-            kfac=kfac_obj, kfac_shardings=kfac_shardings)
+            kfac=kfac_obj, kfac_shardings=kfac_shardings,
+            kfac_capture_model=tapped if kfac_fused else None,
+            kfac_factor_interval=10)
 
         batch = pretrain.put_batch(
             pretrain.stack_microbatches(host, ACCUM), b_shardings)
 
         def run_one(state, kfac_state, global_step):
-            if kfac_obj is not None:
+            if kfac_fused:
+                # Factor capture rides microbatch 0's backward, gated
+                # in-jit by the factor interval; inverses stay host-driven.
+                state, metrics, kfac_state = step(state, batch, kfac_state)
+                if global_step % 100 == 0:
+                    kfac_state = kfac_obj.update_inverses(kfac_state)
+            elif kfac_obj is not None:
                 if global_step % 10 == 0:
                     # Strided rows so every data shard contributes to the
                     # statistics (the runner's pattern; a [:16] head-slice
@@ -393,6 +420,8 @@ def _result_json(seq_per_sec_chip, mfu=None, error=None, n_chips=None,
         out["degraded"] = True
         out["note"] = ("BERT-base fallback at the phase-1 shape — NOT the "
                        "headline BERT-large metric")
+    if KFAC:
+        out["kfac_capture"] = KFAC_CAPTURE
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
     if n_chips is not None:

@@ -21,6 +21,7 @@ choices (vs the reference's torch modules):
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
@@ -61,6 +62,59 @@ def _kfac_input_stat(x: Array, feature_ndim: int = 1) -> Array:
 # Collections used by the K-FAC taps (see optim/kfac.py).
 KFAC_A_COLLECTION = "kfac_a"
 KFAC_TAPS_COLLECTION = "kfac_taps"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _g_factor_probe(y: Array, probe: Array, feature_ndim: int) -> Array:
+    """Identity on ``y`` whose gradient w.r.t. ``probe`` is the G-factor
+    statistic Σᵣ ĝᵣĝᵣᵀ of ``y``'s cotangent.
+
+    The JAX-native analog of kfac_pytorch's *backward* hooks (driven at
+    reference run_pretraining.py:320-355): a torch hook computes the
+    (d, d) outer product layer-by-layer as autograd walks the graph, so
+    the full cotangent is never kept. Differentiating a plain additive
+    tap would instead materialize every layer's stacked cotangent under
+    ``nn.scan`` — for BERT-large ~2 GB per tap group. This custom_vjp
+    moves the outer product INTO the backward pass: the cotangent for
+    ``probe`` (shape (d, d)) is the already-reduced factor, so the scan
+    accumulates (L, d, d) statistics instead of (L, B, S, d) gradients,
+    and a training step can harvest factors from its own backward at the
+    cost of the outer-product FLOPs alone (optim/kfac.py, pretrain.py
+    ``make_train_step(kfac_capture_model=...)``).
+    """
+    del probe
+    return y
+
+
+def _g_factor_probe_fwd(y, probe, feature_ndim):
+    del probe
+    return y, None
+
+
+def _g_factor_probe_bwd(feature_ndim, _, ct):
+    d = 1
+    for s in ct.shape[-feature_ndim:]:
+        d *= s
+    g = ct.reshape(-1, d).astype(jnp.float32)
+    return ct, jnp.einsum("ri,rj->ij", g, g)
+
+
+_g_factor_probe.defvjp(_g_factor_probe_fwd, _g_factor_probe_bwd)
+
+
+def _kfac_g_tap(mdl: nn.Module, name: str, y: Array,
+                feature_ndim: int = 1) -> Array:
+    """Register a (d, d) zero probe variable in ``kfac_taps`` and thread
+    ``y`` through :func:`_g_factor_probe` so grad-w.r.t.-taps yields the
+    per-layer G factors directly. Tap names encode
+    '<dense submodule>__<A-factor name>' (see optim/kfac.py
+    ``build_layer_specs``)."""
+    d = 1
+    for s in y.shape[-feature_ndim:]:
+        d *= s
+    probe = mdl.variable(
+        KFAC_TAPS_COLLECTION, name, lambda: jnp.zeros((d, d), jnp.float32))
+    return _g_factor_probe(y, probe.value, feature_ndim)
 
 
 class LayerNorm(nn.Module):
@@ -233,10 +287,10 @@ class BertSelfAttention(nn.Module):
         k = qkv_proj("key")(hidden)
         v = qkv_proj("value")(hidden)
         if self.kfac_tap:
-            # perturb name encodes '<dense submodule>__<A-factor name>'.
-            q = self.perturb("query__attn_in", q, collection=KFAC_TAPS_COLLECTION)
-            k = self.perturb("key__attn_in", k, collection=KFAC_TAPS_COLLECTION)
-            v = self.perturb("value__attn_in", v, collection=KFAC_TAPS_COLLECTION)
+            # tap name encodes '<dense submodule>__<A-factor name>'.
+            q = _kfac_g_tap(self, "query__attn_in", q, feature_ndim=2)
+            k = _kfac_g_tap(self, "key__attn_in", k, feature_ndim=2)
+            v = _kfac_g_tap(self, "value__attn_in", v, feature_ndim=2)
 
         dropout_rng = None
         if not deterministic and cfg.attention_probs_dropout_prob > 0.0:
@@ -267,9 +321,7 @@ class BertSelfAttention(nn.Module):
             name="output",
         )(context)
         if self.kfac_tap:
-            out = self.perturb(
-                "output__attn_ctx", out, collection=KFAC_TAPS_COLLECTION
-            )
+            out = _kfac_g_tap(self, "output__attn_ctx", out)
         out = nn.Dropout(rate=cfg.hidden_dropout_prob)(
             out, deterministic=deterministic
         )
@@ -321,7 +373,7 @@ class BertLayer(nn.Module):
             name="output",
         )(intermediate)
         if self.kfac_tap:
-            out = self.perturb("output__mlp_in", out, collection=KFAC_TAPS_COLLECTION)
+            out = _kfac_g_tap(self, "output__mlp_in", out)
         out = nn.Dropout(rate=cfg.hidden_dropout_prob)(
             out, deterministic=deterministic
         )

@@ -8,9 +8,17 @@ JAX/XLA re-design of the same capability:
 - **Factor capture without hooks.** The model exposes taps
   (``models/bert.py`` ``kfac_tap`` flag): inputs of each covered dense layer
   are sown as already-reduced second moments x̃ᵀx̃ (bias-augmented) into the
-  ``kfac_a`` collection, and each layer output carries a zero additive
-  variable in ``kfac_taps`` whose cotangent under ``jax.grad`` IS the layer's
-  output gradient — the functional analog of torch's forward/backward hooks.
+  ``kfac_a`` collection, and each layer output is threaded through a
+  ``_g_factor_probe`` custom_vjp whose (d, d) probe variable in
+  ``kfac_taps`` receives the already-reduced Σ ĝĝᵀ as its cotangent — the
+  outer product is computed INSIDE the backward pass, layer by layer, the
+  functional analog of torch's forward/backward hooks. Both statistics are
+  batch-shape-independent (d+1, d+1)/(d, d) reductions, so under the
+  scanned encoder they stack to (L, d, d) and never materialize per-token
+  cotangents. This makes factor harvest cheap enough to run inside the
+  training step's own backward (``pretrain.make_train_step`` with
+  ``kfac_capture_model=``) — the reference's free hook capture
+  (run_pretraining.py:320-355), without a second forward/backward.
 - **Stacked factors.** Under the scanned encoder every per-layer factor
   arrives as one (L, d, d) batch, so the eigendecompositions that
   kfac_pytorch schedules layer-by-layer across ranks run here as a single
@@ -245,6 +253,10 @@ class KFAC:
                 "no K-FAC taps found — was the model built with kfac_tap=True "
                 "(and did skip_layers exclude everything)?"
             )
+        # Probe statistics are batch-shape independent ((L, d, d) factor
+        # reductions), so one zero-taps tree serves every batch shape —
+        # the fused in-train capture path reads it via zero_taps().
+        self._tap_shapes = tap_shapes
 
         flat_astats = {
             _flat_key(p): _unwrap_sown(v)
@@ -309,42 +321,67 @@ class KFAC:
             (_, astats), gtaps = jax.value_and_grad(
                 loss_of_taps, has_aux=True
             )(zeros)
-
-            flat_a = {
-                _flat_key(p): _unwrap_sown(v)
-                for p, v in traverse_util.flatten_dict(
-                    astats, is_leaf=lambda _, v: isinstance(v, tuple)
-                ).items()
-            }
-            flat_g = {
-                _flat_key(p): v
-                for p, v in traverse_util.flatten_dict(gtaps).items()
-            }
-            scale = jnp.asarray(self.grad_scale(batch), jnp.float32)
-
-            decay = self.factor_decay
-            first = state.count == 0
-
-            def ema(old, new):
-                return jnp.where(first, new, decay * old + (1.0 - decay) * new)
-
-            new_a = dict(state.a)
-            new_g = dict(state.g)
-            for spec in self.specs:
-                g_raw = flat_g[spec.g_key].astype(jnp.float32)
-                lead = g_raw.shape[:1] if spec.stacked else ()
-                rows = g_raw.size // (spec.g_dim * (lead[0] if lead else 1))
-                g2 = g_raw.reshape(lead + (rows, spec.g_dim)) * scale
-                g_fac = jnp.einsum("...ri,...rj->...ij", g2, g2) / rows
-                new_g[spec.g_key] = ema(state.g[spec.g_key], g_fac)
-                if spec.a_key in flat_a:  # compute each shared A once
-                    a_fac = flat_a.pop(spec.a_key) / rows
-                    new_a[spec.a_key] = ema(state.a[spec.a_key], a_fac)
-            return state.replace(
-                count=state.count + 1, a=new_a, g=new_g
-            )
+            rows = batch["input_ids"].shape[0] * batch["input_ids"].shape[1]
+            return self.ema_factors(
+                state, astats, gtaps, rows, self.grad_scale(batch))
 
         return impl
+
+    def zero_taps(self):
+        """Zero probe tree for grad-w.r.t.-taps capture (batch-shape
+        independent — see init)."""
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._tap_shapes)
+
+    def ema_factors(self, state: KFACState, astats, gtaps, rows, scale
+                    ) -> KFACState:
+        """Pure traced factor EMA from already-reduced statistics.
+
+        ``astats``: the mutated ``kfac_a`` collection (sown Σ x̃x̃ᵀ);
+        ``gtaps``: grad-w.r.t.-taps tree — each leaf is the per-layer
+        Σ ĝĝᵀ delivered by the ``_g_factor_probe`` backward.
+        ``rows``: token rows the sums ran over. Every tapped layer sits in
+        the encoder interior and consumes [B, S, ...] activations, so one
+        row count (B*S) covers all specs; a future tap outside the encoder
+        (e.g. the pooler, rows=B) would need per-spec rows.
+        ``scale``: per-sample gradient rescale (grad_scale; batch size for
+        batch-averaged losses).
+
+        Callable from inside a jitted train step (the fused capture path,
+        pretrain.make_train_step) or from the standalone stats pass
+        (:meth:`update_factors`).
+        """
+        flat_a = {
+            _flat_key(p): _unwrap_sown(v)
+            for p, v in traverse_util.flatten_dict(
+                astats, is_leaf=lambda _, v: isinstance(v, tuple)
+            ).items()
+        }
+        flat_g = {
+            _flat_key(p): v
+            for p, v in traverse_util.flatten_dict(gtaps).items()
+        }
+        scale = jnp.asarray(scale, jnp.float32)
+
+        decay = self.factor_decay
+        first = state.count == 0
+
+        def ema(old, new):
+            return jnp.where(first, new, decay * old + (1.0 - decay) * new)
+
+        new_a = dict(state.a)
+        new_g = dict(state.g)
+        for spec in self.specs:
+            # The probe backward returns Σᵣ ĝᵣĝᵣᵀ of the RAW cotangent;
+            # rescale to per-sample gradients (x scale on each ĝ, i.e.
+            # scale² on the outer product) and average over rows.
+            g_fac = (flat_g[spec.g_key].astype(jnp.float32)
+                     * (scale * scale) / rows)
+            new_g[spec.g_key] = ema(state.g[spec.g_key], g_fac)
+            if spec.a_key in flat_a:  # compute each shared A once
+                a_fac = flat_a.pop(spec.a_key) / rows
+                new_a[spec.a_key] = ema(state.a[spec.a_key], a_fac)
+        return state.replace(count=state.count + 1, a=new_a, g=new_g)
 
     # -------------------------------------------------------------- inverses
 

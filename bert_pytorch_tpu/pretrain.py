@@ -100,6 +100,44 @@ def _mlm_positions(labels, max_pred_per_seq):
     return labels, masked_positions
 
 
+def _apply_pretraining_loss(model, variables, mb, rng, next_sentence,
+                            max_pred_per_seq, mutable=False):
+    """The one shared apply+loss(+accuracy) sequence behind every
+    pretraining loss path — the plain train-step loss, the fused-capture
+    tapped loss, and the K-FAC stats pass. One definition, so a loss or
+    signature change cannot silently diverge between them.
+
+    Returns (loss, acc, mutated); ``mutated`` is None unless ``mutable``
+    names collections. ``acc`` is always computed — XLA dead-code
+    eliminates it in consumers that drop it.
+    """
+    labels, masked_positions = _mlm_positions(
+        mb["masked_lm_labels"], max_pred_per_seq
+    )
+    out = model.apply(
+        variables,
+        mb["input_ids"],
+        mb["segment_ids"],
+        mb["input_mask"],
+        False,  # deterministic
+        masked_positions,
+        rngs={"dropout": rng},
+        **({"mutable": mutable} if mutable else {}),
+    )
+    if mutable:
+        (mlm_logits, nsp_logits), mutated = out
+    else:
+        (mlm_logits, nsp_logits), mutated = out, None
+    loss = pretraining_loss(
+        mlm_logits,
+        nsp_logits if next_sentence else None,
+        labels,
+        mb["next_sentence_labels"] if next_sentence else None,
+    )
+    acc = mlm_accuracy(mlm_logits, labels)
+    return loss, acc, mutated
+
+
 def make_kfac_fns(
     model_tapped,
     next_sentence: bool = True,
@@ -109,42 +147,27 @@ def make_kfac_fns(
     sharing the pretraining loss with the train step.
 
     ``model_tapped`` must be the same architecture built with
-    ``kfac_tap=True`` (and ``remat='none'`` — the stats pass re-runs
-    forward/backward on one microbatch, so no remat is needed).
+    ``kfac_tap=True``. Remat guidance depends on where the taps fire:
+    the decoupled stats pass runs a small batch where ``remat='none'``
+    suffices, while the fused in-train capture
+    (``make_train_step(kfac_capture_model=...)``) should keep the main
+    model's remat so microbatch 0's tapped backward fits the same memory
+    budget (taps compose with ``nn.remat``).
     """
 
-    def _apply(variables, mb, rng, mutable):
-        labels, masked_positions = _mlm_positions(
-            mb["masked_lm_labels"], max_pred_per_seq
-        )
-        (mlm_logits, nsp_logits), mutated = model_tapped.apply(
-            variables,
-            mb["input_ids"],
-            mb["segment_ids"],
-            mb["input_mask"],
-            False,  # deterministic
-            masked_positions,
-            rngs={"dropout": rng},
-            mutable=mutable,
-        )
-        loss = pretraining_loss(
-            mlm_logits,
-            nsp_logits if next_sentence else None,
-            labels,
-            mb["next_sentence_labels"] if next_sentence else None,
-        )
-        return loss, mutated
-
     def apply_loss(params, taps, mb, rng):
-        loss, mutated = _apply(
-            {"params": params, "kfac_taps": taps}, mb, rng, ["kfac_a"]
+        loss, _, mutated = _apply_pretraining_loss(
+            model_tapped, {"params": params, "kfac_taps": taps}, mb, rng,
+            next_sentence, max_pred_per_seq, mutable=["kfac_a"]
         )
         return loss, mutated["kfac_a"]
 
     def tap_shape_fn(params, mb, rng):
         def f(p, mb_):
-            _, mutated = _apply(
-                {"params": p}, mb_, rng, ["kfac_taps", "kfac_a"]
+            _, _, mutated = _apply_pretraining_loss(
+                model_tapped, {"params": p}, mb_, rng,
+                next_sentence, max_pred_per_seq,
+                mutable=["kfac_taps", "kfac_a"]
             )
             return mutated["kfac_taps"], mutated["kfac_a"]
 
@@ -154,19 +177,25 @@ def make_kfac_fns(
 
 
 def _jit_train_step(step_fn, shardings, batch_shardings_, kfac,
-                    kfac_shardings):
+                    kfac_shardings, fused_kfac=False):
     """Shared jit dispatch for the train-step builders: donated state,
-    declared shardings, and the optional kfac_state third argument."""
+    declared shardings, and the optional kfac_state third argument.
+    ``fused_kfac`` marks the in-train factor-capture step, which returns
+    (and therefore donates) the kfac_state as a third output."""
+    donate = (0, 2) if fused_kfac else (0,)
     if shardings is None:
-        return jax.jit(step_fn, donate_argnums=(0,))
+        return jax.jit(step_fn, donate_argnums=donate)
     in_shardings = (shardings, batch_shardings_)
     if kfac is not None:
         in_shardings = in_shardings + (kfac_shardings,)
+    out_shardings = (
+        (shardings, None, kfac_shardings) if fused_kfac
+        else (shardings, None))
     return jax.jit(
         step_fn,
-        donate_argnums=(0,),
+        donate_argnums=donate,
         in_shardings=in_shardings,
-        out_shardings=(shardings, None),
+        out_shardings=out_shardings,
     )
 
 
@@ -180,6 +209,8 @@ def make_train_step(
     max_pred_per_seq: Optional[int] = None,
     kfac=None,
     kfac_shardings=None,
+    kfac_capture_model=None,
+    kfac_factor_interval: int = 1,
     loss_scale: bool = False,
 ):
     """Build the jitted train step.
@@ -200,6 +231,17 @@ def make_train_step(
     ``take_optimizer_step``, run_pretraining.py:405-417). Requires
     ``schedule`` for the kl_clip learning-rate term.
 
+    ``kfac_capture_model`` switches K-FAC to FUSED in-train factor
+    capture: pass the tapped twin of ``model`` (``kfac_tap=True``, same
+    dtype/remat/backend) and the step harvests Kronecker factors from
+    microbatch 0's own backward pass — the reference's free hook capture
+    (run_pretraining.py:320-355) — instead of the runner paying a
+    separate stats forward/backward per factor update. The step then
+    RETURNS the updated kfac_state: ``(state, metrics, kfac_state)``.
+    Factor EMA fires when ``opt_step_count % kfac_factor_interval == 0``
+    (a ``lax.cond`` — skipped steps pay no capture FLOPs); inverse
+    recomputes stay host-driven (``kfac.update_inverses``).
+
     ``loss_scale=True`` is the fp16 parity mode (reference GradScaler,
     run_pretraining.py:314-318): ``tx`` must be wrapped in
     ``optim.dynamic_loss_scale``; the step multiplies the loss by the
@@ -212,28 +254,28 @@ def make_train_step(
         raise ValueError(
             "loss_scale composes with first-order optimizers only; K-FAC "
             "runs in bf16/f32 where no scaler is needed")
+    if kfac_capture_model is not None and kfac is None:
+        raise ValueError("kfac_capture_model requires kfac")
+    fused_kfac = kfac is not None and kfac_capture_model is not None
+    if fused_kfac and kfac_factor_interval < 1:
+        raise ValueError(
+            f"kfac_factor_interval must be >= 1, got {kfac_factor_interval}")
 
     def loss_fn(params, mb, rng):
-        labels, masked_positions = _mlm_positions(
-            mb["masked_lm_labels"], max_pred_per_seq
-        )
-        mlm_logits, nsp_logits = model.apply(
-            {"params": params},
-            mb["input_ids"],
-            mb["segment_ids"],
-            mb["input_mask"],
-            False,  # deterministic
-            masked_positions,
-            rngs={"dropout": rng},
-        )
-        loss = pretraining_loss(
-            mlm_logits,
-            nsp_logits if next_sentence else None,
-            labels,
-            mb["next_sentence_labels"] if next_sentence else None,
-        )
-        acc = mlm_accuracy(mlm_logits, labels)
+        loss, acc, _ = _apply_pretraining_loss(
+            model, {"params": params}, mb, rng,
+            next_sentence, max_pred_per_seq)
         return loss, acc
+
+    def tapped_loss_fn(params, taps, mb, rng):
+        # Same math as loss_fn, through the tapped twin: identical logits
+        # (taps are identity in the forward), plus the mutated kfac_a
+        # collection and — under grad w.r.t. taps — the per-layer G
+        # factors from the _g_factor_probe backward.
+        loss, acc, mutated = _apply_pretraining_loss(
+            kfac_capture_model, {"params": params, "kfac_taps": taps},
+            mb, rng, next_sentence, max_pred_per_seq, mutable=["kfac_a"])
+        return loss, (acc, mutated["kfac_a"])
 
     def step_fn(state: TrainState, batch: dict, kfac_state=None):
         accum_steps = batch["input_ids"].shape[0]
@@ -258,12 +300,54 @@ def make_train_step(
             )
             return (grads_acc, rng), (loss, acc)
 
-        zero_grads = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
-        )
-        (grads, _), (losses, accs) = jax.lax.scan(
-            body, (zero_grads, step_rng), batch
-        )
+        if fused_kfac:
+            # Microbatch 0 unrolls out of the scan so its backward can be
+            # the tapped one; the rng split chain matches body's exactly,
+            # so microbatch i sees the same dropout rng either way.
+            mb0 = jax.tree_util.tree_map(lambda v: v[0], batch)
+            rng_rest, sub0 = jax.random.split(step_rng)
+            rows = mb0["input_ids"].shape[0] * mb0["input_ids"].shape[1]
+
+            def mb0_capture(ks):
+                (loss0, (acc0, astats)), (g0, gtaps) = jax.value_and_grad(
+                    tapped_loss_fn, argnums=(0, 1), has_aux=True
+                )(state.params, kfac.zero_taps(), mb0, sub0)
+                ks = kfac.ema_factors(
+                    ks, astats, gtaps, rows, kfac.grad_scale(mb0))
+                return loss0, acc0, g0, ks
+
+            def mb0_plain(ks):
+                (loss0, acc0), g0 = jax.value_and_grad(
+                    loss_fn, has_aux=True)(state.params, mb0, sub0)
+                return loss0, acc0, g0, ks
+
+            if kfac_factor_interval == 1:
+                loss0, acc0, grads0, kfac_state = mb0_capture(kfac_state)
+            else:
+                due = (opt_step_count(state.opt_state)
+                       % kfac_factor_interval) == 0
+                loss0, acc0, grads0, kfac_state = jax.lax.cond(
+                    due, mb0_capture, mb0_plain, kfac_state)
+            grads0 = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.float32), grads0)
+            if accum_steps > 1:
+                rest = jax.tree_util.tree_map(lambda v: v[1:], batch)
+                (grads, _), (losses_r, accs_r) = jax.lax.scan(
+                    body, (grads0, rng_rest), rest
+                )
+                losses = jnp.concatenate([loss0[None], losses_r])
+                accs = jnp.concatenate([acc0[None], accs_r])
+            else:
+                grads = grads0
+                losses = loss0[None]
+                accs = acc0[None]
+        else:
+            zero_grads = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (grads, _), (losses, accs) = jax.lax.scan(
+                body, (zero_grads, step_rng), batch
+            )
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
 
         if kfac is not None:
@@ -283,10 +367,14 @@ def make_train_step(
             metrics["loss_scale"] = scale
         if schedule is not None:
             metrics["learning_rate"] = schedule(opt_step_count(state.opt_state))
-        return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
+        new_state = TrainState(params=params, opt_state=opt_state, rng=new_rng)
+        if fused_kfac:
+            return new_state, metrics, kfac_state
+        return new_state, metrics
 
     return _jit_train_step(
-        step_fn, shardings, batch_shardings_, kfac, kfac_shardings)
+        step_fn, shardings, batch_shardings_, kfac, kfac_shardings,
+        fused_kfac=fused_kfac)
 
 
 def make_pp_train_step(

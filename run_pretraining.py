@@ -165,6 +165,18 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "faster than TPU eigh at BERT-large factor "
                              "sizes); 'eigen' = eigenbasis preconditioning "
                              "(kfac_pytorch's eigen method)")
+    parser.add_argument("--kfac_capture", type=str, default="train",
+                        choices=["train", "stats"],
+                        help="'train' (default): harvest Kronecker factors "
+                             "from microbatch 0 of the training step's own "
+                             "backward (the reference's free hook capture, "
+                             "run_pretraining.py:320-355 — no extra "
+                             "forward/backward at factor_interval=1). "
+                             "'stats': decoupled stats pass on "
+                             "--kfac_stats_batch rows every "
+                             "factor_interval steps (pp strategies use "
+                             "this; it is also the knob for stats batches "
+                             "smaller than a microbatch)")
     parser.add_argument("--kfac_stats_batch", type=int, default=16,
                         help="total sequences (strided across the global "
                              "batch, so every data shard contributes) used "
@@ -467,11 +479,25 @@ def main(args) -> dict:
                 logger.info(f"Phase switch: optimizer count reset to {global_step}")
 
         kfac_obj = kfac_state = kfac_shardings = None
+        kfac_fused = False
         if args.kfac:
+            kfac_fused = args.kfac_capture == "train"
+            if kfac_fused and args.parallel_strategy in ("pp", "pp_tp"):
+                # The pipeline step has no fused-capture path (factors
+                # would need per-stage reassembly); fall back to the
+                # decoupled stats pass.
+                logger.info("kfac_capture=train is not supported with "
+                            "pipeline parallelism; using 'stats'")
+                kfac_fused = False
             # Tapped twin of the model (same params, factor-capture taps on;
             # reference drives kfac_pytorch hooks at run_pretraining.py:320-355).
+            # The fused-capture twin keeps the main model's remat so the
+            # tapped microbatch-0 backward fits the same memory budget; the
+            # stats-pass twin runs a small decoupled batch where remat only
+            # costs recompute.
             model_tapped = BertForPreTraining(
-                config, dtype=model.dtype, remat="none",
+                config, dtype=model.dtype,
+                remat=model.remat if kfac_fused else "none",
                 attention_backend=args.attention_backend, kfac_tap=True)
             apply_loss, tap_shape_fn = pretrain.make_kfac_fns(
                 model_tapped, next_sentence=bool(config.next_sentence),
@@ -509,6 +535,7 @@ def main(args) -> dict:
                 kfac_state = jax.device_put(kfac_state, kfac_shardings)
             logger.info(
                 f"K-FAC enabled: {len(kfac_obj.specs)} layer groups, "
+                f"capture={'train (fused)' if kfac_fused else 'stats'}, "
                 f"damping={args.kfac_damping}, kl_clip={args.kfac_kl_clip}, "
                 f"factor_interval={args.kfac_factor_interval}, "
                 f"inv_interval={args.kfac_inv_interval}")
@@ -548,6 +575,8 @@ def main(args) -> dict:
                 shardings=shardings, batch_shardings_=b_shardings,
                 max_pred_per_seq=args.max_predictions_per_seq,
                 kfac=kfac_obj, kfac_shardings=kfac_shardings,
+                kfac_capture_model=model_tapped if kfac_fused else None,
+                kfac_factor_interval=args.kfac_factor_interval,
                 loss_scale=fp16)
 
         eval_step = None
@@ -650,7 +679,28 @@ def main(args) -> dict:
                 sampler.set_epoch(epoch)
                 for batch in pretrain.device_prefetch(
                         loader, args.accumulation_steps, b_shardings):
-                    if kfac_obj is not None:
+                    if kfac_fused:
+                        # In-train capture: the step harvests factors from
+                        # microbatch 0's own backward (gated in-jit by
+                        # factor_interval) and returns the updated state.
+                        # Inverses recompute AFTER the step (they cannot
+                        # precede factors that the same step produces), so
+                        # preconditioning sees inverses one factor-update
+                        # staler than the reference/stats mode, where
+                        # in-step (kfac_pytorch) or pre-step (stats)
+                        # inverse updates include the current factors:
+                        # step 0 runs effectively unpreconditioned (init
+                        # identity operators + kl_clip) and each
+                        # inverse-due step uses the previous interval's
+                        # factors. A one-step lag on a >=10-step inverse
+                        # cadence; accepted for the fused capture's cost
+                        # win rather than compiling the Cholesky solves
+                        # into the train step under a cond.
+                        state, metrics, kfac_state = train_step(
+                            state, batch, kfac_state)
+                        if global_step % args.kfac_inv_interval == 0:
+                            kfac_state = kfac_obj.update_inverses(kfac_state)
+                    elif kfac_obj is not None:
                         # kfac_pytorch cadence: factors (EMA) every
                         # factor_interval steps from the current data, inverses
                         # every inv_interval steps; both fire on the first step.

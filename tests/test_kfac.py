@@ -165,6 +165,138 @@ def test_kfac_requires_schedule(setup):
         pretrain.make_train_step(model, tx, schedule=None, kfac=kfac)
 
 
+class TestFusedCapture:
+    """In-train factor capture (the structural fix for the reference's
+    free hook harvest, run_pretraining.py:320-355): the training step's
+    own backward yields the factors — no separate stats forward/backward
+    at factor_interval=1."""
+
+    def _build(self, dropout=0.0):
+        config = BertConfig(
+            vocab_size=64, hidden_size=16, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=32,
+            max_position_embeddings=32, next_sentence=True,
+            hidden_dropout_prob=dropout, attention_probs_dropout_prob=dropout)
+        model = BertForPreTraining(config, dtype=jnp.float32)
+        tapped = BertForPreTraining(config, dtype=jnp.float32, kfac_tap=True)
+        import flax.linen as nn
+        params = nn.unbox(model.init(
+            jax.random.PRNGKey(0), *(jnp.zeros((1, 16), jnp.int32),) * 3)
+        )["params"]
+        rng = np.random.default_rng(1)
+        A, B, S = 2, 4, 16
+        batch = {
+            "input_ids": rng.integers(0, 64, (A, B, S)).astype(np.int32),
+            "segment_ids": np.zeros((A, B, S), np.int32),
+            "input_mask": np.ones((A, B, S), np.int32),
+            "masked_lm_labels": np.where(
+                rng.random((A, B, S)) < 0.2,
+                rng.integers(0, 64, (A, B, S)), -1).astype(np.int32),
+            "next_sentence_labels": rng.integers(
+                0, 2, (A, B)).astype(np.int32),
+        }
+        apply_loss, tap_shape_fn = pretrain.make_kfac_fns(tapped, True)
+        kfac = optim.KFAC(apply_loss, tap_shape_fn)
+        mb0 = {k: v[0] for k, v in batch.items()}
+        kstate = kfac.init(params, mb0)
+        schedule = optim.warmup_poly_schedule(1e-3, 0.1, 100)
+        tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+        state = pretrain.TrainState(
+            params=params, opt_state=tx.init(params),
+            rng=jax.random.PRNGKey(7))
+        return model, tapped, tx, schedule, kfac, kstate, state, batch, mb0
+
+    def test_fused_matches_stats_pass(self):
+        """One fused step == stats-pass update_factors on mb0 (with the
+        step's mb0 dropout rng) + the plain preconditioned step: same
+        factors, same params, same loss."""
+        (model, tapped, tx, schedule, kfac, kstate, state, batch, mb0
+         ) = self._build(dropout=0.0)
+        fused_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            kfac=kfac, kfac_capture_model=tapped, kfac_factor_interval=1)
+        plain_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True, kfac=kfac)
+
+        # Stats-pass reference first, on COPIES: both steps donate their
+        # state (and the fused one its kfac_state), so the originals must
+        # reach the fused call undeleted.
+        copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+        kstate_s = kfac.update_factors(
+            kstate, state.params, mb0, jax.random.PRNGKey(0))
+        state_s, metrics_s = plain_step(copy(state), batch, kstate)
+        state_f, metrics_f, kstate_f = fused_step(state, batch, kstate)
+
+        assert float(metrics_f["loss"]) == pytest.approx(
+            float(metrics_s["loss"]), rel=1e-5)
+        assert int(kstate_f.count) == 1
+        for key in kstate_f.g:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(kstate_f.g[key])),
+                np.asarray(jax.device_get(kstate_s.g[key])),
+                rtol=2e-4, atol=1e-5)
+        for key in kstate_f.a:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(kstate_f.a[key])),
+                np.asarray(jax.device_get(kstate_s.a[key])),
+                rtol=2e-4, atol=1e-5)
+        for pf, ps in zip(jax.tree_util.tree_leaves(state_f.params),
+                          jax.tree_util.tree_leaves(state_s.params)):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(pf)),
+                np.asarray(jax.device_get(ps)), rtol=1e-4, atol=1e-6)
+
+    def test_interval_gates_capture(self):
+        """factor_interval=2: steps at even opt counts capture, odd skip
+        — and the skipped step still trains (params move)."""
+        (model, tapped, tx, schedule, kfac, kstate, state, batch, _
+         ) = self._build(dropout=0.0)
+        step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            kfac=kfac, kfac_capture_model=tapped, kfac_factor_interval=2)
+        state, _, kstate = step(state, batch, kstate)   # count 0: due
+        assert int(kstate.count) == 1
+        p_before = jax.device_get(state.params)
+        state, _, kstate = step(state, batch, kstate)   # count 1: skip
+        assert int(kstate.count) == 1
+        state, _, kstate = step(state, batch, kstate)   # count 2: due
+        assert int(kstate.count) == 2
+        moved = jax.tree_util.tree_map(
+            lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+            p_before, jax.device_get(state.params))
+        assert max(jax.tree_util.tree_leaves(moved)) > 0
+
+    def test_fused_requires_kfac(self):
+        model, tapped, tx, schedule, *_ = self._build()
+        with pytest.raises(ValueError, match="kfac_capture_model"):
+            pretrain.make_train_step(
+                model, tx, schedule=schedule, kfac_capture_model=tapped)
+
+    def test_fused_matches_plain_step_with_dropout(self):
+        """WITH dropout on, the fused step must train identically to the
+        plain kfac step: the mb0 unroll's rng split chain
+        (rng_rest, sub0 = split(step_rng)) mirrors the scan body's, so
+        every microbatch sees the same dropout mask either way. Pins the
+        parity claim in pretrain.py's fused branch."""
+        (model, tapped, tx, schedule, kfac, kstate, state, batch, _
+         ) = self._build(dropout=0.1)
+        fused_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            kfac=kfac, kfac_capture_model=tapped, kfac_factor_interval=1)
+        plain_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True, kfac=kfac)
+        copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+        state_p, metrics_p = plain_step(copy(state), batch, kstate)
+        state_f, metrics_f, _ = fused_step(state, batch, kstate)
+        assert float(metrics_f["loss"]) == pytest.approx(
+            float(metrics_p["loss"]), rel=1e-6)
+        for pf, pp in zip(jax.tree_util.tree_leaves(state_f.params),
+                          jax.tree_util.tree_leaves(state_p.params)):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(pf)),
+                np.asarray(jax.device_get(pp)), rtol=1e-5, atol=1e-7)
+
+
 def test_checkpoint_roundtrip(setup, tmp_path):
     """KFACState serializes through the checkpoint subsystem (reference
     'preconditioner' checkpoint entry, run_pretraining.py:519-520)."""
