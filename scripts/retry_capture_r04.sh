@@ -1,0 +1,310 @@
+#!/bin/bash
+# Round-4 persistent capture harness (supersedes retry_capture_r03.sh —
+# same legs and artifact names, plus the fused-K-FAC capture-cost leg).
+# Probes the flaky TPU tunnel; when it answers, runs whichever capture
+# legs have not yet produced their repo-root artifact, IN PRIORITY ORDER
+# (VERDICT r3 "Next round"):
+#
+#   1. Warm the IN-REPO persistent compile cache (.jax_cache/) for the
+#      driver's bench shapes, then COLD-VERIFY: a fresh `python bench.py`
+#      with only the committed cache must emit a real number in <600s —
+#      the property whose absence zeroed BENCH_r01/r02/r03. Also warms
+#      the degraded BERT-base fallback entry.
+#   2. LAMB vs K-FAC (reference operating point + cheap cadence)
+#      convergence with equal-step AND equal-wallclock accounting (the
+#      K-FAC legs now run the FUSED in-train capture, the round-4
+#      structural fix).
+#   3. Remaining bench legs: phase2, kfac (fused capture),
+#      kfac capture-cost A/B (lamb vs stats vs fused at BERT-large,
+#      factor_interval=1), seq1024, seq2048.
+#   4. Chip-profile offline e2e chain -> E2E_r03.json.
+#   5. Long anchored convergence run (resumable; retried each window).
+#   6. Phase-1 batch/backend sweep -> SWEEP_r03.jsonl.
+#
+# Each captured artifact is git-committed immediately (tunnel windows are
+# scarce; an artifact must survive even if the session dies right after).
+# Touch .stop_capture in the repo root to make the harness exit at the
+# next loop boundary (do this before the driver's end-of-round bench so
+# the harness cannot contend for the chip).
+#
+#   bash scripts/retry_capture_r04.sh [deadline_epoch_s] [logdir]
+set -uo pipefail
+cd "$(dirname "$0")/.."
+DEADLINE=${1:-$(($(date +%s) + 10 * 3600))}
+LOGS=${2:-/tmp/retry_capture_r04}
+mkdir -p "$LOGS"
+# Cache split: bench.py invocations use its default in-repo cache
+# (.jax_cache/, committed); the runner-based legs (convergence, smoke,
+# e2e, long run) use their scripts' own per-user scratch default. Nothing
+# is exported here — smoke_tpu.sh runs `python bench.py` internally, and
+# an exported BENCH_COMPILE_CACHE_DIR would wrongly divert those bench
+# legs off the committed cache.
+
+probe() {
+  timeout 150 python - <<'EOF' >/dev/null 2>&1
+import jax
+d = jax.devices()[0]
+assert d.platform in ("tpu", "axon") or "TPU" in d.device_kind
+EOF
+}
+
+commit_artifacts() {  # msg, paths...
+  local msg=$1; shift
+  local existing=()
+  for p in "$@"; do [ -e "$p" ] && existing+=("$p"); done
+  [ "${#existing[@]}" -eq 0 ] && return 0
+  git add -f -- "${existing[@]}" 2>> "$LOGS/git.log" || true
+  if ! git diff --cached --quiet; then
+    git commit -q -m "$msg" >> "$LOGS/git.log" 2>&1 \
+      && echo "   committed: $msg" \
+      || { echo "   COMMIT FAILED: $msg"; git reset -q; }
+  fi
+}
+
+good_json() { [ -f "$1" ] && ! grep -q '"error"' "$1" \
+  && ! grep -q '"value": 0.0' "$1"; }
+
+bench_warm() {  # artifact, timeout_s, env pairs...
+  local art=$1 t=$2; shift 2
+  echo "== leg: warm $art"
+  if env "$@" BENCH_DEGRADE=0 BENCH_ATTEMPTS=1 \
+      BENCH_ATTEMPT_TIMEOUT_S=$((t - 60)) BENCH_BUDGET_S=$((t - 30)) \
+      timeout "$t" python bench.py > "$LOGS/$art.tmp" 2> "$LOGS/$art.log" \
+      && good_json "$LOGS/$art.tmp"; then
+    cp "$LOGS/$art.tmp" "$art"
+    echo "   $(cat "$art")"
+    return 0
+  fi
+  echo "   FAILED ($art): $(tail -1 "$LOGS/$art.log" 2>/dev/null | cut -c1-160)"
+  return 1
+}
+
+have_phase1()   { good_json bench_phase1.json && [ -f COLD_BENCH_r03.json ]; }
+have_degraded() { [ -f "$LOGS/degraded_warm.json" ]; }
+have_conv()     { [ -f CONVERGENCE_r03.csv ]; }
+have_phase2()   { good_json bench_phase2.json && grep -q pallas "$LOGS/.phase2_r03_done" 2>/dev/null; }
+have_kfacb()    { good_json bench_kfac.json && [ -f "$LOGS/.kfac_r04_done" ]; }
+have_kfac_cap() { [ -f KFAC_CAPTURE_BENCH_chip_r04.jsonl ] \
+  && grep -q kfac_fused KFAC_CAPTURE_BENCH_chip_r04.jsonl; }
+have_seq1024()  { good_json bench_seq1024.json; }
+have_seq2048()  { good_json bench_seq2048.json; }
+have_e2e()      { [ -f E2E_r03.json ]; }
+have_long()     { [ -f LONG_RUN_r03.json ]; }
+have_sweep()    { [ -f SWEEP_r03.jsonl ] && [ "$(wc -l < SWEEP_r03.jsonl)" -ge 12 ]; }
+
+all_done() {
+  have_phase1 && have_degraded && have_conv && have_phase2 && have_kfacb \
+    && have_kfac_cap && have_seq1024 && have_seq2048 && have_e2e \
+    && have_long && have_sweep
+}
+
+run_sweep() {
+  : > "$LOGS/sweep.tmp"
+  # Points are batch:attn:remat. Three families (VERDICT r2 #3):
+  #  - XLA-attention batch points around the known 56-peak;
+  #  - the fused Pallas kernel at seq 128 (re-measure whether the
+  #    bh-batched tiles close the 366-vs-396 gap the r02 verdict
+  #    flagged);
+  #  - remat=none legs: the fused kernel's O(S) memory may fit the
+  #    batch WITHOUT rematerialization — 'dots' recompute is pure
+  #    overhead if the activations fit, and r02 measured no-remat
+  #    winning at batch 32 (327 vs ~281).
+  # batch : attn : remat : pallas bh-block override (G)
+  for pt in 48::: 52::: 56::: 60::: 64::: 56:pallas:: 64:pallas:: \
+            56:pallas:none: 64:pallas:none: 56::none: \
+            56:pallas::32 64:pallas::32; do
+    IFS=: read -r b attn remat g <<< "$pt"
+    tag="$b${attn:+_$attn}${remat:+_remat_$remat}${g:+_g$g}"
+    if { [ -s "$LOGS/sweep_$tag.json" ] && good_json "$LOGS/sweep_$tag.json"; } \
+        || env BENCH_LOCAL_BATCH="$b" ${attn:+BENCH_ATTN=$attn} \
+        ${remat:+BENCH_REMAT=$remat} ${g:+PALLAS_ATTN_BH_BLOCK=$g} \
+        BENCH_MEASURE_STEPS=12 BENCH_ATTEMPTS=1 BENCH_DEGRADE=0 \
+        timeout 900 python bench.py > "$LOGS/sweep_$tag.json" 2> "$LOGS/sweep_$tag.log"
+    then
+      python - "$b" "${attn:-xla}" "${remat:-dots}" "${g:-0}" \
+          "$LOGS/sweep_$tag.json" >> "$LOGS/sweep.tmp" <<'EOF'
+import json, sys
+b, attn, remat, g, path = sys.argv[1:6]
+rec = json.load(open(path))
+rec["local_batch"] = int(b)
+rec["attention"] = attn
+rec["remat"] = remat
+if int(g):
+    rec["bh_block"] = int(g)
+print(json.dumps(rec))
+EOF
+      echo "   sweep $tag: $(tail -1 "$LOGS/sweep.tmp")"
+    else
+      # An OOM (possible on the no-remat legs) is a data point, not a
+      # harness failure: record it and keep sweeping.
+      if grep -qi "resource exhausted\|out of memory" "$LOGS/sweep_$tag.log"; then
+        echo "{\"local_batch\": $b, \"attention\": \"${attn:-xla}\"," \
+             "\"remat\": \"${remat:-dots}\"${g:+, \"bh_block\": $g}," \
+             "\"oom\": true}" >> "$LOGS/sweep.tmp"
+        echo "   sweep $tag: OOM (recorded)"
+      else
+        echo "   sweep $tag FAILED; aborting sweep pass"
+        return 1
+      fi
+    fi
+  done
+  mv "$LOGS/sweep.tmp" SWEEP_r03.jsonl
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  [ -f .stop_capture ] && { echo "stop_capture flag set; exiting"; exit 0; }
+  if all_done; then
+    echo "retry_capture_r04: all artifacts captured"
+    exit 0
+  fi
+  if ! probe; then
+    echo "$(date +%H:%M:%S) backend down; sleeping 120s"
+    sleep 120
+    continue
+  fi
+  echo "$(date +%H:%M:%S) backend up"
+
+  # -- P1: committed warm cache + cold-verified driver bench ------------
+  if ! have_phase1; then
+    if bench_warm bench_phase1.json 2850 BENCH_PHASE=1; then
+      echo "== leg: cold-verify (fresh process, committed cache only)"
+      if env BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT_S=540 \
+          BENCH_BUDGET_S=560 BENCH_DEGRADE=0 \
+          timeout 600 python bench.py > "$LOGS/cold.tmp" 2> "$LOGS/cold.log" \
+          && good_json "$LOGS/cold.tmp"; then
+        python - "$LOGS/cold.tmp" > COLD_BENCH_r03.json <<'EOF'
+import json, sys, time
+rec = json.load(open(sys.argv[1]))
+rec["cold_start_verified"] = "fresh process, warm committed .jax_cache, <600s"
+print(json.dumps(rec))
+EOF
+        echo "   cold-verify OK: $(cat COLD_BENCH_r03.json)"
+      else
+        echo "   cold-verify FAILED: $(tail -1 "$LOGS/cold.log" | cut -c1-160)"
+      fi
+      commit_artifacts "Capture r03 phase-1 bench; commit the warm compile cache" \
+        .jax_cache bench_phase1.json COLD_BENCH_r03.json
+    fi
+    continue  # re-probe between legs: windows are short
+  fi
+  if ! have_degraded; then
+    echo "== leg: warm degraded (BERT-base) fallback cache entry"
+    if env BENCH_DEGRADED=1 BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT_S=1500 \
+        BENCH_BUDGET_S=1530 BENCH_DEGRADE=0 \
+        timeout 1600 python bench.py > "$LOGS/degraded_warm.json" \
+        2> "$LOGS/degraded_warm.log" \
+        && good_json "$LOGS/degraded_warm.json"; then
+      echo "   $(cat "$LOGS/degraded_warm.json")"
+      commit_artifacts "Warm the degraded-fallback bench cache entry" .jax_cache
+    else
+      rm -f "$LOGS/degraded_warm.json"
+      echo "   FAILED (degraded warm)"
+    fi
+    continue
+  fi
+
+  # -- P2: K-FAC convergence (reference point + cheap cadence) ----------
+  if ! have_conv; then
+    echo "== leg: convergence (LAMB vs K-FAC x2)"
+    if timeout 7200 \
+        bash scripts/convergence_r03.sh /tmp/bert_conv_r03 CONVERGENCE_r03.csv \
+        > "$LOGS/convergence.log" 2>&1; then
+      commit_artifacts "Capture r03 on-chip LAMB-vs-K-FAC convergence (equal step + wallclock)" \
+        CONVERGENCE_r03.csv CONVERGENCE_r03_summary.json docs/convergence_r03.png
+    else
+      echo "   FAILED (convergence); tail:"; tail -3 "$LOGS/convergence.log"
+    fi
+    continue
+  fi
+
+  # -- P3: remaining bench legs ----------------------------------------
+  if ! have_phase2; then
+    if bench_warm bench_phase2.json 2850 BENCH_PHASE=2; then
+      echo pallas > "$LOGS/.phase2_r03_done"
+      commit_artifacts "Capture r03 phase-2 bench; extend the committed cache" \
+        .jax_cache bench_phase2.json
+    fi
+    continue
+  fi
+  if ! have_kfacb; then
+    # Fused in-train capture is the BENCH_KFAC_CAPTURE default now; the
+    # r02-committed 236-seq/s number was the stats mode.
+    if bench_warm bench_kfac.json 2850 BENCH_KFAC=1; then
+      : > "$LOGS/.kfac_r04_done"
+      commit_artifacts "Capture r04 K-FAC bench (fused in-train capture)" \
+        .jax_cache bench_kfac.json
+    fi
+    continue
+  fi
+  if ! have_kfac_cap; then
+    echo "== leg: K-FAC capture-cost A/B (lamb vs stats vs fused, interval 1)"
+    if timeout 3600 python tools/bench_kfac_capture.py \
+        --hidden 1024 --layers 24 --heads 16 --vocab 30528 --seq 128 \
+        --batch 32 --max_pred 20 --remat dots --dtype bfloat16 \
+        --steps 10 --warmup 3 --out KFAC_CAPTURE_BENCH_chip_r04.jsonl \
+        > "$LOGS/kfac_capture.log" 2>&1 \
+        && grep -q kfac_fused KFAC_CAPTURE_BENCH_chip_r04.jsonl; then
+      echo "   $(tail -1 KFAC_CAPTURE_BENCH_chip_r04.jsonl)"
+      commit_artifacts \
+        "Capture r04 on-chip K-FAC capture-cost A/B (fused vs stats)" \
+        KFAC_CAPTURE_BENCH_chip_r04.jsonl
+    else
+      rm -f KFAC_CAPTURE_BENCH_chip_r04.jsonl
+      echo "   FAILED (kfac capture A/B): $(tail -1 "$LOGS/kfac_capture.log" \
+        2>/dev/null | cut -c1-160)"
+    fi
+    continue
+  fi
+  if ! have_seq1024; then
+    bench_warm bench_seq1024.json 2400 BENCH_SEQ=1024 \
+      && commit_artifacts "Capture r03 seq-1024 long-context bench" \
+           .jax_cache bench_seq1024.json
+    continue
+  fi
+  if ! have_seq2048; then
+    bench_warm bench_seq2048.json 3000 BENCH_SEQ=2048 \
+      && commit_artifacts "Capture r03 seq-2048 long-context bench" \
+           .jax_cache bench_seq2048.json
+    continue
+  fi
+
+  # -- P4: chip e2e -----------------------------------------------------
+  if ! have_e2e; then
+    echo "== leg: smoke_and_e2e"
+    if timeout 3600 \
+        bash scripts/smoke_tpu.sh /tmp/bert_tpu_smoke_r03 \
+        > "$LOGS/smoke.log" 2>&1; then
+      commit_artifacts "Capture r03 chip-profile offline e2e chain" E2E_r03.json
+    else
+      echo "   FAILED (smoke_and_e2e); tail:"; tail -3 "$LOGS/smoke.log"
+    fi
+    continue
+  fi
+
+  # -- P5: long anchored convergence (resumable across windows) ---------
+  if ! have_long; then
+    echo "== leg: long convergence (resumable pass)"
+    if timeout 3600 \
+        bash scripts/convergence_long_r03.sh /tmp/bert_conv_long_r03 \
+        > "$LOGS/long.log" 2>&1; then
+      commit_artifacts "Capture r03 long anchored convergence run (pre-stated milestones)" \
+        CONVERGENCE_LONG_r03.csv LONG_RUN_r03.json docs/convergence_long_r03.png
+    else
+      echo "   long pass ended (will resume): $(tail -1 "$LOGS/long.log" | cut -c1-160)"
+    fi
+    continue
+  fi
+
+  # -- P6: sweep --------------------------------------------------------
+  if ! have_sweep; then
+    echo "== leg: batch/backend sweep"
+    run_sweep && commit_artifacts "Capture r03 phase-1 batch/backend sweep" \
+      SWEEP_r03.jsonl || true
+  fi
+done
+echo "retry_capture_r04: deadline reached"
+for f in have_phase1 have_degraded have_conv have_phase2 have_kfacb \
+         have_kfac_cap have_seq1024 have_seq2048 have_e2e have_long \
+         have_sweep; do
+  $f && echo "  $f: yes" || echo "  $f: NO"
+done
