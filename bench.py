@@ -316,18 +316,17 @@ def _child_main():
             max_pred_per_seq=MAX_PRED,
             kfac=kfac_obj, kfac_shardings=kfac_shardings,
             kfac_capture_model=tapped if kfac_fused else None,
-            kfac_factor_interval=10)
+            kfac_factor_interval=10,
+            kfac_inv_interval=100 if kfac_fused else 0)
 
         batch = pretrain.put_batch(
             pretrain.stack_microbatches(host, ACCUM), b_shardings)
 
         def run_one(state, kfac_state, global_step):
             if kfac_fused:
-                # Factor capture rides microbatch 0's backward, gated
-                # in-jit by the factor interval; inverses stay host-driven.
+                # Factor capture rides microbatch 0's backward; both the
+                # factor and inverse cadences are cond-gated in-jit.
                 state, metrics, kfac_state = step(state, batch, kfac_state)
-                if global_step % 100 == 0:
-                    kfac_state = kfac_obj.update_inverses(kfac_state)
             elif kfac_obj is not None:
                 if global_step % 10 == 0:
                     # Strided rows so every data shard contributes to the

@@ -385,53 +385,59 @@ class KFAC:
 
     # -------------------------------------------------------------- inverses
 
+    def inverse_factors(self, state: KFACState) -> KFACState:
+        """Pure traced inverse update — callable from inside a jitted
+        train step (the fused-capture path runs it under a ``lax.cond``
+        so inverse-due steps precondition with the factors THIS step
+        captured, the reference's within-``optimizer.step()`` ordering)
+        or via the standalone :meth:`update_inverses` wrapper."""
+
+        def eig_one(fac):
+            w, v = jnp.linalg.eigh(fac)
+            return v.astype(self.inv_dtype), jnp.maximum(w, 0.0)
+
+        def cho_one(fac):
+            # (F + sqrt(damping) I)^-1 via Cholesky — 40x faster
+            # than eigh on TPU for BERT-large factors (module
+            # docstring); per-mode damping is traded for the
+            # factor-wise Tikhonov term.
+            d = fac.shape[-1]
+            damped = fac + jnp.sqrt(self.damping) * jnp.eye(
+                d, dtype=fac.dtype)
+            c = jax.scipy.linalg.cho_factor(damped)
+            inv = jax.scipy.linalg.cho_solve(
+                c, jnp.eye(d, dtype=fac.dtype))
+            return inv.astype(self.inv_dtype), jnp.ones(
+                (d,), jnp.float32)
+
+        one = eig_one if self.inv_method == "eigen" else cho_one
+
+        def factor_op(fac):
+            # lax.map over the stacked-layer axis instead of one
+            # batched op: identical results, but the fp32 workspace
+            # exists for ONE (d, d) factor at a time — for
+            # BERT-large's (24, 4097, 4097) MLP factor that's the
+            # difference between a multi-GB transient and ~130MB
+            # (the inverse step runs every inv_interval steps, so
+            # the serialization is off the hot path).
+            if fac.ndim == 3:
+                return jax.lax.map(one, fac)
+            return one(fac)
+
+        qa, la, qg, lg = {}, {}, {}, {}
+        for k, fac in state.a.items():
+            qa[k], la[k] = factor_op(fac)
+        for k, fac in state.g.items():
+            qg[k], lg[k] = factor_op(fac)
+        return state.replace(qa=qa, la=la, qg=qg, lg=lg)
+
     def update_inverses(self, state: KFACState) -> KFACState:
-        """Batched eigendecompositions of all factors (the inverse-update of
+        """Batched inverse update of all factors (the inverse-update of
         kfac_pytorch, distributed by the stacked-layer sharding instead of
-        per-layer rank assignment)."""
+        per-layer rank assignment). Host-callable jitted wrapper around
+        :meth:`inverse_factors`."""
         if self._inv_jit is None:
-
-            def impl(state):
-                def eig_one(fac):
-                    w, v = jnp.linalg.eigh(fac)
-                    return v.astype(self.inv_dtype), jnp.maximum(w, 0.0)
-
-                def cho_one(fac):
-                    # (F + sqrt(damping) I)^-1 via Cholesky — 40x faster
-                    # than eigh on TPU for BERT-large factors (module
-                    # docstring); per-mode damping is traded for the
-                    # factor-wise Tikhonov term.
-                    d = fac.shape[-1]
-                    damped = fac + jnp.sqrt(self.damping) * jnp.eye(
-                        d, dtype=fac.dtype)
-                    c = jax.scipy.linalg.cho_factor(damped)
-                    inv = jax.scipy.linalg.cho_solve(
-                        c, jnp.eye(d, dtype=fac.dtype))
-                    return inv.astype(self.inv_dtype), jnp.ones(
-                        (d,), jnp.float32)
-
-                one = eig_one if self.inv_method == "eigen" else cho_one
-
-                def factor_op(fac):
-                    # lax.map over the stacked-layer axis instead of one
-                    # batched op: identical results, but the fp32 workspace
-                    # exists for ONE (d, d) factor at a time — for
-                    # BERT-large's (24, 4097, 4097) MLP factor that's the
-                    # difference between a multi-GB transient and ~130MB
-                    # (the inverse step runs every inv_interval steps, so
-                    # the serialization is off the hot path).
-                    if fac.ndim == 3:
-                        return jax.lax.map(one, fac)
-                    return one(fac)
-
-                qa, la, qg, lg = {}, {}, {}, {}
-                for k, fac in state.a.items():
-                    qa[k], la[k] = factor_op(fac)
-                for k, fac in state.g.items():
-                    qg[k], lg[k] = factor_op(fac)
-                return state.replace(qa=qa, la=la, qg=qg, lg=lg)
-
-            self._inv_jit = jax.jit(impl)
+            self._inv_jit = jax.jit(self.inverse_factors)
         return _retain_shardings(self._inv_jit(state), state)
 
     # --------------------------------------------------------- precondition

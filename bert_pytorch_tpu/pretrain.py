@@ -211,6 +211,7 @@ def make_train_step(
     kfac_shardings=None,
     kfac_capture_model=None,
     kfac_factor_interval: int = 1,
+    kfac_inv_interval: int = 0,
     loss_scale: bool = False,
 ):
     """Build the jitted train step.
@@ -239,8 +240,13 @@ def make_train_step(
     separate stats forward/backward per factor update. The step then
     RETURNS the updated kfac_state: ``(state, metrics, kfac_state)``.
     Factor EMA fires when ``opt_step_count % kfac_factor_interval == 0``
-    (a ``lax.cond`` — skipped steps pay no capture FLOPs); inverse
-    recomputes stay host-driven (``kfac.update_inverses``).
+    (a ``lax.cond`` — skipped steps pay no capture FLOPs). With
+    ``kfac_inv_interval > 0`` the inverse recompute ALSO runs in-jit
+    under a cond on due steps, ordered factors → inverses →
+    precondition exactly like kfac_pytorch's ``optimizer.step()``
+    (hooks during backward, due inverses, then the preconditioned
+    update); with 0 the caller drives ``kfac.update_inverses`` on the
+    host and preconditioning sees inverses one factor-update stale.
 
     ``loss_scale=True`` is the fp16 parity mode (reference GradScaler,
     run_pretraining.py:314-318): ``tx`` must be wrapped in
@@ -260,6 +266,11 @@ def make_train_step(
     if fused_kfac and kfac_factor_interval < 1:
         raise ValueError(
             f"kfac_factor_interval must be >= 1, got {kfac_factor_interval}")
+    if kfac_inv_interval and not fused_kfac:
+        raise ValueError(
+            "kfac_inv_interval (in-jit inverse updates) requires the fused "
+            "capture path (kfac_capture_model); host-driven flows call "
+            "kfac.update_inverses themselves")
 
     def loss_fn(params, mb, rng):
         loss, acc, _ = _apply_pretraining_loss(
@@ -328,6 +339,14 @@ def make_train_step(
                        % kfac_factor_interval) == 0
                 loss0, acc0, grads0, kfac_state = jax.lax.cond(
                     due, mb0_capture, mb0_plain, kfac_state)
+            if kfac_inv_interval:
+                # Reference ordering: inverse-due steps rebuild the
+                # inverses from the factors THIS step just captured,
+                # before preconditioning.
+                inv_due = (opt_step_count(state.opt_state)
+                           % kfac_inv_interval) == 0
+                kfac_state = jax.lax.cond(
+                    inv_due, kfac.inverse_factors, lambda s: s, kfac_state)
             grads0 = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads0)
             if accum_steps > 1:

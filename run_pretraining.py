@@ -577,6 +577,7 @@ def main(args) -> dict:
                 kfac=kfac_obj, kfac_shardings=kfac_shardings,
                 kfac_capture_model=model_tapped if kfac_fused else None,
                 kfac_factor_interval=args.kfac_factor_interval,
+                kfac_inv_interval=args.kfac_inv_interval if kfac_fused else 0,
                 loss_scale=fp16)
 
         eval_step = None
@@ -681,25 +682,15 @@ def main(args) -> dict:
                         loader, args.accumulation_steps, b_shardings):
                     if kfac_fused:
                         # In-train capture: the step harvests factors from
-                        # microbatch 0's own backward (gated in-jit by
-                        # factor_interval) and returns the updated state.
-                        # Inverses recompute AFTER the step (they cannot
-                        # precede factors that the same step produces), so
-                        # preconditioning sees inverses one factor-update
-                        # staler than the reference/stats mode, where
-                        # in-step (kfac_pytorch) or pre-step (stats)
-                        # inverse updates include the current factors:
-                        # step 0 runs effectively unpreconditioned (init
-                        # identity operators + kl_clip) and each
-                        # inverse-due step uses the previous interval's
-                        # factors. A one-step lag on a >=10-step inverse
-                        # cadence; accepted for the fused capture's cost
-                        # win rather than compiling the Cholesky solves
-                        # into the train step under a cond.
+                        # microbatch 0's own backward, rebuilds inverses
+                        # in-jit on due steps from the factors it just
+                        # captured, and preconditions with them — the
+                        # exact kfac_pytorch optimizer.step() ordering
+                        # (hooks during backward, due inverses, update).
+                        # Both cadences are lax.cond-gated inside the one
+                        # compiled step; no host round trips.
                         state, metrics, kfac_state = train_step(
                             state, batch, kfac_state)
-                        if global_step % args.kfac_inv_interval == 0:
-                            kfac_state = kfac_obj.update_inverses(kfac_state)
                     elif kfac_obj is not None:
                         # kfac_pytorch cadence: factors (EMA) every
                         # factor_interval steps from the current data, inverses

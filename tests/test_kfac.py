@@ -272,6 +272,43 @@ class TestFusedCapture:
             pretrain.make_train_step(
                 model, tx, schedule=schedule, kfac_capture_model=tapped)
 
+    def test_fused_in_jit_inverses_match_stats_flow(self):
+        """kfac_inv_interval: an inverse-due fused step must equal the
+        stats flow 'factors on full mb0 -> update_inverses -> step' —
+        the kfac_pytorch optimizer.step() ordering, now with zero
+        staleness and no host round trip."""
+        (model, tapped, tx, schedule, kfac, kstate, state, batch, mb0
+         ) = self._build(dropout=0.0)
+        fused_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            kfac=kfac, kfac_capture_model=tapped,
+            kfac_factor_interval=1, kfac_inv_interval=1)
+        plain_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True, kfac=kfac)
+        copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+        ks = kfac.update_factors(
+            kstate, state.params, mb0, jax.random.PRNGKey(0))
+        ks = kfac.update_inverses(ks)
+        state_s, _ = plain_step(copy(state), batch, ks)
+        state_f, _, ks_f = fused_step(state, batch, kstate)
+        for key in ks_f.qa:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(ks_f.qa[key]), np.float32),
+                np.asarray(jax.device_get(ks.qa[key]), np.float32),
+                rtol=2e-2, atol=1e-4)
+        for pf, ps in zip(jax.tree_util.tree_leaves(state_f.params),
+                          jax.tree_util.tree_leaves(state_s.params)):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(pf)),
+                np.asarray(jax.device_get(ps)), rtol=1e-4, atol=1e-6)
+
+    def test_in_jit_inverses_require_fused(self):
+        model, _, tx, schedule, kfac, *_ = self._build()
+        with pytest.raises(ValueError, match="kfac_inv_interval"):
+            pretrain.make_train_step(
+                model, tx, schedule=schedule, kfac=kfac,
+                kfac_inv_interval=10)
+
     def test_fused_matches_plain_step_with_dropout(self):
         """WITH dropout on, the fused step must train identically to the
         plain kfac step: the mb0 unroll's rng split chain
