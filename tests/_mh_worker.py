@@ -35,6 +35,9 @@ Modes (argv[4], default "dp"):
           update, batched inverse update, preconditioned train steps; both
           ranks must agree on losses (the factor statistics and the
           preconditioned gradient reductions are global collectives).
+  kfac_fused — same mesh, but the whole K-FAC flow in ONE compiled step:
+          fused in-train factor capture from microbatch 0's backward +
+          cond-gated in-jit inverse rebuilds + preconditioning.
 """
 import os
 import sys
@@ -163,14 +166,14 @@ with mesh:
     init_fn = pretrain.make_init_fn(model, tx, sample, sh)
     state = init_fn(jax.random.PRNGKey(0))
     kfac_obj = kstate = None
-    if mode == "kfac":
+    if mode in ("kfac", "kfac_fused"):
         tapped = BertForPreTraining(config, dtype=jnp.float32, kfac_tap=True)
         apply_loss, tap_shape_fn = pretrain.make_kfac_fns(tapped, True)
         kfac_obj = optim.KFAC(apply_loss, tap_shape_fn)
     if mode.startswith("pp"):
         step = pretrain.make_pp_train_step(model, tx, mesh, schedule=schedule,
             next_sentence=True, shardings=sh, batch_shardings_=bs)
-    elif mode == "kfac":
+    elif mode in ("kfac", "kfac_fused"):
         pass  # built after kstate shardings below
     else:
         step = pretrain.make_train_step(model, tx, schedule=schedule,
@@ -192,6 +195,22 @@ with mesh:
             kstate = kfac_obj.update_inverses(kstate)
             state, metrics = step(state, batch, kstate)
             losses.append(float(metrics["loss"]))
+    elif mode == "kfac_fused":
+        # Fused in-train capture + cond-gated in-jit inverses, with the
+        # factor stacks sharded across BOTH processes' devices: the
+        # whole K-FAC flow is one compiled step per iteration.
+        kstate = kfac_obj.init(state.params, host)
+        kshard = optim.kfac_state_shardings(mesh, kstate)
+        kstate = jax.device_put(kstate, kshard)
+        step = pretrain.make_train_step(model, tx, schedule=schedule,
+            next_sentence=True, shardings=sh, batch_shardings_=bs,
+            kfac=kfac_obj, kfac_shardings=kshard,
+            kfac_capture_model=tapped, kfac_factor_interval=1,
+            kfac_inv_interval=2)
+        for i in range(3):
+            state, metrics, kstate = step(state, batch, kstate)
+            losses.append(float(metrics["loss"]))
+        assert int(kstate.count) == 3, int(kstate.count)
     else:
         for _ in range(2 if mode == "fsdp" else 3):
             state, metrics = step(state, batch)

@@ -102,3 +102,10 @@ def test_two_process_kfac():
     """Distributed K-FAC across two real processes: factor statistics,
     batched inverses, and preconditioned steps all agree across ranks."""
     _run_workers("kfac")
+
+
+def test_two_process_kfac_fused():
+    """Fused in-train factor capture + in-jit inverse rebuilds across two
+    real processes — the complete K-FAC flow as one compiled step with
+    process-spanning factor-stack shardings."""
+    _run_workers("kfac_fused")
