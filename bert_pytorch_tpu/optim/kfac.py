@@ -255,8 +255,11 @@ class KFAC:
             )
         # Probe statistics are batch-shape independent ((L, d, d) factor
         # reductions), so one zero-taps tree serves every batch shape —
-        # the fused in-train capture path reads it via zero_taps().
+        # the fused in-train capture path reads it via zero_taps(); the
+        # all-microbatch capture additionally needs a zero A-stat tree to
+        # seed its scan accumulator (zero_astats()).
         self._tap_shapes = tap_shapes
+        self._astat_shapes = astat_shapes
 
         flat_astats = {
             _flat_key(p): _unwrap_sown(v)
@@ -332,6 +335,13 @@ class KFAC:
         independent — see init)."""
         return jax.tree_util.tree_map(
             lambda s: jnp.zeros(s.shape, s.dtype), self._tap_shapes)
+
+    def zero_astats(self):
+        """Zero A-stat tree (the sown ``kfac_a`` collection's structure,
+        also batch-shape independent) — the scan accumulator seed for
+        all-microbatch fused capture."""
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._astat_shapes)
 
     def ema_factors(self, state: KFACState, astats, gtaps, rows, scale
                     ) -> KFACState:

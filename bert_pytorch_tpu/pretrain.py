@@ -212,6 +212,7 @@ def make_train_step(
     kfac_capture_model=None,
     kfac_factor_interval: int = 1,
     kfac_inv_interval: int = 0,
+    kfac_capture_microbatches: str = "first",
     loss_scale: bool = False,
 ):
     """Build the jitted train step.
@@ -247,6 +248,12 @@ def make_train_step(
     (hooks during backward, due inverses, then the preconditioned
     update); with 0 the caller drives ``kfac.update_inverses`` on the
     host and preconditioning sees inverses one factor-update stale.
+    ``kfac_capture_microbatches`` picks the capture source on due
+    steps: ``'first'`` (default) taps microbatch 0 only — capture cost
+    amortizes over the accumulation; ``'all'`` accumulates statistics
+    over EVERY microbatch's backward, kfac_pytorch's exact accumulation
+    semantics (its hooks fire on each micro-backward), at capture cost
+    proportional to accum_steps.
 
     ``loss_scale=True`` is the fp16 parity mode (reference GradScaler,
     run_pretraining.py:314-318): ``tx`` must be wrapped in
@@ -271,6 +278,10 @@ def make_train_step(
             "kfac_inv_interval (in-jit inverse updates) requires the fused "
             "capture path (kfac_capture_model); host-driven flows call "
             "kfac.update_inverses themselves")
+    if kfac_capture_microbatches not in ("first", "all"):
+        raise ValueError(
+            f"kfac_capture_microbatches must be first|all, got "
+            f"{kfac_capture_microbatches!r}")
 
     def loss_fn(params, mb, rng):
         loss, acc, _ = _apply_pretraining_loss(
@@ -311,10 +322,60 @@ def make_train_step(
             )
             return (grads_acc, rng), (loss, acc)
 
-        if fused_kfac:
-            # Microbatch 0 unrolls out of the scan so its backward can be
-            # the tapped one; the rng split chain matches body's exactly,
-            # so microbatch i sees the same dropout rng either way.
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        if fused_kfac and kfac_capture_microbatches == "all":
+            # kfac_pytorch accumulation semantics: every microbatch's
+            # backward contributes statistics (its hooks fire per
+            # micro-backward); the scan carries factor-stat accumulators
+            # alongside the gradient accumulator.
+            rows = (accum_steps * batch["input_ids"].shape[1]
+                    * batch["input_ids"].shape[2])
+            mb_scale = kfac.grad_scale(
+                jax.tree_util.tree_map(lambda v: v[0], batch))
+
+            def tapped_body(carry, mb):
+                grads_acc, gtap_acc, astat_acc, rng = carry
+                rng, sub = jax.random.split(rng)
+                (loss, (acc, astats)), (grads, gtaps) = jax.value_and_grad(
+                    tapped_loss_fn, argnums=(0, 1), has_aux=True
+                )(state.params, kfac.zero_taps(), mb, sub)
+                grads_acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(a.dtype), grads_acc, grads)
+                gtap_acc = jax.tree_util.tree_map(
+                    jnp.add, gtap_acc, gtaps)
+                astat_acc = jax.tree_util.tree_map(
+                    jnp.add, astat_acc, astats)
+                return (grads_acc, gtap_acc, astat_acc, rng), (loss, acc)
+
+            def all_capture(ks):
+                (grads, gtap_sum, astat_sum, _), (losses, accs) = (
+                    jax.lax.scan(
+                        tapped_body,
+                        (zero_grads, kfac.zero_taps(), kfac.zero_astats(),
+                         step_rng),
+                        batch))
+                ks = kfac.ema_factors(ks, astat_sum, gtap_sum, rows, mb_scale)
+                return losses, accs, grads, ks
+
+            def all_plain(ks):
+                (grads, _), (losses, accs) = jax.lax.scan(
+                    body, (zero_grads, step_rng), batch)
+                return losses, accs, grads, ks
+
+            if kfac_factor_interval == 1:
+                losses, accs, grads, kfac_state = all_capture(kfac_state)
+            else:
+                due = (opt_step_count(state.opt_state)
+                       % kfac_factor_interval) == 0
+                losses, accs, grads, kfac_state = jax.lax.cond(
+                    due, all_capture, all_plain, kfac_state)
+        elif fused_kfac:
+            # 'first': microbatch 0 unrolls out of the scan so its
+            # backward can be the tapped one; the rng split chain matches
+            # body's exactly, so microbatch i sees the same dropout rng
+            # either way.
             mb0 = jax.tree_util.tree_map(lambda v: v[0], batch)
             rng_rest, sub0 = jax.random.split(step_rng)
             rows = mb0["input_ids"].shape[0] * mb0["input_ids"].shape[1]
@@ -339,14 +400,6 @@ def make_train_step(
                        % kfac_factor_interval) == 0
                 loss0, acc0, grads0, kfac_state = jax.lax.cond(
                     due, mb0_capture, mb0_plain, kfac_state)
-            if kfac_inv_interval:
-                # Reference ordering: inverse-due steps rebuild the
-                # inverses from the factors THIS step just captured,
-                # before preconditioning.
-                inv_due = (opt_step_count(state.opt_state)
-                           % kfac_inv_interval) == 0
-                kfac_state = jax.lax.cond(
-                    inv_due, kfac.inverse_factors, lambda s: s, kfac_state)
             grads0 = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.float32), grads0)
             if accum_steps > 1:
@@ -361,12 +414,17 @@ def make_train_step(
                 losses = loss0[None]
                 accs = acc0[None]
         else:
-            zero_grads = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
-            )
             (grads, _), (losses, accs) = jax.lax.scan(
                 body, (zero_grads, step_rng), batch
             )
+        if fused_kfac and kfac_inv_interval:
+            # Reference ordering: inverse-due steps rebuild the inverses
+            # from the factors THIS step just captured, before
+            # preconditioning.
+            inv_due = (opt_step_count(state.opt_state)
+                       % kfac_inv_interval) == 0
+            kfac_state = jax.lax.cond(
+                inv_due, kfac.inverse_factors, lambda s: s, kfac_state)
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
 
         if kfac is not None:

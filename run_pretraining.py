@@ -177,6 +177,15 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "factor_interval steps (pp strategies use "
                              "this; it is also the knob for stats batches "
                              "smaller than a microbatch)")
+    parser.add_argument("--kfac_capture_microbatches", type=str,
+                        default="first", choices=["first", "all"],
+                        help="fused capture source on factor-due steps: "
+                             "'first' taps microbatch 0 only (capture "
+                             "cost amortizes over the accumulation); "
+                             "'all' accumulates statistics over every "
+                             "microbatch's backward — kfac_pytorch's "
+                             "exact accumulation semantics, capture cost "
+                             "proportional to accumulation_steps")
     parser.add_argument("--kfac_stats_batch", type=int, default=16,
                         help="total sequences (strided across the global "
                              "batch, so every data shard contributes) used "
@@ -578,6 +587,7 @@ def main(args) -> dict:
                 kfac_capture_model=model_tapped if kfac_fused else None,
                 kfac_factor_interval=args.kfac_factor_interval,
                 kfac_inv_interval=args.kfac_inv_interval if kfac_fused else 0,
+                kfac_capture_microbatches=args.kfac_capture_microbatches,
                 loss_scale=fp16)
 
         eval_step = None

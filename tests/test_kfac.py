@@ -309,6 +309,47 @@ class TestFusedCapture:
                 model, tx, schedule=schedule, kfac=kfac,
                 kfac_inv_interval=10)
 
+    def test_capture_all_microbatches(self):
+        """kfac_capture_microbatches='all' (kfac_pytorch's accumulation
+        semantics): with A=2 IDENTICAL microbatches and dropout off, the
+        all-microbatch factors must equal the first-microbatch factors
+        (both average the same rows), and the training trajectory must
+        match the plain step's."""
+        (model, tapped, tx, schedule, kfac, kstate, state, batch, mb0
+         ) = self._build(dropout=0.0)
+        dup = {k: np.stack([v[0], v[0]]) for k, v in batch.items()}
+        first_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            kfac=kfac, kfac_capture_model=tapped, kfac_factor_interval=1)
+        all_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True,
+            kfac=kfac, kfac_capture_model=tapped, kfac_factor_interval=1,
+            kfac_capture_microbatches="all")
+        plain_step = pretrain.make_train_step(
+            model, tx, schedule=schedule, next_sentence=True, kfac=kfac)
+        copy = lambda t: jax.tree_util.tree_map(jnp.array, t)
+        state_p, metrics_p = plain_step(copy(state), dup, kstate)
+        _, _, ks_first = first_step(copy(state), dup, copy(kstate))
+        state_a, metrics_a, ks_all = all_step(state, dup, kstate)
+        assert int(ks_all.count) == 1
+        for key in ks_all.g:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(ks_all.g[key])),
+                np.asarray(jax.device_get(ks_first.g[key])),
+                rtol=2e-4, atol=1e-5)
+        for key in ks_all.a:
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(ks_all.a[key])),
+                np.asarray(jax.device_get(ks_first.a[key])),
+                rtol=2e-4, atol=1e-5)
+        assert float(metrics_a["loss"]) == pytest.approx(
+            float(metrics_p["loss"]), rel=1e-6)
+        for pa, pp in zip(jax.tree_util.tree_leaves(state_a.params),
+                          jax.tree_util.tree_leaves(state_p.params)):
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(pa)),
+                np.asarray(jax.device_get(pp)), rtol=1e-5, atol=1e-7)
+
     def test_fused_matches_plain_step_with_dropout(self):
         """WITH dropout on, the fused step must train identically to the
         plain kfac step: the mb0 unroll's rng split chain
