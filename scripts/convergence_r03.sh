@@ -30,6 +30,19 @@ LOCAL_BATCH=${CONV_LOCAL_BATCH:-64}
 GLOBAL_BATCH=${CONV_GLOBAL_BATCH:-512}
 # LAMB sqrt LR scaling from the phase-1 recipe: 6e-3 * sqrt(512/65536).
 LR=${CONV_LR:-5.3e-4}
+# K-FAC legs run a smaller microbatch (same global batch via deeper
+# accumulation): the fused-capture step with in-jit inverses peaked
+# 2.41 MB OVER the v5e chip's 15.75G usable HBM at lb=64 (args 6.75G +
+# program temps 8.99G, measured 2026-08-02); halving the microbatch
+# shrinks the activation temps by gigabytes. Gradients are identical at
+# equal global batch; per-row samples_per_second still charges the real
+# (slightly higher) accumulation overhead to the equal-wallclock
+# comparison. LAMB stays at the full microbatch — each optimizer runs at
+# its best feasible single-chip config. The default derives from
+# LOCAL_BATCH so CPU-sanity overrides (CONV_LOCAL_BATCH=8 etc.) scale
+# with it instead of tripping the gbs divisibility check.
+KFAC_LB=${CONV_KFAC_LOCAL_BATCH:-$((LOCAL_BATCH / 2))}
+[ "$KFAC_LB" -lt 1 ] && KFAC_LB=1
 CACHE=${BENCH_COMPILE_CACHE_DIR:-${XDG_CACHE_HOME:-$HOME/.cache}/bert_tpu_jax_cache}
 mkdir -p "$W"
 
@@ -37,10 +50,15 @@ RUN_STAMP="steps=$STEPS lb=$LOCAL_BATCH gb=$GLOBAL_BATCH lr=$LR"
 source scripts/lib_synth_corpus.sh
 synth_corpus_build "$W" "$MODEL" 4 0
 
+# Per-leg stamp: the shared geometry plus LEG_STAMP_EXTRA (set by the
+# caller for leg-specific knobs that change the trajectory or the
+# wallclock accounting, e.g. the K-FAC legs' smaller microbatch). A leg
+# completed under a different microbatch must NOT pass leg_done, or its
+# stale rows would be merged into the CSV labeled as the new config.
 leg_done () {  # name -> 0 if the leg completed under the SAME run stamp
   local csv="$W/$1/log_metrics.csv" stamp="$W/$1/.leg_ok"
   [ -f "$csv" ] && [ -f "$stamp" ] && \
-    [ "$(cat "$stamp")" = "$RUN_STAMP" ] && \
+    [ "$(cat "$stamp")" = "$RUN_STAMP${LEG_STAMP_EXTRA:-}" ] && \
     [ "$(grep -c '^train,' "$csv" 2>/dev/null || true)" -ge "$STEPS" ]
 }
 
@@ -64,18 +82,22 @@ run_leg () {  # name, extra args...
       --log_prefix log --log_steps 1 --num_steps_per_checkpoint 100000 \
       --compile_cache_dir "$CACHE" \
       "$@"
-  echo "$RUN_STAMP" > "$W/$name/.leg_ok"
+  echo "$RUN_STAMP${LEG_STAMP_EXTRA:-}" > "$W/$name/.leg_ok"
 }
 
 run_leg lamb
 # K-FAC at the REFERENCE operating point (run_pretraining.py:133-149:
 # factors every step from the live batch scale, inverses every 10,
 # damping 3e-3, kl_clip 1e-3, stat_decay 0.95).
+# argparse last-wins: the trailing --local_batch_size overrides
+# run_leg's fixed $LOCAL_BATCH for the memory-bound K-FAC legs.
+LEG_STAMP_EXTRA=" kfac_lb=$KFAC_LB"
 run_leg kfac_ref --kfac --kfac_factor_interval 1 --kfac_inv_interval 10 \
     --kfac_damping 3e-3 --kfac_kl_clip 1e-3 --kfac_stat_decay 0.95 \
-    --kfac_stats_batch "$LOCAL_BATCH"
+    --kfac_stats_batch "$KFAC_LB" --local_batch_size "$KFAC_LB"
 # K-FAC at this repo's cheap default cadence (the r02 configuration).
-run_leg kfac --kfac
+run_leg kfac --kfac --local_batch_size "$KFAC_LB"
+LEG_STAMP_EXTRA=""
 
 echo "== merge CSVs -> $OUT"
 python - "$W" "$OUT" <<'EOF'

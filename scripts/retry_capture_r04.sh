@@ -33,6 +33,11 @@ cd "$(dirname "$0")/.."
 DEADLINE=${1:-$(($(date +%s) + 10 * 3600))}
 LOGS=${2:-/tmp/retry_capture_r04}
 mkdir -p "$LOGS"
+# Leg workdirs — used by both the invocations and the progress probes;
+# keep them in one place so the probes can't drift off the real paths.
+CONV_W=/tmp/bert_conv_r03
+LONG_W=/tmp/bert_conv_long_r03
+SMOKE_W=/tmp/bert_tpu_smoke_r03
 # Cache split: bench.py invocations use its default in-repo cache
 # (.jax_cache/, committed); the runner-based legs (convergence, smoke,
 # e2e, long run) use their scripts' own per-user scratch default. Nothing
@@ -64,6 +69,33 @@ commit_artifacts() {  # msg, paths...
 good_json() { [ -f "$1" ] && ! grep -q '"error"' "$1" \
   && ! grep -q '"value": 0.0' "$1"; }
 
+# A leg that fails WHILE THE BACKEND IS STILL ALIVE is its own fault
+# (e.g. an OOM): count it, and after 2 such failures stop retrying so a
+# deterministic failure can't block every lower-priority leg for the rest
+# of a scarce window (the r04 kfac-convergence OOM looped exactly that
+# way). Tunnel-death failures are not counted — the leg gets fresh tries
+# in later windows. Pass timeouts on the resumable legs are excused ONLY
+# when the pass demonstrably advanced (a new sub-leg stamp, checkpoint,
+# or sweep point); a timeout with zero progress is a strike like any
+# other failure. To re-enable a given-up leg after fixing its cause:
+# rm "$LOGS/fail_<leg>".
+fails() { cat "$LOGS/fail_$1" 2>/dev/null || echo 0; }
+gave_up() { [ "$(fails "$1")" -ge 2 ]; }
+# A pass that makes real progress proves the leg is not deterministically
+# broken — forget earlier strikes so two UNRELATED transient failures
+# spread across many windows can't retire a steadily-advancing leg.
+clear_fail() { rm -f "$LOGS/fail_$1"; }
+bump_fail() {
+  if probe; then
+    local n=$(( $(fails "$1") + 1 ))
+    echo "$n" > "$LOGS/fail_$1"
+    echo "   fail #$n for $1 with backend alive$(gave_up "$1" \
+      && echo ' — giving up on this leg (rm '"$LOGS/fail_$1"' to retry)')"
+  else
+    echo "   $1 failed with backend down; not counted"
+  fi
+}
+
 bench_warm() {  # artifact, timeout_s, env pairs...
   local art=$1 t=$2; shift 2
   echo "== leg: warm $art"
@@ -92,10 +124,13 @@ have_e2e()      { [ -f E2E_r03.json ]; }
 have_long()     { [ -f LONG_RUN_r03.json ]; }
 have_sweep()    { [ -f SWEEP_r03.jsonl ] && [ "$(wc -l < SWEEP_r03.jsonl)" -ge 12 ]; }
 
+# One leg list shared by all_done, the gating ifs (via pending), and the
+# end-of-run report — add a leg in one place.
+LEGS="phase1 degraded conv phase2 kfacb kfac_cap seq1024 seq2048 e2e long sweep"
+pending() { ! "have_$1" && ! gave_up "$1"; }
 all_done() {
-  have_phase1 && have_degraded && have_conv && have_phase2 && have_kfacb \
-    && have_kfac_cap && have_seq1024 && have_seq2048 && have_e2e \
-    && have_long && have_sweep
+  local l
+  for l in $LEGS; do "have_$l" || gave_up "$l" || return 1; done
 }
 
 run_sweep() {
@@ -151,10 +186,22 @@ EOF
   mv "$LOGS/sweep.tmp" SWEEP_r03.jsonl
 }
 
+report() {  # per-leg status incl. give-up state (so a NO that needs a
+            # fail_<leg> reset is distinguishable from a never-ran leg)
+  local l
+  for l in $LEGS; do
+    if "have_$l"; then echo "  $l: yes"
+    elif gave_up "$l"; then echo "  $l: NO (gave up after $(fails "$l") failures; rm $LOGS/fail_$l to retry)"
+    else echo "  $l: NO"
+    fi
+  done
+}
+
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   [ -f .stop_capture ] && { echo "stop_capture flag set; exiting"; exit 0; }
   if all_done; then
-    echo "retry_capture_r04: all artifacts captured"
+    echo "retry_capture_r04: all legs resolved (captured or gave up):"
+    report
     exit 0
   fi
   if ! probe; then
@@ -165,8 +212,10 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   echo "$(date +%H:%M:%S) backend up"
 
   # -- P1: committed warm cache + cold-verified driver bench ------------
-  if ! have_phase1; then
-    if bench_warm bench_phase1.json 2850 BENCH_PHASE=1; then
+  if pending phase1; then
+    if ! bench_warm bench_phase1.json 2850 BENCH_PHASE=1; then
+      bump_fail phase1
+    else
       echo "== leg: cold-verify (fresh process, committed cache only)"
       if env BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT_S=540 \
           BENCH_BUDGET_S=560 BENCH_DEGRADE=0 \
@@ -181,13 +230,16 @@ EOF
         echo "   cold-verify OK: $(cat COLD_BENCH_r03.json)"
       else
         echo "   cold-verify FAILED: $(tail -1 "$LOGS/cold.log" | cut -c1-160)"
+        # Counted: without this a deterministic cold-verify failure
+        # would re-run the whole ~50-min warm+verify leg every window.
+        bump_fail phase1
       fi
       commit_artifacts "Capture r03 phase-1 bench; commit the warm compile cache" \
         .jax_cache bench_phase1.json COLD_BENCH_r03.json
     fi
     continue  # re-probe between legs: windows are short
   fi
-  if ! have_degraded; then
+  if pending degraded; then
     echo "== leg: warm degraded (BERT-base) fallback cache entry"
     if env BENCH_DEGRADED=1 BENCH_ATTEMPTS=1 BENCH_ATTEMPT_TIMEOUT_S=1500 \
         BENCH_BUDGET_S=1530 BENCH_DEGRADE=0 \
@@ -199,44 +251,64 @@ EOF
     else
       rm -f "$LOGS/degraded_warm.json"
       echo "   FAILED (degraded warm)"
+      bump_fail degraded
     fi
     continue
   fi
 
   # -- P2: K-FAC convergence (reference point + cheap cadence) ----------
-  if ! have_conv; then
+  if pending conv; then
     echo "== leg: convergence (LAMB vs K-FAC x2)"
-    if timeout 7200 \
-        bash scripts/convergence_r03.sh /tmp/bert_conv_r03 CONVERGENCE_r03.csv \
-        > "$LOGS/convergence.log" 2>&1; then
+    # Progress unit = a sub-leg stamp (.leg_ok) written DURING this pass
+    # (mtime probe, not a count: a pass that re-completes a sub-leg whose
+    # stale stamp run_leg just cleared leaves the count unchanged but is
+    # real progress). An individual sub-leg restarts from step 0 when
+    # interrupted, but completed sub-legs skip on the next pass.
+    touch "$LOGS/conv_pass_start"
+    timeout 7200 \
+        bash scripts/convergence_r03.sh "$CONV_W" CONVERGENCE_r03.csv \
+        > "$LOGS/convergence.log" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      clear_fail conv
       commit_artifacts "Capture r03 on-chip LAMB-vs-K-FAC convergence (equal step + wallclock)" \
         CONVERGENCE_r03.csv CONVERGENCE_r03_summary.json docs/convergence_r03.png
+    elif find "$CONV_W" -mindepth 2 -maxdepth 2 -name .leg_ok \
+        -newer "$LOGS/conv_pass_start" 2>/dev/null | grep -q .; then
+      echo "   convergence pass ended (rc=$rc) after completing a sub-leg; resumes"
+      clear_fail conv
     else
-      echo "   FAILED (convergence); tail:"; tail -3 "$LOGS/convergence.log"
+      echo "   FAILED (convergence, rc=$rc, no sub-leg progress); tail:"
+      tail -3 "$LOGS/convergence.log"
+      bump_fail conv
     fi
     continue
   fi
 
   # -- P3: remaining bench legs ----------------------------------------
-  if ! have_phase2; then
+  if pending phase2; then
     if bench_warm bench_phase2.json 2850 BENCH_PHASE=2; then
       echo pallas > "$LOGS/.phase2_r03_done"
       commit_artifacts "Capture r03 phase-2 bench; extend the committed cache" \
         .jax_cache bench_phase2.json
+    else
+      bump_fail phase2
     fi
     continue
   fi
-  if ! have_kfacb; then
+  if pending kfacb; then
     # Fused in-train capture is the BENCH_KFAC_CAPTURE default now; the
     # r02-committed 236-seq/s number was the stats mode.
     if bench_warm bench_kfac.json 2850 BENCH_KFAC=1; then
       : > "$LOGS/.kfac_r04_done"
       commit_artifacts "Capture r04 K-FAC bench (fused in-train capture)" \
         .jax_cache bench_kfac.json
+    else
+      bump_fail kfacb
     fi
     continue
   fi
-  if ! have_kfac_cap; then
+  if pending kfac_cap; then
     echo "== leg: K-FAC capture-cost A/B (lamb vs stats vs fused, interval 1)"
     if timeout 3600 python tools/bench_kfac_capture.py \
         --hidden 1024 --layers 24 --heads 16 --vocab 30528 --seq 128 \
@@ -252,59 +324,101 @@ EOF
       rm -f KFAC_CAPTURE_BENCH_chip_r04.jsonl
       echo "   FAILED (kfac capture A/B): $(tail -1 "$LOGS/kfac_capture.log" \
         2>/dev/null | cut -c1-160)"
+      bump_fail kfac_cap
     fi
     continue
   fi
-  if ! have_seq1024; then
-    bench_warm bench_seq1024.json 2400 BENCH_SEQ=1024 \
-      && commit_artifacts "Capture r03 seq-1024 long-context bench" \
-           .jax_cache bench_seq1024.json
+  if pending seq1024; then
+    if bench_warm bench_seq1024.json 2400 BENCH_SEQ=1024; then
+      commit_artifacts "Capture r03 seq-1024 long-context bench" \
+        .jax_cache bench_seq1024.json
+    else
+      bump_fail seq1024
+    fi
     continue
   fi
-  if ! have_seq2048; then
-    bench_warm bench_seq2048.json 3000 BENCH_SEQ=2048 \
-      && commit_artifacts "Capture r03 seq-2048 long-context bench" \
-           .jax_cache bench_seq2048.json
+  if pending seq2048; then
+    if bench_warm bench_seq2048.json 3000 BENCH_SEQ=2048; then
+      commit_artifacts "Capture r03 seq-2048 long-context bench" \
+        .jax_cache bench_seq2048.json
+    else
+      bump_fail seq2048
+    fi
     continue
   fi
 
   # -- P4: chip e2e -----------------------------------------------------
-  if ! have_e2e; then
+  if pending e2e; then
     echo "== leg: smoke_and_e2e"
-    if timeout 3600 \
-        bash scripts/smoke_tpu.sh /tmp/bert_tpu_smoke_r03 \
-        > "$LOGS/smoke.log" 2>&1; then
+    timeout 3600 bash scripts/smoke_tpu.sh "$SMOKE_W" \
+        > "$LOGS/smoke.log" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
       commit_artifacts "Capture r03 chip-profile offline e2e chain" E2E_r03.json
     else
-      echo "   FAILED (smoke_and_e2e); tail:"; tail -3 "$LOGS/smoke.log"
+      echo "   FAILED (smoke_and_e2e, rc=$rc); tail:"; tail -3 "$LOGS/smoke.log"
+      bump_fail e2e
     fi
     continue
   fi
 
   # -- P5: long anchored convergence (resumable across windows) ---------
-  if ! have_long; then
+  if pending long; then
     echo "== leg: long convergence (resumable pass)"
-    if timeout 3600 \
-        bash scripts/convergence_long_r03.sh /tmp/bert_conv_long_r03 \
-        > "$LOGS/long.log" 2>&1; then
+    # Progress-aware timeout handling: this leg auto-resumes from its
+    # 250-step checkpoints, so a 3600s pass timeout is fine AS LONG AS
+    # the pass advanced the latest checkpoint; a pass that times out
+    # with zero checkpoint progress counts as a failure. NUMERIC max of
+    # the ckpt_<step> names — the names are unpadded, so a lexicographic
+    # max would stall at e.g. ckpt_750 while ckpt_1000+ accrue (and
+    # timeout-killed writes can leave tmp* litter that sorts last).
+    latest_long_ckpt() {
+      ls "$LONG_W"/run/pretrain_ckpts 2>/dev/null \
+        | grep -oE '^ckpt_[0-9]+' | sed 's/ckpt_//' | sort -n | tail -1
+    }
+    ckpt_before=$(latest_long_ckpt)
+    timeout 3600 bash scripts/convergence_long_r03.sh "$LONG_W" \
+        > "$LOGS/long.log" 2>&1
+    rc=$?
+    if [ "$rc" -eq 0 ]; then
+      clear_fail long
       commit_artifacts "Capture r03 long anchored convergence run (pre-stated milestones)" \
         CONVERGENCE_LONG_r03.csv LONG_RUN_r03.json docs/convergence_long_r03.png
+    elif [ "$(latest_long_ckpt)" != "$ckpt_before" ]; then
+      echo "   long pass ended (rc=$rc) with checkpoint progress; resumes next window"
+      clear_fail long
     else
-      echo "   long pass ended (will resume): $(tail -1 "$LOGS/long.log" | cut -c1-160)"
+      echo "   long pass FAILED (rc=$rc, no checkpoint progress): $(tail -1 "$LOGS/long.log" | cut -c1-160)"
+      bump_fail long
     fi
     continue
   fi
 
   # -- P6: sweep --------------------------------------------------------
-  if ! have_sweep; then
+  if pending sweep; then
     echo "== leg: batch/backend sweep"
-    run_sweep && commit_artifacts "Capture r03 phase-1 batch/backend sweep" \
-      SWEEP_r03.jsonl || true
+    # Per-point resumable (run_sweep reuses good cached sweep_*.json):
+    # a failing pass that still banked at least one NEW point is
+    # progress, same policy as the conv/long legs. Count GOOD points —
+    # a failed point also leaves a (bad) sweep_*.json behind.
+    count_good_sweep() {
+      local n=0 f
+      for f in "$LOGS"/sweep_*.json; do
+        [ -s "$f" ] && good_json "$f" && n=$((n + 1))
+      done
+      echo "$n"
+    }
+    sweep_pts_before=$(count_good_sweep)
+    if run_sweep; then
+      clear_fail sweep
+      commit_artifacts "Capture r03 phase-1 batch/backend sweep" SWEEP_r03.jsonl
+    elif [ "$(count_good_sweep)" -gt "$sweep_pts_before" ]; then
+      echo "   sweep pass banked new points before failing; resumes"
+      clear_fail sweep
+    else
+      bump_fail sweep
+    fi
   fi
 done
 echo "retry_capture_r04: deadline reached"
-for f in have_phase1 have_degraded have_conv have_phase2 have_kfacb \
-         have_kfac_cap have_seq1024 have_seq2048 have_e2e have_long \
-         have_sweep; do
-  $f && echo "  $f: yes" || echo "  $f: NO"
-done
+report
