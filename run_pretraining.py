@@ -93,6 +93,13 @@ def parse_arguments(argv=None) -> argparse.Namespace:
     # checkpoint / logging cadence
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
     parser.add_argument("--keep_checkpoints", type=int, default=3)
+    parser.add_argument("--skip_final_checkpoint", action="store_true",
+                        help="skip the end-of-run checkpoint write. For "
+                             "benchmark/capture runs whose artifact is the "
+                             "metrics log: at BERT-large the final state is "
+                             "multi-GB and the device->host pull can dominate "
+                             "a short run's wallclock. A checkpoint requested "
+                             "by a termination signal is still written")
     parser.add_argument("--log_steps", type=int, default=1)
     parser.add_argument("--term_check_steps", type=int, default=10,
                         help="how often (in optimizer steps) to act on a "
@@ -839,15 +846,20 @@ def main(args) -> dict:
                 jax.devices()[0].device_kind)
             if train_mfu:
                 logger.info(f"training_mfu = {train_mfu:.4f}")
-            # Final checkpoint so short runs resume exactly.
-            save_step = global_step + args.previous_phase_end_step
-            contents = {"model": state.params, "optimizer": state.opt_state,
-                        "sampler": sampler_checkpoint_state(), "epoch": epoch}
-            if kfac_state is not None:
-                contents["preconditioner"] = kfac_state
-            ckpt.save_checkpoint(
-                args.model_output_dir, save_step, contents,
-                keep=args.keep_checkpoints)
+            # Final checkpoint so short runs resume exactly. A
+            # termination-signal checkpoint overrides --skip_final_checkpoint:
+            # preemption resume must survive capture-mode runs too.
+            if not args.skip_final_checkpoint or terminated:
+                save_step = global_step + args.previous_phase_end_step
+                contents = {"model": state.params,
+                            "optimizer": state.opt_state,
+                            "sampler": sampler_checkpoint_state(),
+                            "epoch": epoch}
+                if kfac_state is not None:
+                    contents["preconditioner"] = kfac_state
+                ckpt.save_checkpoint(
+                    args.model_output_dir, save_step, contents,
+                    keep=args.keep_checkpoints)
             ckpt.wait_for_pending_save()
             logger.close()
         finally:

@@ -80,6 +80,7 @@ run_leg () {  # name, extra args...
       --learning_rate "$LR" --warmup_proportion 0.1 \
       --max_predictions_per_seq 20 --remat dots \
       --log_prefix log --log_steps 1 --num_steps_per_checkpoint 100000 \
+      --skip_final_checkpoint \
       --compile_cache_dir "$CACHE" \
       "$@"
   echo "$RUN_STAMP${LEG_STAMP_EXTRA:-}" > "$W/$name/.leg_ok"

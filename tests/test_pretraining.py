@@ -57,7 +57,10 @@ def _args(workdir, **overrides):
         "--seed", "7",
     ]
     for key, value in overrides.items():
-        argv += [f"--{key}", str(value)]
+        if value is True:  # bare store_true flag
+            argv += [f"--{key}"]
+        else:
+            argv += [f"--{key}", str(value)]
     return run_pretraining.parse_arguments(argv)
 
 
