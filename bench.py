@@ -114,6 +114,10 @@ DEGRADED = os.environ.get("BENCH_DEGRADED", "0") == "1"
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.environ.get("BENCH_COMPILE_CACHE_DIR",
                            os.path.join(REPO_ROOT, ".jax_cache"))
+# Optional telemetry sink (docs/telemetry.md): the child appends its
+# compile events (fn/shapes digest/compile seconds/cache hit-miss) as
+# schema-versioned JSONL so capture passes record cold-vs-warm evidence.
+TELEMETRY_JSONL = os.environ.get("BENCH_TELEMETRY_JSONL", "")
 
 
 def _config_digest(degraded=None, local_batch=None):
@@ -319,6 +323,19 @@ def _child_main():
             kfac_factor_interval=10,
             kfac_inv_interval=100 if kfac_fused else 0)
 
+        # Compile observability (telemetry/compile_events.py): the warmup
+        # compile is attributed to the bench step, so the result can state
+        # whether this run was cold (real XLA compile) or warm (persistent
+        # cache hit) — the ambiguity that zeroed BENCH_r01-r03.
+        from bert_pytorch_tpu.telemetry import CompileMonitor
+        sink = None
+        if TELEMETRY_JSONL:
+            from bert_pytorch_tpu.utils.logging import JSONLHandler
+            sink = JSONLHandler(TELEMETRY_JSONL, overwrite=False)
+        monitor = CompileMonitor(
+            emit=sink.write_record if sink else lambda rec: None)
+        step = monitor.instrument(step, "bench_step")
+
         batch = pretrain.put_batch(
             pretrain.stack_microbatches(host, ACCUM), b_shardings)
 
@@ -385,9 +402,19 @@ def _child_main():
             large.vocab_size += 8 - (large.vocab_size % 8)
         anchor = A100_PHASE1_SEQ_PER_SEC * flops_util.bert_train_flops_per_seq(
             large, SEQ_LEN, MAX_PRED, next_sentence=True) / flops_per_seq
-    print(json.dumps(_result_json(
+    result = _result_json(
         seq_per_sec_chip, mfu=model_flops_util, n_chips=n_chips,
-        anchor_override=anchor)))
+        anchor_override=anchor)
+    if monitor.events:
+        result["compile"] = {
+            "events": len(monitor.events),
+            "cache": monitor.events[0]["cache"],
+            "compile_s": round(
+                sum(e["compile_s"] for e in monitor.events), 2),
+        }
+    if sink is not None:
+        sink.close()
+    print(json.dumps(result))
 
 
 def _metric_name_and_anchor():

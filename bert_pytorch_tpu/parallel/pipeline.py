@@ -36,8 +36,36 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.5: top-level shard_map with axis_names + lax.pcast typing
+    from jax import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, axis_names, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, axis_names=axis_names,
+                          in_specs=in_specs, out_specs=out_specs)
+
+    def _pcast_varying(x, axis_name):
+        return jax.lax.pcast(x, axis_name, to="varying")
+
+except ImportError:  # jax 0.4.x: experimental shard_map, auto= complement
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, axis_names, in_specs, out_specs):
+        # The old API is manual over every mesh axis NOT listed in ``auto``;
+        # the new axis_names= is its complement. check_rep=False because the
+        # legacy replication checker predates (and rejects) the partial-auto
+        # composition this engine relies on; the pcast/pvary annotations the
+        # new typing needs don't exist here, so they no-op below.
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        # jit wrapper: the legacy eager path raises NotImplementedError for
+        # partial-auto shard_maps; under the runner's outer jit this inlines.
+        return jax.jit(_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, auto=auto,
+                                  check_rep=False))
+
+    def _pcast_varying(x, axis_name):
+        return x
 
 
 def stage_layer_count(n_layers: int, n_stages: int) -> int:
@@ -192,7 +220,7 @@ def gpipe(
             # conversions would otherwise create — Shardy leaks sharding
             # custom-calls into those reductions' to_apply computations).
             local_params = jax.tree_util.tree_map(
-                lambda p: jax.lax.pcast(p, seq_axis, to="varying"),
+                lambda p: _pcast_varying(p, seq_axis),
                 local_params)
 
         def tick(carry, t):
@@ -220,8 +248,8 @@ def gpipe(
         # The carry is device-varying over 'pipe' after the first tick; mark
         # the zero initializers as varying so the scan carry type is stable
         # (shard_map's varying-manual-axes typing).
-        outs0 = jax.lax.pcast(jnp.zeros_like(x_local), axis, to="varying")
-        act0 = jax.lax.pcast(jnp.zeros_like(x_local[0]), axis, to="varying")
+        outs0 = _pcast_varying(jnp.zeros_like(x_local), axis)
+        act0 = _pcast_varying(jnp.zeros_like(x_local[0]), axis)
         (outs, _), _ = jax.lax.scan(
             tick, (outs0, act0), jnp.arange(ticks, dtype=jnp.int32)
         )

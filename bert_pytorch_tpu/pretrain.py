@@ -433,12 +433,18 @@ def make_train_step(
             )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        # grads carry the loss scale in fp16 mode; report the true norm
+        gnorm = (global_norm(grads) / scale if loss_scale
+                 else global_norm(grads))
         metrics = {
             "loss": jnp.mean(losses),
             "mlm_accuracy": jnp.mean(accs),
-            # grads carry the loss scale in fp16 mode; report the true norm
-            "grad_norm": (global_norm(grads) / scale if loss_scale
-                          else global_norm(grads)),
+            "grad_norm": gnorm,
+            # Failure sentinel (telemetry/sentinels.py): one scalar the host
+            # can fetch for free alongside the loss. isfinite(sum) catches a
+            # non-finite loss in ANY microbatch, not just the mean.
+            "finite": (jnp.isfinite(jnp.sum(losses))
+                       & jnp.isfinite(gnorm)).astype(jnp.float32),
         }
         if loss_scale:
             metrics["loss_scale"] = scale
@@ -658,10 +664,16 @@ def make_pp_train_step(
             )
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        gnorm = global_norm(grads)
         metrics = {
             "loss": loss,
             "mlm_accuracy": acc,
-            "grad_norm": global_norm(grads),
+            "grad_norm": gnorm,
+            # Failure sentinel (telemetry/sentinels.py), same contract as
+            # make_train_step: a NaN in any microbatch propagates into the
+            # mean loss, so isfinite(loss) covers them all.
+            "finite": (jnp.isfinite(loss)
+                       & jnp.isfinite(gnorm)).astype(jnp.float32),
         }
         if schedule is not None:
             metrics["learning_rate"] = schedule(opt_step_count(state.opt_state))

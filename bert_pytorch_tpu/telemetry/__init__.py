@@ -1,0 +1,41 @@
+"""Unified training telemetry (docs/telemetry.md).
+
+Step-time decomposition with device-sync discipline (step_timer), bounded
+``jax.profiler`` trace windows (profiler), compile/cache observability
+(compile_events), failure sentinels + heartbeat (sentinels), and the
+versioned JSONL record schema (schema). ``TrainTelemetry`` (runner) is the
+facade every training entry point threads its loop through.
+"""
+
+from bert_pytorch_tpu.telemetry.cli import (add_cli_args,
+                                            default_jsonl_path,
+                                            from_args)
+from bert_pytorch_tpu.telemetry.compile_events import (CompileMonitor,
+                                                       shapes_digest)
+from bert_pytorch_tpu.telemetry.profiler import (ProfilerWindow,
+                                                 parse_profile_spec)
+from bert_pytorch_tpu.telemetry.runner import TrainTelemetry
+from bert_pytorch_tpu.telemetry.schema import (SCHEMA_VERSION,
+                                               validate_file,
+                                               validate_record)
+from bert_pytorch_tpu.telemetry.sentinels import (FailureSentinel, Heartbeat,
+                                                  NonFiniteError)
+from bert_pytorch_tpu.telemetry.step_timer import StepTimer
+
+__all__ = [
+    "CompileMonitor",
+    "add_cli_args",
+    "default_jsonl_path",
+    "from_args",
+    "FailureSentinel",
+    "Heartbeat",
+    "NonFiniteError",
+    "ProfilerWindow",
+    "SCHEMA_VERSION",
+    "StepTimer",
+    "TrainTelemetry",
+    "parse_profile_spec",
+    "shapes_digest",
+    "validate_file",
+    "validate_record",
+]

@@ -1,0 +1,112 @@
+"""Shared telemetry CLI surface for the runners.
+
+Every runner exposes the same canonical flag set via :func:`add_cli_args`
+and builds its :class:`~bert_pytorch_tpu.telemetry.runner.TrainTelemetry`
+via :func:`from_args` — one copy of the flags, help text, and
+default-path fallbacks instead of five drifting ones. Per-runner knobs are
+constructor arguments (``window_default``: pretraining logs denser windows
+than the short finetune runs; ``sync_every_default``: runners whose loop
+already fetches the loss every step keep the full per-step decomposition,
+runners with an async hot loop sample it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def add_cli_args(parser, window_default: int = 50,
+                 sync_every_default: int = 4) -> None:
+    """Register the canonical telemetry flags (docs/telemetry.md)."""
+    parser.add_argument("--profile_steps", type=str, default="0",
+                        help="capture a JAX profiler trace: 'N' traces N "
+                             "steady-state steps (after the compile step), "
+                             "'N:M' traces the explicit step range [N, M). "
+                             "Auto-stops at the range end (or end of run). "
+                             "'0' disables (docs/telemetry.md)")
+    parser.add_argument("--profile_dir", type=str, default="",
+                        help="profiler trace output directory; default "
+                             "<output_dir>/profile")
+    parser.add_argument("--telemetry_jsonl", type=str, default="",
+                        help="JSONL telemetry sink path; default "
+                             "<output_dir>/<prefix>_telemetry.jsonl (no "
+                             "sink without an output dir)")
+    parser.add_argument("--telemetry_window", type=int,
+                        default=window_default,
+                        help="steps per telemetry window record "
+                             "(step-time percentiles + MFU)")
+    parser.add_argument("--telemetry_sync_every", type=int,
+                        default=sync_every_default,
+                        help="device-sync cadence for the step-time "
+                             "decomposer: 1 = block on every step's metrics "
+                             "(full data/host/device split, step-exact "
+                             "sentinel), N = sample every Nth step (each "
+                             "sync is a host<->device round trip; per-step "
+                             "blocking costs real throughput through a "
+                             "remote-TPU tunnel — bench.py docstring: "
+                             "~35%%), 0 = never sync (data/host only)")
+    parser.add_argument("--sentinel_policy", type=str, default="continue",
+                        choices=["continue", "abort"],
+                        help="non-finite loss/grad-norm policy: 'continue' "
+                             "logs a sentinel record per observed bad step; "
+                             "'abort' raises after --sentinel_patience "
+                             "consecutive observed bad steps")
+    parser.add_argument("--sentinel_patience", type=int, default=3,
+                        help="consecutive OBSERVED non-finite steps before "
+                             "'abort' raises (one scaler-recovered fp16 "
+                             "overflow step should not kill a run). The "
+                             "sentinel observes on the sync/log cadence, so "
+                             "detection lag scales with "
+                             "--telemetry_sync_every; pass 1 there for "
+                             "step-exact abort")
+    parser.add_argument("--heartbeat_file", type=str, default="",
+                        help="rank-0 liveness file (step/wallclock/"
+                             "last_loss/counter, atomically replaced); "
+                             "default <output_dir>/heartbeat.json. The "
+                             "capture harness reads it instead of guessing "
+                             "liveness from checkpoint mtimes")
+
+
+def default_jsonl_path(args, output_dir: Optional[str],
+                       prefix: str) -> Optional[str]:
+    """Resolve the JSONL sink path (None = no sink)."""
+    if args.telemetry_jsonl:
+        return args.telemetry_jsonl
+    if output_dir:
+        return os.path.join(output_dir, f"{prefix}_telemetry.jsonl")
+    return None
+
+
+def from_args(args, sink=None, is_primary: bool = True,
+              seq_per_step: Optional[int] = None,
+              flops_per_seq: Optional[float] = None,
+              output_dir: Optional[str] = None):
+    """Build a TrainTelemetry from the :func:`add_cli_args` namespace.
+
+    ``output_dir`` anchors the profile-dir / heartbeat fallbacks; without
+    one, traces go to ``./profile`` and the heartbeat is disabled unless
+    the flags name paths explicitly.
+    """
+    import jax
+
+    from bert_pytorch_tpu.telemetry.runner import TrainTelemetry
+
+    profile_dir = args.profile_dir or (
+        os.path.join(output_dir, "profile") if output_dir else "profile")
+    heartbeat = args.heartbeat_file or (
+        os.path.join(output_dir, "heartbeat.json") if output_dir else None)
+    return TrainTelemetry(
+        sink=sink,
+        is_primary=is_primary,
+        window=args.telemetry_window,
+        sync_every=args.telemetry_sync_every,
+        seq_per_step=seq_per_step,
+        flops_per_seq=flops_per_seq,
+        device_kind=jax.devices()[0].device_kind,
+        n_devices=jax.device_count(),
+        profile_steps=args.profile_steps,
+        profile_dir=profile_dir,
+        sentinel_policy=args.sentinel_policy,
+        sentinel_patience=args.sentinel_patience,
+        heartbeat_path=heartbeat)

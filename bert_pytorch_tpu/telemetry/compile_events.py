@@ -1,0 +1,193 @@
+"""Compile/cache observability: every XLA compile becomes a telemetry record.
+
+Cold-vs-warm ambiguity burned rounds 1-3 (a 10-30 min BERT-large compile
+through the TPU tunnel is indistinguishable from a hang in a flat seq/s
+log). This module makes compilation explicit: a :class:`CompileMonitor`
+wraps each jitted entry point, and JAX's ``jax.monitoring`` events — which
+``utils/compile_cache.py`` taps via :func:`install_compile_listeners` —
+attribute every backend compile and persistent-cache hit/miss to the
+wrapped function and the argument-shapes digest that triggered it.
+
+Emitted record (``kind="compile"``, schema.py)::
+
+    {"kind": "compile", "fn": "train_step", "shapes_digest": "ab12…",
+     "compile_s": 12.31, "backend_compile_s": 11.90, "cache": "miss"}
+
+``cache`` is one of:
+
+* ``"hit"``  — served from the persistent compile cache (warm start);
+* ``"miss"`` — a real XLA compile ran and the executable was persisted to
+  the cache;
+* ``"uncached"`` — a real compile that was NOT persisted: the persistent
+  cache is disabled, or the compile was cheaper than the
+  min-compile-time/min-entry-size persistence bars (jax fires the miss
+  counter only when it writes the entry, so a below-the-bar compile is
+  indistinguishable from a disabled cache — both mean "next run recompiles
+  this");
+* ``"jit"``  — no compile activity at all for a first-seen shapes digest
+  (served by JAX's in-process executable cache, e.g. a re-jit of an
+  identical program).
+
+Attribution uses a per-thread current-call context: jit tracing and
+compilation run synchronously on the calling thread, so events fired while
+the wrapper is on-stack belong to it. Listener registration is global and
+permanent (jax.monitoring has no unregister), so listeners are installed
+once and route through a module-level active-monitor registry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Optional
+
+from bert_pytorch_tpu.utils import compile_cache as compile_cache_util
+
+_BACKEND_COMPILE_EVENTS = (
+    "/jax/core/compile/backend_compile_duration",
+    # older/newer spellings kept for forward compatibility
+    "/jax/backend_compile_duration",
+)
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_tls = threading.local()
+
+
+def _current_call():
+    return getattr(_tls, "call", None)
+
+
+def _on_duration(event: str, duration_secs: float, **_kw) -> None:
+    call = _current_call()
+    if call is None:
+        return
+    if event in _BACKEND_COMPILE_EVENTS:
+        call["backend_compile_s"] += float(duration_secs)
+        call["compiled"] = True
+
+
+def _on_event(event: str, **_kw) -> None:
+    call = _current_call()
+    if call is None:
+        return
+    if event == _CACHE_HIT_EVENT:
+        call["cache_hits"] += 1
+    elif event == _CACHE_MISS_EVENT:
+        call["cache_misses"] += 1
+
+
+_install_lock = threading.Lock()
+_installed = False
+
+
+def _ensure_listeners() -> None:
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        compile_cache_util.install_compile_listeners(_on_event, _on_duration)
+        _installed = True
+
+
+def shapes_digest(tree) -> str:
+    """Stable digest of the arg tree's structure + shapes/dtypes — the
+    compile-relevant signature of a call (values don't recompile; shapes,
+    dtypes, and tree structure do)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None:
+            parts.append(f"py:{type(leaf).__name__}:{leaf!r}")
+        else:
+            parts.append(f"{dtype}{tuple(shape)}")
+    return hashlib.sha1("|".join(parts).encode()).hexdigest()[:12]
+
+
+class CompileMonitor:
+    """Wrap jitted callables; emit one record per observed compile/lookup.
+
+    ``emit`` receives the record dict; a ``clock`` is injectable for tests.
+    """
+
+    def __init__(self, emit: Callable[[dict], None],
+                 clock: Callable[[], float] = time.perf_counter):
+        _ensure_listeners()
+        self._emit = emit
+        self._clock = clock
+        self.events: list = []  # everything emitted, for programmatic access
+
+    def instrument(self, fn, name: str):
+        """Return ``fn`` wrapped so first-seen shape signatures (and any
+        call during which compile activity fires) emit a compile record.
+
+        The digest walks the FULL arg tree (params + optimizer state for a
+        train step — hundreds of leaves), so it is computed only when a
+        record might be emitted: on the wrapper's first call, or when
+        compile/cache activity actually fired during the call (a new shape
+        signature always triggers a real trace+compile, so it can't slip
+        by). Steady-state calls — the ones inside bench.py's measured
+        window and the StepTimer's host-dispatch segment — add only a
+        thread-local set/restore and two clock reads.
+        """
+        seen: set = set()
+
+        def wrapper(*args, **kwargs):
+            prev = _current_call()
+            call = {"backend_compile_s": 0.0, "compiled": False,
+                    "cache_hits": 0, "cache_misses": 0}
+            _tls.call = call
+            t0 = self._clock()
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                _tls.call = prev
+            elapsed = self._clock() - t0
+            activity = (call["compiled"] or call["cache_hits"]
+                        or call["cache_misses"])
+            if activity or not seen:
+                # Donated args are deleted by now, but aval metadata
+                # (shape/dtype) stays readable — only data access raises.
+                digest = shapes_digest((args, kwargs))
+                first = digest not in seen
+                seen.add(digest)
+                if first or activity:
+                    self._record(name, digest, elapsed, call)
+            return out
+
+        wrapper.__name__ = f"{name}_monitored"
+        return wrapper
+
+    def _record(self, name, digest, elapsed, call) -> None:
+        # The persistent-cache counter events are authoritative: every
+        # lookup fires exactly one hit or miss for the MAIN program, while
+        # backend_compile_duration also fires for tiny auxiliary modules
+        # (constant conversions) even on a cache-hit call — so `compiled`
+        # alone cannot distinguish warm from cold.
+        if call["cache_misses"]:
+            cache = "miss"
+        elif call["cache_hits"]:
+            cache = "hit"
+        elif call["compiled"]:
+            cache = "uncached"
+        else:
+            cache = "jit"
+        record = {
+            "kind": "compile",
+            "tag": "telemetry",
+            "fn": name,
+            "shapes_digest": digest,
+            # dispatch wall time of the call that compiled: trace + lower +
+            # backend compile (+ the async enqueue, which is noise at
+            # compile timescales)
+            "compile_s": round(elapsed, 4),
+            "backend_compile_s": round(call["backend_compile_s"], 4),
+            "cache": cache,
+        }
+        self.events.append(record)
+        self._emit(record)

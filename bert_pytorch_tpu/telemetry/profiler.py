@@ -1,0 +1,101 @@
+"""Bounded ``jax.profiler`` trace windows for the training loop.
+
+``--profile_steps`` accepts either ``"N"`` (legacy: N steady-state steps
+starting after the compile step, i.e. the window ``[2, 2+N)`` in
+step-in-run terms) or ``"N:M"`` (explicit half-open step range). The window
+auto-stops: when the range's last step completes — or the run ends inside
+the window — the trace is synced (``block_until_ready`` on the step's
+outputs, so the trace holds the full device work) and written.
+
+While a trace is active each step is wrapped in
+``jax.profiler.StepTraceAnnotation``, which makes XLA's trace viewer group
+events per training step.
+
+On TPU the trace contains device (XLA op) timelines; on CPU it degrades to
+host tracing only — both are readable with TensorBoard's profile plugin or
+xprof. See docs/telemetry.md for the workflow.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Tuple
+
+
+def parse_profile_spec(spec) -> Optional[Tuple[int, int]]:
+    """``"N"``/``N`` -> (2, 2+N) steady-state window; ``"N:M"`` -> (N, M);
+    falsy / "0" -> None (disabled). Raises ValueError on malformed specs."""
+    if spec is None:
+        return None
+    if isinstance(spec, int):
+        return (2, 2 + spec) if spec > 0 else None
+    text = str(spec).strip()
+    if not text:
+        return None
+    if ":" in text:
+        start_s, stop_s = text.split(":", 1)
+        start, stop = int(start_s), int(stop_s)
+        if start < 1 or stop <= start:
+            raise ValueError(
+                f"--profile_steps range must satisfy 1 <= N < M, got {text!r}")
+        return (start, stop)
+    n = int(text)
+    return (2, 2 + n) if n > 0 else None
+
+
+class ProfilerWindow:
+    """Drives one bounded trace window from per-step calls.
+
+    ``enabled`` gates everything (non-primary processes pass False: traces
+    are per-host artifacts and rank 0's is the one the tooling reads).
+    """
+
+    def __init__(self, spec, trace_dir: Optional[str],
+                 enabled: bool = True, annotate: bool = True):
+        self.range = parse_profile_spec(spec) if enabled else None
+        self.trace_dir = trace_dir
+        self.annotate = annotate
+        self.active = False
+        self.done = False
+
+    def maybe_start(self, step_in_run: int) -> bool:
+        """Start the trace when ``step_in_run`` enters the window."""
+        if (self.range is None or self.active or self.done
+                or step_in_run < self.range[0]
+                or step_in_run >= self.range[1]):
+            return False
+        import jax
+
+        jax.profiler.start_trace(self.trace_dir)
+        self.active = True
+        return True
+
+    def annotation(self, step_in_run: int):
+        """Context manager wrapping one step's dispatch."""
+        if self.active and self.annotate:
+            import jax
+
+            return jax.profiler.StepTraceAnnotation(
+                "train", step_num=step_in_run)
+        return contextlib.nullcontext()
+
+    def maybe_stop(self, step_in_run: int, sync_target=None) -> bool:
+        """Stop when the window's last step completed (auto-stop)."""
+        if not self.active or step_in_run < self.range[1] - 1:
+            return False
+        return self.stop(sync_target)
+
+    def stop(self, sync_target=None) -> bool:
+        """Unconditional stop (end of run inside the window)."""
+        if not self.active:
+            return False
+        import jax
+
+        if sync_target is not None:
+            # The trace must hold the device work of every step in the
+            # window, not just their dispatches.
+            jax.block_until_ready(sync_target)
+        jax.profiler.stop_trace()
+        self.active = False
+        self.done = True
+        return True

@@ -1,0 +1,193 @@
+"""TrainTelemetry — the facade every runner threads its training loop
+through (run_pretraining, run_squad, run_glue, run_ner, run_swag, bench.py).
+
+One object owns the five telemetry pieces and their lifecycle:
+
+* a JSONL sink (``utils/logging.py JSONLHandler``) — registered with the
+  global logger by the runner so ordinary train records land there too,
+  while telemetry records go ONLY there (the CSV/stream sinks stay clean);
+* a :class:`~bert_pytorch_tpu.telemetry.step_timer.StepTimer` for the
+  data-wait / host-dispatch / device-compute decomposition + MFU windows;
+* a :class:`~bert_pytorch_tpu.telemetry.profiler.ProfilerWindow` for
+  bounded ``jax.profiler`` traces with per-step annotations;
+* a :class:`~bert_pytorch_tpu.telemetry.compile_events.CompileMonitor`
+  (``instrument()``) attributing every XLA compile / cache hit to the
+  jitted entry point and shapes digest that triggered it;
+* a :class:`~bert_pytorch_tpu.telemetry.sentinels.FailureSentinel` and
+  rank-0 :class:`~bert_pytorch_tpu.telemetry.sentinels.Heartbeat`.
+
+Minimal loop integration::
+
+    tele = TrainTelemetry(jsonl_path=..., heartbeat_path=..., ...)
+    train_step = tele.instrument(train_step, "train_step")
+    for batch in tele.timed(iter(loader)):        # measures data_wait
+        tele.profiler.maybe_start(step)
+        with tele.profiler.annotation(step):
+            state, metrics = train_step(state, batch)
+        tele.dispatch_done()                      # measures host dispatch
+        tele.step_done(step, metrics)             # sync + window + sentinel
+                                                  # + heartbeat + auto-stop
+    tele.finish(step)                             # flush partial window
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable, Iterator, Optional
+
+from bert_pytorch_tpu.telemetry.compile_events import CompileMonitor
+from bert_pytorch_tpu.telemetry.profiler import ProfilerWindow
+from bert_pytorch_tpu.telemetry.sentinels import FailureSentinel, Heartbeat
+from bert_pytorch_tpu.telemetry.step_timer import StepTimer
+from bert_pytorch_tpu.utils import logging as logging_util
+
+
+class TrainTelemetry:
+    def __init__(
+        self,
+        jsonl_path: Optional[str] = None,
+        sink=None,
+        is_primary: bool = True,
+        window: int = 20,
+        sync_every: int = 1,
+        seq_per_step: Optional[int] = None,
+        flops_per_seq: Optional[float] = None,
+        device_kind: str = "",
+        n_devices: int = 1,
+        profile_steps=None,
+        profile_dir: Optional[str] = None,
+        sentinel_policy: str = "continue",
+        sentinel_patience: int = 3,
+        heartbeat_path: Optional[str] = None,
+        heartbeat_every: int = 1,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        self.is_primary = is_primary
+        # Rank-0 writes the artifacts; other ranks keep a disabled sink so
+        # the loop code is rank-agnostic. An already-open handler can be
+        # shared in via ``sink`` (the runners register the same handler
+        # with the global logger so train records land in the JSONL too).
+        if sink is not None:
+            self.sink = sink
+        else:
+            self.sink = logging_util.JSONLHandler(
+                jsonl_path, is_primary=is_primary) if jsonl_path else None
+        self.timer = StepTimer(
+            window=window, sync_every=sync_every, clock=clock,
+            seq_per_step=seq_per_step, flops_per_seq=flops_per_seq,
+            device_kind=device_kind, n_devices=n_devices)
+        self.profiler = ProfilerWindow(
+            profile_steps, profile_dir, enabled=is_primary)
+        self.compile_monitor = CompileMonitor(emit=self.emit)
+        self.sentinel = FailureSentinel(
+            policy=sentinel_policy, patience=sentinel_patience,
+            emit=self.emit)
+        self.heartbeat = Heartbeat(heartbeat_path, is_primary=is_primary)
+        self.heartbeat_every = max(1, int(heartbeat_every))
+        self._loader_stats: Optional[Callable[[], Optional[dict]]] = None
+        self._last_sync_target = None
+        self.last_step_synced = False
+
+    # -- wiring ---------------------------------------------------------
+
+    def emit(self, record=None, **kwargs) -> None:
+        """Write one telemetry record to the JSONL sink (only)."""
+        rec = dict(record or {})
+        rec.update(kwargs)
+        if self.sink is not None:
+            self.sink.write_record(rec)
+
+    def instrument(self, fn, name: str):
+        """Wrap a jitted callable for compile-event attribution."""
+        return self.compile_monitor.instrument(fn, name)
+
+    def attach_loader(self, loader) -> None:
+        """Use ``loader.snapshot()`` gauges in each window record."""
+        snapshot = getattr(loader, "snapshot", None)
+        if callable(snapshot):
+            self._loader_stats = snapshot
+
+    # -- per-step protocol ----------------------------------------------
+
+    def timed(self, iterator: Iterator) -> Iterator:
+        """Wrap the batch iterator so host time blocked on the input
+        pipeline is measured as data_wait."""
+        while True:
+            self.timer.data_start()
+            try:
+                item = next(iterator)
+            except StopIteration:
+                return
+            self.timer.data_end()
+            yield item
+
+    def dispatch_done(self) -> None:
+        self.timer.dispatch_end()
+
+    def step_done(self, step: int, metrics: Optional[dict] = None,
+                  sync_target=None, force_sync: bool = False,
+                  profile_step: Optional[int] = None) -> Optional[dict]:
+        """Close out one step: device sync (per the cadence), sentinel
+        check, heartbeat, profiler auto-stop, window emission.
+
+        ``metrics`` is the step's device metrics dict (used as the sync
+        target and the source of the ``finite``/``loss`` scalars);
+        ``sync_target`` overrides it. ``profile_step`` is the step number in
+        the SAME base the runner feeds ``profiler.maybe_start`` — pass it
+        when that base differs from ``step`` (run_pretraining profiles in
+        step-in-run terms while ``step`` is the checkpoint-resumed global
+        step; without it a resumed run would close the trace window
+        immediately). Returns the window record when one was emitted.
+        """
+        target = sync_target if sync_target is not None else metrics
+        self._last_sync_target = target
+        synced = False
+        if target is not None and (self.timer.should_sync() or force_sync):
+            self.timer.device_sync(target)
+            synced = True
+        self.last_step_synced = synced
+        if metrics is not None and synced:
+            loss = metrics.get("loss")
+            loss = None if loss is None else float(loss)
+            finite = metrics.get("finite")
+            if finite is not None:
+                finite = float(finite)
+            else:
+                # No in-jit sentinel (the finetune runners): fall back to a
+                # host-side isfinite on the fetched loss.
+                finite = 1.0 if (loss is None or math.isfinite(loss)) else 0.0
+            self.sentinel.observe(step, finite, loss)
+            if self.timer._step_index % self.heartbeat_every == 0:
+                self.heartbeat.beat(step, last_loss=loss)
+        self.profiler.maybe_stop(
+            step if profile_step is None else profile_step,
+            sync_target=target)
+        window = self.timer.step_done(step)
+        if window is not None:
+            if self._loader_stats is not None:
+                gauges = self._loader_stats()
+                if gauges:
+                    window["loader"] = gauges
+            self.emit(window)
+        return window
+
+    # -- teardown -------------------------------------------------------
+
+    def finish(self, step: int, summary: Optional[dict] = None) -> None:
+        """End of run: stop a still-open trace, flush the partial window,
+        final heartbeat, optional run summary record."""
+        self.profiler.stop(sync_target=self._last_sync_target)
+        window = self.timer.flush(step)
+        if window is not None:
+            self.emit(window)
+        if summary is not None:
+            rec = {"kind": "run_summary", "tag": "telemetry", "step": step,
+                   "steps": step}
+            rec.update(summary)
+            self.emit(rec)
+        self.heartbeat.beat(step)
+
+    def close(self) -> None:
+        if self.sink is not None:
+            self.sink.close()

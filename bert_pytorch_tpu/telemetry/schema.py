@@ -1,0 +1,130 @@
+"""Versioned record schema for the telemetry JSONL stream.
+
+Every record the :class:`bert_pytorch_tpu.utils.logging.JSONLHandler` writes
+carries ``schema`` (this module's ``SCHEMA_VERSION``) and ``ts`` (unix
+seconds). Telemetry-layer records additionally carry ``kind``, which selects
+the per-kind required-key set below; runner metric records (tag/step/loss…)
+have no ``kind`` and only the universal rules apply.
+
+Universal rules, lintable offline (``tools/check_telemetry_schema.py``):
+
+* one JSON object per line — no arrays, no trailing prose;
+* no NaN/Infinity spellings (non-finite floats are written as ``null``);
+* a ``schema`` value other than a known version is an error (consumers
+  must be able to dispatch on it).
+
+Legacy artifacts (the ``*_r0*.jsonl`` bench files committed before this
+schema existed) carry no ``schema`` key; the lint holds them to the
+universal rules only, so history stays green while every NEW stream is
+strictly validated. Bump ``SCHEMA_VERSION`` when a kind's required keys
+change incompatibly; consumers dispatch on the per-record value.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+SCHEMA_VERSION = 1
+KNOWN_VERSIONS = (1,)
+
+# Per-kind required keys (beyond the universal schema/ts). Extra keys are
+# always allowed — the schema pins the floor consumers can rely on, not the
+# ceiling.
+KIND_REQUIRED_KEYS = {
+    # windowed step-time decomposition (telemetry/step_timer.py)
+    "step_window": (
+        "step", "window_steps",
+        "data_wait_p50_s", "data_wait_p95_s", "data_wait_max_s",
+        "host_p50_s", "host_p95_s", "host_max_s",
+        "device_p50_s", "device_p95_s", "device_max_s",
+        "step_p50_s", "steps_per_sec", "mfu",
+    ),
+    # one compile (or compile-cache lookup) of a jitted function
+    # (telemetry/compile_events.py)
+    "compile": ("fn", "shapes_digest", "compile_s", "cache"),
+    # non-finite loss/grad-norm observation (telemetry/sentinels.py)
+    "sentinel": ("step", "finite", "consecutive_nonfinite", "policy"),
+    # end-of-run rollup
+    "run_summary": ("steps",),
+}
+
+# Host input-pipeline gauges (data/loader.py snapshot) ride INSIDE a
+# step_window record as its "loader" sub-object — they are not a standalone
+# record kind.
+LOADER_REQUIRED_KEYS = ("batches", "wait_s_total", "stalls", "depth_max")
+
+_NONFINITE_SPELLINGS = ("NaN", "Infinity", "-Infinity")
+
+
+def validate_record(rec) -> list:
+    """Schema errors for one decoded record (empty list = valid)."""
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    errors = []
+    if "schema" in rec:
+        if rec["schema"] not in KNOWN_VERSIONS:
+            errors.append(f"unknown schema version {rec['schema']!r}")
+        kind = rec.get("kind")
+        if kind is not None:
+            required = KIND_REQUIRED_KEYS.get(kind)
+            if required is None:
+                errors.append(f"unknown record kind {kind!r}")
+            else:
+                missing = [k for k in required if k not in rec]
+                if missing:
+                    errors.append(f"kind {kind!r} missing keys {missing}")
+                if kind == "step_window" and isinstance(
+                        rec.get("loader"), dict):
+                    gauges = rec["loader"]
+                    missing = [k for k in LOADER_REQUIRED_KEYS
+                               if k not in gauges]
+                    if missing:
+                        errors.append(
+                            f"loader gauges missing keys {missing}")
+    for key, value in rec.items():
+        if isinstance(value, float) and not math.isfinite(value):
+            errors.append(f"non-finite value for {key!r}")
+    return errors
+
+
+def validate_line(line: str) -> list:
+    """Schema errors for one raw JSONL line (empty list = valid)."""
+    stripped = line.strip()
+    if not stripped:
+        return []  # blank lines tolerated (trailing newline etc.)
+    for spelling in _NONFINITE_SPELLINGS:
+        # json.loads accepts these non-standard spellings; downstream
+        # strict parsers (jq, pandas with precise_float, other languages)
+        # do not — reject them at the source.
+        if spelling in stripped:
+            try:
+                json.loads(stripped, parse_constant=_reject_constant)
+            except _NonFiniteConstant:
+                return [f"non-finite JSON constant in line"]
+            except ValueError:
+                break  # fall through to the normal parse error below
+            break
+    try:
+        rec = json.loads(stripped)
+    except ValueError as exc:
+        return [f"not valid JSON: {exc}"]
+    return validate_record(rec)
+
+
+class _NonFiniteConstant(ValueError):
+    pass
+
+
+def _reject_constant(name):
+    raise _NonFiniteConstant(name)
+
+
+def validate_file(path: str) -> list:
+    """(line_number, error) pairs for a JSONL file; empty list = valid."""
+    errors = []
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            for err in validate_line(line):
+                errors.append((lineno, err))
+    return errors

@@ -1,0 +1,128 @@
+"""Failure sentinels: non-finite detection policy + a liveness heartbeat.
+
+The in-jit half lives in the train step (pretrain.make_train_step emits
+``metrics["finite"]`` — an ``isfinite`` reduction over the step's losses and
+global grad-norm, one scalar, free to fetch alongside the loss). This module
+is the host half: the policy applied to that scalar, and the heartbeat file
+the capture harness reads instead of guessing liveness from checkpoint
+mtimes (scripts/retry_capture_r04.sh).
+
+K-FAC HBM overflows and fp16 overflows in rounds 2-4 surfaced as NaN losses
+that kept training silently for hundreds of steps before anyone looked at
+the CSV. ``policy="abort"`` turns that into a loud, bounded failure:
+``patience`` CONSECUTIVE observed non-finite steps raise
+:class:`NonFiniteError` (one bad step recovered by the fp16 loss-scaler
+backoff does not kill the run; a divergence does). ``policy="continue"``
+(default) logs a sentinel record per observed bad step and keeps going —
+the reference's implicit behavior, now at least visible in the artifacts.
+
+Observation cadence: fetching the finite scalar is a device sync, so the
+sentinel sees a step only when the runner synced it — every
+``--telemetry_sync_every``-th step plus every log step. A sampled cadence
+stretches detection accordingly (patience 3 at cadence 4 aborts within
+~12 steps of a hard divergence, not 3); runs that want step-exact abort
+pass ``--telemetry_sync_every 1``. A NaN burst shorter than the cadence
+that the loss-scaler recovers in between can go entirely unobserved —
+which is also why patience counts OBSERVED consecutive bad steps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional
+
+
+class NonFiniteError(RuntimeError):
+    """Raised by the abort policy after ``patience`` consecutive bad steps."""
+
+
+class FailureSentinel:
+    POLICIES = ("continue", "abort")
+
+    def __init__(self, policy: str = "continue", patience: int = 3,
+                 emit: Optional[Callable[[dict], None]] = None):
+        if policy not in self.POLICIES:
+            raise ValueError(
+                f"sentinel policy must be one of {self.POLICIES}, got "
+                f"{policy!r}")
+        self.policy = policy
+        self.patience = max(1, int(patience))
+        self._emit = emit
+        self.consecutive = 0
+        self.total_nonfinite = 0
+
+    def observe(self, step: int, finite, loss=None) -> bool:
+        """Feed one step's finite flag (truthy = healthy). Returns True when
+        healthy; emits a sentinel record and applies the policy otherwise."""
+        if bool(finite):
+            self.consecutive = 0
+            return True
+        self.consecutive += 1
+        self.total_nonfinite += 1
+        record = {
+            "kind": "sentinel",
+            "tag": "telemetry",
+            "step": int(step),
+            "finite": 0,
+            "loss": None if loss is None else float(loss),
+            "consecutive_nonfinite": self.consecutive,
+            "policy": self.policy,
+        }
+        if self._emit is not None:
+            self._emit(record)
+        if self.policy == "abort" and self.consecutive >= self.patience:
+            raise NonFiniteError(
+                f"non-finite loss/grad-norm for {self.consecutive} "
+                f"consecutive steps (last step {step}); aborting per "
+                f"--sentinel_policy abort")
+        return False
+
+
+class Heartbeat:
+    """Rank-0 liveness file: ``{"step", "wallclock", "last_loss",
+    "counter"}``, written atomically (tmp + rename) so a reader never sees
+    a torn record. ``counter`` increments monotonically per beat — a
+    restarted run resumes it from the file, so "is this process alive"
+    is simply "did counter advance between two reads"."""
+
+    def __init__(self, path: Optional[str], is_primary: bool = True,
+                 clock: Callable[[], float] = time.time):
+        self.path = path if is_primary else None
+        self._clock = clock
+        self.counter = 0
+        self._last_loss = None
+        if self.path:
+            previous = self.read(self.path)
+            if previous:
+                self.counter = int(previous.get("counter", 0))
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                        exist_ok=True)
+
+    def beat(self, step: int, last_loss=None) -> None:
+        if not self.path:
+            return
+        self.counter += 1
+        if last_loss is not None:
+            self._last_loss = float(last_loss)
+        payload = {
+            "step": int(step),
+            "wallclock": round(self._clock(), 3),
+            "last_loss": self._last_loss,
+            "counter": self.counter,
+        }
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.path)
+
+    @staticmethod
+    def read(path: str) -> Optional[dict]:
+        """Parse a heartbeat file; None when absent/torn (callers treat
+        both as 'no evidence of liveness')."""
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None

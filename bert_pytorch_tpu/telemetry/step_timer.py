@@ -1,0 +1,207 @@
+"""Step-time decomposition with correct device-sync discipline.
+
+Under JAX's async dispatch the wall time of ``train_step(...)`` is only the
+HOST cost of building and enqueueing the program; the device executes in the
+background and the next blocking operation (a metrics fetch, the next
+``device_put``) absorbs the device time. Naive timing therefore conflates
+three very different bottlenecks. The :class:`StepTimer` splits each step:
+
+* ``data_wait`` — host blocked on the input pipeline (loader + prefetch);
+* ``host`` — dispatch: trace/lower lookup + enqueue (compile lands here on
+  step 0, which is why window records also carry ``max``, not just p50);
+* ``device`` — dispatch-return until ``jax.block_until_ready`` on the
+  step's metrics completes. Correct only when the caller syncs, so the
+  timer owns the sync (:meth:`device_sync`) and records device time ONLY
+  for synced steps.
+
+Per-step syncing costs one host<->device round trip (measured ~35% reported
+throughput loss through a remote-TPU tunnel — bench.py docstring), so the
+sync cadence is a knob: ``sync_every=1`` gives the full decomposition,
+``sync_every=N`` samples every Nth step and the unsynced steps contribute
+data/host times only (``synced_steps`` in the record says how many device
+samples a window holds). At ``N>1`` each device sample is the residual
+BACKLOG at the sync point — the device work of the unsynced steps queued
+since the previous sync, minus whatever overlapped host time — so the
+``device_*`` percentiles then characterise sync tails, not single steps.
+
+Every ``window`` steps :meth:`step_done` returns one ``kind="step_window"``
+record (schema.py) with p50/p95/max per component and MFU. ``mfu_basis``
+says how MFU was computed: ``"device"`` (from measured device seconds — the
+hardware-normalised number that does not move when the input pipeline
+stalls) when every step in the window was synced, ``"wall"`` (window FLOPs
+over window wall time, the conventional definition) otherwise — dividing
+per-step FLOPs by a multi-step backlog interval would deflate MFU by
+roughly the sync cadence.
+
+The clock is injectable for tests (``clock=fake``); the timer never calls
+into JAX except through the ``sync`` callable handed to it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from bert_pytorch_tpu.utils import flops as flops_util
+
+
+def _percentile(sorted_vals: list, frac: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(frac * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def _stats(vals: list, prefix: str) -> dict:
+    s = sorted(vals)
+    return {
+        f"{prefix}_p50_s": round(_percentile(s, 0.50), 6),
+        f"{prefix}_p95_s": round(_percentile(s, 0.95), 6),
+        f"{prefix}_max_s": round(s[-1] if s else 0.0, 6),
+    }
+
+
+class StepTimer:
+    def __init__(
+        self,
+        window: int = 20,
+        sync_every: int = 1,
+        clock: Callable[[], float] = time.perf_counter,
+        seq_per_step: Optional[int] = None,
+        flops_per_seq: Optional[float] = None,
+        device_kind: str = "",
+        n_devices: int = 1,
+    ):
+        self.window = max(1, int(window))
+        self.sync_every = max(0, int(sync_every))  # 0 = never sync
+        self._clock = clock
+        self.seq_per_step = seq_per_step
+        self.flops_per_seq = flops_per_seq
+        self.device_kind = device_kind
+        self.n_devices = max(1, int(n_devices))
+        self._step_index = 0
+        self._reset_window()
+        self._t_data0 = self._t_data1 = self._t_dispatch1 = None
+        self._t_device1 = None
+
+    def _reset_window(self):
+        self._data_waits: list = []
+        self._hosts: list = []
+        self._devices: list = []
+        self._steps: list = []
+        self._window_t0 = None
+
+    # -- per-step marks, in order --------------------------------------
+
+    def data_start(self) -> None:
+        self._t_data0 = self._clock()
+        if self._window_t0 is None:
+            self._window_t0 = self._t_data0
+
+    def data_end(self) -> None:
+        self._t_data1 = self._clock()
+
+    def dispatch_end(self) -> None:
+        self._t_dispatch1 = self._clock()
+
+    def should_sync(self) -> bool:
+        if self.sync_every == 0:
+            return False
+        return self._step_index % self.sync_every == 0
+
+    def device_sync(self, sync_target) -> bool:
+        """Block until the step's outputs are ready and record the device
+        tail. Call after :meth:`dispatch_end`, only when :meth:`should_sync`
+        (the caller may also force a sync, e.g. on log steps)."""
+        import jax
+
+        jax.block_until_ready(sync_target)
+        self._t_device1 = self._clock()
+        return True
+
+    def step_done(self, step: int) -> Optional[dict]:
+        """Finish the step; every ``window`` steps return the window record.
+
+        Monotonic by construction: each component is a difference of
+        successive clock reads, so components are non-negative and their
+        sum never exceeds the step's total wall time.
+        """
+        if self._t_data0 is None or self._t_data1 is None:
+            return None  # marks were skipped (e.g. epoch boundary)
+        self._data_waits.append(max(0.0, self._t_data1 - self._t_data0))
+        if self._t_dispatch1 is not None:
+            self._hosts.append(max(0.0, self._t_dispatch1 - self._t_data1))
+            if self._t_device1 is not None and \
+                    self._t_device1 >= self._t_dispatch1:
+                self._devices.append(self._t_device1 - self._t_dispatch1)
+        end = self._t_device1 if self._t_device1 is not None \
+            else (self._t_dispatch1 if self._t_dispatch1 is not None
+                  else self._t_data1)
+        self._steps.append(max(0.0, end - self._t_data0))
+        self._t_data0 = self._t_data1 = self._t_dispatch1 = None
+        self._t_device1 = None
+        self._step_index += 1
+
+        if len(self._steps) < self.window:
+            return None
+        record = self._window_record(step, end)
+        self._reset_window()
+        return record
+
+    def flush(self, step: int) -> Optional[dict]:
+        """Emit a final partial-window record (end of run)."""
+        if not self._steps:
+            return None
+        record = self._window_record(step, None)
+        self._reset_window()
+        return record
+
+    # -- window rollup --------------------------------------------------
+
+    def _window_record(self, step: int, window_end) -> dict:
+        n = len(self._steps)
+        wall = ((window_end - self._window_t0)
+                if (window_end is not None and self._window_t0 is not None)
+                else sum(self._steps)) or 1e-9
+        record = {
+            "kind": "step_window",
+            "tag": "telemetry",
+            "step": step,
+            "window_steps": n,
+            "synced_steps": len(self._devices),
+            "steps_per_sec": round(n / wall, 4),
+        }
+        record.update(_stats(self._data_waits, "data_wait"))
+        record.update(_stats(self._hosts, "host"))
+        record.update(_stats(self._devices, "device"))
+        record.update(_stats(self._steps, "step"))
+        record["mfu"], record["mfu_basis"] = self._window_mfu(wall, n)
+        if self.seq_per_step:
+            record["seq_per_sec"] = round(self.seq_per_step * n / wall, 2)
+        return record
+
+    def _window_mfu(self, wall: float, n_steps: int):
+        """(mfu, basis). Device basis — window FLOPs over the peak FLOPs
+        the chips could have delivered in the measured DEVICE seconds —
+        only when EVERY step was synced; with a sampled cadence each device
+        interval is a multi-step backlog, which would deflate device-basis
+        MFU by ~the cadence, so the window falls back to wall basis (FLOPs
+        over window wall time, the conventional definition). 0.0 when the
+        device kind has no known peak (CPU)."""
+        if not self.seq_per_step or not self.flops_per_seq:
+            return 0.0, "none"
+        if self._devices and len(self._devices) == n_steps:
+            device_s = sum(self._devices)
+            if device_s <= 0:
+                return 0.0, "device"
+            per_chip = (self.seq_per_step * n_steps / device_s
+                        / self.n_devices)
+            basis = "device"
+        else:
+            if wall <= 0:
+                return 0.0, "wall"
+            per_chip = self.seq_per_step * n_steps / wall / self.n_devices
+            basis = "wall"
+        return round(flops_util.mfu(
+            per_chip, self.flops_per_seq, self.device_kind), 4), basis

@@ -6,6 +6,14 @@ reuses the cached executables instead of recompiling — minutes for BERT-large.
 failures (unwritable directory, unsupported backend) surface later as buried
 warnings, so the directory is validated up front to make failures visible at
 startup.
+
+This module is also the tap point for compile OBSERVABILITY
+(:mod:`bert_pytorch_tpu.telemetry.compile_events`):
+:func:`install_compile_listeners` registers ``jax.monitoring`` listeners so
+every backend compile duration and persistent-cache hit/miss event reaches
+the telemetry layer, which attributes them to the jitted function and shape
+signature that triggered them — cold-vs-warm is always distinguishable in
+the artifacts.
 """
 
 from __future__ import annotations
@@ -38,4 +46,34 @@ def enable_compile_cache(cache_dir: str) -> bool:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update(
         "jax_persistent_cache_min_compile_time_secs", MIN_COMPILE_TIME_SECS)
+    # jax latches cache-enablement at the first compile of the process
+    # (_cache_used): if anything compiled before this call — a warmup probe,
+    # an eager op that triggered jit — the new cache dir would be silently
+    # ignored for the rest of the process. Reset the latch so it re-reads
+    # the config.
+    from jax._src import compilation_cache as _cc
+
+    _cc.reset_cache()
     return True
+
+
+def cache_enabled() -> bool:
+    """True when a persistent compilation cache directory is configured."""
+    import jax
+
+    return bool(jax.config.jax_compilation_cache_dir)
+
+
+def install_compile_listeners(event_cb, duration_cb) -> None:
+    """Register ``jax.monitoring`` listeners for compile observability.
+
+    ``event_cb(event, **kw)`` receives counter events (persistent-cache
+    hits/misses: ``/jax/compilation_cache/cache_hits`` / ``cache_misses``);
+    ``duration_cb(event, duration_secs, **kw)`` receives durations (real XLA
+    compiles: ``/jax/core/compile/backend_compile_duration``). Registration
+    is permanent — jax.monitoring has no unregister — so callers install
+    once and route internally (telemetry/compile_events.py does)."""
+    import jax.monitoring as monitoring
+
+    monitoring.register_event_listener(event_cb)
+    monitoring.register_event_duration_secs_listener(duration_cb)

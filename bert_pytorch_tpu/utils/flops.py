@@ -61,20 +61,43 @@ def peak_tflops(device_kind: str) -> float:
     return 0.0
 
 
+def bert_encoder_flops_per_seq(config, seq_len: int) -> float:
+    """Forward matmul FLOPs of the encoder stack for ONE sequence."""
+    h = config.hidden_size
+    f = config.intermediate_size
+    ll = config.num_hidden_layers
+    s = seq_len
+    return float(ll * (8 * s * h * h + 4 * s * s * h + 4 * s * h * f))
+
+
 def bert_train_flops_per_seq(config, seq_len: int, max_pred_per_seq: int,
                              next_sentence: bool = True) -> float:
     """Model FLOPs (fwd+bwd) for ONE sequence of the pretraining objective."""
     h = config.hidden_size
-    f = config.intermediate_size
-    ll = config.num_hidden_layers
     v = config.vocab_size
-    s = seq_len
     m = max_pred_per_seq
-    encoder = ll * (8 * s * h * h + 4 * s * s * h + 4 * s * h * f)
     heads = m * (2 * h * h + 2 * h * v)
     if next_sentence:
         heads += 2 * h * h + 2 * h * 2  # pooler + NSP classifier
-    return 3.0 * (encoder + heads)
+    return 3.0 * (bert_encoder_flops_per_seq(config, seq_len) + heads)
+
+
+def bert_finetune_flops_per_seq(config, seq_len: int, head_outputs: int = 2,
+                                per_token_head: bool = True,
+                                pooled: bool = False) -> float:
+    """Model FLOPs (fwd+bwd) for ONE sequence of a finetuning objective.
+
+    The task head is one linear: H -> ``head_outputs`` applied per token
+    (``per_token_head``, e.g. QA span / NER logits) or once on the pooled
+    [CLS] vector (``pooled`` adds the H x H pooler matmul first, e.g.
+    GLUE / SWAG classification)."""
+    h = config.hidden_size
+    head = 2.0 * h * head_outputs
+    if per_token_head:
+        head *= seq_len
+    if pooled:
+        head += 2.0 * h * h  # pooler
+    return 3.0 * (bert_encoder_flops_per_seq(config, seq_len) + head)
 
 
 def mfu(seq_per_sec_per_chip: float, flops_per_seq: float,

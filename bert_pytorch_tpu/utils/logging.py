@@ -1,17 +1,33 @@
 """Multi-sink structured logging — the in-repo replacement for the external
 ``loggerplus`` the reference drives (run_pretraining.py:21,191-204).
 
-Four handler types, all rank-0-gated via ``verbose``: stream, append-mode
-text file, CSV, and TensorBoard (skipped with a warning if no tensorboard
-backend is importable). ``log(tag=..., step=..., **metrics)`` writes one
-structured record to every sink (the reference's record shape:
+Five handler types: stream, append-mode text file, CSV, JSONL (the
+machine-readable telemetry sink, schema-versioned — see
+``bert_pytorch_tpu/telemetry/schema.py`` and docs/telemetry.md), and
+TensorBoard (skipped with a warning if no tensorboard backend is
+importable). ``log(tag=..., step=..., **metrics)`` writes one structured
+record to every sink (the reference's record shape:
 tag/step/epoch/average_loss/step_loss/learning_rate/samples_per_second,
 run_pretraining.py:554-564).
+
+Two orthogonal gates, deliberately separate:
+
+* ``is_primary`` — is this process rank 0? Non-primary processes write no
+  file artifacts at all (file/CSV/JSONL/TensorBoard handlers stay closed).
+* ``verbose`` — purely cosmetic: does the STREAM handler echo to the
+  terminal? A quiet (``verbose=False``) rank-0 run still produces every
+  file artifact.
+
+``is_primary`` defaults to the value of ``verbose`` so pre-existing call
+sites that passed only ``verbose=is_main_process()`` keep their behavior;
+new call sites should pass both explicitly.
 """
 
 from __future__ import annotations
 
 import csv
+import json
+import math
 import os
 import sys
 import time
@@ -20,8 +36,9 @@ from typing import Iterable, Optional
 
 
 class Handler:
-    def __init__(self, verbose: bool = True):
+    def __init__(self, verbose: bool = True, is_primary: Optional[bool] = None):
         self.verbose = verbose
+        self.is_primary = verbose if is_primary is None else is_primary
 
     def write_message(self, message: str) -> None:  # pragma: no cover
         raise NotImplementedError
@@ -42,21 +59,25 @@ def _fmt(v):
 
 
 class StreamHandler(Handler):
-    def __init__(self, verbose: bool = True, stream=None):
-        super().__init__(verbose)
+    def __init__(self, verbose: bool = True, stream=None,
+                 is_primary: Optional[bool] = None):
+        super().__init__(verbose, is_primary)
         self.stream = stream or sys.stdout
 
     def write_message(self, message: str) -> None:
-        if self.verbose:
+        # Stream output is the one place ``verbose`` applies: quiet runs
+        # keep their file artifacts but stop echoing to the terminal.
+        if self.verbose and self.is_primary:
             self.stream.write(message + "\n")
             self.stream.flush()
 
 
 class FileHandler(Handler):
-    def __init__(self, path: str, overwrite: bool = False, verbose: bool = True):
-        super().__init__(verbose)
+    def __init__(self, path: str, overwrite: bool = False, verbose: bool = True,
+                 is_primary: Optional[bool] = None):
+        super().__init__(verbose, is_primary)
         self.path = path
-        if verbose:
+        if self.is_primary:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._f = open(path, "w" if overwrite else "a")
         else:
@@ -74,14 +95,17 @@ class FileHandler(Handler):
 
 
 class CSVHandler(Handler):
-    """One CSV row per structured record; columns fixed by the first record
-    (extra keys in later records are dropped, missing keys are blank)."""
+    """One CSV row per structured record. The column set WIDENS when a later
+    record brings new keys (e.g. eval metrics or telemetry gauges appearing
+    mid-run): the file is rewritten once with the union header and old rows
+    blank-filled — nothing is silently dropped. Missing keys stay blank."""
 
-    def __init__(self, path: str, overwrite: bool = False, verbose: bool = True):
-        super().__init__(verbose)
+    def __init__(self, path: str, overwrite: bool = False, verbose: bool = True,
+                 is_primary: Optional[bool] = None):
+        super().__init__(verbose, is_primary)
         self.path = path
         self._fieldnames: Optional[list] = None
-        if verbose:
+        if self.is_primary:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._f = open(path, "w" if overwrite else "a", newline="")
         else:
@@ -90,16 +114,57 @@ class CSVHandler(Handler):
     def write_message(self, message: str) -> None:
         pass  # CSV carries records only
 
+    def _open_writer(self, write_header: bool) -> None:
+        self._writer = csv.DictWriter(
+            self._f, fieldnames=self._fieldnames, extrasaction="ignore"
+        )
+        if write_header:
+            self._writer.writeheader()
+
+    def _existing_header(self) -> Optional[list]:
+        """First row of the file being appended to (None when empty) — the
+        prior run's column set, which seeds ``_fieldnames`` so a resumed
+        run widens relative to the FILE's header, not this session's first
+        record (else the old header would be misread as a data row)."""
+        if self._f.tell() == 0:
+            return None
+        with open(self.path, newline="") as f:
+            return next(csv.reader(f), None)
+
+    def _widen(self, novel: list) -> None:
+        """Rewrite the file with the union header; existing rows get blanks
+        for the new columns. Metric CSVs are small (one row per log step),
+        and new keys appear a handful of times per run, so the rewrite is
+        cheap — and strictly better than dropping the new metrics."""
+        old_fields = self._fieldnames
+        self._fieldnames = old_fields + novel
+        self._f.close()
+        rows = []
+        with open(self.path, newline="") as f:
+            reader = csv.reader(f)
+            for i, row in enumerate(reader):
+                if i == 0 and row == old_fields:
+                    continue  # old header; replaced below
+                rows.append(dict(zip(old_fields, row)))
+        self._f = open(self.path, "w", newline="")
+        self._open_writer(write_header=True)
+        for row in rows:
+            self._writer.writerow(row)
+
     def write_record(self, record: dict) -> None:
         if self._f is None:
             return
         if self._fieldnames is None:
-            self._fieldnames = list(record.keys())
-            self._writer = csv.DictWriter(
-                self._f, fieldnames=self._fieldnames, extrasaction="ignore"
-            )
-            if self._f.tell() == 0:
-                self._writer.writeheader()
+            existing = self._existing_header()
+            if existing:
+                self._fieldnames = existing
+                self._open_writer(write_header=False)
+            else:
+                self._fieldnames = list(record.keys())
+                self._open_writer(write_header=True)
+        novel = [k for k in record if k not in self._fieldnames]
+        if novel:
+            self._widen(novel)
         self._writer.writerow({k: record.get(k, "") for k in self._fieldnames})
         self._f.flush()
 
@@ -109,13 +174,76 @@ class CSVHandler(Handler):
             self._f = None
 
 
+class JSONLHandler(Handler):
+    """One JSON object per line — the machine-readable sink the telemetry
+    layer, bench.py, and the NOTES/PARITY tooling parse.
+
+    Every line carries ``schema`` (the telemetry record schema version,
+    ``telemetry/schema.py``) and ``ts`` (unix seconds) in addition to the
+    record's own fields; non-finite floats are serialized as JSON ``null``
+    (NaN is not valid JSON and would poison downstream parsers — the
+    sentinel record's ``finite`` flag carries the signal instead).
+    ``tools/check_telemetry_schema.py`` lints committed artifacts against
+    the schema.
+    """
+
+    def __init__(self, path: str, overwrite: bool = False, verbose: bool = True,
+                 is_primary: Optional[bool] = None):
+        super().__init__(verbose, is_primary)
+        self.path = path
+        if self.is_primary:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._f = open(path, "w" if overwrite else "a")
+        else:
+            self._f = None
+
+    def write_message(self, message: str) -> None:
+        pass  # JSONL carries records only; prose goes to the text sink
+
+    def write_record(self, record: dict) -> None:
+        if self._f is None:
+            return
+        from bert_pytorch_tpu.telemetry.schema import SCHEMA_VERSION
+
+        rec = {"schema": SCHEMA_VERSION, "ts": round(time.time(), 3)}
+        rec.update(record)
+        self._f.write(json.dumps(rec, default=str, allow_nan=False,
+                                 cls=_FiniteEncoder) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class _FiniteEncoder(json.JSONEncoder):
+    """Serialize non-finite floats as null instead of raising (allow_nan
+    only controls the invalid-JSON NaN/Infinity spellings)."""
+
+    def iterencode(self, o, _one_shot=False):
+        return super().iterencode(_sanitize_nonfinite(o), _one_shot)
+
+
+def _sanitize_nonfinite(obj):
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return None
+    if isinstance(obj, dict):
+        return {k: _sanitize_nonfinite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize_nonfinite(v) for v in obj]
+    return obj
+
+
 class TensorBoardHandler(Handler):
     """Scalar metrics to TensorBoard via any importable writer backend."""
 
-    def __init__(self, log_dir: str, verbose: bool = True):
-        super().__init__(verbose)
+    def __init__(self, log_dir: str, verbose: bool = True,
+                 is_primary: Optional[bool] = None):
+        super().__init__(verbose, is_primary)
         self._writer = None
-        if not verbose:
+        self._warned_stepless = False
+        if not self.is_primary:
             return
         try:
             from torch.utils.tensorboard import SummaryWriter  # type: ignore
@@ -137,7 +265,17 @@ class TensorBoardHandler(Handler):
     def write_record(self, record: dict) -> None:
         if self._writer is None:
             return
-        step = record.get("step", 0)
+        step = record.get("step")
+        if step is None:
+            # A stepless record has no x-axis position; writing it at step 0
+            # would alias it onto the real step-0 scalars. Skip it (the
+            # file/CSV/JSONL sinks still carry it).
+            if not self._warned_stepless:
+                self._warned_stepless = True
+                warnings.warn(
+                    "TensorBoardHandler: record without 'step' skipped "
+                    "(scalars need an x-axis position)")
+            return
         tag = record.get("tag", "train")
         for key, value in record.items():
             if key in ("tag", "step"):
@@ -156,6 +294,8 @@ class Logger:
         self.handlers: list[Handler] = [StreamHandler()]
 
     def init(self, handlers: Iterable[Handler]) -> None:
+        # Close the handlers being replaced (including the default
+        # StreamHandler) so re-init never leaks open files or TB writers.
         self.close()
         self.handlers = list(handlers)
 

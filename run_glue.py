@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from bert_pytorch_tpu import optim
+from bert_pytorch_tpu import optim, telemetry
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu.data import glue
 from bert_pytorch_tpu.data.tokenization import (
@@ -66,6 +66,11 @@ def parse_arguments(argv=None):
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--skip_eval", action="store_true")
+    # telemetry (docs/telemetry.md)
+    # telemetry: canonical flag set shared by every runner; this loop
+    # fetches the loss every step anyway, so per-step sync is free
+    # (telemetry/cli.py; docs/telemetry.md)
+    telemetry.add_cli_args(parser, sync_every_default=1)
     args = parser.parse_args(argv)
 
     with open(args.model_config_file) as f:
@@ -99,7 +104,13 @@ def main(args):
     processor = glue.PROCESSORS[args.task]()
     regression = processor.regression
     num_labels = 1 if regression else len(processor.labels)
-    logger.init(handlers=[logger.StreamHandler()])
+    telemetry_jsonl = args.telemetry_jsonl or (
+        os.path.join(args.output_dir, "glue_telemetry.jsonl")
+        if args.output_dir else None)
+    telemetry_sink = (logger.JSONLHandler(telemetry_jsonl, overwrite=False)
+                      if telemetry_jsonl else None)
+    logger.init(handlers=[logger.StreamHandler()]
+                + ([telemetry_sink] if telemetry_sink else []))
 
     if args.tokenizer == "wordpiece":
         tokenizer = get_wordpiece_tokenizer(args.vocab_file,
@@ -172,13 +183,28 @@ def main(args):
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    train_step = jax.jit(train_step, donate_argnums=(0, 1))
+    # Telemetry facade (docs/telemetry.md): step-time windows + MFU, trace
+    # window, compile attribution, loss sentinel, optional heartbeat.
+    from bert_pytorch_tpu.utils import flops as flops_util
+    tele = telemetry.from_args(
+        args,
+        sink=telemetry_sink,
+        seq_per_step=args.batch_size,
+        flops_per_seq=flops_util.bert_finetune_flops_per_seq(
+            config, args.max_seq_len, head_outputs=num_labels,
+            per_token_head=False, pooled=True),
+        output_dir=args.output_dir or None)
+
+    train_step = tele.instrument(
+        jax.jit(train_step, donate_argnums=(0, 1)), "train_step")
 
     @jax.jit
     def eval_step(params, batch):
         return model.apply(
             {"params": params}, batch["input_ids"], batch["segment_ids"],
             batch["input_mask"])
+
+    eval_step = tele.instrument(eval_step, "eval_step")
 
     def evaluate():
         preds, labels = [], []
@@ -196,17 +222,26 @@ def main(args):
     key = jax.random.PRNGKey(args.seed)
     t0 = time.perf_counter()
     seen = 0
+    global_step = 0
     for epoch in range(args.epochs):
         losses = []
-        for batch, valid in batches(arrays["train"], args.batch_size, True,
-                                    rng):
+        for batch, valid in tele.timed(
+                batches(arrays["train"], args.batch_size, True, rng)):
             key, sub = jax.random.split(key)
-            params, opt_state, loss = train_step(
-                params, opt_state, batch, valid, sub)
+            tele.profiler.maybe_start(global_step + 1)
+            with tele.profiler.annotation(global_step + 1):
+                params, opt_state, loss = train_step(
+                    params, opt_state, batch, valid, sub)
+            tele.dispatch_done()
+            global_step += 1
+            tele.step_done(global_step, {"loss": loss})
             losses.append(float(loss))
             seen += int(valid.sum())
         logger.info(f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
     train_time = time.perf_counter() - t0
+    tele.finish(global_step, summary={
+        "training_seq_per_sec":
+            round(seen / train_time, 2) if train_time else 0.0})
 
     results = {
         "e2e_train_time": train_time,

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from bert_pytorch_tpu import optim
+from bert_pytorch_tpu import optim, telemetry
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu.data.ner_dataset import NERDataset
 from bert_pytorch_tpu.data.tokenization import (
@@ -58,6 +58,12 @@ def parse_arguments(argv=None):
                         help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
+    # telemetry (docs/telemetry.md) — this runner has no output dir, so the
+    # file sinks are opt-in
+    # telemetry: canonical flag set shared by every runner; this loop
+    # fetches the loss every step anyway, so per-step sync is free
+    # (telemetry/cli.py; docs/telemetry.md)
+    telemetry.add_cli_args(parser, sync_every_default=1)
     args = parser.parse_args(argv)
 
     with open(args.model_config_file) as f:
@@ -104,7 +110,11 @@ def batches(dataset, batch_size, shuffle, rng):
 def main(args):
     enable_compile_cache(args.compile_cache_dir)
     rng = np.random.default_rng(args.seed)
-    logger.init(handlers=[logger.StreamHandler()])
+    telemetry_sink = (logger.JSONLHandler(args.telemetry_jsonl,
+                                          overwrite=False)
+                      if args.telemetry_jsonl else None)
+    logger.init(handlers=[logger.StreamHandler()]
+                + ([telemetry_sink] if telemetry_sink else []))
 
     if args.tokenizer == "wordpiece":
         tokenizer = get_wordpiece_tokenizer(args.vocab_file,
@@ -157,11 +167,23 @@ def main(args):
         updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
         return optax.apply_updates(params, updates), opt_state2, loss
 
-    train_step = jax.jit(train_step, donate_argnums=(0, 1))
+    # Telemetry facade (docs/telemetry.md).
+    from bert_pytorch_tpu.utils import flops as flops_util
+    tele = telemetry.from_args(
+        args,
+        sink=telemetry_sink,
+        seq_per_step=args.batch_size,
+        flops_per_seq=flops_util.bert_finetune_flops_per_seq(
+            config, args.max_seq_len, head_outputs=len(args.labels) + 1))
+
+    train_step = tele.instrument(
+        jax.jit(train_step, donate_argnums=(0, 1)), "train_step")
 
     @jax.jit
     def eval_step(params, seqs, masks):
         return model.apply({"params": params}, seqs, None, masks)
+
+    eval_step = tele.instrument(eval_step, "eval_step")
 
     def evaluate(split):
         dataset = datasets[split]
@@ -179,13 +201,20 @@ def main(args):
 
     key = jax.random.PRNGKey(args.seed)
     results = {}
+    global_step = 0
     for epoch in range(args.epochs):
         t0 = time.perf_counter()
         losses = []
-        for batch in batches(datasets["train"], args.batch_size, True, rng):
+        for batch in tele.timed(
+                batches(datasets["train"], args.batch_size, True, rng)):
             key, sub = jax.random.split(key)
-            params, opt_state, loss = train_step(
-                params, opt_state, batch, sub, epoch)
+            tele.profiler.maybe_start(global_step + 1)
+            with tele.profiler.annotation(global_step + 1):
+                params, opt_state, loss = train_step(
+                    params, opt_state, batch, sub, epoch)
+            tele.dispatch_done()
+            global_step += 1
+            tele.step_done(global_step, {"loss": loss})
             losses.append(float(loss))
         msg = (f"epoch {epoch}: train_loss={np.mean(losses):.4f} "
                f"({time.perf_counter() - t0:.1f}s)")
@@ -199,6 +228,7 @@ def main(args):
         test_loss, test_f1 = evaluate("test")
         results["test_f1"] = test_f1
         logger.info(f"test_loss={test_loss:.4f} test_f1={test_f1:.4f}")
+    tele.finish(global_step)
     logger.close()
     return results
 

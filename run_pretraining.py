@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bert_pytorch_tpu import optim, pretrain
+from bert_pytorch_tpu import optim, pretrain, telemetry
 from bert_pytorch_tpu.config import BertConfig, parse_args_with_config_file, require_args
 from bert_pytorch_tpu.data import DataLoader, DistributedSampler, ShardedPretrainingDataset
 from bert_pytorch_tpu.models import BertForPreTraining
@@ -109,10 +109,10 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "runs at a fixed step cadence so multi-host "
                              "jobs agree collectively on when to stop. "
                              "0 disables graceful termination")
-    parser.add_argument("--profile_steps", type=int, default=0,
-                        help="capture a JAX profiler trace of this many "
-                             "steps (after the compile step) into "
-                             "<output_dir>/profile; 0 disables (SURVEY §5.1)")
+    # telemetry (docs/telemetry.md): step-time decomposition + MFU windows,
+    # profiler trace windows, compile events, failure sentinels, heartbeat —
+    # canonical flag set shared by every runner (telemetry/cli.py)
+    telemetry.add_cli_args(parser, window_default=20, sync_every_default=4)
     # numerics / memory
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32", "float16"],
@@ -255,17 +255,29 @@ def setup_training(args):
     if is_main_process():
         os.makedirs(args.model_output_dir, exist_ok=True)
 
+    # Telemetry sink shared between the logger (ordinary train records) and
+    # the TrainTelemetry facade (its records go ONLY there); built in main().
+    args.telemetry_jsonl = args.telemetry_jsonl or os.path.join(
+        args.output_dir, args.log_prefix + "_telemetry.jsonl")
+    args.heartbeat_file = args.heartbeat_file or os.path.join(
+        args.output_dir, "heartbeat.json")
+    args.profile_dir = args.profile_dir or os.path.join(
+        args.output_dir, "profile")
+    args.telemetry_sink = logger.JSONLHandler(
+        args.telemetry_jsonl, overwrite=False, is_primary=is_main_process())
     logger.init(handlers=[
-        logger.StreamHandler(verbose=is_main_process()),
+        logger.StreamHandler(verbose=is_main_process(),
+                             is_primary=is_main_process()),
         logger.FileHandler(
             os.path.join(args.output_dir, args.log_prefix + ".txt"),
-            overwrite=False, verbose=is_main_process()),
+            overwrite=False, is_primary=is_main_process()),
         logger.TensorBoardHandler(
             os.path.join(args.output_dir, "tensorboard"),
-            verbose=is_main_process()),
+            is_primary=is_main_process()),
         logger.CSVHandler(
             os.path.join(args.output_dir, args.log_prefix + "_metrics.csv"),
-            overwrite=False, verbose=is_main_process()),
+            overwrite=False, is_primary=is_main_process()),
+        args.telemetry_sink,
     ])
     logger.info(
         f"mesh initialized: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
@@ -597,12 +609,32 @@ def main(args) -> dict:
                 kfac_capture_microbatches=args.kfac_capture_microbatches,
                 loss_scale=fp16)
 
+        # Telemetry (docs/telemetry.md): JSONL sink shared with the logger,
+        # step-time decomposition windows, profiler trace window, compile
+        # attribution, failure sentinels, rank-0 heartbeat. flops_per_seq is
+        # refreshed once the DATA sequence length is known (phase-1 data is
+        # 128 tokens while max_position_embeddings stays 512).
+        from bert_pytorch_tpu.utils import flops as flops_util
+        tele = telemetry.from_args(
+            args,
+            sink=args.telemetry_sink,
+            is_primary=is_main_process(),
+            seq_per_step=args.global_batch_size,
+            flops_per_seq=flops_util.bert_train_flops_per_seq(
+                config, seq_len, args.max_predictions_per_seq,
+                next_sentence=bool(config.next_sentence)),
+            output_dir=args.output_dir)
+        tele.attach_loader(loader)
+        train_step = tele.instrument(train_step, "train_step")
+
         eval_step = None
         if val_loader is not None:
             from bert_pytorch_tpu.parallel import batch_sharding
 
-            eval_step = pretrain.make_eval_step(
-                model, next_sentence=bool(config.next_sentence))
+            eval_step = tele.instrument(
+                pretrain.make_eval_step(
+                    model, next_sentence=bool(config.next_sentence)),
+                "eval_step")
             eval_bsh = {k: batch_sharding(mesh) for k in (
                 "input_ids", "segment_ids", "input_mask",
                 "masked_lm_labels", "next_sentence_labels")}
@@ -645,7 +677,6 @@ def main(args) -> dict:
 
         epoch = int(checkpoint["epoch"]) if checkpoint else 0
         step_in_run = 0
-        profiling = False
         train_start = time.perf_counter()
         samples_seen = 0
         last_metrics = {}
@@ -687,6 +718,47 @@ def main(args) -> dict:
             s["index"] = trained_index
             return s
 
+        def dispatch_step(state, batch, kfac_state, global_step):
+            """One optimizer step's dispatch (the only Python between
+            batches; returns before the device finishes — telemetry's
+            step timer owns the sync)."""
+            if kfac_fused:
+                # In-train capture: the step harvests factors from
+                # microbatch 0's own backward, rebuilds inverses
+                # in-jit on due steps from the factors it just
+                # captured, and preconditions with them — the
+                # exact kfac_pytorch optimizer.step() ordering
+                # (hooks during backward, due inverses, update).
+                # Both cadences are lax.cond-gated inside the one
+                # compiled step; no host round trips.
+                state, metrics, kfac_state = train_step(
+                    state, batch, kfac_state)
+            elif kfac_obj is not None:
+                # kfac_pytorch cadence: factors (EMA) every
+                # factor_interval steps from the current data, inverses
+                # every inv_interval steps; both fire on the first step.
+                if global_step % args.kfac_factor_interval == 0:
+                    n_stats = args.kfac_stats_batch
+                    if n_stats and n_stats < batch["input_ids"].shape[1]:
+                        # Strided rows: every data shard of the global
+                        # batch contributes to the statistics (a [:n]
+                        # head-slice would sample only shard 0's data).
+                        stride = batch["input_ids"].shape[1] // n_stats
+                        mb0 = {k: v[0][::stride][:n_stats]
+                               for k, v in batch.items()}
+                    else:
+                        mb0 = {k: v[0] for k, v in batch.items()}
+                    kfac_state = kfac_obj.update_factors(
+                        kfac_state, state.params, mb0,
+                        jax.random.fold_in(
+                            jax.random.PRNGKey(args.seed + 17), global_step))
+                if global_step % args.kfac_inv_interval == 0:
+                    kfac_state = kfac_obj.update_inverses(kfac_state)
+                state, metrics = train_step(state, batch, kfac_state)
+            else:
+                state, metrics = train_step(state, batch)
+            return state, metrics, kfac_state
+
         # Handlers stay installed through the final checkpoint write:
         # preemption re-delivers SIGTERM during the grace period, and
         # the default disposition would kill the write mid-file. The
@@ -695,48 +767,28 @@ def main(args) -> dict:
         try:
             while not done:
                 sampler.set_epoch(epoch)
-                for batch in pretrain.device_prefetch(
-                        loader, args.accumulation_steps, b_shardings):
-                    if kfac_fused:
-                        # In-train capture: the step harvests factors from
-                        # microbatch 0's own backward, rebuilds inverses
-                        # in-jit on due steps from the factors it just
-                        # captured, and preconditions with them — the
-                        # exact kfac_pytorch optimizer.step() ordering
-                        # (hooks during backward, due inverses, update).
-                        # Both cadences are lax.cond-gated inside the one
-                        # compiled step; no host round trips.
-                        state, metrics, kfac_state = train_step(
-                            state, batch, kfac_state)
-                    elif kfac_obj is not None:
-                        # kfac_pytorch cadence: factors (EMA) every
-                        # factor_interval steps from the current data, inverses
-                        # every inv_interval steps; both fire on the first step.
-                        if global_step % args.kfac_factor_interval == 0:
-                            n_stats = args.kfac_stats_batch
-                            if n_stats and n_stats < batch["input_ids"].shape[1]:
-                                # Strided rows: every data shard of the global
-                                # batch contributes to the statistics (a [:n]
-                                # head-slice would sample only shard 0's data).
-                                stride = batch["input_ids"].shape[1] // n_stats
-                                mb0 = {k: v[0][::stride][:n_stats]
-                                       for k, v in batch.items()}
-                            else:
-                                mb0 = {k: v[0] for k, v in batch.items()}
-                            kfac_state = kfac_obj.update_factors(
-                                kfac_state, state.params, mb0,
-                                jax.random.fold_in(
-                                    jax.random.PRNGKey(args.seed + 17), global_step))
-                        if global_step % args.kfac_inv_interval == 0:
-                            kfac_state = kfac_obj.update_inverses(kfac_state)
-                        state, metrics = train_step(state, batch, kfac_state)
-                    else:
-                        state, metrics = train_step(state, batch)
+                for batch in tele.timed(iter(pretrain.device_prefetch(
+                        loader, args.accumulation_steps, b_shardings))):
+                    # Profiler window (steps are step_in_run indices; this
+                    # iteration runs step step_in_run + 1).
+                    tele.profiler.maybe_start(step_in_run + 1)
+                    with tele.profiler.annotation(step_in_run + 1):
+                        state, metrics, kfac_state = dispatch_step(
+                            state, batch, kfac_state, global_step)
+                    tele.dispatch_done()
                     global_step += 1
                     step_in_run += 1
                     trained_index += args.host_batch_per_step
                     if data_seq_len is None:
                         data_seq_len = int(batch["input_ids"].shape[-1])
+                        if data_seq_len != seq_len:
+                            # MFU must use the DATA shape, not the model cap.
+                            from bert_pytorch_tpu.utils import flops as _fl
+                            tele.timer.flops_per_seq = (
+                                _fl.bert_train_flops_per_seq(
+                                    config, data_seq_len,
+                                    args.max_predictions_per_seq,
+                                    next_sentence=bool(config.next_sentence)))
                     if step_in_run > 1:  # skip step-0 compile in throughput
                         samples_seen += args.global_batch_size
                     if step_in_run == 1:
@@ -750,23 +802,30 @@ def main(args) -> dict:
                         # for identical steady-state device throughput).
                         jax.block_until_ready(metrics)
                         train_start = time.perf_counter()
-                    # Profiler window: steps [2, 2+profile_steps) — after the
-                    # compile step (metrics already blocked on above), so the
-                    # trace holds steady-state device work.
-                    if args.profile_steps > 0 and is_main_process():
-                        if step_in_run == 1:
-                            jax.profiler.start_trace(
-                                os.path.join(args.output_dir, "profile"))
-                            profiling = True
-                        elif profiling and step_in_run == 1 + args.profile_steps:
-                            jax.block_until_ready(metrics)
-                            jax.profiler.stop_trace()
-                            profiling = False
-                            logger.info("profiler trace written to "
-                                        f"{args.output_dir}/profile")
+                    # Telemetry step close-out: device sync (per cadence) +
+                    # step-window emission + sentinel policy + heartbeat +
+                    # profiler auto-stop. NonFiniteError propagates under
+                    # --sentinel_policy abort.
+                    tele.step_done(global_step, metrics,
+                                   profile_step=step_in_run)
 
                     if global_step % args.log_steps == 0:
                         last_metrics = {k: float(v) for k, v in metrics.items()}
+                        if not tele.last_step_synced:
+                            # The float() fetches above were this step's
+                            # sync; feed the sentinel/heartbeat that missed
+                            # the cadence. Both train steps emit the in-jit
+                            # "finite" scalar; the isfinite(loss) fallback
+                            # is defensive for any step that doesn't, so a
+                            # missing key can't read as healthy.
+                            finite = last_metrics.get("finite")
+                            if finite is None:
+                                finite = (1.0 if math.isfinite(
+                                    last_metrics["loss"]) else 0.0)
+                            tele.sentinel.observe(
+                                global_step, finite, last_metrics["loss"])
+                            tele.heartbeat.beat(
+                                global_step, last_metrics["loss"])
                         elapsed = time.perf_counter() - train_start
                         logger.log(
                             tag="train", step=global_step, epoch=epoch,
@@ -824,10 +883,10 @@ def main(args) -> dict:
                     continue
                 break
 
-            if profiling:  # run ended inside the profile window
-                jax.block_until_ready(metrics)
-                jax.profiler.stop_trace()
-                logger.info(f"profiler trace written to {args.output_dir}/profile")
+            if tele.profiler.active:  # run ended inside the profile window
+                tele.profiler.stop(sync_target=metrics)
+            if tele.profiler.done:
+                logger.info(f"profiler trace written to {args.profile_dir}")
 
             train_time = time.perf_counter() - train_start
             seq_per_sec = samples_seen / max(train_time, 1e-9)
@@ -861,6 +920,13 @@ def main(args) -> dict:
                     args.model_output_dir, save_step, contents,
                     keep=args.keep_checkpoints)
             ckpt.wait_for_pending_save()
+            # Flush the partial telemetry window + final heartbeat + run
+            # summary (the JSONL sink itself is closed by logger.close()).
+            tele.finish(global_step, summary={
+                "training_seq_per_sec": round(seq_per_sec, 2),
+                "training_mfu": round(train_mfu, 4),
+                "terminated_by_signal": terminated,
+            })
             logger.close()
         finally:
             for sig, handler in old_handlers.items():

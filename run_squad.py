@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bert_pytorch_tpu import optim, pretrain, squad
+from bert_pytorch_tpu import optim, pretrain, squad, telemetry
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu.data.tokenization import (
     get_bpe_tokenizer,
@@ -92,6 +92,9 @@ def parse_args(argv=None):
                         help="fp16 only: initial dynamic loss scale "
                              "(default matches torch GradScaler's 2**16)")
     parser.add_argument("--log_freq", type=int, default=50)
+    # telemetry: canonical flag set shared by every runner
+    # (telemetry/cli.py; docs/telemetry.md)
+    telemetry.add_cli_args(parser)
     parser.add_argument("--json_summary", type=str, default="squad_log.json")
     parser.add_argument("--eval_script", type=str, default=None)
     parser.add_argument("--skip_checkpoint", action="store_true")
@@ -190,10 +193,22 @@ def main(args):
         devices = jax.devices()[: args.mesh_data]
     mesh = create_mesh(MeshConfig(data=-1), devices=devices)
     os.makedirs(args.output_dir, exist_ok=True)
+    args.telemetry_jsonl = args.telemetry_jsonl or os.path.join(
+        args.output_dir, "squad_telemetry.jsonl")
+    args.heartbeat_file = args.heartbeat_file or os.path.join(
+        args.output_dir, "heartbeat.json")
+    args.profile_dir = args.profile_dir or os.path.join(
+        args.output_dir, "profile")
+    # Sink shared between the logger (train records) and TrainTelemetry
+    # (docs/telemetry.md); telemetry records go ONLY to the JSONL.
+    telemetry_sink = logger.JSONLHandler(
+        args.telemetry_jsonl, overwrite=False, is_primary=is_main_process())
     logger.init(handlers=[
-        logger.StreamHandler(verbose=is_main_process()),
+        logger.StreamHandler(verbose=is_main_process(),
+                             is_primary=is_main_process()),
         logger.FileHandler(os.path.join(args.output_dir, args.json_summary),
-                           verbose=is_main_process()),
+                           is_primary=is_main_process()),
+        telemetry_sink,
     ])
 
     config = BertConfig.from_json_file(args.config_file)
@@ -232,6 +247,19 @@ def main(args):
         from jax.sharding import NamedSharding, PartitionSpec as P
         batch_sh = {k: NamedSharding(mesh, P(("data", "fsdp")))
                     for k in batch_sh}
+
+        # Telemetry facade (docs/telemetry.md): step-time windows + MFU,
+        # profiler trace window, compile attribution, non-finite sentinel
+        # (host-side isfinite on the fetched loss), rank-0 heartbeat.
+        from bert_pytorch_tpu.utils import flops as flops_util
+        tele = telemetry.from_args(
+            args,
+            sink=telemetry_sink,
+            is_primary=is_main_process(),
+            seq_per_step=args.train_batch_size if args.do_train else None,
+            flops_per_seq=flops_util.bert_finetune_flops_per_seq(
+                config, args.max_seq_length, head_outputs=2),
+            output_dir=args.output_dir)
 
         if args.do_train:
             train_examples = squad.read_squad_examples(
@@ -291,7 +319,8 @@ def main(args):
                 import optax
                 return optax.apply_updates(params, updates), opt_state2, loss
 
-            train_step = jax.jit(train_step, donate_argnums=(0, 1))
+            train_step = tele.instrument(
+                jax.jit(train_step, donate_argnums=(0, 1)), "train_step")
 
             rng = jax.random.PRNGKey(args.seed)
             order = np.random.permutation(n)
@@ -300,19 +329,29 @@ def main(args):
             seqs = 0
             epoch = 0
             losses = []
-            while global_step < total_steps:
+
+            def epoch_batches():
+                """Featurize + device_put one epoch's batches; host time
+                spent here is telemetry's data_wait (tele.timed)."""
                 for i in range(0, n - args.train_batch_size + 1,
                                args.train_batch_size):
                     idx = order[i:i + args.train_batch_size]
                     feats = [train_features[j] for j in idx]
                     arrays = features_to_arrays(feats, True)
-                    batch = {k: jax.device_put(v, batch_sh[k])
-                             for k, v in arrays.items()}
+                    yield {k: jax.device_put(v, batch_sh[k])
+                           for k, v in arrays.items()}
+
+            while global_step < total_steps:
+                for batch in tele.timed(epoch_batches()):
                     rng, sub = jax.random.split(rng)
-                    params, opt_state, loss = train_step(
-                        params, opt_state, batch, sub)
+                    tele.profiler.maybe_start(global_step + 1)
+                    with tele.profiler.annotation(global_step + 1):
+                        params, opt_state, loss = train_step(
+                            params, opt_state, batch, sub)
+                    tele.dispatch_done()
                     global_step += 1
                     seqs += args.train_batch_size
+                    tele.step_done(global_step, {"loss": loss})
                     if global_step % args.log_freq == 0:
                         losses.append(float(loss))
                         logger.log(tag="train", step=global_step,
@@ -327,6 +366,8 @@ def main(args):
             summary["e2e_train_time"] = train_time
             summary["training_sequences_per_second"] = seqs / train_time
             summary["final_loss"] = float(loss)
+            tele.finish(global_step, summary={
+                "training_seq_per_sec": round(seqs / train_time, 2)})
 
             if not args.skip_checkpoint and is_main_process():
                 ckpt.save_checkpoint(args.output_dir, global_step,
@@ -344,6 +385,8 @@ def main(args):
             def predict_step(params, batch):
                 return model.apply({"params": params}, batch["input_ids"],
                                    batch["segment_ids"], batch["input_mask"])
+
+            predict_step = tele.instrument(predict_step, "predict_step")
 
             t_infer = time.perf_counter()
             results = []

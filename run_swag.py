@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from bert_pytorch_tpu import optim
+from bert_pytorch_tpu import optim, telemetry
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu.data import swag
 from bert_pytorch_tpu.data.tokenization import (
@@ -60,6 +60,11 @@ def parse_arguments(argv=None):
                         help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
+    # telemetry (docs/telemetry.md)
+    # telemetry: canonical flag set shared by every runner; this loop
+    # fetches the loss every step anyway, so per-step sync is free
+    # (telemetry/cli.py; docs/telemetry.md)
+    telemetry.add_cli_args(parser, sync_every_default=1)
     args = parser.parse_args(argv)
 
     with open(args.model_config_file) as f:
@@ -75,7 +80,13 @@ def parse_arguments(argv=None):
 
 def main(args):
     enable_compile_cache(args.compile_cache_dir)
-    logger.init(handlers=[logger.StreamHandler()])
+    telemetry_jsonl = args.telemetry_jsonl or (
+        os.path.join(args.output_dir, "swag_telemetry.jsonl")
+        if args.output_dir else None)
+    telemetry_sink = (logger.JSONLHandler(telemetry_jsonl, overwrite=False)
+                      if telemetry_jsonl else None)
+    logger.init(handlers=[logger.StreamHandler()]
+                + ([telemetry_sink] if telemetry_sink else []))
     if args.tokenizer == "wordpiece":
         tokenizer = get_wordpiece_tokenizer(args.vocab_file,
                                             uppercase=args.uppercase)
@@ -138,8 +149,22 @@ def main(args):
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    train_step = jax.jit(train_step, donate_argnums=(0, 1))
-    eval_step = jax.jit(scores_fn)
+    # Telemetry facade (docs/telemetry.md). One SWAG example is
+    # NUM_CHOICES encoder passes, so flops_per_seq scales by the choices.
+    from bert_pytorch_tpu.utils import flops as flops_util
+    tele = telemetry.from_args(
+        args,
+        sink=telemetry_sink,
+        seq_per_step=args.batch_size,
+        flops_per_seq=swag.NUM_CHOICES
+        * flops_util.bert_finetune_flops_per_seq(
+            config, args.max_seq_len, head_outputs=1,
+            per_token_head=False, pooled=True),
+        output_dir=args.output_dir or None)
+
+    train_step = tele.instrument(
+        jax.jit(train_step, donate_argnums=(0, 1)), "train_step")
+    eval_step = tele.instrument(jax.jit(scores_fn), "eval_step")
 
     def evaluate():
         correct = total = 0
@@ -155,17 +180,26 @@ def main(args):
     key = jax.random.PRNGKey(args.seed)
     t0 = time.perf_counter()
     seen = 0
+    global_step = 0
     for epoch in range(args.epochs):
         losses = []
-        for batch, valid in batches(arrays["train"], args.batch_size, True,
-                                    rng):
+        for batch, valid in tele.timed(
+                batches(arrays["train"], args.batch_size, True, rng)):
             key, sub = jax.random.split(key)
-            params, opt_state, loss = train_step(
-                params, opt_state, batch, valid, sub)
+            tele.profiler.maybe_start(global_step + 1)
+            with tele.profiler.annotation(global_step + 1):
+                params, opt_state, loss = train_step(
+                    params, opt_state, batch, valid, sub)
+            tele.dispatch_done()
+            global_step += 1
+            tele.step_done(global_step, {"loss": loss})
             losses.append(float(loss))
             seen += int(valid.sum())
         logger.info(f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
     train_time = time.perf_counter() - t0
+    tele.finish(global_step, summary={
+        "training_seq_per_sec":
+            round(seen / train_time, 2) if train_time else 0.0})
 
     results = {
         "e2e_train_time": train_time,

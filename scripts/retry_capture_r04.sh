@@ -376,7 +376,19 @@ EOF
       ls "$LONG_W"/run/pretrain_ckpts 2>/dev/null \
         | grep -oE '^ckpt_[0-9]+' | sed 's/ckpt_//' | sort -n | tail -1
     }
+    # Direct liveness evidence (docs/telemetry.md): run_pretraining
+    # atomically maintains <run>/heartbeat.json with a monotonic per-step
+    # counter that RESUMES across restarts. Counter advance across the
+    # pass means the run was training when the window closed — finer than
+    # the 250-step checkpoint cadence (a pass killed at step 240 shows
+    # zero checkpoint progress but 240 trained steps), and not foolable
+    # by tmp-file litter the way mtime probes were.
+    long_hb_counter() {
+      grep -oE '"counter": *[0-9]+' "$LONG_W/run/heartbeat.json" \
+        2>/dev/null | grep -oE '[0-9]+' || echo 0
+    }
     ckpt_before=$(latest_long_ckpt)
+    hb_before=$(long_hb_counter)
     timeout 3600 bash scripts/convergence_long_r03.sh "$LONG_W" \
         > "$LOGS/long.log" 2>&1
     rc=$?
@@ -384,11 +396,14 @@ EOF
       clear_fail long
       commit_artifacts "Capture r03 long anchored convergence run (pre-stated milestones)" \
         CONVERGENCE_LONG_r03.csv LONG_RUN_r03.json docs/convergence_long_r03.png
-    elif [ "$(latest_long_ckpt)" != "$ckpt_before" ]; then
-      echo "   long pass ended (rc=$rc) with checkpoint progress; resumes next window"
+    elif [ "$(latest_long_ckpt)" != "$ckpt_before" ] \
+        || [ "$(long_hb_counter)" -gt "$hb_before" ]; then
+      echo "   long pass ended (rc=$rc) alive (ckpt $ckpt_before ->" \
+        "$(latest_long_ckpt), heartbeat $hb_before -> $(long_hb_counter));" \
+        "resumes next window"
       clear_fail long
     else
-      echo "   long pass FAILED (rc=$rc, no checkpoint progress): $(tail -1 "$LOGS/long.log" | cut -c1-160)"
+      echo "   long pass FAILED (rc=$rc, no checkpoint or heartbeat progress): $(tail -1 "$LOGS/long.log" | cut -c1-160)"
       bump_fail long
     fi
     continue

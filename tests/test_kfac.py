@@ -17,6 +17,11 @@ from bert_pytorch_tpu.models import BertForPreTraining
 from bert_pytorch_tpu.optim.kfac import KFACState, kfac_state_shardings
 from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
 
+# Heavyweight (module-scope model + many jit compiles on the virtual 8-device
+# mesh): outside the tier-1 wallclock budget on a throttled CPU host. Run
+# explicitly with `-m slow`.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def setup():

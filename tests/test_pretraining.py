@@ -15,6 +15,12 @@ import run_pretraining
 from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 
+# End-to-end runner tests (compile + train on the virtual 8-device mesh, many
+# minutes on a throttled CPU host): outside the tier-1 wallclock budget. Run
+# explicitly with `-m slow`; tier-1 keeps the telemetry CPU smoke run
+# (tests/test_telemetry.py) as the fast end-to-end pretraining guard.
+pytestmark = pytest.mark.slow
+
 VOCAB = 1000
 
 

@@ -18,6 +18,11 @@ from bert_pytorch_tpu.ops.attention import dot_product_attention, make_attention
 from bert_pytorch_tpu.ops.ring import ring_attention
 from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
 
+# Heavyweight (ring-attention grad comparisons + end-to-end sp-mesh training):
+# outside the tier-1 wallclock budget on a throttled CPU host. Run explicitly
+# with `-m slow`.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def qkv():

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Lint JSONL metric artifacts against the telemetry record schema.
+
+Invoked from the tier-1 suite (tests/test_telemetry.py) over every
+committed ``*_r0*.jsonl`` bench artifact in the repo root, so a future
+round cannot commit malformed metrics (invalid JSON lines, NaN/Infinity
+spellings, records claiming a schema version whose required keys are
+missing). Legacy artifacts written before the schema existed carry no
+``schema`` key and are held to the universal rules only
+(bert_pytorch_tpu/telemetry/schema.py).
+
+Usage::
+
+    python tools/check_telemetry_schema.py [paths...]
+
+With no paths, lints ``<repo_root>/*_r0*.jsonl``. Exit 0 = all valid,
+1 = violations (one ``path:line: error`` per finding), 2 = a named path
+is missing. Imports only the schema module — no jax — so it runs
+anywhere, including pre-commit hooks on machines without the accelerator
+stack.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from bert_pytorch_tpu.telemetry.schema import validate_file  # noqa: E402
+
+
+def main(argv=None) -> int:
+    paths = list(argv if argv is not None else sys.argv[1:])
+    if not paths:
+        paths = sorted(glob.glob(os.path.join(REPO_ROOT, "*_r0*.jsonl")))
+        if not paths:
+            print("check_telemetry_schema: no *_r0*.jsonl artifacts found")
+            return 0
+    failed = False
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"check_telemetry_schema: {path}: no such file")
+            return 2
+        errors = validate_file(path)
+        rel = os.path.relpath(path, REPO_ROOT)
+        if errors:
+            failed = True
+            for lineno, err in errors:
+                print(f"{rel}:{lineno}: {err}")
+        else:
+            print(f"{rel}: ok")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
