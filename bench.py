@@ -115,9 +115,20 @@ REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.environ.get("BENCH_COMPILE_CACHE_DIR",
                            os.path.join(REPO_ROOT, ".jax_cache"))
 # Optional telemetry sink (docs/telemetry.md): the child appends its
-# compile events (fn/shapes digest/compile seconds/cache hit-miss) as
-# schema-versioned JSONL so capture passes record cold-vs-warm evidence.
+# compile events (fn/shapes digest/compile seconds/cache hit-miss), a
+# run_summary (seq/s + MFU), and — on backends with allocator stats — a
+# device-memory watermark record as schema-versioned JSONL, so capture
+# passes record cold-vs-warm AND cost/memory evidence. When a baseline
+# artifact exists (BENCH_TELEMETRY_BASELINE, default the committed
+# repo-root BENCH_TELEMETRY.jsonl), the parent additionally runs
+# tools/telemetry_report.py over the pair and attaches its regression
+# verdict to the result JSON — the bench trajectory becomes
+# machine-checkable instead of eyeballed.
 TELEMETRY_JSONL = os.environ.get("BENCH_TELEMETRY_JSONL", "")
+TELEMETRY_BASELINE = os.environ.get(
+    "BENCH_TELEMETRY_BASELINE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "BENCH_TELEMETRY.jsonl"))
 
 
 def _config_digest(degraded=None, local_batch=None):
@@ -332,8 +343,22 @@ def _child_main():
         if TELEMETRY_JSONL:
             from bert_pytorch_tpu.utils.logging import JSONLHandler
             sink = JSONLHandler(TELEMETRY_JSONL, overwrite=False)
+        # Static cost attribution only when there is a sink to keep it:
+        # 'auto' never pays an un-cached extra backend compile
+        # (telemetry/memory.py), and the bench always enables the
+        # persistent cache, so memory_analysis costs a deserialize. An
+        # unknown env value degrades to 'off' — a typo must not kill a
+        # bench attempt after the compile already ran.
+        from bert_pytorch_tpu.telemetry.memory import COST_MODES
+        cost_mode = os.environ.get(
+            "BENCH_COST_ANALYSIS", "auto" if sink else "off")
+        if cost_mode not in COST_MODES:
+            print(f"BENCH_COST_ANALYSIS={cost_mode!r} unknown; "
+                  "disabling cost attribution", file=sys.stderr)
+            cost_mode = "off"
         monitor = CompileMonitor(
-            emit=sink.write_record if sink else lambda rec: None)
+            emit=sink.write_record if sink else lambda rec: None,
+            cost_analysis=cost_mode)
         step = monitor.instrument(step, "bench_step")
 
         batch = pretrain.put_batch(
@@ -405,14 +430,31 @@ def _child_main():
     result = _result_json(
         seq_per_sec_chip, mfu=model_flops_util, n_chips=n_chips,
         anchor_override=anchor)
-    if monitor.events:
+    compile_events = [e for e in monitor.events if e["kind"] == "compile"]
+    if compile_events:
         result["compile"] = {
-            "events": len(monitor.events),
-            "cache": monitor.events[0]["cache"],
+            "events": len(compile_events),
+            "cache": compile_events[0]["cache"],
             "compile_s": round(
-                sum(e["compile_s"] for e in monitor.events), 2),
+                sum(e["compile_s"] for e in compile_events), 2),
         }
     if sink is not None:
+        # Summary + memory watermark records so the offline regression
+        # gate (tools/telemetry_report.py) can diff seq/s, MFU, and peak
+        # device memory between this artifact and a committed baseline.
+        from bert_pytorch_tpu.telemetry.memory import MemorySampler
+
+        sampler = MemorySampler(emit=sink.write_record)
+        sampler.sample(MEASURE_STEPS)
+        sampler.flush(MEASURE_STEPS)
+        sink.write_record({
+            "kind": "run_summary", "tag": "telemetry",
+            "step": MEASURE_STEPS, "steps": MEASURE_STEPS,
+            "metric": result["metric"],
+            "training_seq_per_sec": round(seq_per_sec, 2),
+            "seq_per_sec_chip": round(seq_per_sec_chip, 2),
+            "mfu": round(model_flops_util, 4),
+        })
         sink.close()
     print(json.dumps(result))
 
@@ -455,6 +497,95 @@ def _result_json(seq_per_sec_chip, mfu=None, error=None, n_chips=None,
     if error is not None:
         out["error"] = error
     return out
+
+
+def _telemetry_offset():
+    """Byte size of the append-mode telemetry sink RIGHT NOW — taken
+    immediately before each child attempt, so a failed earlier attempt's
+    partial records (cold windows, near-OOM watermarks) never leak into
+    the tail the regression gate scores for the attempt that succeeded."""
+    if TELEMETRY_JSONL and os.path.exists(TELEMETRY_JSONL):
+        try:
+            return os.path.getsize(TELEMETRY_JSONL)
+        except OSError:
+            return 0
+    return 0
+
+
+def _attach_regression(result, offset=0):
+    """Offline regression gate: when this run wrote a telemetry artifact
+    and a previous committed one exists, diff them with
+    tools/telemetry_report.py and attach the verdict. The bench result
+    must always print, so the report's nonzero exit becomes a field
+    (CI/the capture harness gate on it), never a bench failure.
+
+    ``offset`` is the artifact's byte size when this invocation started:
+    the sink is append-mode (capture passes accumulate evidence across
+    runs), so the verdict must be computed over THIS invocation's records
+    only — older runs' windows/memory records would otherwise pollute the
+    maxima."""
+    if not TELEMETRY_JSONL or not os.path.exists(TELEMETRY_JSONL):
+        return result
+    baseline = TELEMETRY_BASELINE
+    if (not baseline or not os.path.exists(baseline)
+            or os.path.abspath(baseline) == os.path.abspath(TELEMETRY_JSONL)):
+        return result
+    tool = os.path.join(REPO_ROOT, "tools", "telemetry_report.py")
+    try:
+        run_path = TELEMETRY_JSONL
+        tmp_tail = None
+        if offset:
+            import tempfile
+
+            with open(TELEMETRY_JSONL, "rb") as f:
+                f.seek(offset)
+                tail = f.read()
+            fd, tmp_tail = tempfile.mkstemp(suffix=".jsonl")
+            with os.fdopen(fd, "wb") as f:
+                f.write(tail)
+            run_path = tmp_tail
+        try:
+            # --last-run: both artifacts are append-mode accumulations
+            # (this invocation's tail can hold several attempts; the
+            # committed baseline can hold several legs) — score each
+            # side's final run only.
+            proc = subprocess.run(
+                [sys.executable, tool, run_path, baseline, "--json",
+                 "--last-run"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                timeout=120)
+        finally:
+            if tmp_tail:
+                os.unlink(tmp_tail)
+        verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as exc:  # the gate is advisory; never break the bench
+        print(f"telemetry regression gate failed: {exc}", file=sys.stderr)
+        return result
+    # Different bench legs (phase2, seq2048, kfac, degraded fallback)
+    # share the default baseline path; diffing step time or peak memory
+    # across configurations is meaningless — refuse, don't flag.
+    run_metric = verdict.get("run", {}).get("metric")
+    base_metric = verdict.get("baseline", {}).get("metric")
+    if run_metric and base_metric and run_metric != base_metric:
+        result["regression"] = {
+            "verdict": "n/a",
+            "baseline": os.path.basename(baseline),
+            "note": f"baseline is {base_metric}, this run is "
+                    f"{run_metric}; not comparable",
+        }
+        return result
+    result["regression"] = {
+        "verdict": verdict.get("verdict"),
+        "baseline": os.path.basename(baseline),
+        "regressions": [
+            {k: r.get(k) for k in ("metric", "base", "new", "change")}
+            for r in verdict.get("regressions", [])],
+    }
+    if verdict.get("verdict") == "regression":
+        names = ", ".join(
+            r.get("metric", "?") for r in verdict.get("regressions", []))
+        print(f"bench REGRESSION vs {baseline}: {names}", file=sys.stderr)
+    return result
 
 
 _PROBE_SRC = ("import jax; ds = jax.devices(); "
@@ -575,6 +706,7 @@ def main():
             if remaining <= 5:
                 last_err = "backend probe ok but wall-clock budget exhausted"
                 break
+        tele_offset = _telemetry_offset()
         ok, out = _run_attempt(
             [sys.executable, os.path.abspath(__file__)],
             min(attempt_timeout, remaining), env)
@@ -593,7 +725,7 @@ def main():
             if not ok:
                 result.setdefault(
                     "child_exit", "non-zero after printing result")
-            print(json.dumps(result))
+            print(json.dumps(_attach_regression(result, tele_offset)))
             return
         last_err = f"bench child failed (attempt {attempt}): {out[-400:]}"
         print(last_err, file=sys.stderr)
@@ -616,6 +748,7 @@ def main():
         if ok and "BENCH_PROBE_OK" in out:
             denv = dict(env)
             denv["BENCH_DEGRADED"] = "1"
+            tele_offset = _telemetry_offset()
             ok, out = _run_attempt(
                 [sys.executable, os.path.abspath(__file__)],
                 max(30, deadline - time.monotonic()), denv)
@@ -624,7 +757,7 @@ def main():
                 if not ok:
                     result.setdefault(
                         "child_exit", "non-zero after printing result")
-                print(json.dumps(result))
+                print(json.dumps(_attach_regression(result, tele_offset)))
                 return
             last_err = (f"degraded fallback also failed: {out[-300:]}; "
                         f"after: {last_err}")

@@ -214,6 +214,8 @@ def make_train_step(
     kfac_inv_interval: int = 0,
     kfac_capture_microbatches: str = "first",
     loss_scale: bool = False,
+    stats_every: int = 0,
+    stats_phase: int = 0,
 ):
     """Build the jitted train step.
 
@@ -260,6 +262,14 @@ def make_train_step(
     ``optim.dynamic_loss_scale``; the step multiplies the loss by the
     state's current scale before differentiating and the wrapper
     unscales, finite-checks, and skips/backs off.
+
+    ``stats_every > 0`` splices the in-jit grad-health block
+    (telemetry/model_stats.py: per-layer-group grad/param norms and
+    update:weight ratios) into ``metrics["grad_health"]``, lax.cond-gated
+    on the optimizer step counter so off-cadence steps pay nothing.
+    ``stats_phase`` is the optimizer count at run start (resumed runs),
+    aligning the due gate with the host's run-local sync cadence.
+    TrainTelemetry.step_done pops and emits it.
     """
     if kfac is not None and schedule is None:
         raise ValueError("kfac preconditioning requires a schedule")
@@ -450,6 +460,22 @@ def make_train_step(
             metrics["loss_scale"] = scale
         if schedule is not None:
             metrics["learning_rate"] = schedule(opt_step_count(state.opt_state))
+        if stats_every:
+            from bert_pytorch_tpu.telemetry import model_stats
+
+            # fp16: skipped overflow steps do NOT advance the inner
+            # optimizer count (optim/transforms.py dynamic_loss_scale),
+            # so a count-based gate would drift off the host's
+            # step-index sync cadence after the first skip and the
+            # records would silently stop. Compute every step instead —
+            # the O(params) reduction is noise next to the step's
+            # O(params x tokens) — and let the sync cadence sample.
+            metrics["grad_health"] = model_stats.gated_grad_health(
+                state.params, grads, updates,
+                opt_step_count(state.opt_state),
+                1 if loss_scale else stats_every,
+                grad_scale=scale if loss_scale else None,
+                phase=stats_phase)
         new_state = TrainState(params=params, opt_state=opt_state, rng=new_rng)
         if fused_kfac:
             return new_state, metrics, kfac_state
@@ -471,6 +497,8 @@ def make_pp_train_step(
     max_pred_per_seq: Optional[int] = None,
     kfac=None,
     kfac_shardings=None,
+    stats_every: int = 0,
+    stats_phase: int = 0,
 ):
     """Train step with the encoder executed as a GPipe pipeline over the
     mesh 'pipe' axis (parallel/pipeline.py).
@@ -677,6 +705,16 @@ def make_pp_train_step(
         }
         if schedule is not None:
             metrics["learning_rate"] = schedule(opt_step_count(state.opt_state))
+        if stats_every:
+            # Same grad-health block as make_train_step; the norms are
+            # pure per-leaf reductions, so XLA reshards them over the
+            # pipe-sharded gradient layout for free.
+            from bert_pytorch_tpu.telemetry import model_stats
+
+            metrics["grad_health"] = model_stats.gated_grad_health(
+                state.params, grads, updates,
+                opt_step_count(state.opt_state), stats_every,
+                phase=stats_phase)
         return TrainState(params=params, opt_state=opt_state, rng=new_rng), metrics
 
     return _jit_train_step(

@@ -9,9 +9,17 @@ facade every training entry point threads its loop through.
 
 from bert_pytorch_tpu.telemetry.cli import (add_cli_args,
                                             default_jsonl_path,
-                                            from_args)
+                                            from_args,
+                                            stats_every)
 from bert_pytorch_tpu.telemetry.compile_events import (CompileMonitor,
                                                        shapes_digest)
+from bert_pytorch_tpu.telemetry.memory import (MemorySampler,
+                                               analyze_executable)
+from bert_pytorch_tpu.telemetry.model_stats import (DivergenceError,
+                                                    DivergenceMonitor,
+                                                    finetune_grad_health,
+                                                    gated_grad_health,
+                                                    grad_health)
 from bert_pytorch_tpu.telemetry.profiler import (ProfilerWindow,
                                                  parse_profile_spec)
 from bert_pytorch_tpu.telemetry.runner import TrainTelemetry
@@ -24,15 +32,23 @@ from bert_pytorch_tpu.telemetry.step_timer import StepTimer
 
 __all__ = [
     "CompileMonitor",
+    "DivergenceError",
+    "DivergenceMonitor",
+    "MemorySampler",
     "add_cli_args",
+    "analyze_executable",
     "default_jsonl_path",
     "from_args",
     "FailureSentinel",
+    "finetune_grad_health",
+    "gated_grad_health",
+    "grad_health",
     "Heartbeat",
     "NonFiniteError",
     "ProfilerWindow",
     "SCHEMA_VERSION",
     "StepTimer",
+    "stats_every",
     "TrainTelemetry",
     "parse_profile_spec",
     "shapes_digest",

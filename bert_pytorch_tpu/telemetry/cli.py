@@ -66,6 +66,46 @@ def add_cli_args(parser, window_default: int = 50,
                              "default <output_dir>/heartbeat.json. The "
                              "capture harness reads it instead of guessing "
                              "liveness from checkpoint mtimes")
+    parser.add_argument("--grad_stats_every", type=int, default=-1,
+                        help="in-jit grad-health cadence (per-layer-group "
+                             "grad/param norms + update:weight ratios, "
+                             "telemetry/model_stats.py): N computes every "
+                             "Nth optimizer step, 0 disables, -1 (default) "
+                             "follows --telemetry_sync_every so the host "
+                             "reads every computed block for free on its "
+                             "existing sync")
+    parser.add_argument("--grad_spike_factor", type=float, default=10.0,
+                        help="divergence early-warning: warn when the "
+                             "global grad norm exceeds this factor x its "
+                             "own EMA (0 disables). Warnings follow "
+                             "--sentinel_policy/--sentinel_patience")
+    parser.add_argument("--update_ratio_max", type=float, default=1.0,
+                        help="divergence early-warning: warn when the "
+                             "global update:weight ratio exceeds this "
+                             "absolute bound (0 disables) — a per-step "
+                             "relative weight change near 1 is a blown "
+                             "learning rate, caught before the loss NaNs")
+    parser.add_argument("--telemetry_cost_analysis", type=str,
+                        default="auto", choices=["auto", "off", "full"],
+                        help="static per-executable cost attribution "
+                             "(compile_cost records: FLOPs, bytes "
+                             "accessed, argument/output/temp bytes). "
+                             "'auto' compiles for memory_analysis only "
+                             "when that is cheap (CPU, or persistent "
+                             "compile cache on) and falls back to the "
+                             "compile-free HLO cost analysis elsewhere; "
+                             "'full' always compiles (one extra backend "
+                             "compile per shapes digest)")
+
+
+def stats_every(args) -> int:
+    """Resolve --grad_stats_every: -1 follows the sync cadence (the host
+    can only READ the block on synced steps, so computing it off-cadence
+    would burn device FLOPs on values nobody fetches)."""
+    every = getattr(args, "grad_stats_every", 0)
+    if every is None or every < 0:
+        return max(0, int(getattr(args, "telemetry_sync_every", 0)))
+    return int(every)
 
 
 def default_jsonl_path(args, output_dir: Optional[str],
@@ -109,4 +149,7 @@ def from_args(args, sink=None, is_primary: bool = True,
         profile_dir=profile_dir,
         sentinel_policy=args.sentinel_policy,
         sentinel_patience=args.sentinel_patience,
-        heartbeat_path=heartbeat)
+        heartbeat_path=heartbeat,
+        grad_spike_factor=args.grad_spike_factor,
+        update_ratio_max=args.update_ratio_max,
+        cost_analysis=args.telemetry_cost_analysis)

@@ -33,6 +33,12 @@ compilation run synchronously on the calling thread, so events fired while
 the wrapper is on-stack belong to it. Listener registration is global and
 permanent (jax.monitoring has no unregister), so listeners are installed
 once and route through a module-level active-monitor registry.
+
+With ``cost_analysis`` != "off" every first-seen (fn, shapes_digest) pair
+additionally emits one ``kind="compile_cost"`` record — the executable's
+static FLOPs / bytes-accessed / argument-output-temp bytes
+(telemetry/memory.py :func:`analyze_executable`) — so each compile event
+in the stream carries the cost of what it compiled.
 """
 
 from __future__ import annotations
@@ -116,11 +122,27 @@ class CompileMonitor:
     """
 
     def __init__(self, emit: Callable[[dict], None],
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 cost_analysis: str = "off"):
         _ensure_listeners()
         self._emit = emit
         self._clock = clock
         self.events: list = []  # everything emitted, for programmatic access
+        # Static cost/memory attribution (telemetry/memory.py): one
+        # kind="compile_cost" record per (fn, shapes_digest), emitted
+        # right after that signature's first compile event so every
+        # compile in the stream carries its cost. Mode semantics —
+        # auto/off/full — are analyze_executable's; validate HERE so a
+        # bad mode fails at construction, not mid-run after the first
+        # (expensive) compile already happened.
+        from bert_pytorch_tpu.telemetry.memory import COST_MODES
+
+        if cost_analysis not in COST_MODES:
+            raise ValueError(
+                f"cost_analysis must be one of {COST_MODES}, got "
+                f"{cost_analysis!r}")
+        self.cost_analysis = cost_analysis
+        self._cost_done: set = set()
 
     def instrument(self, fn, name: str):
         """Return ``fn`` wrapped so first-seen shape signatures (and any
@@ -158,6 +180,7 @@ class CompileMonitor:
                 seen.add(digest)
                 if first or activity:
                     self._record(name, digest, elapsed, call)
+                    self._attribute_cost(fn, name, digest, args, kwargs)
             return out
 
         wrapper.__name__ = f"{name}_monitored"
@@ -189,5 +212,23 @@ class CompileMonitor:
             "backend_compile_s": round(call["backend_compile_s"], 4),
             "cache": cache,
         }
+        self.events.append(record)
+        self._emit(record)
+
+    def _attribute_cost(self, fn, name, digest, args, kwargs) -> None:
+        if self.cost_analysis == "off":
+            return
+        key = (name, digest)
+        if key in self._cost_done:
+            return
+        self._cost_done.add(key)
+        from bert_pytorch_tpu.telemetry import memory as memory_util
+
+        fields = memory_util.analyze_executable(
+            fn, args, kwargs, mode=self.cost_analysis)
+        if fields is None:
+            return
+        record = {"kind": "compile_cost", "tag": "telemetry", "fn": name,
+                  "shapes_digest": digest, **fields}
         self.events.append(record)
         self._emit(record)

@@ -1,0 +1,406 @@
+"""Offline telemetry reporting: human summary + baseline-diff regression
+verdict over the JSONL artifacts the telemetry layer writes.
+
+``summarize_file`` folds one artifact's records (``step_window``,
+``compile``, ``sentinel``, ``grad_health``, ``divergence``, ``memory``,
+``run_summary``) into a flat summary; ``compare`` diffs two summaries
+against relative tolerances and returns named regressions. The CLI
+(`tools/telemetry_report.py`, console entry ``telemetry-report``) prints
+the summary — and, given a baseline, the diff table — and exits nonzero
+when any regression trips, which is what lets bench/CI gate on "did this
+change make training slower, hungrier, or less healthy" instead of
+eyeballing JSON.
+
+Aggregation note: window records carry per-window percentiles, not raw
+per-step samples, so the file-level ``step_p50_s`` is the
+window-steps-weighted median of window p50s (robust to a cold-compile
+first window) and ``step_p95_s`` is the max of window p95s (a tail
+regression anywhere in the run must not average away). Throughput is the
+harmonic aggregate — total steps over total window wall time.
+
+This module imports stdlib only. The repo-root shim
+(``tools/telemetry_report.py``) loads it by file path — bypassing the
+package __init__ chain, which imports jax — so the checkout tool runs on
+any machine, including CI boxes without the accelerator stack; the
+installed ``telemetry-report`` console script goes through the package
+import, where jax is a declared dependency anyway.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional
+
+# Relative tolerances (fraction of the baseline value) per check; chosen
+# so real regressions (the ISSUE-2 gate injects +25% step time) trip
+# clearly while window-to-window noise on a busy host does not.
+DEFAULT_TOLERANCES = {
+    "step": 0.10,    # step-time p50 / throughput / seq-per-sec
+    "p95": 0.25,     # step-time p95 (noisier tail)
+    "mfu": 0.10,     # MFU drop
+    "mem": 0.05,     # peak device memory growth
+    "grad": 1.00,    # grad-health envelope (2x the baseline max)
+}
+
+
+def _weighted_median(pairs):
+    """Median of (value, weight) pairs; None when empty."""
+    pairs = sorted((p for p in pairs if p[1] > 0), key=lambda p: p[0])
+    total = sum(w for _, w in pairs)
+    if not total:
+        return None
+    acc = 0.0
+    for value, weight in pairs:
+        acc += weight
+        if acc >= total / 2.0:
+            return value
+    return pairs[-1][0]
+
+
+def iter_records(path: str):
+    """Decoded records of one JSONL artifact; silently skips blank and
+    undecodable lines (the schema linter owns strictness)."""
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                yield rec
+
+
+def last_run_records(records):
+    """Trim an append-mode artifact to its FINAL run. Runs are terminated
+    by ``run_summary`` records, so the final run is everything after the
+    penultimate run_summary (including any trailing records of an
+    unfinished newer run — those are the freshest evidence either way).
+    With fewer than two run_summary records there is nothing to trim."""
+    recs = list(records)
+    ends = [i for i, rec in enumerate(recs)
+            if rec.get("kind") == "run_summary"]
+    if len(ends) >= 2:
+        return recs[ends[-2] + 1:]
+    return recs
+
+
+def summarize_file(path: str, last_run: bool = False) -> dict:
+    records = iter_records(path)
+    if last_run:
+        records = last_run_records(records)
+    return summarize_records(records, name=os.path.basename(path))
+
+
+def summarize_records(records, name: str = "") -> dict:
+    windows = []
+    compiles = []
+    sentinels = []
+    divergences = []
+    grad_health = []
+    memory = []
+    run_summary: Optional[dict] = None
+    n_records = 0
+    for rec in records:
+        n_records += 1
+        kind = rec.get("kind")
+        if kind == "step_window":
+            windows.append(rec)
+        elif kind == "compile":
+            compiles.append(rec)
+        elif kind == "sentinel":
+            sentinels.append(rec)
+        elif kind == "divergence":
+            divergences.append(rec)
+        elif kind == "grad_health":
+            grad_health.append(rec)
+        elif kind == "memory":
+            memory.append(rec)
+        elif kind == "run_summary":
+            run_summary = rec
+
+    out: dict = {"name": name, "records": n_records}
+
+    if windows:
+        steps = sum(int(w.get("window_steps", 0)) for w in windows)
+        wall = sum(
+            int(w["window_steps"]) / float(w["steps_per_sec"])
+            for w in windows
+            if w.get("steps_per_sec") and float(w["steps_per_sec"]) > 0)
+        out["steps"] = steps
+        out["windows"] = len(windows)
+        if wall > 0:
+            out["wall_s"] = round(wall, 3)
+            out["steps_per_sec"] = round(steps / wall, 4)
+        for key in ("step_p50_s", "data_wait_p50_s", "host_p50_s",
+                    "device_p50_s"):
+            med = _weighted_median(
+                [(float(w[key]), int(w.get("window_steps", 1)))
+                 for w in windows if key in w])
+            if med is not None:
+                out[key] = round(med, 6)
+        # The step-0 compile lands in the FIRST window (its tail AND its
+        # wall-basis MFU), so a cold run diffed against a warm baseline
+        # would flag bogus p95/MFU regressions that are only cache
+        # temperature; with more than one window the steady-state tail
+        # is what the gate should compare.
+        tail = windows[1:] if len(windows) > 1 else windows
+        p95s = [float(w["step_p95_s"]) for w in tail if "step_p95_s" in w]
+        if p95s:
+            out["step_p95_s"] = round(max(p95s), 6)
+        mfus = [(float(w["mfu"]), int(w.get("window_steps", 1)))
+                for w in tail
+                if w.get("mfu") and w.get("mfu_basis") not in (None, "none")]
+        if mfus:
+            total_w = sum(w for _, w in mfus)
+            out["mfu"] = round(
+                sum(v * w for v, w in mfus) / total_w, 4)
+
+    if compiles:
+        by_cache: dict = {}
+        for rec in compiles:
+            by_cache[rec.get("cache", "?")] = (
+                by_cache.get(rec.get("cache", "?"), 0) + 1)
+        out["compiles"] = len(compiles)
+        out["compile_s"] = round(
+            sum(float(rec.get("compile_s", 0.0)) for rec in compiles), 3)
+        out["compile_cache"] = by_cache
+        out["cold_start"] = bool(
+            by_cache.get("miss", 0) + by_cache.get("uncached", 0))
+
+    out["nonfinite_steps"] = len(sentinels)
+    if sentinels:
+        out["nonfinite_max_consecutive"] = max(
+            int(rec.get("consecutive_nonfinite", 1)) for rec in sentinels)
+
+    out["divergence_warnings"] = len(divergences)
+    if divergences:
+        out["divergence_reasons"] = sorted(
+            {rec.get("reason", "?") for rec in divergences})
+
+    if grad_health:
+        norms = [float(rec["grad_norm"]) for rec in grad_health
+                 if rec.get("grad_norm") is not None]
+        ratios = [float(rec["update_ratio"]) for rec in grad_health
+                  if rec.get("update_ratio") is not None]
+        out["grad_health_records"] = len(grad_health)
+        if norms:
+            out["grad_norm_last"] = round(norms[-1], 6)
+            out["grad_norm_max"] = round(max(norms), 6)
+        if ratios:
+            out["update_ratio_last"] = round(ratios[-1], 8)
+            out["update_ratio_max"] = round(max(ratios), 8)
+
+    supported = [rec for rec in memory if rec.get("memory_supported")]
+    if memory:
+        out["memory_supported"] = bool(supported)
+    if supported:
+        out["peak_bytes_in_use"] = max(
+            int(rec.get("peak_bytes_in_use", 0)) for rec in supported)
+        out["bytes_in_use_last"] = int(supported[-1].get("bytes_in_use", 0))
+        limits = [int(rec.get("bytes_limit", 0)) for rec in supported]
+        if any(limits):
+            out["bytes_limit"] = max(limits)
+
+    if run_summary:
+        for key, value in run_summary.items():
+            if key in ("schema", "ts", "kind", "tag"):
+                continue
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                out.setdefault(key, value)
+            elif key == "metric" and isinstance(value, str):
+                # Bench runs stamp their config's metric name; consumers
+                # (bench.py's regression gate) use it to refuse diffing
+                # incomparable configurations.
+                out.setdefault("metric", value)
+    return out
+
+
+# (summary key, pretty name, direction, tolerance key). Direction "up"
+# means a larger NEW value is the regression.
+_CHECKS = (
+    ("step_p50_s", "step-time p50", "up", "step"),
+    ("step_p95_s", "step-time p95", "up", "p95"),
+    ("steps_per_sec", "throughput (steps/s)", "down", "step"),
+    ("training_seq_per_sec", "training seq/s", "down", "step"),
+    ("mfu", "MFU", "down", "mfu"),
+    ("peak_bytes_in_use", "peak device memory", "up", "mem"),
+    ("grad_norm_max", "grad-norm envelope", "up", "grad"),
+    ("update_ratio_max", "update-ratio envelope", "up", "grad"),
+)
+
+
+def compare(base: dict, new: dict, tolerances: Optional[dict] = None):
+    """(regressions, checks): every comparable metric with a verdict.
+
+    A check only runs when BOTH summaries carry the metric with a
+    nonzero baseline — a metric appearing or disappearing (e.g. MFU on
+    CPU) is reported as an ``"n/a"`` check, not a regression.
+    """
+    tol = dict(DEFAULT_TOLERANCES)
+    tol.update(tolerances or {})
+    checks = []
+    regressions = []
+    for key, label, direction, tol_key in _CHECKS:
+        b, n = base.get(key), new.get(key)
+        if b is None or n is None or not b:
+            if b is not None or n is not None:
+                checks.append({"metric": key, "label": label,
+                               "verdict": "n/a", "base": b, "new": n})
+            continue
+        rel = (n - b) / abs(b)
+        worse = rel > tol[tol_key] if direction == "up" \
+            else rel < -tol[tol_key]
+        entry = {
+            "metric": key, "label": label, "base": b, "new": n,
+            "change": round(rel, 4), "tolerance": tol[tol_key],
+            "verdict": "regression" if worse else "ok",
+        }
+        checks.append(entry)
+        if worse:
+            regressions.append(entry)
+    # Health counters: any NEW occurrence where the baseline had none is
+    # a regression regardless of tolerance.
+    for key, label in (("nonfinite_steps", "non-finite steps"),
+                       ("divergence_warnings", "divergence warnings")):
+        b, n = int(base.get(key, 0)), int(new.get(key, 0))
+        if n > b:
+            entry = {"metric": key, "label": label, "base": b, "new": n,
+                     "verdict": "regression"}
+            checks.append(entry)
+            regressions.append(entry)
+        elif b or n:
+            checks.append({"metric": key, "label": label, "base": b,
+                           "new": n, "verdict": "ok"})
+    return regressions, checks
+
+
+def _fmt_value(key, value):
+    if value is None:
+        return "-"
+    if key.endswith("bytes_in_use") or key in ("bytes_limit",):
+        return f"{value / (1 << 20):.1f} MiB"
+    if isinstance(value, float):
+        return f"{value:.6g}"
+    return str(value)
+
+
+def format_summary(summary: dict) -> str:
+    lines = [f"== {summary.get('name') or 'telemetry'} "
+             f"({summary.get('records', 0)} records)"]
+    order = ("steps", "wall_s", "steps_per_sec", "step_p50_s", "step_p95_s",
+             "data_wait_p50_s", "host_p50_s", "device_p50_s", "mfu",
+             "training_seq_per_sec", "compiles", "compile_s", "cold_start",
+             "nonfinite_steps", "divergence_warnings", "grad_norm_last",
+             "grad_norm_max", "update_ratio_max", "memory_supported",
+             "peak_bytes_in_use", "bytes_in_use_last", "bytes_limit")
+    for key in order:
+        if key in summary:
+            lines.append(f"  {key:>22}: {_fmt_value(key, summary[key])}")
+    if summary.get("compile_cache"):
+        lines.append(f"  {'compile_cache':>22}: "
+                     + ", ".join(f"{k}={v}" for k, v
+                                 in sorted(summary["compile_cache"].items())))
+    if summary.get("divergence_reasons"):
+        lines.append(f"  {'divergence_reasons':>22}: "
+                     + ", ".join(summary["divergence_reasons"]))
+    return "\n".join(lines)
+
+
+def format_checks(checks) -> str:
+    lines = []
+    for c in checks:
+        mark = {"regression": "REGRESSION", "ok": "ok", "n/a": "n/a"}[
+            c["verdict"]]
+        if "change" in c:
+            lines.append(
+                f"  {mark:>10} {c['label']}: "
+                f"{_fmt_value(c['metric'], c['base'])} -> "
+                f"{_fmt_value(c['metric'], c['new'])} "
+                f"({c['change']:+.1%}, tolerance {c['tolerance']:.0%})")
+        else:
+            lines.append(
+                f"  {mark:>10} {c['label']}: "
+                f"{_fmt_value(c['metric'], c.get('base'))} -> "
+                f"{_fmt_value(c['metric'], c.get('new'))}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="telemetry-report",
+        description="Summarize a telemetry JSONL artifact; with a "
+                    "baseline, diff the two and exit 1 on regression "
+                    "(docs/telemetry.md).")
+    parser.add_argument("run", help="telemetry JSONL of the run under test")
+    parser.add_argument("baseline", nargs="?", default=None,
+                        help="baseline telemetry JSONL to diff against")
+    parser.add_argument("--baseline", dest="baseline_flag", default=None,
+                        help="alternative spelling of the baseline path")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output (summaries + checks "
+                             "+ verdict) instead of the human tables")
+    parser.add_argument("--last-run", action="store_true",
+                        help="summarize only each artifact's FINAL run "
+                             "(append-mode artifacts accumulate runs, "
+                             "delimited by run_summary records; blending "
+                             "them poisons the medians/maxima the "
+                             "regression checks compare)")
+    parser.add_argument("--step-tol", type=float,
+                        default=DEFAULT_TOLERANCES["step"],
+                        help="relative tolerance for step-time p50 and "
+                             "throughput (default %(default)s)")
+    parser.add_argument("--p95-tol", type=float,
+                        default=DEFAULT_TOLERANCES["p95"],
+                        help="relative tolerance for step-time p95")
+    parser.add_argument("--mfu-tol", type=float,
+                        default=DEFAULT_TOLERANCES["mfu"],
+                        help="relative tolerance for MFU drop")
+    parser.add_argument("--mem-tol", type=float,
+                        default=DEFAULT_TOLERANCES["mem"],
+                        help="relative tolerance for peak-memory growth")
+    parser.add_argument("--grad-tol", type=float,
+                        default=DEFAULT_TOLERANCES["grad"],
+                        help="relative tolerance for the grad-health "
+                             "envelopes (1.0 = 2x the baseline max)")
+    args = parser.parse_args(argv)
+    baseline = args.baseline_flag or args.baseline
+
+    for path in filter(None, (args.run, baseline)):
+        if not os.path.exists(path):
+            print(f"telemetry-report: {path}: no such file")
+            return 2
+    new = summarize_file(args.run, last_run=args.last_run)
+    if baseline is None:
+        if args.json:
+            print(json.dumps({"run": new}))
+        else:
+            print(format_summary(new))
+        return 0
+
+    base = summarize_file(baseline, last_run=args.last_run)
+    tolerances = {"step": args.step_tol, "p95": args.p95_tol,
+                  "mfu": args.mfu_tol, "mem": args.mem_tol,
+                  "grad": args.grad_tol}
+    regressions, checks = compare(base, new, tolerances)
+    verdict = "regression" if regressions else "ok"
+    if args.json:
+        print(json.dumps({"verdict": verdict, "regressions": regressions,
+                          "checks": checks, "run": new, "baseline": base}))
+    else:
+        print(format_summary(base))
+        print(format_summary(new))
+        print(f"== regression check (run vs baseline: {verdict})")
+        print(format_checks(checks))
+        if regressions:
+            names = ", ".join(r["label"] for r in regressions)
+            print(f"telemetry-report: REGRESSION in: {names}")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,7 +1,7 @@
 """TrainTelemetry — the facade every runner threads its training loop
 through (run_pretraining, run_squad, run_glue, run_ner, run_swag, bench.py).
 
-One object owns the five telemetry pieces and their lifecycle:
+One object owns the telemetry pieces and their lifecycle:
 
 * a JSONL sink (``utils/logging.py JSONLHandler``) — registered with the
   global logger by the runner so ordinary train records land there too,
@@ -14,7 +14,14 @@ One object owns the five telemetry pieces and their lifecycle:
   (``instrument()``) attributing every XLA compile / cache hit to the
   jitted entry point and shapes digest that triggered it;
 * a :class:`~bert_pytorch_tpu.telemetry.sentinels.FailureSentinel` and
-  rank-0 :class:`~bert_pytorch_tpu.telemetry.sentinels.Heartbeat`.
+  rank-0 :class:`~bert_pytorch_tpu.telemetry.sentinels.Heartbeat`;
+* a :class:`~bert_pytorch_tpu.telemetry.memory.MemorySampler` reading
+  ``device.memory_stats()`` watermarks on the sync cadence (one record
+  per window; a single ``memory_supported: false`` note on CPU);
+* a :class:`~bert_pytorch_tpu.telemetry.model_stats.DivergenceMonitor`
+  consuming the in-jit grad-health block the train steps splice into
+  ``metrics["grad_health"]`` (popped here, emitted as ``grad_health``
+  records, checked for grad-norm spikes / update-ratio drift).
 
 Minimal loop integration::
 
@@ -37,6 +44,9 @@ import time
 from typing import Callable, Iterator, Optional
 
 from bert_pytorch_tpu.telemetry.compile_events import CompileMonitor
+from bert_pytorch_tpu.telemetry.memory import MemorySampler
+from bert_pytorch_tpu.telemetry.model_stats import (DivergenceMonitor,
+                                                    health_record)
 from bert_pytorch_tpu.telemetry.profiler import ProfilerWindow
 from bert_pytorch_tpu.telemetry.sentinels import FailureSentinel, Heartbeat
 from bert_pytorch_tpu.telemetry.step_timer import StepTimer
@@ -61,6 +71,10 @@ class TrainTelemetry:
         sentinel_patience: int = 3,
         heartbeat_path: Optional[str] = None,
         heartbeat_every: int = 1,
+        grad_spike_factor: float = 10.0,
+        update_ratio_max: float = 1.0,
+        grad_warmup: int = 10,
+        cost_analysis: str = "auto",
         clock: Callable[[], float] = time.perf_counter,
     ):
         self.is_primary = is_primary
@@ -79,10 +93,23 @@ class TrainTelemetry:
             device_kind=device_kind, n_devices=n_devices)
         self.profiler = ProfilerWindow(
             profile_steps, profile_dir, enabled=is_primary)
-        self.compile_monitor = CompileMonitor(emit=self.emit)
+        self.compile_monitor = CompileMonitor(
+            emit=self.emit, cost_analysis=cost_analysis)
         self.sentinel = FailureSentinel(
             policy=sentinel_policy, patience=sentinel_patience,
             emit=self.emit)
+        # Grad-health early-warning shares the sentinel's policy/patience:
+        # a sustained divergence warning is the same class of failure as a
+        # sustained NaN, just caught earlier (model_stats.py).
+        self.divergence = DivergenceMonitor(
+            emit=self.emit, policy=sentinel_policy,
+            patience=sentinel_patience, spike_factor=grad_spike_factor,
+            ratio_max=update_ratio_max, warmup=grad_warmup)
+        # Device-memory watermarks, sampled where the host already blocks
+        # (the sync cadence) and emitted one record per window. Rank-0
+        # only: every process sees the same allocator story under SPMD,
+        # and per-rank duplicates would just bloat the artifact.
+        self.memory = MemorySampler(emit=self.emit, enabled=is_primary)
         self.heartbeat = Heartbeat(heartbeat_path, is_primary=is_primary)
         self.heartbeat_every = max(1, int(heartbeat_every))
         self._loader_stats: Optional[Callable[[], Optional[dict]]] = None
@@ -140,6 +167,13 @@ class TrainTelemetry:
         step; without it a resumed run would close the trace window
         immediately). Returns the window record when one was emitted.
         """
+        # The in-jit grad-health block rides in metrics but is telemetry's,
+        # not the runner's: pop it unconditionally so runner-side
+        # float(metrics[...]) loops never trip over the nested dict, and
+        # read it only on synced steps (fetching it otherwise would BE a
+        # sync and defeat the cadence).
+        health = metrics.pop("grad_health", None) \
+            if isinstance(metrics, dict) else None
         target = sync_target if sync_target is not None else metrics
         self._last_sync_target = target
         synced = False
@@ -147,6 +181,15 @@ class TrainTelemetry:
             self.timer.device_sync(target)
             synced = True
         self.last_step_synced = synced
+        if synced:
+            self.memory.sample(step)
+            if health is not None and float(health.get("due", 0.0)):
+                record = health_record(step, health)
+                self.emit(record)
+                # DivergenceError propagates under policy="abort", same
+                # surface as the sentinel's NonFiniteError.
+                self.divergence.observe(
+                    step, record["grad_norm"], record["update_ratio"])
         if metrics is not None and synced:
             loss = metrics.get("loss")
             loss = None if loss is None else float(loss)
@@ -170,6 +213,7 @@ class TrainTelemetry:
                 if gauges:
                     window["loader"] = gauges
             self.emit(window)
+            self.memory.flush(step)  # one memory record per window
         return window
 
     # -- teardown -------------------------------------------------------
@@ -181,6 +225,7 @@ class TrainTelemetry:
         window = self.timer.flush(step)
         if window is not None:
             self.emit(window)
+        self.memory.flush(step)  # partial-window memory samples
         if summary is not None:
             rec = {"kind": "run_summary", "tag": "telemetry", "step": step,
                    "steps": step}

@@ -45,6 +45,22 @@ KIND_REQUIRED_KEYS = {
     "compile": ("fn", "shapes_digest", "compile_s", "cache"),
     # non-finite loss/grad-norm observation (telemetry/sentinels.py)
     "sentinel": ("step", "finite", "consecutive_nonfinite", "policy"),
+    # in-jit model-internals statistics fetched on the sync cadence
+    # (telemetry/model_stats.py): global + per-layer-group grad/param
+    # norms and update:weight ratios
+    "grad_health": ("step", "grad_norm", "param_norm", "update_ratio",
+                    "groups"),
+    # divergence early-warning from the grad-health monitor
+    # (telemetry/model_stats.py DivergenceMonitor)
+    "divergence": ("step", "reason", "value", "threshold", "policy"),
+    # device-memory watermarks sampled on the sync cadence, or the
+    # one-shot memory_supported:false note on backends without
+    # allocator stats (telemetry/memory.py MemorySampler)
+    "memory": ("step", "memory_supported"),
+    # one-shot static cost/memory attribution of a jitted executable,
+    # joined to the compile event by (fn, shapes_digest)
+    # (telemetry/memory.py analyze_executable)
+    "compile_cost": ("fn", "shapes_digest", "analysis"),
     # end-of-run rollup
     "run_summary": ("steps",),
 }
@@ -83,9 +99,21 @@ def validate_record(rec) -> list:
                         errors.append(
                             f"loader gauges missing keys {missing}")
     for key, value in rec.items():
-        if isinstance(value, float) and not math.isfinite(value):
-            errors.append(f"non-finite value for {key!r}")
+        _check_finite(key, value, errors)
     return errors
+
+
+def _check_finite(key, value, errors) -> None:
+    """Non-finite floats anywhere in the record (grad_health nests its
+    per-group stats; memory/compile_cost nest nothing today but may)."""
+    if isinstance(value, float) and not math.isfinite(value):
+        errors.append(f"non-finite value for {key!r}")
+    elif isinstance(value, dict):
+        for k, v in value.items():
+            _check_finite(f"{key}.{k}", v, errors)
+    elif isinstance(value, list):
+        for i, v in enumerate(value):
+            _check_finite(f"{key}[{i}]", v, errors)
 
 
 def validate_line(line: str) -> list:

@@ -39,6 +39,17 @@ def barrier() -> None:
         )
 
 
+def _multihost_utils():
+    """Deferred multihost_utils accessor — the explicit seam the resume-
+    agreement unit test replaces (patching ``sys.modules`` is unreliable:
+    once the real module was imported anywhere, ``from jax.experimental
+    import multihost_utils`` binds the package ATTRIBUTE and ignores the
+    sys.modules entry)."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils
+
+
 def agree_on_resume_step(step: int | None) -> int | None:
     """Cross-process agreement on which checkpoint step to resume from.
 
@@ -54,11 +65,13 @@ def agree_on_resume_step(step: int | None) -> int | None:
     """
     if jax.process_count() == 1:
         return step
-    from jax.experimental import multihost_utils
-
+    # Host numpy proposal, not jnp: process_allgather accepts host-local
+    # values, and building a jax array here would dispatch a device op
+    # before the gather — under a faked two-process setup (the unit test)
+    # that dispatch explodes inside jax before the fake gather is reached.
     proposals = np.asarray(
-        multihost_utils.process_allgather(
-            jnp.int32(-1 if step is None else step)
+        _multihost_utils().process_allgather(
+            np.int32(-1 if step is None else step)
         )
     )
     lo, hi = int(proposals.min()), int(proposals.max())

@@ -104,9 +104,8 @@ def main(args):
     processor = glue.PROCESSORS[args.task]()
     regression = processor.regression
     num_labels = 1 if regression else len(processor.labels)
-    telemetry_jsonl = args.telemetry_jsonl or (
-        os.path.join(args.output_dir, "glue_telemetry.jsonl")
-        if args.output_dir else None)
+    telemetry_jsonl = telemetry.default_jsonl_path(
+        args, args.output_dir, "glue")
     telemetry_sink = (logger.JSONLHandler(telemetry_jsonl, overwrite=False)
                       if telemetry_jsonl else None)
     logger.init(handlers=[logger.StreamHandler()]
@@ -171,6 +170,8 @@ def main(args):
         return _xent_ignore(
             logits.astype(jnp.float32), jnp.where(valid, labels, -1), -1)
 
+    stats_every = telemetry.stats_every(args)
+
     def train_step(params, opt_state, batch, valid, dropout_rng):
         def loss_fn(p):
             logits = model.apply(
@@ -180,8 +181,13 @@ def main(args):
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads, _ = clip_by_global_norm(grads, args.clip_grad)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+        updates, opt_state2 = tx.update(grads, opt_state, params)
+        metrics = {"loss": loss}
+        health = telemetry.finetune_grad_health(
+            params, grads, updates, opt_state, stats_every)
+        if health is not None:
+            metrics["grad_health"] = health
+        return optax.apply_updates(params, updates), opt_state2, metrics
 
     # Telemetry facade (docs/telemetry.md): step-time windows + MFU, trace
     # window, compile attribution, loss sentinel, optional heartbeat.
@@ -230,12 +236,12 @@ def main(args):
             key, sub = jax.random.split(key)
             tele.profiler.maybe_start(global_step + 1)
             with tele.profiler.annotation(global_step + 1):
-                params, opt_state, loss = train_step(
+                params, opt_state, metrics = train_step(
                     params, opt_state, batch, valid, sub)
             tele.dispatch_done()
             global_step += 1
-            tele.step_done(global_step, {"loss": loss})
-            losses.append(float(loss))
+            tele.step_done(global_step, metrics)
+            losses.append(float(metrics["loss"]))
             seen += int(valid.sum())
         logger.info(f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
     train_time = time.perf_counter() - t0

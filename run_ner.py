@@ -152,6 +152,8 @@ def main(args):
     base_tx = optim.adamw(1.0, bias_correction=False, weight_decay=0.0)
     opt_state = base_tx.init(params)
 
+    stats_every = telemetry.stats_every(args)
+
     def train_step(params, opt_state, batch, dropout_rng, epoch):
         seqs, labels, masks = batch
 
@@ -165,7 +167,12 @@ def main(args):
         updates, opt_state2 = base_tx.update(grads, opt_state, params)
         lr = args.lr / (1.0 + 0.05 * epoch)
         updates = jax.tree_util.tree_map(lambda u: u * lr, updates)
-        return optax.apply_updates(params, updates), opt_state2, loss
+        metrics = {"loss": loss}
+        health = telemetry.finetune_grad_health(
+            params, grads, updates, opt_state, stats_every)
+        if health is not None:
+            metrics["grad_health"] = health
+        return optax.apply_updates(params, updates), opt_state2, metrics
 
     # Telemetry facade (docs/telemetry.md).
     from bert_pytorch_tpu.utils import flops as flops_util
@@ -210,12 +217,12 @@ def main(args):
             key, sub = jax.random.split(key)
             tele.profiler.maybe_start(global_step + 1)
             with tele.profiler.annotation(global_step + 1):
-                params, opt_state, loss = train_step(
+                params, opt_state, metrics = train_step(
                     params, opt_state, batch, sub, epoch)
             tele.dispatch_done()
             global_step += 1
-            tele.step_done(global_step, {"loss": loss})
-            losses.append(float(loss))
+            tele.step_done(global_step, metrics)
+            losses.append(float(metrics["loss"]))
         msg = (f"epoch {epoch}: train_loss={np.mean(losses):.4f} "
                f"({time.perf_counter() - t0:.1f}s)")
         if "val" in datasets:

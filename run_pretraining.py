@@ -257,8 +257,8 @@ def setup_training(args):
 
     # Telemetry sink shared between the logger (ordinary train records) and
     # the TrainTelemetry facade (its records go ONLY there); built in main().
-    args.telemetry_jsonl = args.telemetry_jsonl or os.path.join(
-        args.output_dir, args.log_prefix + "_telemetry.jsonl")
+    args.telemetry_jsonl = telemetry.default_jsonl_path(
+        args, args.output_dir, args.log_prefix)
     args.heartbeat_file = args.heartbeat_file or os.path.join(
         args.output_dir, "heartbeat.json")
     args.profile_dir = args.profile_dir or os.path.join(
@@ -568,6 +568,14 @@ def main(args) -> dict:
                 f"factor_interval={args.kfac_factor_interval}, "
                 f"inv_interval={args.kfac_inv_interval}")
 
+        # Grad-health due gate must count from THIS run's start: the host
+        # reads it on a run-local 0-based sync cadence, while the restored
+        # optimizer count is absolute — a resume step that is not a
+        # multiple of the cadence would otherwise push every due step
+        # onto an unsynced step (zero records for the whole resumed run).
+        stats_phase = int(jax.device_get(
+            optim.opt_step_count(state.opt_state)))
+
         if args.parallel_strategy in ("pp", "pp_tp"):
             if mesh.shape["pipe"] < 2:
                 raise ValueError(
@@ -595,7 +603,9 @@ def main(args) -> dict:
                 next_sentence=bool(config.next_sentence),
                 shardings=shardings, batch_shardings_=b_shardings,
                 max_pred_per_seq=args.max_predictions_per_seq,
-                kfac=kfac_obj, kfac_shardings=kfac_shardings)
+                kfac=kfac_obj, kfac_shardings=kfac_shardings,
+                stats_every=telemetry.stats_every(args),
+                stats_phase=stats_phase)
         else:
             train_step = pretrain.make_train_step(
                 model, tx, schedule=schedule,
@@ -607,7 +617,9 @@ def main(args) -> dict:
                 kfac_factor_interval=args.kfac_factor_interval,
                 kfac_inv_interval=args.kfac_inv_interval if kfac_fused else 0,
                 kfac_capture_microbatches=args.kfac_capture_microbatches,
-                loss_scale=fp16)
+                loss_scale=fp16,
+                stats_every=telemetry.stats_every(args),
+                stats_phase=stats_phase)
 
         # Telemetry (docs/telemetry.md): JSONL sink shared with the logger,
         # step-time decomposition windows, profiler trace window, compile

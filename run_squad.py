@@ -193,8 +193,8 @@ def main(args):
         devices = jax.devices()[: args.mesh_data]
     mesh = create_mesh(MeshConfig(data=-1), devices=devices)
     os.makedirs(args.output_dir, exist_ok=True)
-    args.telemetry_jsonl = args.telemetry_jsonl or os.path.join(
-        args.output_dir, "squad_telemetry.jsonl")
+    args.telemetry_jsonl = telemetry.default_jsonl_path(
+        args, args.output_dir, "squad")
     args.heartbeat_file = args.heartbeat_file or os.path.join(
         args.output_dir, "heartbeat.json")
     args.profile_dir = args.profile_dir or os.path.join(
@@ -294,6 +294,8 @@ def main(args):
                     tx, init_scale=args.init_loss_scale)
             opt_state = tx.init(params)
 
+            stats_every = telemetry.stats_every(args)
+
             def train_step(params, opt_state, batch, rng):
                 loss_scale = opt_state.scale if fp16 else 1.0
 
@@ -317,7 +319,13 @@ def main(args):
                     grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
                 updates, opt_state2 = tx.update(grads, opt_state, params)
                 import optax
-                return optax.apply_updates(params, updates), opt_state2, loss
+                metrics = {"loss": loss}
+                health = telemetry.finetune_grad_health(
+                    params, grads, updates, opt_state, stats_every,
+                    fp16_scale=loss_scale if fp16 else None)
+                if health is not None:
+                    metrics["grad_health"] = health
+                return optax.apply_updates(params, updates), opt_state2, metrics
 
             train_step = tele.instrument(
                 jax.jit(train_step, donate_argnums=(0, 1)), "train_step")
@@ -346,12 +354,13 @@ def main(args):
                     rng, sub = jax.random.split(rng)
                     tele.profiler.maybe_start(global_step + 1)
                     with tele.profiler.annotation(global_step + 1):
-                        params, opt_state, loss = train_step(
+                        params, opt_state, metrics = train_step(
                             params, opt_state, batch, sub)
                     tele.dispatch_done()
                     global_step += 1
                     seqs += args.train_batch_size
-                    tele.step_done(global_step, {"loss": loss})
+                    loss = metrics["loss"]
+                    tele.step_done(global_step, metrics)
                     if global_step % args.log_freq == 0:
                         losses.append(float(loss))
                         logger.log(tag="train", step=global_step,

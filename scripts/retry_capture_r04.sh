@@ -56,7 +56,25 @@ EOF
 commit_artifacts() {  # msg, paths...
   local msg=$1; shift
   local existing=()
-  for p in "$@"; do [ -e "$p" ] && existing+=("$p"); done
+  # Schema-lint each JSONL before it can land: the tier-1 suite lints
+  # every COMMITTED repo-root *.jsonl, so a malformed capture committed
+  # here would break the next round's tests. Only the offending file is
+  # dropped from the commit (kept on disk for inspection) — the other
+  # artifacts of the leg (bench JSONs, the warm cache) must still
+  # survive the session, which is this harness's whole point.
+  for p in "$@"; do
+    [ -e "$p" ] || continue
+    case "$p" in
+      *.jsonl)
+        if ! python tools/check_telemetry_schema.py "$p" \
+            >> "$LOGS/schema_lint.log" 2>&1; then
+          echo "   SCHEMA LINT FAILED for $p; dropping it from this" \
+               "commit (see $LOGS/schema_lint.log)"
+          continue
+        fi;;
+    esac
+    existing+=("$p")
+  done
   [ "${#existing[@]}" -eq 0 ] && return 0
   git add -f -- "${existing[@]}" 2>> "$LOGS/git.log" || true
   if ! git diff --cached --quiet; then

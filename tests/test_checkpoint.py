@@ -119,12 +119,14 @@ def test_agree_on_resume_step_policies(monkeypatch):
         def process_allgather(self, _x):
             return np.asarray(self.values, np.int32)
 
-    import sys
-
     def run(values, step):
-        fake = FakeMH(values)
-        monkeypatch.setitem(
-            sys.modules, "jax.experimental.multihost_utils", fake)
+        # Patch dist's own accessor seam, not sys.modules: once the real
+        # multihost_utils has been imported anywhere in the process, a
+        # 'from jax.experimental import ...' binds the package attribute
+        # and a sys.modules patch is silently ignored (order-dependent
+        # failure in the full suite).
+        monkeypatch.setattr(
+            dist, "_multihost_utils", lambda: FakeMH(values))
         return dist.agree_on_resume_step(step)
 
     assert run([7, 7], 7) == 7

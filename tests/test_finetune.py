@@ -191,6 +191,15 @@ def test_squad_end_to_end_tiny(tmp_path, squad_json, vocab_file):
     assert pred_file.exists()
     answers = json.loads(pred_file.read_text())
     assert set(answers.keys()) == {"q1", "q2"}
+    # Grad-health must land at the DEFAULT sampled sync cadence (4): the
+    # in-jit due gate counts from the PRE-update optimizer count, which is
+    # the same 0-base the host's sync cadence uses — a post-update count
+    # would be off by one and never coincide with a synced step.
+    tele = [json.loads(line) for line in
+            open(tmp_path / "out" / "squad_telemetry.jsonl")]
+    health = [r for r in tele if r.get("kind") == "grad_health"]
+    assert health, "no grad_health record at the default sync cadence"
+    assert "bert/encoder" in health[0]["groups"]
 
 
 def test_squad_fp16_loss_scaled_tiny(tmp_path, squad_json, vocab_file):

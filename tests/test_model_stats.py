@@ -1,0 +1,289 @@
+"""Model-internals & memory observability units (ISSUE 2,
+docs/telemetry.md): in-jit grad-health reduction + cadence gating, the
+divergence early-warning policy, the memory sampler's supported/
+unsupported paths, and the static per-executable cost attribution."""
+
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.telemetry import memory as memory_mod
+from bert_pytorch_tpu.telemetry import model_stats
+from bert_pytorch_tpu.telemetry import schema as tschema
+from bert_pytorch_tpu.telemetry.model_stats import (DivergenceError,
+                                                    DivergenceMonitor)
+
+
+def _tree(scale=1.0):
+    import jax.numpy as jnp
+
+    return {
+        "bert": {
+            "embeddings": {"word_embeddings": jnp.full((4, 2), scale)},
+            "encoder": {"layers": {
+                "kernel": jnp.full((3, 2, 2), scale),  # stacked [L, ...]
+                "bias": jnp.full((3, 2), scale),
+            }},
+        },
+        "qa_outputs": {"kernel": jnp.full((2, 2), scale)},
+    }
+
+
+# -- grad_health reduction ----------------------------------------------
+
+
+def test_grad_health_groups_and_per_layer():
+    health = model_stats.grad_health(
+        _tree(2.0), _tree(1.0), _tree(0.5))
+    assert set(health["groups"]) == {
+        "bert/embeddings", "bert/encoder", "qa_outputs"}
+    # bert/embeddings: 8 grad entries of 1.0 -> norm sqrt(8); params 2.0
+    emb = health["groups"]["bert/embeddings"]
+    assert float(emb["grad_norm"]) == pytest.approx(np.sqrt(8))
+    assert float(emb["param_norm"]) == pytest.approx(np.sqrt(8 * 4))
+    # update_ratio = ||0.5 * ones|| / ||2.0 * ones|| = 0.25 per group
+    assert float(emb["update_ratio"]) == pytest.approx(0.25, rel=1e-5)
+    assert float(health["update_ratio"]) == pytest.approx(0.25, rel=1e-5)
+    # global norm = sqrt(total leaves) over 8+6+3+4=... every leaf is 1.0
+    n_entries = 8 + 12 + 6 + 4
+    assert float(health["grad_norm"]) == pytest.approx(np.sqrt(n_entries))
+    # stacked encoder: per-layer vector of length L=3, each layer holds
+    # 4 kernel + 2 bias unit entries -> norm sqrt(6)
+    per_layer = np.asarray(health["per_layer_grad_norm"])
+    assert per_layer.shape == (3,)
+    np.testing.assert_allclose(per_layer, np.sqrt(6.0), rtol=1e-5)
+
+
+def test_grad_health_grad_scale_divides_grad_norms_only():
+    plain = model_stats.grad_health(_tree(2.0), _tree(1.0), _tree(0.5))
+    scaled = model_stats.grad_health(
+        _tree(2.0), _tree(1.0), _tree(0.5), grad_scale=4.0)
+    assert float(scaled["grad_norm"]) == pytest.approx(
+        float(plain["grad_norm"]) / 4.0)
+    assert float(scaled["param_norm"]) == pytest.approx(
+        float(plain["param_norm"]))
+    assert float(scaled["update_ratio"]) == pytest.approx(
+        float(plain["update_ratio"]))
+
+
+def test_gated_grad_health_cadence_inside_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(count):
+        return model_stats.gated_grad_health(
+            _tree(2.0), _tree(1.0), _tree(0.5), count, every=3)
+
+    due = step(jnp.int32(0))
+    off = step(jnp.int32(1))
+    assert float(due["due"]) == 1.0 and float(due["grad_norm"]) > 0
+    # Off-cadence: the cond's zero branch — values are zeros, flag says so.
+    assert float(off["due"]) == 0.0 and float(off["grad_norm"]) == 0.0
+    assert model_stats.gated_grad_health(
+        _tree(1.0), _tree(1.0), _tree(1.0), 0, every=0) is None
+
+    # Resumed runs: `phase` (the count at run start) rebases the gate onto
+    # the host's run-local 0-based cadence — count 250 with phase 250 is
+    # due, count 252 is not.
+    @jax.jit
+    def resumed(count):
+        return model_stats.gated_grad_health(
+            _tree(2.0), _tree(1.0), _tree(0.5), count, every=4, phase=250)
+
+    assert float(resumed(jnp.int32(250))["due"]) == 1.0
+    assert float(resumed(jnp.int32(252))["due"]) == 0.0
+    assert float(resumed(jnp.int32(254))["due"]) == 1.0
+
+
+def test_health_record_is_schema_valid():
+    health = model_stats.grad_health(_tree(2.0), _tree(1.0), _tree(0.5))
+    record = model_stats.health_record(7, health)
+    assert record["step"] == 7
+    full = {"schema": tschema.SCHEMA_VERSION, "ts": 0.0, **record}
+    assert tschema.validate_record(full) == []
+    # everything JSON-serializable (floats/lists, no device arrays)
+    import json
+
+    json.dumps(record)
+
+
+# -- divergence monitor -------------------------------------------------
+
+
+def test_divergence_spike_and_abort():
+    emitted = []
+    mon = DivergenceMonitor(emit=emitted.append, policy="abort",
+                            patience=2, spike_factor=5.0, ratio_max=0.0,
+                            warmup=3)
+    for step in range(5):
+        assert mon.observe(step, 1.0, 0.001)
+    assert mon.observe(5, 2.0, 0.001)   # 2x EMA: under the 5x bar
+    assert not mon.observe(6, 50.0, 0.001)  # spike
+    assert emitted[-1]["reason"] == "grad_norm_spike"
+    with pytest.raises(DivergenceError):
+        mon.observe(7, 500.0, 0.001)    # second consecutive -> abort
+    assert all(r["kind"] == "divergence" for r in emitted)
+    for rec in emitted:
+        full = {"schema": tschema.SCHEMA_VERSION, "ts": 0.0, **rec}
+        assert tschema.validate_record(full) == []
+
+
+def test_divergence_plateau_still_aborts():
+    """The EMA must not absorb warned observations: a diverged-but-
+    plateaued grad norm has to keep warning until patience aborts,
+    not warn once and then normalize its own threshold."""
+    mon = DivergenceMonitor(policy="abort", patience=3, spike_factor=5.0,
+                            ratio_max=0.0, warmup=2)
+    for step in range(3):
+        assert mon.observe(step, 1.0)
+    assert not mon.observe(3, 50.0)
+    assert not mon.observe(4, 50.0)  # same plateau: EMA frozen, still warns
+    with pytest.raises(DivergenceError):
+        mon.observe(5, 50.0)
+
+
+def test_divergence_warmup_suppresses_early_spikes():
+    emitted = []
+    mon = DivergenceMonitor(emit=emitted.append, spike_factor=2.0,
+                            ratio_max=0.0, warmup=10)
+    # step-0 norms are legitimately wild; no warning inside the warmup
+    assert mon.observe(0, 100.0)
+    assert mon.observe(1, 1.0)
+    assert emitted == []
+
+
+def test_divergence_update_ratio_and_recovery():
+    emitted = []
+    mon = DivergenceMonitor(emit=emitted.append, policy="continue",
+                            spike_factor=0.0, ratio_max=0.5)
+    assert not mon.observe(1, 1.0, update_ratio=0.9)
+    assert emitted[0]["reason"] == "update_ratio_high"
+    assert mon.observe(2, 1.0, update_ratio=0.1)  # recovery resets
+    assert mon.consecutive == 0
+    # non-finite norms are the sentinel's signal, not a spike
+    assert mon.observe(3, float("nan"), update_ratio=0.1)
+
+
+def test_divergence_rejects_unknown_policy():
+    with pytest.raises(ValueError):
+        DivergenceMonitor(policy="explode")
+
+
+# -- memory sampler -----------------------------------------------------
+
+
+class _FakeDevice:
+    def __init__(self, stats):
+        self._stats = stats
+
+    def memory_stats(self):
+        return self._stats
+
+
+def test_memory_sampler_unsupported_emits_single_note(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: [_FakeDevice(None)])
+    emitted = []
+    sampler = memory_mod.MemorySampler(emit=emitted.append)
+    for step in range(5):
+        sampler.sample(step)
+    sampler.flush(5)
+    assert len(emitted) == 1  # ONE note, not a warning storm
+    assert emitted[0]["memory_supported"] is False
+    full = {"schema": tschema.SCHEMA_VERSION, "ts": 0.0, **emitted[0]}
+    assert tschema.validate_record(full) == []
+
+
+def test_memory_sampler_window_aggregation(monkeypatch):
+    import jax
+
+    readings = iter([
+        {"bytes_in_use": 100, "peak_bytes_in_use": 150, "bytes_limit": 1000},
+        {"bytes_in_use": 300, "peak_bytes_in_use": 400, "bytes_limit": 1000},
+        {"bytes_in_use": 200, "peak_bytes_in_use": 400, "bytes_limit": 1000},
+    ])
+    monkeypatch.setattr(
+        jax, "local_devices", lambda: [_FakeDevice(next(readings))])
+    emitted = []
+    sampler = memory_mod.MemorySampler(emit=emitted.append)
+    for step in (1, 2, 3):
+        sampler.sample(step)
+    record = sampler.flush(3)
+    assert record is emitted[0] is not None
+    assert record["memory_supported"] is True
+    assert record["samples"] == 3
+    assert record["bytes_in_use"] == 200       # last
+    assert record["bytes_in_use_max"] == 300   # max live
+    assert record["peak_bytes_in_use"] == 400  # allocator high-water
+    assert record["bytes_limit"] == 1000
+    full = {"schema": tschema.SCHEMA_VERSION, "ts": 0.0, **record}
+    assert tschema.validate_record(full) == []
+    # window reset: nothing left to flush
+    assert sampler.flush(4) is None
+    # non-primary ranks never emit
+    quiet = memory_mod.MemorySampler(emit=emitted.append, enabled=False)
+    quiet.sample(1)
+    assert len(emitted) == 1
+
+
+# -- static cost attribution --------------------------------------------
+
+
+def test_analyze_executable_full_and_off():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: (x @ x.T).sum())
+    x = jnp.ones((16, 16))
+    fn(x)
+    fields = memory_mod.analyze_executable(fn, (x,), {}, mode="full")
+    assert fields["analysis"] == "compiled"
+    assert fields["flops"] > 0 and fields["bytes_accessed"] > 0
+    assert fields["argument_bytes"] == x.size * 4
+    assert "temp_bytes" in fields
+    assert memory_mod.analyze_executable(fn, (x,), {}, mode="off") is None
+    # Not an AOT-capable callable: attribution declines, never raises.
+    assert memory_mod.analyze_executable(
+        lambda x: x, (x,), {}, mode="full") is None
+    with pytest.raises(ValueError):
+        memory_mod.analyze_executable(fn, (x,), {}, mode="bogus")
+
+
+def test_analyze_executable_after_donation():
+    """Attribution runs after the instrumented call, when donated args
+    are already deleted — lowering needs only aval metadata."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda s, b: s + b.sum(), donate_argnums=(0,))
+    s, b = jnp.ones((4,)), jnp.ones((3,))
+    fn(s, b)  # s is deleted now
+    fields = memory_mod.analyze_executable(fn, (s, b), {}, mode="auto")
+    assert fields is not None and fields["flops"] >= 0
+
+
+def test_compile_monitor_emits_cost_records():
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.telemetry.compile_events import CompileMonitor
+
+    emitted = []
+    monitor = CompileMonitor(emit=emitted.append, cost_analysis="auto")
+    fn = monitor.instrument(jax.jit(lambda x: x * 2.0 + 1.0), "probe")
+    fn(jnp.arange(5, dtype=jnp.float32))
+    kinds = [r["kind"] for r in emitted]
+    assert kinds.count("compile") == 1
+    assert kinds.count("compile_cost") == 1
+    cost = next(r for r in emitted if r["kind"] == "compile_cost")
+    compile_rec = next(r for r in emitted if r["kind"] == "compile")
+    assert cost["shapes_digest"] == compile_rec["shapes_digest"]
+    assert cost["fn"] == "probe"
+    # steady-state call: no new records of either kind
+    fn(jnp.arange(5, dtype=jnp.float32))
+    assert len(emitted) == 2
+    # new shapes: one more of each, attribution stays one-shot per digest
+    fn(jnp.arange(7, dtype=jnp.float32))
+    assert [r["kind"] for r in emitted].count("compile_cost") == 2

@@ -601,6 +601,41 @@ def test_pretraining_smoke_emits_telemetry(pretrain_workdir):
     # step time.
     assert max(r["compile_s"] for r in compiles) > 0
 
+    # ISSUE 2: in-jit grad-health on the sync cadence (1 here, so every
+    # step), with per-layer-group norms and the stacked-encoder
+    # per-layer vector.
+    health = kinds["grad_health"]
+    assert len(health) >= 20
+    for rec in health[:3]:
+        assert rec["grad_norm"] > 0 and rec["param_norm"] > 0
+        assert 0 < rec["update_ratio"] < 1
+        assert "bert/encoder" in rec["groups"]
+        assert "bert/embeddings" in rec["groups"]
+        for vals in rec["groups"].values():
+            assert set(vals) == {"grad_norm", "param_norm", "update_ratio"}
+        assert len(rec["per_layer_grad_norm"]) == 2  # num_hidden_layers
+    # The in-jit global grad norm must agree with the step's own metric.
+    train_recs = [r for r in kinds["metric"] if r.get("tag") == "train"]
+    by_step = {r["step"]: r for r in train_recs}
+    probe = health[5]
+    assert probe["grad_norm"] == pytest.approx(
+        by_step[probe["step"]]["grad_norm"], rel=1e-4)
+
+    # ISSUE 2: memory observability on CPU = exactly ONE unsupported
+    # note (never a per-step storm), and one-shot static cost
+    # attribution joined to the compile event's digest.
+    mem = kinds["memory"]
+    assert len(mem) == 1 and mem[0]["memory_supported"] is False
+    costs = kinds["compile_cost"]
+    assert any(r["fn"] == "train_step" for r in costs)
+    cost = next(r for r in costs if r["fn"] == "train_step")
+    assert cost["shapes_digest"] in {c["shapes_digest"] for c in compiles}
+    assert cost["flops"] > 0
+    assert cost["analysis"] == "compiled"  # CPU: the extra compile is cheap
+    assert cost["temp_bytes"] >= 0 and cost["argument_bytes"] > 0
+    # No divergence warnings on a healthy run.
+    assert "divergence" not in kinds
+
     hb = Heartbeat.read(
         os.path.join(pretrain_workdir["out"], "heartbeat.json"))
     assert hb is not None
@@ -613,6 +648,39 @@ def test_pretraining_smoke_emits_telemetry(pretrain_workdir):
     # The ordinary train records share the sink (tag/step/loss... records
     # with no "kind"): the artifact is single-file parseable.
     assert any(r.get("tag") == "train" for r in kinds["metric"])
+
+
+def test_pretraining_resume_keeps_grad_health_cadence(pretrain_workdir):
+    """A checkpoint-resumed run whose resume step is NOT a multiple of
+    the sampled sync cadence must still emit grad_health records: the
+    in-jit due gate is rebased on the run-start optimizer count
+    (stats_phase), matching the host's run-local sync index."""
+    import run_pretraining
+
+    def run(steps):
+        args = run_pretraining.parse_arguments([
+            "--input_dir", pretrain_workdir["data"],
+            "--output_dir", pretrain_workdir["out"],
+            "--model_config_file", pretrain_workdir["model"],
+            "--global_batch_size", "16", "--local_batch_size", "2",
+            "--max_steps", "20", "--steps", str(steps),
+            "--num_steps_per_checkpoint", "100", "--dtype", "float32",
+            "--seed", "7", "--telemetry_window", "5",
+            "--telemetry_sync_every", "4",  # sampled cadence
+        ])
+        return run_pretraining.main(args)
+
+    assert run(6)["global_step"] == 6   # final checkpoint at step 6
+    assert run(6)["global_step"] == 12  # resumes; 6 % 4 != 0
+    jsonl = os.path.join(pretrain_workdir["out"],
+                         "pretraining_telemetry.jsonl")
+    health = [json.loads(line) for line in open(jsonl)]
+    health = [r for r in health if r.get("kind") == "grad_health"]
+    first = [r for r in health if r["step"] <= 6]
+    resumed = [r for r in health if r["step"] > 6]
+    assert first, "fresh run emitted no grad_health"
+    assert resumed, ("resumed run emitted no grad_health — the due gate "
+                     "drifted off the run-local sync cadence")
 
 
 def test_pretraining_sentinel_abort_flag(pretrain_workdir):

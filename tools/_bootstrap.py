@@ -1,0 +1,26 @@
+"""Shared bootstrap for the repo-root tools: load a stdlib-only engine
+module from the package by FILE PATH, without executing the
+``bert_pytorch_tpu/__init__`` chain (which imports jax) — the property
+that lets these tools run on machines without the accelerator stack
+(pre-commit hooks, CI boxes). Scripts in this directory can import it
+directly: Python puts the script's own directory on ``sys.path``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_by_path(name: str, *relpath: str):
+    """Load ``<REPO_ROOT>/<relpath...>`` as module ``name`` (no package
+    __init__ execution; the module must be stdlib-only)."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, *relpath))
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module

@@ -1,19 +1,21 @@
 #!/usr/bin/env python
 """Lint JSONL metric artifacts against the telemetry record schema.
 
-Invoked from the tier-1 suite (tests/test_telemetry.py) over every
-committed ``*_r0*.jsonl`` bench artifact in the repo root, so a future
-round cannot commit malformed metrics (invalid JSON lines, NaN/Infinity
-spellings, records claiming a schema version whose required keys are
-missing). Legacy artifacts written before the schema existed carry no
-``schema`` key and are held to the universal rules only
+Invoked from the tier-1 suite (tests/test_telemetry.py) over EVERY
+committed ``*.jsonl`` artifact in the repo root — bench artifacts,
+telemetry captures, sweep logs — so a future round cannot commit
+malformed metrics (invalid JSON lines, NaN/Infinity spellings, records
+claiming a schema version whose required keys are missing). The capture
+harness (scripts/retry_capture_r04.sh) also runs it over any ``*.jsonl``
+it is about to auto-commit. Legacy artifacts written before the schema
+existed carry no ``schema`` key and are held to the universal rules only
 (bert_pytorch_tpu/telemetry/schema.py).
 
 Usage::
 
     python tools/check_telemetry_schema.py [paths...]
 
-With no paths, lints ``<repo_root>/*_r0*.jsonl``. Exit 0 = all valid,
+With no paths, lints ``<repo_root>/*.jsonl``. Exit 0 = all valid,
 1 = violations (one ``path:line: error`` per finding), 2 = a named path
 is missing. Imports only the schema module — no jax — so it runs
 anywhere, including pre-commit hooks on machines without the accelerator
@@ -26,18 +28,19 @@ import glob
 import os
 import sys
 
-REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO_ROOT)
+from _bootstrap import REPO_ROOT, load_by_path
 
-from bert_pytorch_tpu.telemetry.schema import validate_file  # noqa: E402
+validate_file = load_by_path(
+    "_telemetry_schema", "bert_pytorch_tpu", "telemetry", "schema.py"
+).validate_file
 
 
 def main(argv=None) -> int:
     paths = list(argv if argv is not None else sys.argv[1:])
     if not paths:
-        paths = sorted(glob.glob(os.path.join(REPO_ROOT, "*_r0*.jsonl")))
+        paths = sorted(glob.glob(os.path.join(REPO_ROOT, "*.jsonl")))
         if not paths:
-            print("check_telemetry_schema: no *_r0*.jsonl artifacts found")
+            print("check_telemetry_schema: no *.jsonl artifacts found")
             return 0
     failed = False
     for path in paths:
