@@ -25,7 +25,10 @@ with backoff, and on final failure still prints the one-line JSON with
 an ``"error"`` field so the driver always records something parseable.
 Set BENCH_CHILD=1 to run the benchmark body directly (what the parent
 spawns); knobs: BENCH_ATTEMPTS, BENCH_BACKOFF_S, BENCH_PROBE_TIMEOUT_S,
-BENCH_ATTEMPT_TIMEOUT_S, BENCH_BUDGET_S.
+BENCH_ATTEMPT_TIMEOUT_S, BENCH_BUDGET_S. BENCH_PACK=1 (or
+``--pack_sequences``) benches the sequence-packed step on synthetic
+mixed-length data and stamps padding_efficiency into the result
+(docs/packing.md).
 
 Cold-start survival (the round-1/round-2 failure mode): a BERT-large
 compile through the tunnel can take 10-30 min, far beyond any one attempt
@@ -101,6 +104,16 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 # pass, i.e. full-microbatch factor quality at the 16-row subsampled
 # pass's price — KFAC_CAPTURE_BENCH_r04.jsonl); 'stats' keeps the
 # round-3 decoupled stats pass for comparability with the round-2 number.
+# BENCH_PACK=1 (or passing --pack_sequences on the command line) benches
+# SEQUENCE PACKING (docs/packing.md): synthetic mixed-length samples are
+# greedily packed into full rows (sequence_ids + per-sequence NSP heads +
+# block-diagonal attention), and the result carries padding_efficiency —
+# the fraction of the token budget that is real work. Compare against the
+# default full-row run: rows/s stays ~flat while real tokens/s roughly
+# doubles at Wikipedia-like length spreads (Krell 2021, arXiv:2107.02027).
+PACK = (os.environ.get("BENCH_PACK", "0") == "1"
+        or "--pack_sequences" in sys.argv[1:])
+PACK_K = int(os.environ.get("BENCH_PACK_K", "8"))
 KFAC = os.environ.get("BENCH_KFAC", "0") == "1"
 KFAC_CAPTURE = os.environ.get("BENCH_KFAC_CAPTURE", "train")
 if KFAC_CAPTURE not in ("train", "stats"):
@@ -148,6 +161,11 @@ def _config_digest(degraded=None, local_batch=None):
                 # kfac capture mode changes the train-step program; keep
                 # the digest stable for non-kfac configs
                 KFAC_CAPTURE if KFAC else ""))
+    if PACK:
+        # Packing changes the compiled step (extra arrays, packed heads).
+        # Appended OUTSIDE the tuple so non-packed digests stay
+        # byte-identical to the committed warm markers of earlier rounds.
+        key += f"+pack{PACK_K}"
     return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 
@@ -195,6 +213,10 @@ if ATTN not in ("xla", "pallas", "ring"):
     raise ValueError(f"BENCH_ATTN must be xla|pallas|ring, got {ATTN!r}")
 if RNG_IMPL not in ("rbg", "threefry2x32"):
     raise ValueError(f"BENCH_RNG_IMPL must be rbg|threefry2x32, got {RNG_IMPL!r}")
+if PACK and ATTN == "ring":
+    raise ValueError(
+        "BENCH_PACK does not compose with BENCH_ATTN=ring (the block-"
+        "diagonal mask is not implemented over the sharded seq axis)")
 if LONG_SEQ and (LONG_SEQ < 128 or LONG_SEQ % 128 != 0):
     raise ValueError(
         f"BENCH_SEQ must be a positive multiple of 128 (tile alignment for "
@@ -285,24 +307,74 @@ def _child_main():
     global_batch = LOCAL_BATCH * data_shards * ACCUM
     sample = (jnp.zeros((1, SEQ_LEN), jnp.int32),) * 3
     rng = np.random.default_rng(0)
-    host = {
-        "input_ids": rng.integers(
-            0, config.vocab_size, (global_batch, SEQ_LEN)).astype(np.int32),
-        "segment_ids": rng.integers(0, 2, (global_batch, SEQ_LEN)).astype(np.int32),
-        "input_mask": np.ones((global_batch, SEQ_LEN), np.int32),
-        "masked_lm_labels": np.where(
-            rng.random((global_batch, SEQ_LEN)) < 0.15,
-            rng.integers(0, config.vocab_size, (global_batch, SEQ_LEN)),
-            -1).astype(np.int32),
-        "next_sentence_labels": rng.integers(0, 2, (global_batch,)).astype(np.int32),
-    }
+    eff_max_pred = MAX_PRED * PACK_K if PACK else MAX_PRED
+    if PACK:
+        # Mixed-length synthetic samples FFD-packed into exactly
+        # global_batch full rows (the runner's on-the-fly path,
+        # data/packing.py) — what a Wikipedia-style shard looks like to
+        # the train step after packing.
+        from bert_pytorch_tpu.data.packing import first_fit_decreasing
 
+        lengths: list = []
+        while True:
+            lengths.extend(
+                int(x) for x in rng.integers(8, SEQ_LEN + 1, 512))
+            packs = first_fit_decreasing(lengths, SEQ_LEN, PACK_K)
+            if len(packs) >= global_batch:
+                break
+        packs = packs[:global_batch]
+        host = {
+            "input_ids": np.zeros((global_batch, SEQ_LEN), np.int32),
+            "segment_ids": np.zeros((global_batch, SEQ_LEN), np.int32),
+            "input_mask": np.zeros((global_batch, SEQ_LEN), np.int32),
+            "masked_lm_labels": np.full(
+                (global_batch, SEQ_LEN), -1, np.int32),
+            "next_sentence_labels": np.full(
+                (global_batch, PACK_K), -1, np.int32),
+            "sequence_ids": np.zeros((global_batch, SEQ_LEN), np.int32),
+            "cls_positions": np.zeros((global_batch, PACK_K), np.int32),
+        }
+        for r, pack in enumerate(packs):
+            offset = 0
+            for k, i in enumerate(pack):
+                n = min(lengths[i], SEQ_LEN - offset)
+                span = slice(offset, offset + n)
+                host["input_ids"][r, span] = rng.integers(
+                    0, config.vocab_size, n)
+                host["segment_ids"][r, span] = rng.integers(0, 2, n)
+                host["input_mask"][r, span] = 1
+                host["masked_lm_labels"][r, span] = np.where(
+                    rng.random(n) < 0.15,
+                    rng.integers(0, config.vocab_size, n), -1)
+                host["sequence_ids"][r, span] = k + 1
+                host["next_sentence_labels"][r, k] = int(rng.integers(0, 2))
+                host["cls_positions"][r, k] = offset
+                offset += n
+        pack_efficiency = float(host["input_mask"].sum()) / (
+            global_batch * SEQ_LEN)
+    else:
+        host = {
+            "input_ids": rng.integers(
+                0, config.vocab_size, (global_batch, SEQ_LEN)).astype(np.int32),
+            "segment_ids": rng.integers(0, 2, (global_batch, SEQ_LEN)).astype(np.int32),
+            "input_mask": np.ones((global_batch, SEQ_LEN), np.int32),
+            "masked_lm_labels": np.where(
+                rng.random((global_batch, SEQ_LEN)) < 0.15,
+                rng.integers(0, config.vocab_size, (global_batch, SEQ_LEN)),
+                -1).astype(np.int32),
+            "next_sentence_labels": rng.integers(0, 2, (global_batch,)).astype(np.int32),
+        }
+        pack_efficiency = None
+
+    batch_spec = {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+                  "masked_lm_labels": 3,
+                  "next_sentence_labels": 3 if PACK else 2}
+    if PACK:
+        batch_spec.update({"sequence_ids": 3, "cls_positions": 3})
     with mesh:
         shardings = pretrain.state_shardings(mesh, model, rules, sample)
         b_shardings = pretrain.batch_shardings(
-            mesh, {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
-                   "masked_lm_labels": 3, "next_sentence_labels": 2},
-            seq_sharded=ATTN == "ring")
+            mesh, batch_spec, seq_sharded=ATTN == "ring")
         state = pretrain.make_init_fn(model, tx, sample, shardings)(
             jax.random.PRNGKey(0))
 
@@ -317,7 +389,7 @@ def _child_main():
                 remat=REMAT if kfac_fused else "none",
                 attention_backend=ATTN, kfac_tap=True)
             apply_loss, tap_shape_fn = pretrain.make_kfac_fns(
-                tapped, next_sentence=True, max_pred_per_seq=MAX_PRED)
+                tapped, next_sentence=True, max_pred_per_seq=eff_max_pred)
             kfac_obj = optim.KFAC(apply_loss, tap_shape_fn)
             _st = max(1, global_batch // 16)
             stats_mb = {k: v[::_st][:16] for k, v in host.items()}
@@ -328,7 +400,7 @@ def _child_main():
         step = pretrain.make_train_step(
             model, tx, schedule=schedule, next_sentence=True,
             shardings=shardings, batch_shardings_=b_shardings,
-            max_pred_per_seq=MAX_PRED,
+            max_pred_per_seq=eff_max_pred,
             kfac=kfac_obj, kfac_shardings=kfac_shardings,
             kfac_capture_model=tapped if kfac_fused else None,
             kfac_factor_interval=10,
@@ -407,7 +479,7 @@ def _child_main():
     seq_per_sec_chip = seq_per_sec / n_chips
     from bert_pytorch_tpu.utils import flops as flops_util
     flops_per_seq = flops_util.bert_train_flops_per_seq(
-        config, SEQ_LEN, MAX_PRED, next_sentence=True)
+        config, SEQ_LEN, eff_max_pred, next_sentence=True)
     model_flops_util = flops_util.mfu(
         seq_per_sec_chip, flops_per_seq, devices[0].device_kind)
     # Compile + measurement done => the cache holds this config's entries;
@@ -430,6 +502,12 @@ def _child_main():
     result = _result_json(
         seq_per_sec_chip, mfu=model_flops_util, n_chips=n_chips,
         anchor_override=anchor)
+    if PACK:
+        # Padding-aware accounting (docs/telemetry.md): rows/s barely
+        # moves under packing; real tokens/s is the number that ~doubles.
+        result["padding_efficiency"] = round(pack_efficiency, 4)
+        result["real_tokens_per_sec_chip"] = round(
+            seq_per_sec_chip * SEQ_LEN * pack_efficiency, 2)
     compile_events = [e for e in monitor.events if e["kind"] == "compile"]
     if compile_events:
         result["compile"] = {
@@ -461,15 +539,16 @@ def _child_main():
 
 def _metric_name_and_anchor():
     kfac_tag = "_kfac" if KFAC else ""
+    pack_tag = "_packed" if PACK else ""
     if DEGRADED:
         # Parent-side estimate only (error paths); the child overrides the
         # anchor with the exactly FLOP-scaled value.
         return ("bert_base_phase1_seq_per_sec",
                 A100_PHASE1_SEQ_PER_SEC * 3.0)
     if LONG_SEQ:
-        return (f"bert_large_seq{SEQ_LEN}{kfac_tag}_seq_per_sec",
+        return (f"bert_large_seq{SEQ_LEN}{kfac_tag}{pack_tag}_seq_per_sec",
                 A100_PHASE2_SEQ_PER_SEC * 512.0 / SEQ_LEN)
-    return (f"bert_large_phase{PHASE}{kfac_tag}_seq_per_sec",
+    return (f"bert_large_phase{PHASE}{kfac_tag}{pack_tag}_seq_per_sec",
             A100_PHASE2_SEQ_PER_SEC if _P2 else A100_PHASE1_SEQ_PER_SEC)
 
 
@@ -663,7 +742,7 @@ def main():
     # tail suffices; cold, the tail must hold a small-model compile.
     degrade_ok = (os.environ.get("BENCH_DEGRADE", "auto") != "0"
                   and not DEGRADED and PHASE == 1 and not KFAC
-                  and not LONG_SEQ and not N_DEVICES)
+                  and not LONG_SEQ and not N_DEVICES and not PACK)
     degraded_warm = degrade_ok and os.path.exists(
         os.path.join(CACHE_DIR, f"warm_{_degraded_digest()}"))
     if not degrade_ok:
@@ -679,6 +758,12 @@ def main():
 
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
+    if PACK:
+        # The child is respawned WITHOUT argv, so the --pack_sequences
+        # command-line spelling must be forwarded as the env knob — the
+        # parent's digest/degrade gating already assumed the packed config.
+        env["BENCH_PACK"] = "1"
+        env.setdefault("BENCH_PACK_K", str(PACK_K))
     last_err = "no attempts ran"
     for attempt in range(1, attempts + 1):
         remaining = normal_deadline - time.monotonic()

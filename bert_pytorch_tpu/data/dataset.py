@@ -5,6 +5,9 @@ Behavioral parity with reference src/dataset.py:9-338
 background-thread prefetch of the next), segment/input-mask derivation from
 ``special_token_positions``, dynamic masking with the 80/10/10 split, legacy
 NVIDIA pre-masked format support, and warn-and-skip shard verification.
+Offline-PACKED shards (sequence packing, data/packing.py / docs/packing.md)
+are auto-detected: samples then carry sequence_ids/cls_positions and
+per-sequence NSP labels, with dynamic masking run per packed member.
 
 Deliberate deviations from the reference (SURVEY.md §7 "known quirks"):
   - mask positions are sampled WITHOUT replacement (the reference's
@@ -38,6 +41,11 @@ LEGACY_FORMAT_KEYS = (
     "masked_lm_ids",
     "next_sentence_labels",
 )
+# Offline-packed shards (data/packing.py write_packed_shard; docs/packing.md):
+# several sequences share one row; samples gain sequence_ids/cls_positions
+# and per-sequence NSP labels. Detected per dataset (mixing packed and
+# unpacked shards is an error — the sample shapes differ).
+PACKED_KEY = "packed_sequence_lengths"
 
 
 class ShardedPretrainingDataset:
@@ -83,7 +91,8 @@ class ShardedPretrainingDataset:
         if isinstance(files, str):
             files = [files]
         files = sorted(files)  # all processes must agree on the order
-        self.files, self.file_idxs = self._verify_and_count_samples(files)
+        (self.files, self.file_idxs, self.packed,
+         self.max_sequences_per_pack) = self._verify_and_count_samples(files)
 
         self.mask_token_index = mask_token_index
         self.max_pred_per_seq = int(max_pred_per_seq)
@@ -170,6 +179,8 @@ class ShardedPretrainingDataset:
         input_ids = np.array(self.data["input_ids"][local])
         next_sentence_label = np.asarray(self.data["next_sentence_labels"][local])
 
+        if self.packed:
+            return self._packed_item(local, input_ids, next_sentence_label)
         if "special_token_positions" in self.data:
             special = np.asarray(self.data["special_token_positions"][local])
             segment_ids = self._get_segment_ids(input_ids, special)
@@ -190,6 +201,57 @@ class ShardedPretrainingDataset:
             input_mask.astype(np.int32),
             masked_lm_labels.astype(np.int32),
             next_sentence_label.astype(np.int32),
+        ]
+
+    def _packed_item(self, local: int, input_ids, nsp_labels):
+        """One offline-packed row (data/packing.py layout): re-derive
+        sequence_ids/segments/cls positions from the per-member lengths and
+        run dynamic masking per member — the same draws a member would get
+        unpacked, just rebased onto its offset in the row."""
+        lengths = np.asarray(self.data[PACKED_KEY][local], np.int64)
+        specials_all = np.asarray(
+            self.data["packed_special_token_positions"][local], np.int64)
+        nsp_labels = np.asarray(nsp_labels, np.int64).reshape(-1)
+        k_max = self.max_sequences_per_pack
+        seq_len = input_ids.shape[0]
+
+        segment_ids = np.zeros_like(input_ids)
+        input_mask = np.zeros_like(input_ids)
+        sequence_ids = np.zeros_like(input_ids)
+        labels = np.full_like(input_ids, -1)
+        nsp = np.full(k_max, -1, np.int32)
+        cls_positions = np.zeros(k_max, np.int32)
+
+        offset = 0
+        for k, n in enumerate(lengths):
+            n = int(n)
+            span = slice(offset, offset + n)
+            sequence_ids[span] = k + 1
+            input_mask[span] = 1
+            cls_positions[k] = offset
+            nsp[k] = int(nsp_labels[k])
+            member_specials = (
+                specials_all[(specials_all >= offset)
+                             & (specials_all < offset + n)] - offset)
+            if len(member_specials) == 3:
+                # [CLS] a [SEP] b [SEP]: second segment gets type 1
+                # (the unpacked _get_segment_ids rule, rebased).
+                segment_ids[offset + member_specials[1] + 1:
+                            offset + member_specials[2] + 1] = 1
+            ids_view = input_ids[span]
+            _, member_labels = self._mask_input(ids_view, member_specials)
+            labels[span] = member_labels
+            offset += n
+        assert offset <= seq_len, (offset, seq_len)
+
+        return [
+            input_ids.astype(np.int32),
+            segment_ids.astype(np.int32),
+            input_mask.astype(np.int32),
+            labels.astype(np.int32),
+            nsp.astype(np.int32),
+            sequence_ids.astype(np.int32),
+            cls_positions.astype(np.int32),
         ]
 
     def _file_idx_for(self, idx: int) -> int:
@@ -291,6 +353,7 @@ class ShardedPretrainingDataset:
     def _verify_and_count_samples(files):
         current_idx = 0
         verified_files, verified_idxs = [], []
+        packed_flags, pack_limits = [], []
         keys = ["input_ids", "next_sentence_labels"]
         for fpath in files:
             if not os.path.isfile(fpath):
@@ -301,6 +364,11 @@ class ShardedPretrainingDataset:
                 with h5py.File(fpath, "r") as f:
                     for key in keys:
                         counts.append(len(f[key]))
+                    is_packed = PACKED_KEY in f
+                    if is_packed:
+                        from bert_pytorch_tpu.data.packing import (
+                            PACKED_MAX_SEQUENCES_ATTR)
+                        pack_limit = int(f.attrs[PACKED_MAX_SEQUENCES_ATTR])
             except Exception:
                 warnings.warn(
                     f"Unable to read keys ({keys}) from {fpath}. Skipping File"
@@ -314,7 +382,22 @@ class ShardedPretrainingDataset:
                 continue
             verified_files.append(fpath)
             verified_idxs.append((current_idx, current_idx + counts[0]))
+            packed_flags.append(is_packed)
+            if is_packed:
+                # Only VERIFIED shards may shape the dataset-wide pack
+                # limit (a rejected shard contributes zero samples and
+                # must not widen every [B, K] batch array).
+                pack_limits.append(pack_limit)
             current_idx += counts[0]
         if not verified_files:
             raise RuntimeError("Unable to open any valid data files")
-        return verified_files, verified_idxs
+        if len(set(packed_flags)) > 1:
+            # Packed and unpacked samples have different shapes; one batch
+            # cannot hold both, and silently dropping either set would skew
+            # the data distribution.
+            raise ValueError(
+                "cannot mix packed and unpacked shards in one dataset: "
+                f"packed={[f for f, p in zip(verified_files, packed_flags) if p]}")
+        packed = packed_flags[0]
+        return (verified_files, verified_idxs, packed,
+                max(pack_limits) if packed else 0)

@@ -49,6 +49,9 @@ BATCH_KEYS = (
     "masked_lm_labels",
     "next_sentence_labels",
 )
+# Packed samples (data/packing.py) append the per-token sequence-id vector
+# and per-pack [CLS] offsets; next_sentence_labels is then [K] per row.
+PACKED_EXTRA_KEYS = ("sequence_ids", "cls_positions")
 
 
 def _bounded_put(q, item, stop_event) -> bool:
@@ -285,5 +288,6 @@ class DataLoader:
 
     @staticmethod
     def _collate(samples) -> dict:
-        arrays = [np.stack([s[i] for s in samples]) for i in range(len(BATCH_KEYS))]
-        return dict(zip(BATCH_KEYS, arrays))
+        keys = BATCH_KEYS + PACKED_EXTRA_KEYS[:len(samples[0]) - len(BATCH_KEYS)]
+        arrays = [np.stack([s[i] for s in samples]) for i in range(len(keys))]
+        return dict(zip(keys, arrays))

@@ -231,9 +231,24 @@ class BertEmbeddings(nn.Module):
         input_ids: Array,
         token_type_ids: Optional[Array] = None,
         deterministic: bool = True,
+        sequence_ids: Optional[Array] = None,
     ) -> Array:
         seq_len = input_ids.shape[-1]
-        position_ids = jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+        if sequence_ids is not None:
+            # Packed rows (data/packing.py): position embeddings restart at
+            # 0 for every packed sequence, so a sequence embeds identically
+            # whether it rides alone or packed at some row offset (the
+            # positional half of Krell 2021's no-cross-contamination
+            # requirement; the attention half is the block-diagonal mask).
+            idx = jnp.arange(seq_len, dtype=jnp.int32)[None, :]
+            is_start = jnp.concatenate(
+                [jnp.ones_like(sequence_ids[:, :1], dtype=bool),
+                 sequence_ids[:, 1:] != sequence_ids[:, :-1]], axis=-1)
+            starts = jnp.where(is_start, idx, 0)
+            seg_start = jax.lax.cummax(starts, axis=starts.ndim - 1)
+            position_ids = idx - seg_start
+        else:
+            position_ids = jnp.arange(seq_len, dtype=jnp.int32)[None, :]
         x = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
         if self.config.next_sentence:
             if token_type_ids is None:
@@ -259,7 +274,8 @@ class BertSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(
-        self, hidden: Array, bias: Array, deterministic: bool = True
+        self, hidden: Array, bias: Array, deterministic: bool = True,
+        sequence_ids: Optional[Array] = None,
     ) -> Array:
         cfg = self.config
         heads, head_dim = cfg.num_attention_heads, cfg.head_dim
@@ -304,6 +320,7 @@ class BertSelfAttention(nn.Module):
             dropout_rate=cfg.attention_probs_dropout_prob,
             deterministic=deterministic,
             backend=self.attention_backend,
+            sequence_ids=sequence_ids,
         )
         if self.kfac_tap:
             self.sow(
@@ -344,7 +361,8 @@ class BertLayer(nn.Module):
     kfac_tap: bool = False
 
     @nn.compact
-    def __call__(self, hidden: Array, bias: Array, deterministic: bool = True):
+    def __call__(self, hidden: Array, bias: Array, deterministic: bool = True,
+                 sequence_ids: Optional[Array] = None):
         cfg = self.config
         init = bert_normal_init(cfg.initializer_range)
         attn_out = BertSelfAttention(
@@ -353,7 +371,7 @@ class BertLayer(nn.Module):
             attention_backend=self.attention_backend,
             kfac_tap=self.kfac_tap,
             name="attention",
-        )(hidden, bias, deterministic)
+        )(hidden, bias, deterministic, sequence_ids)
         intermediate = LinearActivation(
             cfg.intermediate_size,
             act=cfg.hidden_act,
@@ -399,7 +417,8 @@ class BertEncoder(nn.Module):
     kfac_tap: bool = False
 
     @nn.compact
-    def __call__(self, hidden: Array, bias: Array, deterministic: bool = True):
+    def __call__(self, hidden: Array, bias: Array, deterministic: bool = True,
+                 sequence_ids: Optional[Array] = None):
         cfg = self.config
         if self.remat not in ("none", "dots", "full"):
             raise ValueError(f"remat must be none|dots|full, got {self.remat!r}")
@@ -423,7 +442,7 @@ class BertEncoder(nn.Module):
             variable_axes={"params": 0, KFAC_A_COLLECTION: 0,
                            KFAC_TAPS_COLLECTION: 0},
             split_rngs={"params": True, "dropout": True},
-            in_axes=(nn.broadcast, nn.broadcast),
+            in_axes=(nn.broadcast, nn.broadcast, nn.broadcast),
             length=cfg.num_hidden_layers,
             metadata_params={nn.PARTITION_NAME: "layers"},
         )(
@@ -433,19 +452,33 @@ class BertEncoder(nn.Module):
             kfac_tap=self.kfac_tap,
             name="layers",
         )
-        hidden, _ = scanned(hidden, bias, deterministic)
+        hidden, _ = scanned(hidden, bias, deterministic, sequence_ids)
         return hidden
 
 
 class BertPooler(nn.Module):
-    """tanh dense over the [CLS] token; parity with modeling.py:538-549."""
+    """tanh dense over the [CLS] token; parity with modeling.py:538-549.
+
+    For PACKED rows (data/packing.py), ``positions`` [B, K] gathers the
+    pooled vector at each packed sequence's own [CLS] offset instead of
+    position 0, returning [B, K, hidden]; empty pack slots point at
+    offset 0 and are neutralized downstream by their -1 NSP label.
+    """
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, sequence_output: Array) -> Array:
-        cls = sequence_output[:, 0]
+    def __call__(self, sequence_output: Array,
+                 positions: Optional[Array] = None) -> Array:
+        if positions is None:
+            cls = sequence_output[:, 0]
+        else:
+            # One-hot matmul instead of gather — the same MXU-friendly
+            # trick as the masked-positions MLM gather (BertForPreTraining).
+            onehot = jax.nn.one_hot(
+                positions, sequence_output.shape[1], dtype=self.dtype)
+            cls = jnp.einsum("bks,bsh->bkh", onehot, sequence_output)
         return LinearActivation(
             self.config.hidden_size,
             act="tanh",
@@ -489,14 +522,25 @@ class BertModel(nn.Module):
         token_type_ids: Optional[Array] = None,
         attention_mask: Optional[Array] = None,
         deterministic: bool = True,
+        sequence_ids: Optional[Array] = None,
+        cls_positions: Optional[Array] = None,
     ):
+        """``sequence_ids``/``cls_positions`` mark a PACKED batch
+        (data/packing.py): block-diagonal attention, per-sequence position
+        restart, and — when ``cls_positions`` [B, K] is given — a pooled
+        output per packed sequence ([B, K, hidden]) instead of one per row.
+        """
         if attention_mask is None:
             attention_mask = jnp.ones_like(input_ids)
-        bias = ops.attention.make_attention_bias(attention_mask, dtype=jnp.float32)
-        hidden = self.embeddings(input_ids, token_type_ids, deterministic)
-        sequence_output = self.encoder(hidden, bias, deterministic)
+        bias = ops.attention.make_attention_bias(
+            attention_mask, dtype=jnp.float32, sequence_ids=sequence_ids)
+        hidden = self.embeddings(
+            input_ids, token_type_ids, deterministic, sequence_ids)
+        sequence_output = self.encoder(
+            hidden, bias, deterministic, sequence_ids)
         pooled = (
-            self.pooler(sequence_output) if self.config.next_sentence else None
+            self.pooler(sequence_output, cls_positions)
+            if self.config.next_sentence else None
         )
         return sequence_output, pooled
 
@@ -601,15 +645,23 @@ class BertForPreTraining(nn.Module):
         attention_mask: Optional[Array] = None,
         deterministic: bool = True,
         masked_positions: Optional[Array] = None,
+        sequence_ids: Optional[Array] = None,
+        cls_positions: Optional[Array] = None,
     ):
         """When ``masked_positions`` [B, P] is given, MLM logits are computed
         only at those positions ([B, P, V] instead of [B, S, V]) — the
         TPU-native optimization the reference lacks (its head projects every
         position into the 30k vocab, modeling.py:611-617, though only
         max_pred<=80 of 512 carry loss). ~6x less decoder matmul FLOPs at
-        phase-2 shapes."""
+        phase-2 shapes.
+
+        ``sequence_ids``/``cls_positions`` select the PACKED-batch path
+        (data/packing.py): block-diagonal attention, restarted positions,
+        and [B, K, 2] NSP logits — one per packed sequence — whose -1
+        labels on empty slots the loss already ignores."""
         sequence_output, pooled = self.bert(
-            input_ids, token_type_ids, attention_mask, deterministic
+            input_ids, token_type_ids, attention_mask, deterministic,
+            sequence_ids, cls_positions,
         )
         if masked_positions is not None:
             # One-hot matmul instead of gather: TPU lowers gather/scatter

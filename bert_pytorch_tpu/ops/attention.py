@@ -19,11 +19,27 @@ import jax
 import jax.numpy as jnp
 
 
-def make_attention_bias(input_mask: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+def make_attention_bias(
+    input_mask: jnp.ndarray,
+    dtype=jnp.float32,
+    sequence_ids: jnp.ndarray | None = None,
+) -> jnp.ndarray:
     """[B, S] {0,1} mask -> [B, 1, 1, S] additive bias, (1-m) * -10000.
 
     Parity with reference modeling.py:862-870 (``extended_attention_mask``).
+
+    With ``sequence_ids`` ([B, S] int, 0 = pad, k = k-th packed sequence;
+    data/packing.py), returns the BLOCK-DIAGONAL [B, 1, S, S] bias instead:
+    position q may attend to position k iff both carry the same nonzero
+    sequence id — the cross-contamination-free packing mask of Krell et al.
+    2021 (arXiv:2107.02027). Padding is excluded by id 0, so ``input_mask``
+    is redundant (and ignored) on this path.
     """
+    if sequence_ids is not None:
+        seg = sequence_ids
+        same = (seg[:, :, None] == seg[:, None, :]) & (seg[:, :, None] > 0)
+        bias = (1.0 - same.astype(jnp.float32)) * -10000.0
+        return bias[:, None, :, :].astype(dtype)
     bias = (1.0 - input_mask.astype(jnp.float32)) * -10000.0
     return bias[:, None, None, :].astype(dtype)
 
@@ -37,11 +53,19 @@ def dot_product_attention(
     dropout_rate: float = 0.0,
     deterministic: bool = True,
     backend: str = "xla",
+    sequence_ids: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Attention over [B, S, H, D] query/key/value tensors.
 
     Returns [B, S, H, D]. Scores are scaled by 1/sqrt(D) and softmaxed in
     fp32 (modeling.py:403-429's score path, bf16-safe).
+
+    ``sequence_ids`` ([B, S], 0 = pad) marks a PACKED batch
+    (data/packing.py): on the XLA path the caller's ``bias`` is then the
+    [B, 1, S, S] block-diagonal mask from :func:`make_attention_bias`; the
+    Pallas path ignores that bias and regenerates the block-diagonal tile
+    mask inside the kernel from the per-token id vectors, preserving its
+    no-[B,H,S,S]-in-HBM property.
     """
     if backend == "auto":
         # Measured crossover (module docstring): the fused kernel wins from
@@ -61,13 +85,29 @@ def dot_product_attention(
         from bert_pytorch_tpu.ops.pallas.attention import flash_attention
         from bert_pytorch_tpu.ops.pallas.common import interpret_mode
 
+        # Packed batches: the caller's bias is the [B, 1, S, S] block
+        # diagonal, which the kernel must NOT consume — it rebuilds the
+        # tile mask from the id vectors (pad keys carry id 0, so no
+        # separate key bias is needed).
+        kbias = None if sequence_ids is not None else bias
         active = not deterministic and dropout_rate > 0.0
         if not active:
-            return flash_attention(q, k, v, bias=bias)
+            return flash_attention(q, k, v, bias=kbias,
+                                   sequence_ids=sequence_ids)
         if not interpret_mode():
             return flash_attention(
-                q, k, v, bias=bias,
-                dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+                q, k, v, bias=kbias,
+                dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                sequence_ids=sequence_ids)
+    if backend in ("ring", "ring_manual") and sequence_ids is not None:
+        # Ring attention shards the sequence axis across chips; the
+        # block-diagonal mask would need per-shard id exchange alongside
+        # the K/V rotation — not implemented. Packing targets the padded
+        # phase-1/2 shapes, context parallelism targets long single
+        # documents; the combination has no workload yet.
+        raise ValueError(
+            "sequence packing (sequence_ids) is not supported with "
+            "backend='ring'/'ring_manual'; use 'xla' or 'pallas'")
     if backend == "ring_manual":
         # Ring attention's per-shard body, for callers ALREADY inside a
         # region that is manual over the mesh 'seq' axis (the pipeline

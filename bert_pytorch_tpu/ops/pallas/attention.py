@@ -16,6 +16,14 @@ on GPU (SURVEY §2.3) — built TPU-native:
   - **Pallas backward**: two kernels (dq; dk/dv/dbias) recompute
     probabilities from (q, k, bias, lse) blockwise — O(S) memory end to end,
     replacing the v1 XLA backward that materialized [B*H, S, S].
+  - **Packed-batch block-diagonal masking** (``sequence_ids``; sequence
+    packing, data/packing.py): each tile regenerates its
+    cross-contamination mask from the per-token sequence-id vectors
+    ([BH, 1, S] fp32, the bias layout) — the [B, 1, S, S] mask the XLA
+    path materializes never exists in HBM, exactly like the dropout mask;
+    the backward kernels rebuild the identical mask when recomputing
+    probabilities. Statically gated (``segmented``), so unpacked callers
+    compile the same kernel as before.
 
 Derivation with dropout (rate r, keep mask D ∈ {0,1}, P = softmax(S)):
   out   = (D ⊙ P) V / (1-r)
@@ -129,13 +137,28 @@ def _pick_bh_block(seq, bh):
     return g
 
 
+def _seg_mask(q_seg, k_seg):
+    """Additive block-diagonal tile mask from per-token sequence-id
+    vectors (packing, data/packing.py): q may attend to k iff both carry
+    the same NONZERO id. Ids travel as fp32 [G, 1, S] rows — the exact
+    layout of bias_ref, so Mosaic sees nothing new — and small-int
+    equality in fp32 is exact. The -10000 additive convention matches
+    make_attention_bias, keeping the XLA and Pallas packed paths
+    numerically aligned (masked scores underflow to exactly 0 after the
+    fp32 exp in both)."""
+    same = (q_seg[:, None] == k_seg[None, :]) & (q_seg[:, None] > 0.5)
+    return jnp.where(same, 0.0, -10000.0)
+
+
 def _flash_fwd_kernel(
-    seed_ref, q_ref, k_ref, v_ref, bias_ref, out_ref, lse_ref,
-    *, block_k, scale, rate, bh_block
+    seed_ref, q_ref, k_ref, v_ref, bias_ref, seg_ref, out_ref, lse_ref,
+    *, block_k, scale, rate, bh_block, segmented
 ):
-    # q_ref: [G, block_q, D]; k_ref/v_ref: [G, S, D]; bias_ref: [G, 1, S]
-    # where G = bh_block (batch*head) pairs per program — an unrolled loop
-    # that amortizes the grid at short sequence lengths (_pick_bh_block).
+    # q_ref: [G, block_q, D]; k_ref/v_ref: [G, S, D]; bias_ref/seg_ref:
+    # [G, 1, S], where G = bh_block (batch*head) pairs per program — an
+    # unrolled loop that amortizes the grid at short sequence lengths
+    # (_pick_bh_block). ``segmented`` statically gates the packed
+    # block-diagonal mask (_seg_mask); unpacked callers pay nothing.
     # Matmul operands stay in the input dtype (bf16 in training) with fp32
     # accumulation — a single MXU pass per dot; casting inputs up to fp32
     # first would decompose each matmul into several passes. The softmax
@@ -147,6 +170,9 @@ def _flash_fwd_kernel(
     for g in range(bh_block):
         bh = pl.program_id(0) * bh_block + g
         q = q_ref[g]
+        block_q = q.shape[0]
+        if segmented:
+            q_seg = seg_ref[g, 0, pl.ds(qb * block_q, block_q)]
 
         def body(j, carry):
             m_prev, l_prev, acc = carry
@@ -158,6 +184,9 @@ def _flash_fwd_kernel(
                 preferred_element_type=jnp.float32,
             ) * scale  # [block_q, block_k]
             s = s + b[None, :]
+            if segmented:
+                k_seg = seg_ref[g, 0, pl.ds(j * block_k, block_k)]
+                s = s + _seg_mask(q_seg, k_seg)
             m_cur = jnp.max(s, axis=-1)
             m_new = jnp.maximum(m_prev, m_cur)
             alpha = jnp.exp(m_prev - m_new)
@@ -185,8 +214,8 @@ def _flash_fwd_kernel(
 
 
 def _flash_dq_kernel(
-    seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref, do_ref,
-    dq_ref, *, block_k, scale, rate, bh_block
+    seed_ref, q_ref, k_ref, v_ref, bias_ref, seg_ref, lse_ref, delta_ref,
+    do_ref, dq_ref, *, block_k, scale, rate, bh_block, segmented
 ):
     """dq for [G, block_q, D] tiles (G bh pairs/program); loops over k blocks."""
     qb = pl.program_id(1)
@@ -200,6 +229,8 @@ def _flash_dq_kernel(
         lse = lse_ref[g, 0]  # [block_q]
         delta = delta_ref[g, 0]  # [block_q]
         do = do_ref[g]  # [block_q, D]
+        if segmented:
+            q_seg = seg_ref[g, 0, pl.ds(qb * q.shape[0], q.shape[0])]
 
         def body(j, dq_acc):
             k = k_ref[g, pl.ds(j * block_k, block_k), :]
@@ -209,6 +240,11 @@ def _flash_dq_kernel(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale + b[None, :]
+            if segmented:
+                # Identical mask regeneration as the forward — the
+                # probabilities below must be the ones the forward used.
+                s = s + _seg_mask(
+                    q_seg, seg_ref[g, 0, pl.ds(j * block_k, block_k)])
             p = jnp.exp(s - lse[:, None])  # normalized probabilities
             da = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -229,8 +265,9 @@ def _flash_dq_kernel(
 
 
 def _flash_dkv_kernel(
-    seed_ref, q_ref, k_ref, v_ref, bias_ref, lse_ref, delta_ref, do_ref,
-    dk_ref, dv_ref, dbias_ref, *, block_q, scale, rate, bh_block
+    seed_ref, q_ref, k_ref, v_ref, bias_ref, seg_ref, lse_ref, delta_ref,
+    do_ref, dk_ref, dv_ref, dbias_ref, *, block_q, scale, rate, bh_block,
+    segmented
 ):
     """dk/dv/dbias for [G, block_k, D] tiles; loops over q blocks."""
     kb = pl.program_id(1)
@@ -244,6 +281,8 @@ def _flash_dkv_kernel(
         v = v_ref[g]
         b = bias_ref[g, 0].astype(jnp.float32)  # [block_k]
         block_k, depth = k.shape
+        if segmented:
+            k_seg = seg_ref[g, 0, pl.ds(kb * block_k, block_k)]
 
         def body(i, carry):
             dk_acc, dv_acc, db_acc = carry
@@ -255,6 +294,9 @@ def _flash_dkv_kernel(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale + b[None, :]
+            if segmented:
+                s = s + _seg_mask(
+                    seg_ref[g, 0, pl.ds(i * block_q, block_q)], k_seg)
             p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
             da = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
@@ -298,21 +340,23 @@ def _seed_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
-def _flash_forward(q3, k3, v3, bias3, seed, scale, rate):
-    """q3/k3/v3: [BH, S, D]; bias3: [BH, 1, S] additive key bias."""
+def _flash_forward(q3, k3, v3, bias3, seg3, seed, scale, rate, segmented):
+    """q3/k3/v3: [BH, S, D]; bias3: [BH, 1, S] additive key bias; seg3:
+    [BH, 1, S] fp32 sequence ids (all-zero dummy when not segmented)."""
     bh, seq, depth = q3.shape
     block_q, block_k = _pick_blocks(seq)
     g = _pick_bh_block(seq, bh)
     grid = (bh // g, seq // block_q)
     out, lse = pl.pallas_call(
         partial(_flash_fwd_kernel, block_k=block_k, scale=scale, rate=rate,
-                bh_block=g),
+                bh_block=g, segmented=segmented),
         grid=grid,
         in_specs=[
             _seed_spec(),
             pl.BlockSpec((g, block_q, depth), lambda b, i: (b, i, 0)),
             pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, seq), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((g, 1, seq), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
@@ -324,23 +368,25 @@ def _flash_forward(q3, k3, v3, bias3, seed, scale, rate):
             jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(seed, q3, k3, v3, bias3)
+    )(seed, q3, k3, v3, bias3, seg3)
     return out, lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
-def _flash(q3, k3, v3, bias3, seed, scale, rate):
-    out, _ = _flash_forward(q3, k3, v3, bias3, seed, scale, rate)
+@partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash(q3, k3, v3, bias3, seg3, seed, scale, rate, segmented):
+    out, _ = _flash_forward(q3, k3, v3, bias3, seg3, seed, scale, rate,
+                            segmented)
     return out
 
 
-def _flash_fwd(q3, k3, v3, bias3, seed, scale, rate):
-    out, lse = _flash_forward(q3, k3, v3, bias3, seed, scale, rate)
-    return out, (q3, k3, v3, bias3, seed, out, lse)
+def _flash_fwd(q3, k3, v3, bias3, seg3, seed, scale, rate, segmented):
+    out, lse = _flash_forward(q3, k3, v3, bias3, seg3, seed, scale, rate,
+                              segmented)
+    return out, (q3, k3, v3, bias3, seg3, seed, out, lse)
 
 
-def _flash_bwd(scale, rate, residuals, g):
-    q3, k3, v3, bias3, seed, out, lse = residuals
+def _flash_bwd(scale, rate, segmented, residuals, g):
+    q3, k3, v3, bias3, seg3, seed, out, lse = residuals
     bh, seq, depth = q3.shape
     block_q, block_k = _pick_blocks(seq)
     # delta = rowsum(dO ⊙ O): one cheap fused XLA reduction, [BH, 1, S].
@@ -351,13 +397,14 @@ def _flash_bwd(scale, rate, residuals, g):
     gb = _pick_bh_block(seq, bh)
     dq = pl.pallas_call(
         partial(_flash_dq_kernel, block_k=block_k, scale=scale, rate=rate,
-                bh_block=gb),
+                bh_block=gb, segmented=segmented),
         grid=(bh // gb, seq // block_q),
         in_specs=[
             _seed_spec(),
             pl.BlockSpec((gb, block_q, depth), lambda b, i: (b, i, 0)),
             pl.BlockSpec((gb, seq, depth), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((gb, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((gb, 1, seq), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((gb, 1, seq), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((gb, 1, block_q), lambda b, i: (b, 0, i)),
             pl.BlockSpec((gb, 1, block_q), lambda b, i: (b, 0, i)),
@@ -366,11 +413,11 @@ def _flash_bwd(scale, rate, residuals, g):
         out_specs=pl.BlockSpec((gb, block_q, depth), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq, depth), q3.dtype),
         interpret=interpret_mode(),
-    )(seed, q3, k3, v3, bias3, lse, delta, g)
+    )(seed, q3, k3, v3, bias3, seg3, lse, delta, g)
 
     dk, dv, dbias = pl.pallas_call(
         partial(_flash_dkv_kernel, block_q=block_q, scale=scale, rate=rate,
-                bh_block=gb),
+                bh_block=gb, segmented=segmented),
         grid=(bh // gb, seq // block_k),
         in_specs=[
             _seed_spec(),
@@ -378,6 +425,8 @@ def _flash_bwd(scale, rate, residuals, g):
             pl.BlockSpec((gb, block_k, depth), lambda b, j: (b, j, 0)),
             pl.BlockSpec((gb, block_k, depth), lambda b, j: (b, j, 0)),
             pl.BlockSpec((gb, 1, block_k), lambda b, j: (b, 0, j)),
+            # seg needs the k tile AND every q block: full row, like lse.
+            pl.BlockSpec((gb, 1, seq), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((gb, 1, seq), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((gb, 1, seq), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((gb, seq, depth), lambda b, j: (b, 0, 0)),
@@ -393,21 +442,30 @@ def _flash_bwd(scale, rate, residuals, g):
             jax.ShapeDtypeStruct((bh, 1, seq), jnp.float32),
         ],
         interpret=interpret_mode(),
-    )(seed, q3, k3, v3, bias3, lse, delta, g)
+    )(seed, q3, k3, v3, bias3, seg3, lse, delta, g)
 
     dseed = np.zeros(seed.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, dbias.astype(bias3.dtype), dseed
+    dseg = jnp.zeros_like(seg3)  # ids are data, not parameters
+    return dq, dk, dv, dbias.astype(bias3.dtype), dseg, dseed
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, bias=None, dropout_rate=0.0, dropout_rng=None):
+def flash_attention(q, k, v, bias=None, dropout_rate=0.0, dropout_rng=None,
+                    sequence_ids=None):
     """Fused attention over [B, S, H, D] tensors.
 
     ``bias`` is the [B, 1, 1, S] additive mask from
     :func:`bert_pytorch_tpu.ops.attention.make_attention_bias` (key-only
     bias; a full [B, H, Sq, Sk] bias is not supported by this kernel).
+
+    ``sequence_ids`` ([B, S] int, 0 = pad) enables PACKED-batch attention
+    (data/packing.py): each [block_q, block_k] tile regenerates its
+    block-diagonal mask from the per-token id vectors inside the kernel —
+    the [B, 1, S, S] mask the XLA path materializes never exists in HBM,
+    the same property the dropout mask already has. Padding is excluded by
+    id 0, so ``bias`` is redundant (and must be None) on this path.
 
     ``dropout_rate > 0`` applies attention-probability dropout *inside* the
     kernel using the TPU hardware PRNG, seeded from ``dropout_rng`` — the
@@ -420,6 +478,17 @@ def flash_attention(q, k, v, bias=None, dropout_rate=0.0, dropout_rng=None):
     def to3(t):
         return t.transpose(0, 2, 1, 3).reshape(batch * heads, seq, depth)
 
+    segmented = sequence_ids is not None
+    if segmented and bias is not None:
+        raise ValueError(
+            "flash_attention: pass either bias (padded batches) or "
+            "sequence_ids (packed batches), not both — packed padding is "
+            "already encoded as sequence id 0")
+    if segmented:
+        seg3 = jnp.repeat(
+            sequence_ids.astype(jnp.float32), heads, axis=0)[:, None, :]
+    else:
+        seg3 = jnp.zeros((batch * heads, 1, seq), jnp.float32)
     if bias is None:
         bias3 = jnp.zeros((batch * heads, 1, seq), jnp.float32)
     else:
@@ -442,5 +511,6 @@ def flash_attention(q, k, v, bias=None, dropout_rate=0.0, dropout_rng=None):
         seed = seed.astype(jnp.int32)[None]
     else:
         seed = jnp.zeros((1,), jnp.int32)
-    out3 = _flash(to3(q), to3(k), to3(v), bias3, seed, scale, float(dropout_rate))
+    out3 = _flash(to3(q), to3(k), to3(v), bias3, seg3, seed, scale,
+                  float(dropout_rate), segmented)
     return out3.reshape(batch, heads, seq, depth).transpose(0, 2, 1, 3)

@@ -121,6 +121,10 @@ def _apply_pretraining_loss(model, variables, mb, rng, next_sentence,
         mb["input_mask"],
         False,  # deterministic
         masked_positions,
+        # Packed batches (data/packing.py) carry the extra arrays; absent
+        # keys select the unpacked model path unchanged.
+        mb.get("sequence_ids"),
+        mb.get("cls_positions"),
         rngs={"dropout": rng},
         **({"mutable": mutable} if mutable else {}),
     )
@@ -455,6 +459,12 @@ def make_train_step(
             # non-finite loss in ANY microbatch, not just the mean.
             "finite": (jnp.isfinite(jnp.sum(losses))
                        & jnp.isfinite(gnorm)).astype(jnp.float32),
+            # Padding-aware throughput accounting (docs/telemetry.md): the
+            # non-pad token count this step actually trained on. Telemetry
+            # pops it on the sync cadence (never an extra device fetch) and
+            # reports padding_efficiency / real-token throughput; with
+            # sequence packing this approaches the full batch token budget.
+            "real_tokens": jnp.sum(batch["input_mask"]).astype(jnp.float32),
         }
         if loss_scale:
             metrics["loss_scale"] = scale
@@ -702,6 +712,8 @@ def make_pp_train_step(
             # mean loss, so isfinite(loss) covers them all.
             "finite": (jnp.isfinite(loss)
                        & jnp.isfinite(gnorm)).astype(jnp.float32),
+            # Padding-aware accounting, same contract as make_train_step.
+            "real_tokens": jnp.sum(batch["input_mask"]).astype(jnp.float32),
         }
         if schedule is not None:
             metrics["learning_rate"] = schedule(opt_step_count(state.opt_state))
@@ -722,7 +734,9 @@ def make_pp_train_step(
 
 
 def make_eval_step(model, next_sentence: bool = True):
-    """Deterministic forward + loss for held-out evaluation."""
+    """Deterministic forward + loss for held-out evaluation. Handles
+    packed validation batches the same way the train step does (the extra
+    keys select the block-diagonal path)."""
 
     def eval_fn(params, batch):
         mlm_logits, nsp_logits = model.apply(
@@ -730,6 +744,10 @@ def make_eval_step(model, next_sentence: bool = True):
             batch["input_ids"],
             batch["segment_ids"],
             batch["input_mask"],
+            True,  # deterministic
+            None,  # masked_positions
+            batch.get("sequence_ids"),
+            batch.get("cls_positions"),
         )
         loss = pretraining_loss(
             mlm_logits,

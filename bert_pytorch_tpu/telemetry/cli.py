@@ -121,6 +121,7 @@ def default_jsonl_path(args, output_dir: Optional[str],
 def from_args(args, sink=None, is_primary: bool = True,
               seq_per_step: Optional[int] = None,
               flops_per_seq: Optional[float] = None,
+              tokens_per_step: Optional[int] = None,
               output_dir: Optional[str] = None):
     """Build a TrainTelemetry from the :func:`add_cli_args` namespace.
 
@@ -143,6 +144,7 @@ def from_args(args, sink=None, is_primary: bool = True,
         sync_every=args.telemetry_sync_every,
         seq_per_step=seq_per_step,
         flops_per_seq=flops_per_seq,
+        tokens_per_step=tokens_per_step,
         device_kind=jax.devices()[0].device_kind,
         n_devices=jax.device_count(),
         profile_steps=args.profile_steps,

@@ -159,6 +159,22 @@ def summarize_records(records, name: str = "") -> dict:
             total_w = sum(w for _, w in mfus)
             out["mfu"] = round(
                 sum(v * w for v, w in mfus) / total_w, 4)
+        # Padding-aware accounting (step_timer.py): steady-state real-token
+        # rate and the window-weighted padding efficiency it divides by.
+        effs = [(float(w["padding_efficiency"]),
+                 int(w.get("window_steps", 1)))
+                for w in tail if w.get("padding_efficiency")]
+        if effs:
+            total_w = sum(w for _, w in effs)
+            out["padding_efficiency"] = round(
+                sum(v * w for v, w in effs) / total_w, 4)
+        tok = _weighted_median(
+            [(float(w["tokens_per_s"]), int(w.get("window_steps", 1)))
+             for w in tail
+             if w.get("tokens_per_s")
+             and w.get("tokens_per_s_basis") == "real"])
+        if tok is not None:
+            out["tokens_per_s"] = round(tok, 2)
 
     if compiles:
         by_cache: dict = {}
@@ -294,7 +310,8 @@ def format_summary(summary: dict) -> str:
              f"({summary.get('records', 0)} records)"]
     order = ("steps", "wall_s", "steps_per_sec", "step_p50_s", "step_p95_s",
              "data_wait_p50_s", "host_p50_s", "device_p50_s", "mfu",
-             "training_seq_per_sec", "compiles", "compile_s", "cold_start",
+             "training_seq_per_sec", "padding_efficiency", "tokens_per_s",
+             "real_tokens_per_sec", "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",
              "peak_bytes_in_use", "bytes_in_use_last", "bytes_limit")

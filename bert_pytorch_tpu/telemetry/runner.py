@@ -63,6 +63,7 @@ class TrainTelemetry:
         sync_every: int = 1,
         seq_per_step: Optional[int] = None,
         flops_per_seq: Optional[float] = None,
+        tokens_per_step: Optional[int] = None,
         device_kind: str = "",
         n_devices: int = 1,
         profile_steps=None,
@@ -90,7 +91,8 @@ class TrainTelemetry:
         self.timer = StepTimer(
             window=window, sync_every=sync_every, clock=clock,
             seq_per_step=seq_per_step, flops_per_seq=flops_per_seq,
-            device_kind=device_kind, n_devices=n_devices)
+            device_kind=device_kind, n_devices=n_devices,
+            tokens_per_step=tokens_per_step)
         self.profiler = ProfilerWindow(
             profile_steps, profile_dir, enabled=is_primary)
         self.compile_monitor = CompileMonitor(
@@ -171,8 +173,12 @@ class TrainTelemetry:
         # not the runner's: pop it unconditionally so runner-side
         # float(metrics[...]) loops never trip over the nested dict, and
         # read it only on synced steps (fetching it otherwise would BE a
-        # sync and defeat the cadence).
+        # sync and defeat the cadence). The real-token count
+        # (padding-aware accounting, step_timer.py) follows the same
+        # contract: popped always, fetched only when this step syncs.
         health = metrics.pop("grad_health", None) \
+            if isinstance(metrics, dict) else None
+        real_tokens = metrics.pop("real_tokens", None) \
             if isinstance(metrics, dict) else None
         target = sync_target if sync_target is not None else metrics
         self._last_sync_target = target
@@ -182,6 +188,8 @@ class TrainTelemetry:
             synced = True
         self.last_step_synced = synced
         if synced:
+            if real_tokens is not None:
+                self.timer.note_tokens(float(real_tokens))
             self.memory.sample(step)
             if health is not None and float(health.get("due", 0.0)):
                 record = health_record(step, health)

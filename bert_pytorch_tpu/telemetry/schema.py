@@ -70,6 +70,15 @@ KIND_REQUIRED_KEYS = {
 # record kind.
 LOADER_REQUIRED_KEYS = ("batches", "wait_s_total", "stalls", "depth_max")
 
+# Padding-aware throughput fields (schema v1 addition; step_timer.py,
+# sequence packing data/packing.py). Optional — pre-packing artifacts
+# simply omit them — but internally consistent when present: a
+# tokens_per_s without its basis, or a "real" basis without the
+# padding_efficiency that defines it, would make artifacts incomparable
+# across the packing transition (exactly what the basis field exists to
+# prevent).
+TOKENS_BASES = ("real", "all")
+
 _NONFINITE_SPELLINGS = ("NaN", "Infinity", "-Infinity")
 
 
@@ -98,9 +107,31 @@ def validate_record(rec) -> list:
                     if missing:
                         errors.append(
                             f"loader gauges missing keys {missing}")
+                if kind == "step_window":
+                    _check_token_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
+
+
+def _check_token_fields(rec, errors) -> None:
+    """Padding-aware throughput consistency (schema v1 addition)."""
+    if "tokens_per_s" in rec:
+        basis = rec.get("tokens_per_s_basis")
+        if basis not in TOKENS_BASES:
+            errors.append(
+                f"tokens_per_s requires tokens_per_s_basis in "
+                f"{TOKENS_BASES}, got {basis!r}")
+        if basis == "real" and "padding_efficiency" not in rec:
+            errors.append(
+                "tokens_per_s_basis 'real' requires padding_efficiency")
+    if "padding_efficiency" in rec:
+        eff = rec["padding_efficiency"]
+        if not isinstance(eff, (int, float)) or not 0 < eff <= 1:
+            errors.append(
+                f"padding_efficiency must be in (0, 1], got {eff!r}")
+    if "mfu_real_tokens" in rec and "padding_efficiency" not in rec:
+        errors.append("mfu_real_tokens requires padding_efficiency")
 
 
 def _check_finite(key, value, errors) -> None:

@@ -33,6 +33,16 @@ over window wall time, the conventional definition) otherwise — dividing
 per-step FLOPs by a multi-step backlog interval would deflate MFU by
 roughly the sync cadence.
 
+Padding-aware accounting (sequence packing, data/packing.py): given
+``tokens_per_step`` (the step's token budget, pad included) and per-step
+real-token counts (``note_tokens``, fed from the train step's
+``real_tokens`` metric on the sync cadence), windows additionally report
+``padding_efficiency`` (real/budget over the sampled steps),
+``tokens_per_s`` with an explicit ``tokens_per_s_basis`` ("real" — pad
+divided out; "all" — raw budget rate, the pre-packing convention), and
+``mfu_real_tokens`` (MFU scaled to count only real-token FLOPs as useful
+work, while ``mfu`` keeps reporting hardware occupancy).
+
 The clock is injectable for tests (``clock=fake``); the timer never calls
 into JAX except through the ``sync`` callable handed to it.
 """
@@ -72,6 +82,7 @@ class StepTimer:
         flops_per_seq: Optional[float] = None,
         device_kind: str = "",
         n_devices: int = 1,
+        tokens_per_step: Optional[int] = None,
     ):
         self.window = max(1, int(window))
         self.sync_every = max(0, int(sync_every))  # 0 = never sync
@@ -80,6 +91,14 @@ class StepTimer:
         self.flops_per_seq = flops_per_seq
         self.device_kind = device_kind
         self.n_devices = max(1, int(n_devices))
+        # Padding-aware accounting (docs/telemetry.md): tokens_per_step is
+        # the step's token BUDGET (rows x seq_len, pad included); the train
+        # step reports the real (non-pad) count via note_tokens on the sync
+        # cadence. Their ratio is padding_efficiency — what sequence
+        # packing (data/packing.py) exists to raise.
+        self.tokens_per_step = tokens_per_step
+        self.run_real_tokens = 0.0
+        self.run_token_steps = 0
         self._step_index = 0
         self._reset_window()
         self._t_data0 = self._t_data1 = self._t_dispatch1 = None
@@ -90,6 +109,7 @@ class StepTimer:
         self._hosts: list = []
         self._devices: list = []
         self._steps: list = []
+        self._real_tokens: list = []
         self._window_t0 = None
 
     # -- per-step marks, in order --------------------------------------
@@ -109,6 +129,24 @@ class StepTimer:
         if self.sync_every == 0:
             return False
         return self._step_index % self.sync_every == 0
+
+    def note_tokens(self, real_tokens: float) -> None:
+        """Record one step's REAL (non-pad) token count. Called by the
+        telemetry facade on synced steps only — the count rides in the
+        step metrics, so reading it off-cadence would itself be a sync.
+        Window records then report padding_efficiency and real-token
+        throughput from the sampled steps."""
+        self._real_tokens.append(float(real_tokens))
+        self.run_real_tokens += float(real_tokens)
+        self.run_token_steps += 1
+
+    def run_padding_efficiency(self) -> Optional[float]:
+        """Run-level real/budget token ratio over the sampled steps (None
+        when no counts were observed or the budget is unknown)."""
+        if not self.run_token_steps or not self.tokens_per_step:
+            return None
+        return self.run_real_tokens / (
+            self.run_token_steps * self.tokens_per_step)
 
     def device_sync(self, sync_target) -> bool:
         """Block until the step's outputs are ready and record the device
@@ -179,6 +217,31 @@ class StepTimer:
         record["mfu"], record["mfu_basis"] = self._window_mfu(wall, n)
         if self.seq_per_step:
             record["seq_per_sec"] = round(self.seq_per_step * n / wall, 2)
+        if self.tokens_per_step:
+            # Padding-aware throughput: tokens_per_s with an explicit basis
+            # so pre-packing artifacts stay comparable. "real" divides out
+            # the pad tokens (sampled from the steps the sync cadence
+            # observed); "all" is the raw token budget rate (the only
+            # number available when no step in the window was sampled).
+            if self._real_tokens:
+                eff = (sum(self._real_tokens)
+                       / (len(self._real_tokens) * self.tokens_per_step))
+                eff = min(1.0, eff)
+                record["padding_efficiency"] = round(eff, 4)
+                record["tokens_per_s"] = round(
+                    self.tokens_per_step * n / wall * eff, 2)
+                record["tokens_per_s_basis"] = "real"
+                if record["mfu"]:
+                    # Tokens-basis MFU: counts only real-token FLOPs as
+                    # useful work (pad FLOPs ARE executed — "mfu" keeps
+                    # reporting hardware occupancy; this reports how much
+                    # of it trained the model).
+                    record["mfu_real_tokens"] = round(
+                        record["mfu"] * eff, 4)
+            else:
+                record["tokens_per_s"] = round(
+                    self.tokens_per_step * n / wall, 2)
+                record["tokens_per_s_basis"] = "all"
         return record
 
     def _window_mfu(self, wall: float, n_steps: int):

@@ -174,6 +174,35 @@ def create_samples(
     return samples
 
 
+def write_packed_samples_to_hdf5(output_file, samples, tokenizer,
+                                 max_seq_len, max_sequences_per_pack) -> int:
+    """Offline sequence packing (docs/packing.md): greedy
+    first-fit-decreasing over the encoded samples, written in the packed
+    shard layout data/packing.py owns. Dynamic masking still happens in
+    the runtime dataset — the shard stores raw token ids plus per-member
+    lengths/special positions; returns the packed row count."""
+    from bert_pytorch_tpu.data.packing import (first_fit_decreasing,
+                                               write_packed_shard)
+
+    encoded = []
+    for sample in samples:
+        ids = [tokenizer.token_to_id(t) for t in sample.sequence]
+        assert None not in ids, "token missing from vocab"
+        assert len(ids) <= max_seq_len
+        encoded.append((np.asarray(ids, np.int32),
+                        sample.special_token_positions,
+                        1 if sample.is_random_next else 0))
+    packs = first_fit_decreasing(
+        [len(e[0]) for e in encoded], max_seq_len, max_sequences_per_pack)
+    rows = [[encoded[i] for i in pack] for pack in packs]
+    n = write_packed_shard(output_file, rows, max_seq_len,
+                           max_sequences_per_pack)
+    total = sum(len(e[0]) for e in encoded)
+    print(f"[encoder] packed {len(encoded)} samples into {n} rows "
+          f"(occupancy {total / max(1, n * max_seq_len):.3f})")
+    return n
+
+
 def write_samples_to_hdf5(output_file, samples, tokenizer, max_seq_len) -> int:
     """Gzip HDF5 in the runtime dataset's format (reference :183-210);
     special_token_positions is a ragged (vlen) i4 dataset since samples mix
@@ -220,8 +249,13 @@ def encode_file(args, input_file: str, output_file: str) -> None:
     samples = create_samples(
         input_file, tokenizer, args.max_seq_len, args.next_seq_prob,
         args.short_seq_prob)
-    n = write_samples_to_hdf5(output_file, samples, tokenizer,
-                              args.max_seq_len)
+    if getattr(args, "pack_sequences", False):
+        n = write_packed_samples_to_hdf5(
+            output_file, samples, tokenizer, args.max_seq_len,
+            args.max_sequences_per_pack)
+    else:
+        n = write_samples_to_hdf5(output_file, samples, tokenizer,
+                                  args.max_seq_len)
     print(f"[encoder] Encoded {output_file} ({n} samples, "
           f"time={time.time() - start:.0f}s)")
 
@@ -240,6 +274,14 @@ def main(argv=None):
     parser.add_argument("--tokenizer", type=str, default="wordpiece",
                         choices=["wordpiece", "bpe"])
     parser.add_argument("--processes", type=int, default=4)
+    parser.add_argument("--pack_sequences", action="store_true",
+                        help="emit offline-PACKED shards (greedy first-fit-"
+                             "decreasing, data/packing.py layout): several "
+                             "sequences share one max_seq_len row; the "
+                             "runtime derives block-diagonal attention "
+                             "masks from it (docs/packing.md)")
+    parser.add_argument("--max_sequences_per_pack", type=int, default=8,
+                        help="cap on sequences per packed row")
     args = parser.parse_args(argv)
 
     input_files = []
@@ -256,6 +298,9 @@ def main(argv=None):
         f"sequences_{'uppercase' if args.uppercase else 'lowercase'}"
         f"_max_seq_len_{args.max_seq_len}"
         f"_next_seq_task_{str(args.next_seq_prob > 0).lower()}"
+        # Packed and unpacked shards cannot share a dataset directory
+        # (data/dataset.py refuses the mix), so the prefix keeps them apart.
+        + ("_packed" if args.pack_sequences else "")
     )
     out_dir = os.path.join(args.output_dir, prefix)
     os.makedirs(out_dir, exist_ok=True)

@@ -25,7 +25,19 @@ def make_shard(
     nsp: bool = True,
     legacy: bool = False,
     max_pred_per_seq: int = 20,
+    mixed_lengths: bool = False,
+    packed: bool = False,
+    max_sequences_per_pack: int = 8,
 ):
+    """``mixed_lengths`` draws content lengths uniformly from nearly the
+    whole range (instead of the [S/2, S) default) — a stand-in for the
+    Wikipedia-style length distribution that makes sequence packing
+    (docs/packing.md) worth ~2x, so packing is exercisable in tests and
+    bench.py. ``packed`` additionally packs the generated samples
+    first-fit-decreasing and writes an OFFLINE-PACKED shard
+    (data/packing.py layout) instead of the unpacked one."""
+    if packed and legacy:
+        raise ValueError("packed shards use the new format only")
     rng = np.random.default_rng(seed)
     input_ids = np.zeros((num_samples, seq_len), np.int32)
     specials = []
@@ -34,7 +46,14 @@ def make_shard(
     cls_id, sep_id = 2, 3  # arbitrary special ids clear of 0 ([PAD])
     for i in range(num_samples):
         # Random content length; two segments when NSP.
-        content = int(rng.integers(seq_len // 2, seq_len - 1))
+        if mixed_lengths:
+            # Short-biased draw (u^2 over the full range): mean occupancy
+            # ~0.4 like real Wikipedia-style corpora (Krell 2021 fig. 1),
+            # with occasional near-full rows so truncation paths are hit.
+            lo = min(6, seq_len - 4)
+            content = lo + int((seq_len - 2 - lo) * rng.random() ** 2)
+        else:
+            content = int(rng.integers(seq_len // 2, seq_len - 1))
         ids = rng.integers(5, vocab_size, size=content).astype(np.int32)
         if nsp:
             split = int(rng.integers(1, content - 1)) if content > 2 else 1
@@ -51,6 +70,19 @@ def make_shard(
         specials.append(special)
 
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    if packed:
+        from bert_pytorch_tpu.data.packing import (first_fit_decreasing,
+                                                   write_packed_shard)
+
+        lengths = [sp[-1] + 1 for sp in specials]
+        packs = first_fit_decreasing(lengths, seq_len, max_sequences_per_pack)
+        rows = [
+            [(input_ids[i, :lengths[i]], specials[i], int(next_sentence[i]))
+             for i in pack]
+            for pack in packs
+        ]
+        write_packed_shard(path, rows, seq_len, max_sequences_per_pack)
+        return path
     with h5py.File(path, "w") as f:
         f.create_dataset("input_ids", data=input_ids, dtype="i4", compression="gzip")
         if legacy:
@@ -94,6 +126,14 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--no_nsp", action="store_true")
     p.add_argument("--legacy", action="store_true")
+    p.add_argument("--mixed_lengths", action="store_true",
+                   help="draw content lengths from (6, seq_len) instead of "
+                        "[seq_len/2, seq_len) — the length spread that makes "
+                        "sequence packing (docs/packing.md) worth testing")
+    p.add_argument("--packed", action="store_true",
+                   help="write offline-PACKED shards (data/packing.py "
+                        "layout); combine with --mixed_lengths")
+    p.add_argument("--max_sequences_per_pack", type=int, default=8)
     args = p.parse_args(argv)
 
     for s in range(args.num_shards):
@@ -106,6 +146,9 @@ def main(argv=None):
             seed=args.seed + s,
             nsp=not args.no_nsp,
             legacy=args.legacy,
+            mixed_lengths=args.mixed_lengths,
+            packed=args.packed,
+            max_sequences_per_pack=args.max_sequences_per_pack,
         )
         print(f"wrote {path}")
 

@@ -12,7 +12,23 @@ import socket
 import subprocess
 import sys
 
+import jax
+import pytest
+
 WORKER = os.path.join(os.path.dirname(__file__), "_mh_worker.py")
+
+_JAX_VERSION = tuple(int(x) for x in jax.__version__.split(".")[:2])
+# Every test in this module spawns a real 2-process jax.distributed job on
+# CPU devices; on jax 0.4.x the legacy shard_map path those collectives
+# lower through hits XLA's "PartitionId unsupported for SPMD" (the same
+# gate as test_pipeline's gpipe tests — see CHANGES.md PR 1). Skipping with
+# an explicit version gate keeps tier-1 red meaning NEW regression only.
+pytestmark = pytest.mark.skipif(
+    _JAX_VERSION < (0, 5),
+    reason="multi-process CPU collectives need jax>=0.5 "
+           f"(running {jax.__version__}: legacy shard_map lowers to XLA "
+           "'PartitionId unsupported for SPMD')",
+)
 
 
 def _free_port() -> int:
