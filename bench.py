@@ -111,6 +111,21 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 # the fraction of the token budget that is real work. Compare against the
 # default full-row run: rows/s stays ~flat while real tokens/s roughly
 # doubles at Wikipedia-like length spreads (Krell 2021, arXiv:2107.02027).
+# BENCH_SERVE=1 switches to the ONLINE-INFERENCE leg (docs/serving.md):
+# instead of the training step, the child replays a synthetic request
+# trace (tools/make_synthetic_data.py --requests shape) through the
+# serve/ engine — AOT bucket warmup, dynamic batching, optional packing
+# (BENCH_SERVE_PACK=1) — and stamps latency p50/p95/p99 (ms), requests/s,
+# and batch occupancy into the result JSON. Knobs: BENCH_SERVE_REQUESTS
+# (default 256), BENCH_SERVE_BATCH (default 8), BENCH_SERVE_BUCKETS
+# (default "32,64,128"), BENCH_SERVE_RATE (req/s arrival rate; 0 =
+# saturation replay, the default).
+SERVE = os.environ.get("BENCH_SERVE", "0") == "1"
+SERVE_PACK = os.environ.get("BENCH_SERVE_PACK", "0") == "1"
+SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", "256"))
+SERVE_BATCH = int(os.environ.get("BENCH_SERVE_BATCH", "8"))
+SERVE_BUCKETS = os.environ.get("BENCH_SERVE_BUCKETS", "32,64,128")
+SERVE_RATE = float(os.environ.get("BENCH_SERVE_RATE", "0"))
 PACK = (os.environ.get("BENCH_PACK", "0") == "1"
         or "--pack_sequences" in sys.argv[1:])
 PACK_K = int(os.environ.get("BENCH_PACK_K", "8"))
@@ -166,6 +181,11 @@ def _config_digest(degraded=None, local_batch=None):
         # Appended OUTSIDE the tuple so non-packed digests stay
         # byte-identical to the committed warm markers of earlier rounds.
         key += f"+pack{PACK_K}"
+    if SERVE:
+        # The serve leg compiles inference forwards, not the train step;
+        # appended outside the tuple for the same marker-stability reason.
+        key += (f"+serve{SERVE_BATCH}x{SERVE_BUCKETS}"
+                + ("+spack" if SERVE_PACK else ""))
     return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 
@@ -537,9 +557,149 @@ def _child_main():
     print(json.dumps(result))
 
 
+def _serve_child_main():
+    """BENCH_SERVE leg: replay a synthetic request trace through the
+    online-inference engine (docs/serving.md) and print one JSON line with
+    latency percentiles, request throughput, and batch occupancy."""
+    import json as _json
+    import tempfile
+    import threading
+
+    from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(CACHE_DIR)
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.data.tokenization import BertTokenizer
+    from bert_pytorch_tpu.serve import (Batcher, InferenceEngine,
+                                        ServeTelemetry, ServingService)
+    from bert_pytorch_tpu.telemetry import CompileMonitor
+    from bert_pytorch_tpu.tools.make_synthetic_data import (
+        make_request_trace, write_trace_vocab)
+
+    config = BertConfig.from_json_file(
+        os.path.join(REPO_ROOT, "configs", "bert_base_config.json"))
+    if config.vocab_size % 8 != 0:
+        config.vocab_size += 8 - (config.vocab_size % 8)
+
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    # Trace text uses the small covering vocab (token ids stay tiny); the
+    # MODEL keeps its real 30k vocab, so per-request FLOPs are realistic.
+    vocab = write_trace_vocab(os.path.join(tmp, "vocab.txt"))
+    trace = make_request_trace(
+        os.path.join(tmp, "requests.jsonl"), SERVE_REQUESTS, seed=0,
+        rate_rps=SERVE_RATE)
+    tokenizer = BertTokenizer(vocab, do_lower_case=True)
+
+    sink = None
+    if TELEMETRY_JSONL:
+        from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+        sink = JSONLHandler(TELEMETRY_JSONL, overwrite=False)
+    emit = sink.write_record if sink else (lambda rec: None)
+    monitor = CompileMonitor(emit=emit)
+    buckets = [int(b) for b in SERVE_BUCKETS.split(",")]
+    pack_k = int(os.environ.get("BENCH_SERVE_PACK_K", "4"))
+    engine = InferenceEngine(
+        config, tokenizer,
+        tasks={"fill_mask": {}, "classify": {"labels": ["0", "1"]},
+               "squad": {}, "ner": {"labels": ["O", "B-LOC", "B-PER"]}},
+        buckets=buckets, max_batch_size=SERVE_BATCH,
+        max_requests_per_pack=pack_k if SERVE_PACK else 1,
+        dtype=jnp.bfloat16, monitor=monitor)
+    telemetry = ServeTelemetry(emit=emit, window=64)
+    service = ServingService(
+        engine,
+        Batcher(max_batch_size=SERVE_BATCH, max_wait_ms=5.0,
+                max_requests_per_pack=engine.max_requests_per_pack),
+        telemetry)
+
+    t_warm = time.perf_counter()
+    service.start()  # warms every (task, bucket[, packed]) forward
+    warmup_s = time.perf_counter() - t_warm
+
+    lines = [_json.loads(line) for line in open(trace)]
+    errors: list = []
+    t0 = time.perf_counter()
+
+    def worker(chunk):
+        for line in chunk:
+            if SERVE_RATE > 0:
+                delay = t0 + line["arrival_s"] - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+            try:
+                service.submit(line["task"], line["payload"], timeout=300)
+            except Exception as exc:  # stamped, not fatal
+                errors.append(f"{type(exc).__name__}: {exc}")
+
+    n_workers = min(32, max(4, SERVE_BATCH * 4))
+    threads = [threading.Thread(target=worker, args=(lines[i::n_workers],),
+                                daemon=True) for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    snap = telemetry.snapshot()
+    service.stop()
+
+    metric = "bert_base_serve{}_req_per_sec".format(
+        "_packed" if SERVE_PACK else "")
+    result = {
+        "metric": metric,
+        "value": round(SERVE_REQUESTS / wall, 2),
+        "unit": "req/s",
+        "n_requests": SERVE_REQUESTS,
+        "latency_p50_ms": snap.get("latency_p50_ms"),
+        "latency_p95_ms": snap.get("latency_p95_ms"),
+        "latency_p99_ms": snap.get("latency_p99_ms"),
+        "device_p50_ms": snap.get("device_p50_ms"),
+        "batch_occupancy": snap.get("batch_occupancy"),
+        "warmup_s": round(warmup_s, 2),
+        "serve_errors": len(errors),
+        "buckets": buckets,
+        "batch_size": SERVE_BATCH,
+        "pack": pack_k if SERVE_PACK else 1,
+    }
+    if SERVE_RATE > 0:
+        result["arrival_rate_rps"] = SERVE_RATE
+    if errors:
+        result["error_sample"] = errors[0][:200]
+    compile_events = [e for e in monitor.events if e["kind"] == "compile"]
+    if compile_events:
+        result["compile"] = {
+            "events": len(compile_events),
+            "cache": compile_events[0]["cache"],
+            "compile_s": round(
+                sum(e["compile_s"] for e in compile_events), 2),
+        }
+    try:
+        with open(_warm_marker_path(), "w") as f:
+            f.write("ok\n")
+    except OSError:
+        pass
+    if sink is not None:
+        # The metric stamp lets the regression gate refuse diffing a serve
+        # artifact against a training baseline (_attach_regression).
+        sink.write_record({
+            "kind": "run_summary", "tag": "telemetry",
+            "step": SERVE_REQUESTS, "steps": SERVE_REQUESTS,
+            "metric": metric})
+        sink.close()
+    print(_json.dumps(result))
+
+
 def _metric_name_and_anchor():
     kfac_tag = "_kfac" if KFAC else ""
     pack_tag = "_packed" if PACK else ""
+    if SERVE:
+        # No external anchor exists for the serve leg; anchor 1.0 keeps
+        # the parent's error-path JSON shape parseable (vs_baseline ==
+        # value). The child prints its own richer result.
+        return ("bert_base_serve{}_req_per_sec".format(
+            "_packed" if SERVE_PACK else ""), 1.0)
     if DEGRADED:
         # Parent-side estimate only (error paths); the child overrides the
         # anchor with the exactly FLOP-scaled value.
@@ -742,7 +902,8 @@ def main():
     # tail suffices; cold, the tail must hold a small-model compile.
     degrade_ok = (os.environ.get("BENCH_DEGRADE", "auto") != "0"
                   and not DEGRADED and PHASE == 1 and not KFAC
-                  and not LONG_SEQ and not N_DEVICES and not PACK)
+                  and not LONG_SEQ and not N_DEVICES and not PACK
+                  and not SERVE)
     degraded_warm = degrade_ok and os.path.exists(
         os.path.join(CACHE_DIR, f"warm_{_degraded_digest()}"))
     if not degrade_ok:
@@ -855,6 +1016,6 @@ def main():
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
-        _child_main()
+        _serve_child_main() if SERVE else _child_main()
     else:
         main()

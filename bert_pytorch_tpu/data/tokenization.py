@@ -11,6 +11,26 @@ Fast-path backends, in preference order:
   2. the HuggingFace `tokenizers` package when installed.
 The pure-Python implementation below is the behavioral specification both
 are tested against.
+
+Thread-safety (audited for the serving engine's worker threads,
+docs/serving.md; asserted by tests/test_tokenizer.py's concurrent-encode
+test):
+
+* the pure-Python ``BertTokenizer``/``BasicTokenizer``/
+  ``WordpieceTokenizer`` hold only read-only state after construction
+  (vocab dicts, flags) — concurrent ``tokenize``/``convert_*`` calls are
+  safe and run in parallel;
+* the C++ tokenizers keep per-HANDLE result buffers (``wp_encode`` writes,
+  ``wp_get_ids`` reads), so ``encode`` is stateful; each instance
+  serializes encode calls behind its own ``_encode_lock``
+  (tools/tokenizer_cpp.py) — safe under concurrency, one encode at a time
+  per instance. Construct one tokenizer per thread for parallel encoding;
+* HF ``tokenizers`` encode is thread-safe per upstream (Rust, no shared
+  mutable state on the encode path).
+
+One SHARED instance per server is therefore correct for all backends —
+the engine's preprocessing threads contend only on the C++ lock, and
+tokenization is microseconds against a model forward.
 """
 
 from __future__ import annotations

@@ -680,7 +680,14 @@ class BertForPreTraining(nn.Module):
 
 
 class BertForMaskedLM(nn.Module):
-    """MLM only; parity with modeling.py:950-1008."""
+    """MLM only; parity with modeling.py:950-1008.
+
+    ``sequence_ids`` selects the PACKED-row path (data/packing.py):
+    block-diagonal attention + per-sequence position restart, so several
+    short requests can share one row at serve time (serve/engine.py) with
+    per-token logits demultiplexed by segment. No extra parameters — the
+    unpacked call compiles the identical program.
+    """
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
@@ -702,9 +709,11 @@ class BertForMaskedLM(nn.Module):
         token_type_ids: Optional[Array] = None,
         attention_mask: Optional[Array] = None,
         deterministic: bool = True,
+        sequence_ids: Optional[Array] = None,
     ):
         sequence_output, _ = self.bert(
-            input_ids, token_type_ids, attention_mask, deterministic
+            input_ids, token_type_ids, attention_mask, deterministic,
+            sequence_ids,
         )
         word_embedding = self.bert.embeddings.word_embeddings.embedding
         return self.predictions(sequence_output, word_embedding)
@@ -774,7 +783,13 @@ class _ClassifierHead(nn.Module):
 
 
 class BertForSequenceClassification(nn.Module):
-    """Pooled-output classifier; parity with modeling.py:1072-1128."""
+    """Pooled-output classifier; parity with modeling.py:1072-1128.
+
+    ``sequence_ids`` + ``cls_positions`` select the PACKED-row path
+    (data/packing.py): K requests share one row, the pooler gathers each
+    request's own [CLS] vector, and the head returns [B, K, num_labels]
+    (serve/engine.py demultiplexes by pack slot). No extra parameters.
+    """
 
     config: BertConfig
     num_labels: int
@@ -802,8 +817,11 @@ class BertForSequenceClassification(nn.Module):
         token_type_ids: Optional[Array] = None,
         attention_mask: Optional[Array] = None,
         deterministic: bool = True,
+        sequence_ids: Optional[Array] = None,
+        cls_positions: Optional[Array] = None,
     ):
-        _, pooled = self.bert(input_ids, token_type_ids, attention_mask, deterministic)
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                              deterministic, sequence_ids, cls_positions)
         return self.head(pooled, deterministic)
 
 
@@ -848,7 +866,12 @@ class BertForMultipleChoice(nn.Module):
 
 
 class BertForTokenClassification(nn.Module):
-    """Per-token classifier; parity with modeling.py:1200-1271."""
+    """Per-token classifier; parity with modeling.py:1200-1271.
+
+    ``sequence_ids`` selects the PACKED-row path (data/packing.py): the
+    per-token logits of several packed requests ride one row, demultiplexed
+    by segment (serve/engine.py). No extra parameters.
+    """
 
     config: BertConfig
     num_labels: int
@@ -876,9 +899,11 @@ class BertForTokenClassification(nn.Module):
         token_type_ids: Optional[Array] = None,
         attention_mask: Optional[Array] = None,
         deterministic: bool = True,
+        sequence_ids: Optional[Array] = None,
     ):
         sequence_output, _ = self.bert(
-            input_ids, token_type_ids, attention_mask, deterministic
+            input_ids, token_type_ids, attention_mask, deterministic,
+            sequence_ids,
         )
         return self.head(sequence_output, deterministic)
 
@@ -887,6 +912,11 @@ class BertForQuestionAnswering(nn.Module):
     """Start/end span logits; parity with modeling.py:1274-1327.
 
     Returns ``(start_logits, end_logits)`` each [B, S].
+
+    ``sequence_ids`` selects the PACKED-row path (data/packing.py): each
+    packed request's start/end logits occupy its own row segment
+    (serve/engine.py demultiplexes and decodes spans per request). No
+    extra parameters.
     """
 
     config: BertConfig
@@ -919,9 +949,11 @@ class BertForQuestionAnswering(nn.Module):
         token_type_ids: Optional[Array] = None,
         attention_mask: Optional[Array] = None,
         deterministic: bool = True,
+        sequence_ids: Optional[Array] = None,
     ):
         sequence_output, _ = self.bert(
-            input_ids, token_type_ids, attention_mask, deterministic
+            input_ids, token_type_ids, attention_mask, deterministic,
+            sequence_ids,
         )
         logits = self.qa_outputs(sequence_output)
         start_logits, end_logits = jnp.split(logits, 2, axis=-1)

@@ -1,0 +1,33 @@
+"""Online inference subsystem (docs/serving.md).
+
+The first subsystem that exercises the model library outside a training
+loop: a bucket-compiled :class:`InferenceEngine` (params-only checkpoint
+load, AOT warmup per (task, length-bucket), optional request packing via
+``data/packing.py``), a dynamically micro-batching :class:`Batcher`
+(flush on size or deadline), per-task pre/post-processing
+(:mod:`~bert_pytorch_tpu.serve.tasks`), a stdlib JSON-over-HTTP front end
+(:mod:`~bert_pytorch_tpu.serve.http`), and the ``serve`` telemetry record
+family (:class:`ServeTelemetry`) flowing through the schema-v1 JSONL
+machinery.
+"""
+
+from bert_pytorch_tpu.serve.batcher import Batcher, BatcherFull, Request
+from bert_pytorch_tpu.serve.engine import BatchPlan, InferenceEngine, TaskSpec
+from bert_pytorch_tpu.serve.http import make_server
+from bert_pytorch_tpu.serve.service import ServingService
+from bert_pytorch_tpu.serve.stats import ServeTelemetry
+from bert_pytorch_tpu.serve.tasks import TASK_NAMES, build_handlers
+
+__all__ = [
+    "Batcher",
+    "BatcherFull",
+    "BatchPlan",
+    "InferenceEngine",
+    "Request",
+    "ServeTelemetry",
+    "ServingService",
+    "TaskSpec",
+    "TASK_NAMES",
+    "build_handlers",
+    "make_server",
+]

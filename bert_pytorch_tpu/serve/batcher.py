@@ -1,0 +1,212 @@
+"""Dynamic micro-batching for online inference (docs/serving.md).
+
+A :class:`Batcher` owns a thread-safe FIFO of :class:`Request` objects fed
+by the HTTP worker threads and drained by the single dispatch thread
+(serve/service.py). Batches form per task head (one jitted forward serves
+one head) and flush on whichever comes first:
+
+* **size** — the head-of-queue task has accumulated a full batch
+  (``max_batch_size`` requests, or ``max_batch_size * max_requests_per_pack``
+  when packing — packed rows hold several requests each);
+* **deadline** — the OLDEST pending request has waited ``max_wait_ms``
+  (tail latency is bounded by the oldest request, not the newest).
+
+The flush policy is deliberately separated from the blocking machinery:
+:meth:`poll` is a non-blocking pure function of (queue state, clock) so
+tests drive it deterministically with an injected fake clock, while
+:meth:`next_batch` adds the condition-variable wait the dispatch thread
+uses in production.
+
+Length-aware grouping happens downstream: the batcher keeps arrival order
+(FIFO fairness bounds worst-case wait), and the engine's batch planner
+(serve/engine.py ``plan_batch``) picks the smallest length bucket — and,
+when packing, the row assignment — for the flushed group, returning any
+requests that did not fit to :meth:`requeue_front`.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Callable, List, Optional
+
+
+class BatcherFull(RuntimeError):
+    """Raised by :meth:`Batcher.submit` when the pending queue is at its
+    ``max_pending`` cap — the load-shedding signal the HTTP layer turns
+    into a 503 instead of letting memory (and client-visible latency)
+    grow without bound under sustained overload."""
+
+
+class Request:
+    """One in-flight inference request.
+
+    ``features`` is the task's prepared input (serve/tasks.py): a dict with
+    unpadded ``input_ids``/``segment_ids`` plus task-specific decode
+    context. ``length`` (tokens incl. specials) drives bucket selection and
+    packing. The dispatch thread fulfils the request via :meth:`set_result`
+    / :meth:`set_error`; the submitting thread blocks in :meth:`wait`.
+    A submitter that gives up marks the request ``abandoned`` so the
+    dispatch thread skips it instead of spending device time on a result
+    nobody is waiting for.
+    """
+
+    _ids = itertools.count()
+
+    def __init__(self, task: str, features: dict, payload: dict,
+                 enqueued_at: float = 0.0):
+        self.id = next(Request._ids)
+        self.task = task
+        self.features = features
+        self.payload = payload
+        self.length = len(features["input_ids"])
+        self.enqueued_at = enqueued_at
+        self.completed_at: Optional[float] = None
+        self.result: Optional[dict] = None
+        self.error: Optional[str] = None
+        self.abandoned = False
+        # Filled by the dispatch thread for telemetry: seconds of jitted
+        # forward (incl. the device sync) the request's batch cost.
+        self.device_s: Optional[float] = None
+        self._done = threading.Event()
+
+    def set_result(self, result: dict, completed_at: float) -> None:
+        self.result = result
+        self.completed_at = completed_at
+        self._done.set()
+
+    def set_error(self, error: str, completed_at: float) -> None:
+        self.error = error
+        self.completed_at = completed_at
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class Batcher:
+    def __init__(
+        self,
+        max_batch_size: int = 8,
+        max_wait_ms: float = 5.0,
+        max_requests_per_pack: int = 1,
+        max_pending: int = 1024,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if max_batch_size < 1:
+            raise ValueError(
+                f"max_batch_size must be >= 1, got {max_batch_size}")
+        if max_requests_per_pack < 1:
+            raise ValueError(
+                "max_requests_per_pack must be >= 1, got "
+                f"{max_requests_per_pack}")
+        self.max_batch_size = int(max_batch_size)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_requests_per_pack = int(max_requests_per_pack)
+        self.max_pending = int(max_pending)
+        self._clock = clock
+        self._pending: List[Request] = []
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+        # Gauges for the serve telemetry window (serve/stats.py).
+        self.depth_max = 0
+        self.submitted = 0
+
+    # -- producer side ---------------------------------------------------
+
+    def submit(self, request: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if len(self._pending) >= self.max_pending:
+                raise BatcherFull(
+                    f"pending queue at max_pending={self.max_pending}; "
+                    "shedding load")
+            request.enqueued_at = self._clock()
+            self._pending.append(request)
+            self.submitted += 1
+            self.depth_max = max(self.depth_max, len(self._pending))
+            self._cond.notify()
+
+    def requeue_front(self, requests: List[Request]) -> None:
+        """Return requests a partial dispatch could not fit to the FRONT of
+        the queue (they are the oldest; FIFO order is preserved)."""
+        if not requests:
+            return
+        with self._cond:
+            self._pending[:0] = requests
+            self.depth_max = max(self.depth_max, len(self._pending))
+            self._cond.notify()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    # -- consumer side ---------------------------------------------------
+
+    def _flush_size(self) -> int:
+        """Requests of the head task that justify a size flush."""
+        return self.max_batch_size * self.max_requests_per_pack
+
+    def _take_head_task_locked(self) -> List[Request]:
+        """Pop up to a full batch of the HEAD request's task, preserving
+        both the taken group's and the remainder's arrival order."""
+        head_task = self._pending[0].task
+        take, keep = [], []
+        limit = self._flush_size()
+        for req in self._pending:
+            if req.task == head_task and len(take) < limit:
+                take.append(req)
+            else:
+                keep.append(req)
+        self._pending = keep
+        return take
+
+    def poll(self) -> Optional[List[Request]]:
+        """Non-blocking: the next batch if one is DUE (size or deadline),
+        else None. The deadline check uses the injected clock, so tests
+        advance a fake clock instead of sleeping."""
+        with self._cond:
+            if not self._pending:
+                return None
+            head_task = self._pending[0].task
+            n_head = sum(1 for r in self._pending if r.task == head_task)
+            oldest_wait_ms = (self._clock()
+                              - self._pending[0].enqueued_at) * 1000.0
+            if (n_head >= self._flush_size()
+                    or oldest_wait_ms >= self.max_wait_ms):
+                return self._take_head_task_locked()
+            return None
+
+    def next_batch(self, timeout: Optional[float] = None
+                   ) -> Optional[List[Request]]:
+        """Blocking: wait until a batch is due (or the batcher closes /
+        ``timeout`` elapses) and return it. The wait granularity is the
+        time to the oldest request's deadline, so a lone request is
+        dispatched ~``max_wait_ms`` after arrival without polling."""
+        deadline = None if timeout is None else self._clock() + timeout
+        while True:
+            batch = self.poll()
+            if batch is not None:
+                return batch
+            with self._cond:
+                if self._closed and not self._pending:
+                    return None
+                if deadline is not None and self._clock() >= deadline:
+                    return None
+                waits = []
+                if self._pending:
+                    waits.append(max(
+                        0.0,
+                        self._pending[0].enqueued_at
+                        + self.max_wait_ms / 1000.0 - self._clock()))
+                if deadline is not None:
+                    waits.append(max(0.0, deadline - self._clock()))
+                self._cond.wait(timeout=min(waits) if waits else None)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._pending)

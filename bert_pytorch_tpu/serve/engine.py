@@ -1,0 +1,360 @@
+"""Bucket-compiled inference engine for the BERT task heads
+(docs/serving.md).
+
+The :class:`InferenceEngine` owns the device side of serving:
+
+* **params-only checkpoint load** — each task head restores just the model
+  subtree via :func:`bert_pytorch_tpu.utils.checkpoint.load_params_only`
+  (a K-FAC pretraining checkpoint's preconditioner/optimizer pytrees never
+  touch serving host memory); a missing checkpoint falls back to seeded
+  random init (demo/smoke mode, loudly noted by run_server.py);
+* **AOT bucket compilation** — one jitted forward per task head, warmed at
+  startup over every (length-bucket, packedness) shape it will ever see,
+  so steady-state serving never recompiles. Compiles are attributed by
+  the shared :class:`~bert_pytorch_tpu.telemetry.compile_events
+  .CompileMonitor`, so the serve telemetry can assert "zero compiles
+  after warmup" instead of hoping;
+* **batch planning** — :meth:`plan_batch` picks the SMALLEST bucket whose
+  budget fits the flushed group (and, with packing on, the first-fit-
+  decreasing row assignment over ``data/packing.py``'s packer), returning
+  requests that do not fit for the batcher to requeue;
+* **execution + demultiplexing** — :meth:`execute` pads/packs the group
+  into the fixed (max_batch_size, bucket) compile shape, runs the jitted
+  forward, and slices each request's own output back out (row, or
+  (row, segment-span) / (row, pack-slot) when packed).
+
+Batch shapes are FIXED at (max_batch_size, bucket): a partially full
+group pads with all-zero rows (attention mask 0 — rows are independent
+under the padding/block-diagonal mask, so parity with a direct
+single-request forward holds to fp32 exactness; tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.data.packing import first_fit_decreasing
+from bert_pytorch_tpu.serve import tasks as tasks_lib
+from bert_pytorch_tpu.serve.batcher import Request
+from bert_pytorch_tpu.telemetry.compile_events import CompileMonitor
+from bert_pytorch_tpu.utils import checkpoint as ckpt_util
+
+
+class TaskSpec:
+    """One served head: its flax model, restored params, handler, and the
+    jitted (instrumented) forwards."""
+
+    def __init__(self, name: str, model, params, handler):
+        self.name = name
+        self.model = model
+        self.params = params
+        self.handler = handler
+        self.forward: Optional[Callable] = None
+        self.forward_packed: Optional[Callable] = None
+
+
+class BatchPlan:
+    """Output of :meth:`InferenceEngine.plan_batch`."""
+
+    def __init__(self, bucket: int, rows: List[List[Request]],
+                 leftover: List[Request], packed: bool):
+        self.bucket = bucket
+        self.rows = rows          # per dispatched row, its member requests
+        self.leftover = leftover  # did not fit; requeue at queue front
+        self.packed = packed
+
+    @property
+    def requests(self) -> List[Request]:
+        return [r for row in self.rows for r in row]
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        config: BertConfig,
+        tokenizer,
+        tasks: Dict[str, dict],
+        buckets: Sequence[int] = (64, 128),
+        max_batch_size: int = 8,
+        max_requests_per_pack: int = 1,
+        dtype=None,
+        seed: int = 0,
+        monitor: Optional[CompileMonitor] = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ):
+        import jax.numpy as jnp
+
+        self.config = config
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        if not self.buckets or self.buckets[0] < 8:
+            raise ValueError(f"buckets must be >= 8, got {buckets}")
+        if max(self.buckets) > config.max_position_embeddings:
+            raise ValueError(
+                f"largest bucket {max(self.buckets)} exceeds "
+                f"max_position_embeddings {config.max_position_embeddings}")
+        self.max_batch_size = int(max_batch_size)
+        self.max_requests_per_pack = max(1, int(max_requests_per_pack))
+        self.pack = self.max_requests_per_pack > 1
+        self.dtype = dtype if dtype is not None else jnp.float32
+        self._clock = clock
+        self.monitor = monitor or CompileMonitor(emit=lambda rec: None)
+        handlers = tasks_lib.build_handlers(tokenizer, tasks)
+        self.tasks: Dict[str, TaskSpec] = {}
+        for name, options in tasks.items():
+            options = options or {}
+            model, params = self._build_task(
+                name, options, seed=seed + len(self.tasks))
+            spec = TaskSpec(name, model, params, handlers[name])
+            self._build_forwards(spec)
+            self.tasks[name] = spec
+        self.warmed = False
+
+    # -- construction ----------------------------------------------------
+
+    def _build_task(self, name: str, options: dict, seed: int):
+        import flax.linen as nn
+        import jax
+        import jax.numpy as jnp
+
+        from bert_pytorch_tpu import models
+
+        cfg = self.config
+        if name == "fill_mask":
+            model = models.BertForMaskedLM(cfg, dtype=self.dtype)
+        elif name == "classify":
+            labels = options.get("labels") or ["0", "1"]
+            model = models.BertForSequenceClassification(
+                cfg, num_labels=len(labels), dtype=self.dtype)
+        elif name == "squad":
+            model = models.BertForQuestionAnswering(cfg, dtype=self.dtype)
+        elif name == "ner":
+            labels = options.get("labels") or ["O"]
+            # +1: label ids start at 1, id 0 is reserved (run_ner.py).
+            model = models.BertForTokenClassification(
+                cfg, num_labels=len(labels) + 1, dtype=self.dtype)
+        else:
+            raise ValueError(f"unknown serve task {name!r}")
+        sample = (jnp.zeros((1, self.buckets[0]), jnp.int32),) * 3
+        params = nn.unbox(
+            model.init(jax.random.PRNGKey(seed), *sample))["params"]
+        checkpoint = options.get("checkpoint")
+        if checkpoint:
+            params = ckpt_util.load_params_only(checkpoint, params)
+        return model, params
+
+    def _build_forwards(self, spec: TaskSpec) -> None:
+        import jax
+
+        model = spec.model
+
+        def forward(params, input_ids, segment_ids, input_mask):
+            return model.apply(
+                {"params": params}, input_ids, segment_ids, input_mask)
+
+        spec.forward = self.monitor.instrument(
+            jax.jit(forward), f"serve_{spec.name}")
+
+        if not self.pack:
+            return
+        if spec.handler.output_kind == "pooled":
+            def forward_packed(params, input_ids, segment_ids, input_mask,
+                               sequence_ids, cls_positions):
+                return model.apply(
+                    {"params": params}, input_ids, segment_ids, input_mask,
+                    True, sequence_ids, cls_positions)
+        else:
+            def forward_packed(params, input_ids, segment_ids, input_mask,
+                               sequence_ids):
+                return model.apply(
+                    {"params": params}, input_ids, segment_ids, input_mask,
+                    True, sequence_ids)
+        spec.forward_packed = self.monitor.instrument(
+            jax.jit(forward_packed), f"serve_{spec.name}_packed")
+
+    def warmup(self) -> int:
+        """AOT-compile every (task, bucket[, packed]) forward the serving
+        loop can dispatch; returns the number of compile events observed.
+        After this, steady-state traffic never compiles — the acceptance
+        the smoke test asserts via the CompileMonitor."""
+        import jax
+
+        before = len(self.monitor.events)
+        zeros = {}
+        for bucket in self.buckets:
+            B, S, K = (self.max_batch_size, bucket,
+                       self.max_requests_per_pack)
+            zeros[bucket] = (
+                np.zeros((B, S), np.int32), np.zeros((B, S), np.int32),
+                np.zeros((B, S), np.int32), np.zeros((B, S), np.int32),
+                np.zeros((B, K), np.int32))
+        for spec in self.tasks.values():
+            for bucket in self.buckets:
+                ids, seg, mask, sids, cpos = zeros[bucket]
+                out = spec.forward(spec.params, ids, seg, mask)
+                if spec.forward_packed is not None:
+                    if spec.handler.output_kind == "pooled":
+                        out = spec.forward_packed(
+                            spec.params, ids, seg, mask, sids, cpos)
+                    else:
+                        out = spec.forward_packed(
+                            spec.params, ids, seg, mask, sids)
+                jax.block_until_ready(out)
+        self.warmed = True
+        return len(self.monitor.events) - before
+
+    # -- planning --------------------------------------------------------
+
+    def select_bucket(self, length: int) -> int:
+        """Smallest bucket that fits ``length``; the largest bucket for
+        over-long requests (prepare() already truncated to it)."""
+        for bucket in self.buckets:
+            if length <= bucket:
+                return bucket
+        return self.buckets[-1]
+
+    def max_len(self) -> int:
+        return self.buckets[-1]
+
+    def plan_batch(self, requests: List[Request],
+                   packed: Optional[bool] = None) -> BatchPlan:
+        """Assign a flushed request group to rows of the smallest workable
+        bucket. Unpacked: one request per row, first ``max_batch_size``
+        requests, bucket = smallest fitting the longest. Packed: the
+        smallest bucket whose FFD packing needs <= ``max_batch_size``
+        rows; requests falling outside the first ``max_batch_size`` rows
+        are leftover for the batcher to requeue."""
+        if packed is None:
+            packed = self.pack
+        if not requests:
+            raise ValueError("plan_batch needs at least one request")
+        if not packed:
+            take = requests[: self.max_batch_size]
+            leftover = requests[self.max_batch_size:]
+            bucket = self.select_bucket(max(r.length for r in take))
+            return BatchPlan(bucket, [[r] for r in take], leftover, False)
+
+        lengths = [r.length for r in requests]
+        # Budget-greedy bucket choice: every dispatch costs a FULL
+        # (max_batch_size x bucket) token budget regardless of fill, so
+        # the right bucket minimizes total dispatched budget INCLUDING
+        # the extra dispatches a smaller bucket forces (ties -> smaller
+        # bucket, which also means lower per-dispatch latency). A
+        # smallest-that-fits-one-dispatch rule would pick a half-empty
+        # large bucket over two dense small ones.
+        chosen_bucket, chosen_packs, best_budget = None, None, None
+        for bucket in self.buckets:
+            if max(lengths) > bucket:
+                continue
+            packs = first_fit_decreasing(
+                lengths, bucket, self.max_requests_per_pack)
+            dispatches = -(-len(packs) // self.max_batch_size)
+            budget = dispatches * self.max_batch_size * bucket
+            if best_budget is None or budget < best_budget:
+                chosen_bucket, chosen_packs, best_budget = (
+                    bucket, packs, budget)
+        if chosen_packs is None:  # nothing fits: largest bucket, truncate
+            chosen_bucket = self.buckets[-1]
+            chosen_packs = first_fit_decreasing(
+                lengths, chosen_bucket, self.max_requests_per_pack)
+        rows = [[requests[i] for i in pack]
+                for pack in chosen_packs[: self.max_batch_size]]
+        leftover_idx = sorted(
+            i for pack in chosen_packs[self.max_batch_size:] for i in pack)
+        return BatchPlan(chosen_bucket, rows,
+                         [requests[i] for i in leftover_idx], True)
+
+    # -- execution -------------------------------------------------------
+
+    def execute(self, task: str, plan: BatchPlan
+                ) -> Tuple[List[object], dict]:
+        """Run one planned batch; returns (per-request output slices in
+        ``plan.requests`` order, info dict with bucket/rows/real_tokens/
+        device_s/compiles)."""
+        import jax
+
+        spec = self.tasks[task]
+        B, S = self.max_batch_size, plan.bucket
+        ids = np.zeros((B, S), np.int32)
+        seg = np.zeros((B, S), np.int32)
+        mask = np.zeros((B, S), np.int32)
+        offsets: Dict[int, Tuple[int, int, int]] = {}  # req id -> (row, off, slot)
+        if plan.packed:
+            K = self.max_requests_per_pack
+            sids = np.zeros((B, S), np.int32)
+            cpos = np.zeros((B, K), np.int32)
+            for r, row in enumerate(plan.rows):
+                offset = 0
+                for k, req in enumerate(row):
+                    n = req.length
+                    ids[r, offset:offset + n] = req.features["input_ids"]
+                    seg[r, offset:offset + n] = req.features["segment_ids"]
+                    mask[r, offset:offset + n] = 1
+                    sids[r, offset:offset + n] = k + 1
+                    cpos[r, k] = offset
+                    offsets[req.id] = (r, offset, k)
+                    offset += n
+        else:
+            for r, row in enumerate(plan.rows):
+                (req,) = row
+                n = req.length
+                ids[r, :n] = req.features["input_ids"]
+                seg[r, :n] = req.features["segment_ids"]
+                mask[r, :n] = 1
+                offsets[req.id] = (r, 0, 0)
+
+        compiles_before = len(self.monitor.events)
+        t0 = self._clock()
+        if plan.packed:
+            if spec.handler.output_kind == "pooled":
+                out = spec.forward_packed(
+                    spec.params, ids, seg, mask, sids, cpos)
+            else:
+                out = spec.forward_packed(spec.params, ids, seg, mask, sids)
+        else:
+            out = spec.forward(spec.params, ids, seg, mask)
+        out = jax.block_until_ready(out)
+        device_s = self._clock() - t0
+        compiles = sum(
+            1 for e in self.monitor.events[compiles_before:]
+            if e.get("kind") == "compile")
+
+        kind = spec.handler.output_kind
+        if kind == "span":
+            start = np.asarray(out[0], np.float32)
+            end = np.asarray(out[1], np.float32)
+        else:
+            host = np.asarray(out, np.float32)
+
+        results: List[object] = []
+        for req in plan.requests:
+            r, off, slot = offsets[req.id]
+            n = req.length
+            if kind == "pooled":
+                results.append(host[r, slot] if plan.packed else host[r])
+            elif kind == "span":
+                results.append((start[r, off:off + n], end[r, off:off + n]))
+            else:
+                results.append(host[r, off:off + n])
+        info = {
+            "bucket": plan.bucket,
+            "rows": B,
+            "real_tokens": sum(r.length for r in plan.requests),
+            "device_s": device_s,
+            "compiles": compiles,
+            "packed": plan.packed,
+        }
+        return results, info
+
+    def run_direct(self, task: str, payload: dict) -> dict:
+        """One request end to end through the SAME batched path (a batch
+        of one) — the offline/batch-scoring and parity-test entry point."""
+        spec = self.tasks[task]
+        features = spec.handler.prepare(payload, self.max_len())
+        req = Request(task, features, payload)
+        plan = self.plan_batch([req], packed=False)
+        outputs, _ = self.execute(task, plan)
+        return spec.handler.postprocess(features, outputs[0], payload)

@@ -1,0 +1,113 @@
+"""Stdlib JSON-over-HTTP front end for the serving engine
+(docs/serving.md).
+
+``ThreadingHTTPServer`` gives one OS thread per in-flight connection —
+each worker blocks in :meth:`ServingService.submit` while the single
+dispatch thread batches across all of them, which is exactly the
+concurrency shape dynamic micro-batching wants. No framework, no new
+dependency: the repo's hard constraint is stdlib-only for the server.
+
+Routes:
+
+* ``POST /v1/<task>``  — task in {fill_mask, classify, squad, ner}
+  (whichever the engine was configured with); JSON body is the task
+  payload (serve/tasks.py docstrings); 200 with the result JSON,
+  400 on bad payloads, 404 on unknown tasks, 503 on timeout/overload;
+* ``GET  /healthz``    — liveness + the served task list;
+* ``GET  /statsz``     — the live ServeTelemetry rollup (requests,
+  latency percentiles, batch occupancy, compile count).
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from bert_pytorch_tpu.serve.batcher import BatcherFull
+from bert_pytorch_tpu.serve.service import ServingService
+
+MAX_BODY_BYTES = 1 << 20  # 1 MiB: plenty for text payloads, bounds abuse
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # The service rides on the server object so handler instances (one per
+    # request) can reach it without globals.
+    service: ServingService = None
+    request_timeout_s: float = 30.0
+
+
+def _make_handler():
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # quiet; telemetry is the log
+            pass
+
+        def _reply(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            service = self.server.service
+            if self.path == "/healthz":
+                self._reply(200, {
+                    "status": "ok",
+                    "tasks": sorted(service.engine.tasks),
+                    "buckets": list(service.engine.buckets),
+                    "warmed": service.engine.warmed,
+                })
+            elif self.path == "/statsz":
+                self._reply(200, service.telemetry.snapshot())
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            service = self.server.service
+            if not self.path.startswith("/v1/"):
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            task = self.path[len("/v1/"):].strip("/")
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if length > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "payload too large"})
+                    return
+                payload = json.loads(
+                    self.rfile.read(length).decode("utf-8") or "{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("payload must be a JSON object")
+            except ValueError as exc:
+                self._reply(400, {"error": f"bad JSON payload: {exc}"})
+                return
+            try:
+                result = service.submit(
+                    task, payload, timeout=self.server.request_timeout_s)
+            except ValueError as exc:
+                code = 404 if "unknown task" in str(exc) else 400
+                self._reply(code, {"error": str(exc)})
+            except KeyError as exc:
+                self._reply(400, {"error": f"missing payload field {exc}"})
+            except (TimeoutError, BatcherFull) as exc:
+                self._reply(503, {"error": str(exc)})
+            except Exception as exc:
+                self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+            else:
+                self._reply(200, result)
+
+    return Handler
+
+
+def make_server(service: ServingService, host: str = "127.0.0.1",
+                port: int = 8000,
+                request_timeout_s: float = 30.0) -> ServeHTTPServer:
+    """Build (but do not start) the HTTP server; ``port=0`` binds an
+    ephemeral port (tests read ``server.server_address``)."""
+    server = ServeHTTPServer((host, port), _make_handler())
+    server.service = service
+    server.request_timeout_s = request_timeout_s
+    return server

@@ -1,0 +1,141 @@
+"""ServingService — the dispatch loop tying Batcher, InferenceEngine, and
+ServeTelemetry together (docs/serving.md).
+
+HTTP worker threads (or the offline batch scorer) call :meth:`submit`:
+the payload is preprocessed on the calling thread (tokenization
+parallelizes across workers — the tokenizers are thread-safe, see
+data/tokenization.py), enqueued, and the caller blocks until the single
+dispatch thread fulfils the request. The dispatch thread drains the
+batcher, plans each flushed group onto the smallest bucket (packing when
+enabled), runs the jitted forward, demultiplexes, postprocesses, and
+records one telemetry observation per batch.
+
+One dispatch thread is deliberate: JAX dispatch is not thread-safe-fast,
+and a single consumer keeps batches maximal. Concurrency lives in the
+HTTP layer (many blocked submitters) and on the device (the batch).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from bert_pytorch_tpu.serve.batcher import Batcher, Request
+from bert_pytorch_tpu.serve.engine import InferenceEngine
+from bert_pytorch_tpu.serve.stats import ServeTelemetry
+
+
+class ServingService:
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        batcher: Batcher,
+        telemetry: Optional[ServeTelemetry] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.engine = engine
+        self.batcher = batcher
+        self.telemetry = telemetry or ServeTelemetry()
+        self._clock = clock
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- request side ----------------------------------------------------
+
+    def submit(self, task: str, payload: dict,
+               timeout: Optional[float] = 30.0) -> dict:
+        """Prepare, enqueue, and wait for one request; returns the task
+        handler's JSON-able result. Raises ValueError for bad payloads /
+        unknown tasks, TimeoutError when the deadline passes."""
+        spec = self.engine.tasks.get(task)
+        if spec is None:
+            raise ValueError(
+                f"unknown task {task!r}; serving: "
+                f"{sorted(self.engine.tasks)}")
+        features = spec.handler.prepare(payload, self.engine.max_len())
+        request = Request(task, features, payload)
+        self.batcher.submit(request)
+        if not request.wait(timeout):
+            # Nobody will read the result: let the dispatch thread skip
+            # the forward instead of spending device time on it.
+            request.abandoned = True
+            self.telemetry.observe_error()
+            raise TimeoutError(f"request timed out after {timeout}s")
+        if request.error is not None:
+            raise RuntimeError(request.error)
+        return request.result
+
+    # -- dispatch side ---------------------------------------------------
+
+    def process_batch(self, batch: List[Request]) -> None:
+        """Plan, execute, demultiplex, postprocess, observe one flushed
+        group (callable directly for deterministic tests and offline
+        scoring — the background thread just loops it)."""
+        batch = [r for r in batch if not r.abandoned]
+        if not batch:
+            return
+        task = batch[0].task
+        spec = self.engine.tasks[task]
+        plan = self.engine.plan_batch(batch)
+        if plan.leftover:
+            self.batcher.requeue_front(plan.leftover)
+        now = self._clock()
+        try:
+            outputs, info = self.engine.execute(task, plan)
+        except Exception as exc:  # fulfil waiters; the server stays up
+            now = self._clock()
+            for req in plan.requests:
+                req.set_error(f"{type(exc).__name__}: {exc}", now)
+                self.telemetry.observe_error()
+            return
+        now = self._clock()
+        e2e = []
+        for req, out in zip(plan.requests, outputs):
+            try:
+                result = spec.handler.postprocess(
+                    req.features, out, req.payload)
+                req.device_s = info["device_s"]
+                req.set_result(result, now)
+                e2e.append(now - req.enqueued_at)
+            except Exception as exc:
+                req.set_error(f"{type(exc).__name__}: {exc}", now)
+                self.telemetry.observe_error()
+        if e2e:
+            self.telemetry.observe_batch(
+                e2e_s=e2e,
+                device_s=info["device_s"],
+                rows=info["rows"],
+                bucket=info["bucket"],
+                real_tokens=info["real_tokens"],
+                queue_depth=self.batcher.depth(),
+                compiles=info["compiles"],
+            )
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self.batcher.next_batch(timeout=0.1)
+            if batch:
+                self.process_batch(batch)
+
+    def start(self) -> None:
+        if not self.engine.warmed:
+            self.engine.warmup()
+        self.telemetry.reset_clock()  # rps measures serving, not warmup
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-dispatch", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain_s: float = 2.0) -> None:
+        """Stop the dispatch loop, draining already-queued requests for up
+        to ``drain_s`` seconds, and flush the serve telemetry summary."""
+        deadline = self._clock() + drain_s
+        while self.batcher.depth() and self._clock() < deadline:
+            time.sleep(0.01)
+        self._stop.set()
+        self.batcher.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.telemetry.finish()

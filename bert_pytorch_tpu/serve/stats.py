@@ -1,0 +1,209 @@
+"""Serve-side telemetry: windowed latency/occupancy records + /statsz.
+
+The ``serve`` record family (telemetry/schema.py) mirrors the training
+layer's ``step_window``/``run_summary`` pair:
+
+* ``kind="serve_window"`` — emitted every ``window`` completed requests:
+  request count, end-to-end and on-device latency percentiles
+  (p50/p95/p99, milliseconds), batch count, mean batch occupancy
+  (real tokens / dispatched slot budget — the serving analog of
+  ``padding_efficiency``), max queue depth, and the number of XLA
+  compiles observed in the window (zero in steady state — the engine
+  AOT-compiles every (task, bucket) at startup);
+* ``kind="serve_summary"`` — the end-of-run rollup ``finish()`` emits,
+  plus the live snapshot ``/statsz`` serves.
+
+Records flow through the same JSONLHandler/schema machinery as training
+telemetry, so ``tools/check_telemetry_schema.py`` lints them (p50 <= p95
+<= p99, occupancy in (0, 1]) and ``telemetry-report`` summarizes and
+baseline-diffs them (p95 latency gate).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, List, Optional
+
+# Run-level percentile basis: the MOST RECENT this-many request samples.
+# A long-running server at heavy traffic would otherwise grow its latency
+# history without bound and sort it under the lock on every /statsz scrape
+# (window records are exact — they reset per window).
+RUN_SAMPLE_CAP = 8192
+
+
+def _pctl(sorted_vals: List[float], frac: float) -> float:
+    """Nearest-rank percentile of an already-sorted list (the step_timer
+    convention)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              int(frac * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+def _latency_fields(prefix: str, seconds: List[float]) -> dict:
+    s = sorted(seconds)
+    return {
+        f"{prefix}_p50_ms": round(_pctl(s, 0.50) * 1000.0, 3),
+        f"{prefix}_p95_ms": round(_pctl(s, 0.95) * 1000.0, 3),
+        f"{prefix}_p99_ms": round(_pctl(s, 0.99) * 1000.0, 3),
+    }
+
+
+class ServeTelemetry:
+    """Accumulates per-batch serving observations; emits window records.
+
+    Thread-safety: ``observe_batch`` is called by the single dispatch
+    thread, but ``snapshot()`` is read by HTTP worker threads — one lock
+    covers both. ``emit`` receives plain record dicts (a JSONLHandler's
+    ``write_record``, or TrainTelemetry.emit); None disables emission
+    while the in-memory rollup keeps working (/statsz, bench).
+    """
+
+    def __init__(self, emit: Optional[Callable[[dict], None]] = None,
+                 window: int = 64,
+                 clock: Callable[[], float] = time.monotonic):
+        self.emit = emit
+        self.window = max(1, int(window))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._t0 = clock()
+        # current window
+        self._e2e: List[float] = []
+        self._device: List[float] = []
+        self._batches = 0
+        self._real_tokens = 0
+        self._budget_tokens = 0
+        self._depth_max = 0
+        self._compiles = 0
+        self._window_t0 = clock()
+        # run totals; latency samples bounded to the RUN_SAMPLE_CAP most
+        # recent so a long-lived server's memory and /statsz cost stay flat
+        self.total_requests = 0
+        self.total_batches = 0
+        self.total_errors = 0
+        self._run_e2e = collections.deque(maxlen=RUN_SAMPLE_CAP)
+        self._run_device = collections.deque(maxlen=RUN_SAMPLE_CAP)
+        self._run_real_tokens = 0
+        self._run_budget_tokens = 0
+        self._run_depth_max = 0
+        self._run_compiles = 0
+
+    # -- producer --------------------------------------------------------
+
+    def observe_batch(self, e2e_s: List[float], device_s: float,
+                      rows: int, bucket: int, real_tokens: int,
+                      queue_depth: int = 0, compiles: int = 0) -> None:
+        """Record one dispatched batch: per-request end-to-end latencies,
+        the batch's forward wall time (incl. device sync), its dispatched
+        slot budget (``rows * bucket``), and the real tokens it carried."""
+        budget = int(rows) * int(bucket)
+        with self._lock:
+            self._e2e.extend(e2e_s)
+            self._device.append(device_s)
+            self._batches += 1
+            self._real_tokens += int(real_tokens)
+            self._budget_tokens += budget
+            self._depth_max = max(self._depth_max, int(queue_depth))
+            self._compiles += int(compiles)
+            self.total_requests += len(e2e_s)
+            self.total_batches += 1
+            self._run_e2e.extend(e2e_s)
+            self._run_device.append(device_s)
+            self._run_real_tokens += int(real_tokens)
+            self._run_budget_tokens += budget
+            self._run_depth_max = max(self._run_depth_max,
+                                      int(queue_depth))
+            self._run_compiles += int(compiles)
+            due = len(self._e2e) >= self.window
+        if due:
+            self.flush_window()
+
+    def observe_error(self) -> None:
+        with self._lock:
+            self.total_errors += 1
+
+    def reset_clock(self) -> None:
+        """Restart the run/window wall-clock base. Called by the service
+        after engine warmup so ``requests_per_sec`` measures serving time,
+        not the AOT compile phase it would otherwise amortize in."""
+        with self._lock:
+            now = self._clock()
+            self._t0 = now
+            self._window_t0 = now
+
+    # -- records ---------------------------------------------------------
+
+    def _occupancy(self, real: int, budget: int) -> Optional[float]:
+        if budget <= 0:
+            return None
+        # Clamp into the schema's (0, 1] — an all-pad window (real == 0)
+        # cannot happen because every dispatched request carries >= 2
+        # tokens, but guard the floor anyway.
+        return round(min(1.0, max(real, 1) / budget), 4)
+
+    def flush_window(self) -> Optional[dict]:
+        """Emit (and return) the current window record; None when empty."""
+        with self._lock:
+            if not self._e2e:
+                return None
+            now = self._clock()
+            wall = max(now - self._window_t0, 1e-9)
+            record = {
+                "kind": "serve_window",
+                "tag": "serve",
+                "window_requests": len(self._e2e),
+                "batches": self._batches,
+                "requests_per_sec": round(len(self._e2e) / wall, 3),
+                "queue_depth_max": self._depth_max,
+                "compiles": self._compiles,
+            }
+            record.update(_latency_fields("latency", self._e2e))
+            record.update(_latency_fields("device", self._device))
+            occ = self._occupancy(self._real_tokens, self._budget_tokens)
+            if occ is not None:
+                record["batch_occupancy"] = occ
+            self._e2e = []
+            self._device = []
+            self._batches = 0
+            self._real_tokens = 0
+            self._budget_tokens = 0
+            self._depth_max = 0
+            self._compiles = 0
+            self._window_t0 = now
+        if self.emit is not None:
+            self.emit(record)
+        return record
+
+    def snapshot(self) -> dict:
+        """Run-level rollup for /statsz and the serve_summary record."""
+        with self._lock:
+            wall = max(self._clock() - self._t0, 1e-9)
+            record = {
+                "requests": self.total_requests,
+                "batches": self.total_batches,
+                "errors": self.total_errors,
+                "requests_per_sec": round(self.total_requests / wall, 3),
+                "queue_depth_max": self._run_depth_max,
+                "compiles": self._run_compiles,
+            }
+            record.update(_latency_fields("latency", self._run_e2e))
+            record.update(_latency_fields("device", self._run_device))
+            occ = self._occupancy(self._run_real_tokens,
+                                  self._run_budget_tokens)
+            if occ is not None:
+                record["batch_occupancy"] = occ
+            return record
+
+    def finish(self) -> Optional[dict]:
+        """Flush the partial window and emit the serve_summary record."""
+        self.flush_window()
+        if not self.total_requests:
+            return None
+        record = {"kind": "serve_summary", "tag": "serve"}
+        record.update(self.snapshot())
+        if self.emit is not None:
+            self.emit(record)
+        return record

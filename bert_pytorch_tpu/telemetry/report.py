@@ -103,6 +103,8 @@ def summarize_records(records, name: str = "") -> dict:
     divergences = []
     grad_health = []
     memory = []
+    serve_windows = []
+    serve_summary: Optional[dict] = None
     run_summary: Optional[dict] = None
     n_records = 0
     for rec in records:
@@ -120,6 +122,10 @@ def summarize_records(records, name: str = "") -> dict:
             grad_health.append(rec)
         elif kind == "memory":
             memory.append(rec)
+        elif kind == "serve_window":
+            serve_windows.append(rec)
+        elif kind == "serve_summary":
+            serve_summary = rec
         elif kind == "run_summary":
             run_summary = rec
 
@@ -222,6 +228,46 @@ def summarize_records(records, name: str = "") -> dict:
         if any(limits):
             out["bytes_limit"] = max(limits)
 
+    # -- serve record family (serve/stats.py, docs/serving.md) ----------
+    # The serve_summary record carries exact run-level percentiles; when a
+    # run died before finish(), fall back to aggregating the windows with
+    # the step-window conventions (weighted-median p50, max-of-window
+    # tails — a latency spike anywhere in the run must not average away).
+    if serve_summary is not None:
+        for src, dst in (("requests", "serve_requests"),
+                         ("requests_per_sec", "serve_rps"),
+                         ("latency_p50_ms", "serve_latency_p50_ms"),
+                         ("latency_p95_ms", "serve_latency_p95_ms"),
+                         ("latency_p99_ms", "serve_latency_p99_ms"),
+                         ("device_p50_ms", "serve_device_p50_ms"),
+                         ("batch_occupancy", "serve_occupancy"),
+                         ("compiles", "serve_compiles"),
+                         ("errors", "serve_errors")):
+            if serve_summary.get(src) is not None:
+                out[dst] = serve_summary[src]
+    elif serve_windows:
+        reqs = sum(int(w.get("window_requests", 0)) for w in serve_windows)
+        out["serve_requests"] = reqs
+        p50 = _weighted_median(
+            [(float(w["latency_p50_ms"]), int(w.get("window_requests", 1)))
+             for w in serve_windows if "latency_p50_ms" in w])
+        if p50 is not None:
+            out["serve_latency_p50_ms"] = round(p50, 3)
+        for pct in ("p95", "p99"):
+            vals = [float(w[f"latency_{pct}_ms"]) for w in serve_windows
+                    if f"latency_{pct}_ms" in w]
+            if vals:
+                out[f"serve_latency_{pct}_ms"] = round(max(vals), 3)
+        occs = [(float(w["batch_occupancy"]),
+                 int(w.get("window_requests", 1)))
+                for w in serve_windows if w.get("batch_occupancy")]
+        if occs:
+            total_w = sum(w for _, w in occs)
+            out["serve_occupancy"] = round(
+                sum(v * w for v, w in occs) / total_w, 4)
+        out["serve_compiles"] = sum(
+            int(w.get("compiles", 0)) for w in serve_windows)
+
     if run_summary:
         for key, value in run_summary.items():
             if key in ("schema", "ts", "kind", "tag"):
@@ -247,6 +293,12 @@ _CHECKS = (
     ("peak_bytes_in_use", "peak device memory", "up", "mem"),
     ("grad_norm_max", "grad-norm envelope", "up", "grad"),
     ("update_ratio_max", "update-ratio envelope", "up", "grad"),
+    # serve record family (docs/serving.md): the latency gate is p95 —
+    # p50 hides tail regressions and p99 is too noisy at smoke-test
+    # request counts; throughput guards the batching path.
+    ("serve_latency_p95_ms", "serve p95 latency", "up", "p95"),
+    ("serve_rps", "serve throughput (req/s)", "down", "step"),
+    ("serve_occupancy", "serve batch occupancy", "down", "step"),
 )
 
 
@@ -311,7 +363,12 @@ def format_summary(summary: dict) -> str:
     order = ("steps", "wall_s", "steps_per_sec", "step_p50_s", "step_p95_s",
              "data_wait_p50_s", "host_p50_s", "device_p50_s", "mfu",
              "training_seq_per_sec", "padding_efficiency", "tokens_per_s",
-             "real_tokens_per_sec", "compiles", "compile_s", "cold_start",
+             "real_tokens_per_sec",
+             "serve_requests", "serve_rps", "serve_latency_p50_ms",
+             "serve_latency_p95_ms", "serve_latency_p99_ms",
+             "serve_device_p50_ms", "serve_occupancy", "serve_compiles",
+             "serve_errors",
+             "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",
              "peak_bytes_in_use", "bytes_in_use_last", "bytes_limit")

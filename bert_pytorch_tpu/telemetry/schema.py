@@ -63,7 +63,27 @@ KIND_REQUIRED_KEYS = {
     "compile_cost": ("fn", "shapes_digest", "analysis"),
     # end-of-run rollup
     "run_summary": ("steps",),
+    # -- serve record family (serve/stats.py, docs/serving.md) ---------
+    # one window of online-inference traffic: request count, e2e and
+    # on-device latency percentiles (ms), batch occupancy (real tokens /
+    # dispatched slot budget), recompile count
+    "serve_window": (
+        "window_requests", "batches",
+        "latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+        "device_p50_ms", "device_p95_ms", "device_p99_ms",
+        "compiles",
+    ),
+    # end-of-run serving rollup (also the live /statsz shape)
+    "serve_summary": (
+        "requests", "batches",
+        "latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+    ),
 }
+
+# Serve-kind consistency rules (lintable offline): percentiles must be
+# ordered, and occupancy is a ratio of real work to dispatched budget —
+# the serving analog of padding_efficiency, with the same (0, 1] domain.
+_SERVE_LATENCY_PREFIXES = ("latency", "device")
 
 # Host input-pipeline gauges (data/loader.py snapshot) ride INSIDE a
 # step_window record as its "loader" sub-object — they are not a standalone
@@ -109,6 +129,8 @@ def validate_record(rec) -> list:
                             f"loader gauges missing keys {missing}")
                 if kind == "step_window":
                     _check_token_fields(rec, errors)
+                if kind in ("serve_window", "serve_summary"):
+                    _check_serve_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -132,6 +154,27 @@ def _check_token_fields(rec, errors) -> None:
                 f"padding_efficiency must be in (0, 1], got {eff!r}")
     if "mfu_real_tokens" in rec and "padding_efficiency" not in rec:
         errors.append("mfu_real_tokens requires padding_efficiency")
+
+
+def _check_serve_fields(rec, errors) -> None:
+    """Serve-kind consistency (schema v1 addition; serve/stats.py)."""
+    for prefix in _SERVE_LATENCY_PREFIXES:
+        keys = [f"{prefix}_p50_ms", f"{prefix}_p95_ms", f"{prefix}_p99_ms"]
+        vals = [rec.get(k) for k in keys]
+        if not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in vals if v is not None):
+            continue  # type errors surface via the required-key check
+        present = [v for v in vals if v is not None]
+        if len(present) == 3 and not (vals[0] <= vals[1] <= vals[2]):
+            errors.append(
+                f"{prefix} percentiles not ordered "
+                f"(p50 <= p95 <= p99): {vals}")
+    if "batch_occupancy" in rec:
+        occ = rec["batch_occupancy"]
+        if not isinstance(occ, (int, float)) or isinstance(occ, bool) \
+                or not 0 < occ <= 1:
+            errors.append(
+                f"batch_occupancy must be in (0, 1], got {occ!r}")
 
 
 def _check_finite(key, value, errors) -> None:

@@ -1,0 +1,143 @@
+"""Offline batch inference: file-in / file-out scoring through the same
+engine the online server runs (docs/serving.md).
+
+Input is a JSONL request file — the shape ``make_synthetic_data
+--requests`` emits, minus ``arrival_s`` which is ignored offline::
+
+    {"id": 0, "task": "fill_mask", "payload": {"text": "... [MASK] ..."}}
+
+Output is one JSONL line per request: ``{"id", "task", "result"}`` (or
+``"error"``), in input order. Requests are grouped per task and run
+through the SAME bucket-compiled, optionally packed batched path as the
+server (serve/engine.py ``plan_batch``/``execute``), so offline scores
+are bit-identical to served ones — this tool is the regression harness
+for the serving path as much as a utility.
+
+::
+
+    python -m bert_pytorch_tpu.tools.batch_infer \
+        --model_config_file configs/bert_base_config.json \
+        --vocab_file vocab.txt --input requests.jsonl --output scored.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def run_offline(service, lines, out_stream) -> dict:
+    """Score decoded request dicts through the service's engine; returns
+    summary stats. ``service`` is a ServingService (its batcher/dispatch
+    thread are NOT used — offline scoring drives the engine directly in
+    submission order, grouping consecutive same-task requests)."""
+    from bert_pytorch_tpu.serve.batcher import Request
+
+    engine = service.engine
+    results = {}
+    errors = 0
+    pending: list = []
+
+    def flush():
+        nonlocal errors
+        group, pending[:] = list(pending), []
+        if not group:
+            return
+        task = group[0][1]["task"]
+        spec = engine.tasks[task]
+        line_of = {}
+        todo = []
+        for idx, line in group:
+            payload = line.get("payload", {})
+            try:
+                features = spec.handler.prepare(payload, engine.max_len())
+            except Exception as exc:
+                results[idx] = {"id": line.get("id", idx), "task": task,
+                                "error": f"{type(exc).__name__}: {exc}"}
+                errors += 1
+                continue
+            req = Request(task, features, payload)
+            line_of[req.id] = (idx, line)
+            todo.append(req)
+        while todo:
+            plan = engine.plan_batch(todo)
+            outputs, _ = engine.execute(task, plan)
+            for req, out in zip(plan.requests, outputs):
+                idx, line = line_of[req.id]
+                try:
+                    results[idx] = {
+                        "id": line.get("id", idx), "task": task,
+                        "result": spec.handler.postprocess(
+                            req.features, out, req.payload)}
+                except Exception as exc:
+                    results[idx] = {
+                        "id": line.get("id", idx), "task": task,
+                        "error": f"{type(exc).__name__}: {exc}"}
+                    errors += 1
+            done = {r.id for r in plan.requests}
+            todo = [r for r in todo if r.id not in done]
+
+    for idx, line in enumerate(lines):
+        task = line.get("task")
+        if task not in engine.tasks:
+            results[idx] = {"id": line.get("id", idx), "task": task,
+                            "error": f"unknown task {task!r}"}
+            errors += 1
+            continue
+        if pending and pending[-1][1]["task"] != task:
+            flush()
+        pending.append((idx, line))
+    flush()
+
+    for idx in sorted(results):
+        out_stream.write(json.dumps(results[idx]) + "\n")
+    return {"requests": len(results), "errors": errors}
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--input", required=True,
+                        help="JSONL request file ({task, payload} lines)")
+    parser.add_argument("--output", required=True,
+                        help="JSONL results file (- for stdout)")
+    # The engine knobs reuse run_server's surface.
+    import run_server
+
+    server_args, _ = parser.parse_known_args(argv)
+    engine_argv = []
+    skip_value = False
+    for arg in (argv if argv is not None else sys.argv[1:]):
+        if skip_value:
+            skip_value = False
+            continue
+        if arg in ("--input", "--output"):
+            skip_value = True
+            continue
+        if arg.startswith("--input=") or arg.startswith("--output="):
+            continue
+        engine_argv.append(arg)
+    args = run_server.parse_arguments(engine_argv)
+
+    service, sink = run_server.build_service(args)
+    service.engine.warmup()
+    with open(server_args.input, "r", encoding="utf-8") as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    t0 = time.perf_counter()
+    out = (sys.stdout if server_args.output == "-"
+           else open(server_args.output, "w", encoding="utf-8"))
+    try:
+        stats = run_offline(service, lines, out)
+    finally:
+        if out is not sys.stdout:
+            out.close()
+        if sink is not None:
+            sink.close()
+    stats["wall_s"] = round(time.perf_counter() - t0, 3)
+    print(json.dumps({"batch_infer": stats}), file=sys.stderr)
+    return stats
+
+
+if __name__ == "__main__":
+    main()

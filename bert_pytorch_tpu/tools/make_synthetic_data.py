@@ -5,15 +5,96 @@ Writes HDF5 shards in the same formats the real pipeline produces
 ``special_token_positions`` format; NVIDIA DeepLearningExamples layout for
 the legacy pre-masked format, reference dataset.py:184-192) so the data
 runtime and runners can be exercised end-to-end without the real corpus.
+
+``--requests N`` switches to REQUEST-TRACE mode (docs/serving.md): a JSONL
+trace of N online-inference requests — mixed task heads, short-biased
+text lengths (the same u^2 draw as ``--mixed_lengths``, which is what
+makes request packing worth testing), Poisson arrival offsets — plus a
+``vocab.txt`` covering the trace's word list, consumed by bench.py's
+``BENCH_SERVE`` leg and the serving smoke test (tests/test_serve.py).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 
 import h5py
 import numpy as np
+
+# Word list for synthetic request text; ``write_trace_vocab`` derives a
+# WordPiece vocab covering exactly these, so any trace line tokenizes
+# without [UNK] under either the C++ or the pure-Python tokenizer.
+TRACE_WORDS = (
+    "the capital of france is paris what who wrote hamlet shakespeare "
+    "william city big a in was by play london england river runs through "
+    "where mountain tall old new house red blue green").split()
+TRACE_TASKS = ("fill_mask", "classify", "squad", "ner")
+
+
+def write_trace_vocab(path: str) -> str:
+    """WordPiece vocab covering :data:`TRACE_WORDS` + the BERT specials."""
+    tokens = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + list(TRACE_WORDS)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(tokens) + "\n")
+    return path
+
+
+def _trace_text(rng, n_words: int) -> str:
+    return " ".join(
+        TRACE_WORDS[i]
+        for i in rng.integers(0, len(TRACE_WORDS), max(1, n_words)))
+
+
+def make_request_trace(
+    path: str,
+    num_requests: int,
+    seed: int = 0,
+    tasks=TRACE_TASKS,
+    max_words: int = 48,
+    rate_rps: float = 100.0,
+) -> str:
+    """Write a JSONL request trace for the serving engine.
+
+    Each line: ``{"id", "arrival_s", "task", "payload"}``. Lengths are
+    short-biased (``lo + (max-lo) * u^2`` words — the Wikipedia-style
+    spread of ``--mixed_lengths``, so packing has headroom); arrivals are
+    Poisson (exponential inter-arrival at ``rate_rps``; 0 = all at t=0,
+    the closed-loop saturation replay bench.py uses by default).
+    """
+    rng = np.random.default_rng(seed)
+    lines = []
+    t = 0.0
+    for i in range(num_requests):
+        if rate_rps > 0:
+            t += float(rng.exponential(1.0 / rate_rps))
+        task = str(tasks[int(rng.integers(0, len(tasks)))])
+        n_words = 3 + int((max_words - 3) * float(rng.random()) ** 2)
+        if task == "fill_mask":
+            words = _trace_text(rng, n_words).split()
+            words[int(rng.integers(0, len(words)))] = "[MASK]"
+            payload = {"text": " ".join(words)}
+        elif task == "classify":
+            payload = {"text": _trace_text(rng, n_words)}
+            if rng.random() < 0.3:
+                payload["text_pair"] = _trace_text(
+                    rng, max(1, n_words // 2))
+        elif task == "squad":
+            payload = {
+                "question": _trace_text(rng, min(8, max(3, n_words // 4))),
+                "context": _trace_text(rng, n_words),
+            }
+        else:  # ner
+            payload = {"text": _trace_text(rng, n_words)}
+        lines.append(json.dumps({
+            "id": i, "arrival_s": round(t, 6), "task": task,
+            "payload": payload}))
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
 
 
 def make_shard(
@@ -134,7 +215,31 @@ def main(argv=None):
                    help="write offline-PACKED shards (data/packing.py "
                         "layout); combine with --mixed_lengths")
     p.add_argument("--max_sequences_per_pack", type=int, default=8)
+    p.add_argument("--requests", type=int, default=0,
+                   help="REQUEST-TRACE mode: write a JSONL trace of N "
+                        "online-inference requests (mixed tasks, short-"
+                        "biased lengths, Poisson arrivals) plus a "
+                        "covering vocab.txt into --output_dir, for "
+                        "BENCH_SERVE and the serving smoke test "
+                        "(docs/serving.md)")
+    p.add_argument("--request_rate", type=float, default=100.0,
+                   help="Poisson arrival rate (req/s) for --requests; "
+                        "0 = all arrivals at t=0 (saturation replay)")
+    p.add_argument("--max_words", type=int, default=48,
+                   help="--requests: max words per request text (short-"
+                        "biased draw below this)")
     args = p.parse_args(argv)
+
+    if args.requests:
+        trace = make_request_trace(
+            os.path.join(args.output_dir, "requests.jsonl"),
+            args.requests, seed=args.seed, max_words=args.max_words,
+            rate_rps=args.request_rate)
+        vocab = write_trace_vocab(
+            os.path.join(args.output_dir, "vocab.txt"))
+        print(f"wrote {trace}")
+        print(f"wrote {vocab}")
+        return
 
     for s in range(args.num_shards):
         path = os.path.join(args.output_dir, f"shard_{s:04d}.hdf5")

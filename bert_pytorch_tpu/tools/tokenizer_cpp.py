@@ -10,6 +10,15 @@ The library is built on demand with ``make -C native`` (g++ only, no
 external deps); when neither the prebuilt .so nor a compiler is available,
 callers fall back to the HF tokenizers package or the pure-Python
 implementation (bert_pytorch_tpu/data/tokenization.py).
+
+Thread-safety: the C++ core keeps the LAST encode's ids/tokens in
+per-handle buffers (``wp_encode`` fills, ``wp_get_ids``/``wp_get_tokens``
+read), so an unguarded concurrent encode would hand one thread another
+thread's result. Every tokenizer instance therefore serializes
+``encode`` behind its own ``_encode_lock`` — shared instances are safe
+under the serving engine's worker threads (docs/serving.md), at the cost
+of one-encode-at-a-time per instance; ``token_to_id``/``id_to_token``
+are read-only lookups and take no lock.
 """
 
 from __future__ import annotations

@@ -98,6 +98,68 @@ def find_resume_step(output_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def latest_checkpoint(output_dir: str) -> Optional[str]:
+    """Path of the newest ``ckpt_*.msgpack`` in ``output_dir``, or None.
+
+    Safe when the directory does not exist yet (a serving process pointed
+    at a training run's output dir may start before the first checkpoint
+    lands) — ``_ckpt_steps`` already treats a missing dir as empty.
+    """
+    step = find_resume_step(output_dir)
+    return None if step is None else checkpoint_path(output_dir, step)
+
+
+def load_params_only(path: str, target: Any, key: str = "model") -> Any:
+    """Restore ONLY the ``key`` (model-params) subtree of a checkpoint onto
+    ``target``, without materializing the optimizer/preconditioner pytrees.
+
+    A pretraining checkpoint holds ``{model, optimizer, sampler, epoch
+    [, preconditioner][, scaler]}``; for LAMB the optimizer subtree is 2x
+    the params, and K-FAC adds per-layer factor/inverse stacks on top —
+    state a serving process (serve/engine.py) must never pay host memory
+    for. The top-level msgpack map is walked with a streaming unpacker:
+    every subtree except ``key`` is skipped byte-wise (``Unpacker.skip``
+    decodes nothing), and only the ``key`` span is handed to flax's
+    ``msgpack_restore``. Falls back to a full restore if the file is not
+    the expected top-level map (e.g. a hand-rolled artifact).
+    """
+    with open(path, "rb") as f:
+        blob = f.read()
+    state = _extract_toplevel_subtree(blob, key)
+    if state is None:
+        full = serialization.msgpack_restore(blob)
+        if not isinstance(full, dict) or key not in full:
+            raise KeyError(
+                f"checkpoint {path} has no top-level {key!r} subtree "
+                f"(keys: {sorted(full) if isinstance(full, dict) else type(full).__name__})")
+        state = full[key]
+    return serialization.from_state_dict(target, state)
+
+
+def _extract_toplevel_subtree(blob: bytes, key: str) -> Optional[Any]:
+    """Decode one value of the checkpoint's top-level msgpack map,
+    byte-skipping the others; None when the layout is unexpected (the
+    caller then falls back to a full restore)."""
+    import msgpack
+
+    try:
+        unpacker = msgpack.Unpacker(max_buffer_size=len(blob) or 1,
+                                    raw=False)
+        unpacker.feed(blob)
+        n_items = unpacker.read_map_header()
+        for _ in range(n_items):
+            name = unpacker.unpack()
+            if name == key:
+                start = unpacker.tell()
+                unpacker.skip()
+                return serialization.msgpack_restore(
+                    blob[start:unpacker.tell()])
+            unpacker.skip()
+    except Exception:
+        return None
+    return None
+
+
 def load_latest_checkpoint(output_dir: str):
     """(step, state) of the newest LOADABLE checkpoint, or None.
 

@@ -1,0 +1,229 @@
+"""Online inference server — the serving entry point (docs/serving.md).
+
+Loads a checkpoint PARAMS-ONLY (utils/checkpoint.py ``load_params_only``
+— the optimizer/K-FAC pytrees never touch serving memory), AOT-compiles
+one jitted forward per (task head, length bucket) at startup, and serves
+a stdlib JSON-over-HTTP API with dynamic micro-batching and optional
+request packing::
+
+    python run_server.py --model_config_file configs/bert_base_config.json \
+        --vocab_file vocab.txt --tasks fill_mask,classify \
+        --classify_labels neg,pos --fill_mask_checkpoint out/ \
+        --buckets 32,64,128 --max_batch_size 8 --max_wait_ms 5 --port 8000
+
+    curl -s localhost:8000/v1/fill_mask \
+        -d '{"text": "the capital of [MASK] is paris"}'
+    curl -s localhost:8000/healthz
+    curl -s localhost:8000/statsz
+
+Per-task ``--<task>_checkpoint`` accepts either a ``ckpt_*.msgpack`` file
+or a directory (the newest checkpoint is picked via
+``latest_checkpoint``); a task without one serves RANDOMLY-INITIALIZED
+weights (smoke/demo mode) and says so loudly. Serve telemetry
+(``serve_window``/``serve_summary`` records, schema v1) lands in the
+JSONL sink next to training telemetry and is summarized by
+``telemetry-report``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+
+from bert_pytorch_tpu.utils import logging as logger
+
+
+def parse_arguments(argv=None):
+    parser = argparse.ArgumentParser(description="TPU BERT inference server")
+    parser.add_argument("--model_config_file", type=str, required=True)
+    parser.add_argument("--vocab_file", type=str, default=None)
+    parser.add_argument("--tokenizer", type=str, default=None,
+                        choices=["wordpiece", "bpe"])
+    parser.add_argument("--uppercase", action="store_true")
+    parser.add_argument("--tasks", type=str,
+                        default="fill_mask,classify,squad,ner",
+                        help="comma-separated task heads to serve")
+    for task in ("fill_mask", "classify", "squad", "ner"):
+        parser.add_argument(f"--{task}_checkpoint", type=str, default=None,
+                            help=f"params checkpoint for the {task} head "
+                                 "(file or run output dir); omitted = "
+                                 "random init (demo mode)")
+    parser.add_argument("--classify_labels", type=str, default="0,1",
+                        help="comma-separated labels for classify")
+    parser.add_argument("--ner_labels", type=str,
+                        default="O,B-PER,I-PER,B-LOC,I-LOC,B-ORG,I-ORG,"
+                                "B-MISC,I-MISC",
+                        help="comma-separated NER tag set (ids 1-based)")
+    parser.add_argument("--buckets", type=str, default="32,64,128",
+                        help="length buckets; one forward is AOT-compiled "
+                             "per (task, bucket) at startup")
+    parser.add_argument("--max_batch_size", type=int, default=8)
+    parser.add_argument("--max_wait_ms", type=float, default=5.0,
+                        help="micro-batch deadline: a partial batch "
+                             "dispatches when its oldest request has "
+                             "waited this long")
+    parser.add_argument("--pack_requests", action="store_true",
+                        help="pack several short requests per row with "
+                             "block-diagonal attention (data/packing.py)")
+    parser.add_argument("--max_requests_per_pack", type=int, default=4)
+    parser.add_argument("--max_pending", type=int, default=1024,
+                        help="pending-queue cap; submissions beyond it "
+                             "shed with HTTP 503 instead of growing "
+                             "memory/latency without bound")
+    parser.add_argument("--host", type=str, default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--request_timeout_s", type=float, default=30.0)
+    parser.add_argument("--dtype", type=str, default="bfloat16",
+                        choices=["bfloat16", "float32"])
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--output_dir", type=str, default=None,
+                        help="telemetry/heartbeat anchor dir")
+    parser.add_argument("--telemetry_jsonl", type=str, default="",
+                        help="serve telemetry JSONL sink; default "
+                             "<output_dir>/serve_telemetry.jsonl")
+    parser.add_argument("--telemetry_window", type=int, default=64,
+                        help="requests per serve_window record")
+    parser.add_argument("--compile_cache_dir", type=str, default="",
+                        help="persistent XLA compile cache; empty disables")
+    args = parser.parse_args(argv)
+
+    with open(args.model_config_file) as f:
+        configs = json.load(f)
+    if args.vocab_file is None:
+        args.vocab_file = configs.get("vocab_file")
+        if args.vocab_file is None:
+            raise ValueError("vocab_file must be in model config or CLI")
+    if args.tokenizer is None:
+        args.tokenizer = configs.get("tokenizer", "wordpiece")
+    return args
+
+
+def build_service(args):
+    """(service, telemetry_sink) — separated from main() so bench.py and
+    tests can build the serving stack without binding a socket."""
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.data.tokenization import (get_bpe_tokenizer,
+                                                    get_wordpiece_tokenizer)
+    from bert_pytorch_tpu.serve import (Batcher, InferenceEngine,
+                                        ServeTelemetry, ServingService)
+    from bert_pytorch_tpu.telemetry.compile_events import CompileMonitor
+    from bert_pytorch_tpu.utils import checkpoint as ckpt_util
+
+    if args.compile_cache_dir:
+        from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache_dir)
+
+    config = BertConfig.from_json_file(args.model_config_file)
+    if config.vocab_size % 8 != 0:
+        config.vocab_size += 8 - (config.vocab_size % 8)
+    if args.tokenizer == "wordpiece":
+        tokenizer = get_wordpiece_tokenizer(
+            args.vocab_file, uppercase=args.uppercase)
+    else:
+        tokenizer = get_bpe_tokenizer(
+            args.vocab_file, uppercase=args.uppercase)
+
+    def resolve_ckpt(path):
+        if not path:
+            return None
+        if os.path.isdir(path):
+            found = ckpt_util.latest_checkpoint(path)
+            if found is None:
+                raise FileNotFoundError(f"no ckpt_*.msgpack under {path}")
+            return found
+        return path
+
+    tasks = {}
+    for task in args.tasks.split(","):
+        task = task.strip()
+        if not task:
+            continue
+        options = {"checkpoint":
+                   resolve_ckpt(getattr(args, f"{task}_checkpoint", None))}
+        if task == "classify":
+            options["labels"] = args.classify_labels.split(",")
+        elif task == "ner":
+            options["labels"] = args.ner_labels.split(",")
+        elif task == "squad":
+            options["do_lower_case"] = not args.uppercase
+        tasks[task] = options
+        if options["checkpoint"] is None:
+            logger.info(f"task {task}: NO checkpoint — serving randomly "
+                        "initialized weights (demo mode)")
+
+    telemetry_jsonl = args.telemetry_jsonl or (
+        os.path.join(args.output_dir, "serve_telemetry.jsonl")
+        if args.output_dir else None)
+    sink = (logger.JSONLHandler(telemetry_jsonl, overwrite=False)
+            if telemetry_jsonl else None)
+    serve_tele = ServeTelemetry(
+        emit=sink.write_record if sink else None,
+        window=args.telemetry_window)
+    monitor = CompileMonitor(
+        emit=sink.write_record if sink else (lambda rec: None))
+
+    engine = InferenceEngine(
+        config,
+        tokenizer,
+        tasks,
+        buckets=[int(b) for b in args.buckets.split(",")],
+        max_batch_size=args.max_batch_size,
+        max_requests_per_pack=(args.max_requests_per_pack
+                               if args.pack_requests else 1),
+        dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
+        seed=args.seed,
+        monitor=monitor,
+    )
+    batcher = Batcher(
+        max_batch_size=args.max_batch_size,
+        max_wait_ms=args.max_wait_ms,
+        max_requests_per_pack=engine.max_requests_per_pack,
+        max_pending=args.max_pending)
+    service = ServingService(engine, batcher, serve_tele)
+    return service, sink
+
+
+def main(args):
+    from bert_pytorch_tpu.serve import make_server
+
+    logger.init(handlers=[logger.StreamHandler()])
+    service, sink = build_service(args)
+    logger.info(
+        f"warming {len(service.engine.tasks)} task heads over buckets "
+        f"{service.engine.buckets} "
+        f"(pack={service.engine.max_requests_per_pack})")
+    compiles = service.engine.warmup()
+    logger.info(f"warmup done: {compiles} compile events; steady-state "
+                "serving recompiles nothing")
+    service.start()
+    server = make_server(service, host=args.host, port=args.port,
+                         request_timeout_s=args.request_timeout_s)
+    host, port = server.server_address[:2]
+    logger.info(f"serving {sorted(service.engine.tasks)} on "
+                f"http://{host}:{port} (POST /v1/<task>, GET /healthz, "
+                "GET /statsz)")
+
+    def shutdown(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        logger.info("shutting down")
+        server.shutdown()
+        service.stop()
+        if sink is not None:
+            sink.close()
+        logger.close()
+
+
+if __name__ == "__main__":
+    main(parse_arguments())

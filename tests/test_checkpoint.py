@@ -134,3 +134,43 @@ def test_agree_on_resume_step_policies(monkeypatch):
     assert run([-1, -1], None) is None  # nobody has one: fresh start
     with pytest.raises(RuntimeError, match="inconsistent across hosts"):
         run([-1, 7], 7)
+
+
+def test_latest_checkpoint_public_and_missing_dir_safe(tmp_path):
+    assert ckpt.latest_checkpoint(str(tmp_path / "not_there")) is None
+    assert ckpt.latest_checkpoint(str(tmp_path)) is None  # empty dir
+    for step in (2, 9):
+        ckpt.save_checkpoint(str(tmp_path), step, _contents(step))
+    assert ckpt.latest_checkpoint(str(tmp_path)) == ckpt.checkpoint_path(
+        str(tmp_path), 9)
+
+
+def test_load_params_only_skips_optimizer_subtree(tmp_path):
+    """Serving restores just the model subtree: the optimizer bytes are
+    skipped by the streaming unpacker, never decoded into arrays."""
+    import pytest
+
+    params = {"dense": {"kernel": np.arange(6, dtype=np.float32).reshape(2, 3),
+                        "bias": np.full(3, 0.5, np.float32)}}
+    heavy_opt = {"mu": {"dense": {"kernel": np.ones((2, 3), np.float32)}},
+                 "nu": {"dense": {"kernel": np.ones((2, 3), np.float32)}}}
+    path = ckpt.save_checkpoint(
+        str(tmp_path), 4,
+        {"model": params, "optimizer": heavy_opt, "epoch": 1})
+
+    # The streaming extractor finds the subtree without a full decode.
+    blob = open(path, "rb").read()
+    sub = ckpt._extract_toplevel_subtree(blob, "model")
+    assert sub is not None
+    np.testing.assert_array_equal(
+        np.asarray(sub["dense"]["kernel"]), params["dense"]["kernel"])
+
+    target = {"dense": {"kernel": np.zeros((2, 3), np.float32),
+                        "bias": np.zeros(3, np.float32)}}
+    out = ckpt.load_params_only(path, target)
+    np.testing.assert_array_equal(out["dense"]["kernel"],
+                                  params["dense"]["kernel"])
+    np.testing.assert_array_equal(out["dense"]["bias"],
+                                  params["dense"]["bias"])
+    with pytest.raises(KeyError, match="no top-level"):
+        ckpt.load_params_only(path, target, key="preconditioner")

@@ -1,0 +1,559 @@
+"""Online inference subsystem tests (serve/, docs/serving.md).
+
+Covers the ISSUE-4 acceptance surface on CPU: bucket-selection
+boundaries, batcher flush on size vs deadline under an injected fake
+clock, packed-batch response demultiplexing, per-task served-vs-direct
+output parity (1e-5 fp32), the >=32-concurrent-request HTTP smoke with
+zero post-warmup compiles + schema-clean serve telemetry +
+telemetry-report summary, and the >=1.5x packed-vs-unpacked batch
+occupancy acceptance on a short-biased trace.
+
+One module-scoped engine (tiny config, buckets (16, 32), batch 4,
+pack K=4) amortizes the AOT warmup compiles across every test.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.serve.batcher import Batcher, Request
+
+ATOL = 1e-5
+BUCKETS = (16, 32)
+BATCH = 4
+PACK_K = 4
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    from bert_pytorch_tpu.tools.make_synthetic_data import write_trace_vocab
+
+    d = tmp_path_factory.mktemp("serve_vocab")
+    return write_trace_vocab(str(d / "vocab.txt"))
+
+
+@pytest.fixture(scope="module")
+def tokenizer(vocab_file):
+    from bert_pytorch_tpu.data.tokenization import BertTokenizer
+
+    return BertTokenizer(vocab_file, do_lower_case=True)
+
+
+@pytest.fixture(scope="module")
+def config():
+    from bert_pytorch_tpu.tools.make_synthetic_data import TRACE_WORDS
+
+    vocab = 5 + len(TRACE_WORDS)
+    vocab += (8 - vocab % 8) % 8
+    return BertConfig(
+        vocab_size=vocab, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2, next_sentence=True,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+NER_LABELS = ["O", "B-LOC", "B-PER", "I-PER"]
+CLS_LABELS = ["neg", "pos"]
+
+
+@pytest.fixture(scope="module")
+def engine(config, tokenizer):
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.serve import InferenceEngine
+
+    eng = InferenceEngine(
+        config, tokenizer,
+        tasks={"fill_mask": {}, "classify": {"labels": CLS_LABELS},
+               "squad": {}, "ner": {"labels": NER_LABELS}},
+        buckets=BUCKETS, max_batch_size=BATCH,
+        max_requests_per_pack=PACK_K, dtype=jnp.float32, seed=7)
+    eng.warmup()
+    eng.warm_events = len(eng.monitor.events)
+    return eng
+
+
+def _payloads():
+    """Mixed-task payloads over the trace vocabulary, varied lengths."""
+    return [
+        ("fill_mask", {"text": "the capital of [MASK] is paris"}),
+        ("fill_mask", {"text": "paris is [MASK]"}),
+        ("fill_mask", {"text": "william shakespeare wrote [MASK] in "
+                               "london england where the river runs"}),
+        ("classify", {"text": "paris is big"}),
+        ("classify", {"text": "the river runs through london",
+                      "text_pair": "england is old"}),
+        ("squad", {"question": "what is the capital of france",
+                   "context": "the capital of france is paris"}),
+        ("squad", {"question": "who wrote hamlet",
+                   "context": "hamlet was wrote by william shakespeare "
+                              "in london"}),
+        # short enough (7 tokens) that two share even the 16 bucket
+        ("squad", {"question": "who wrote hamlet",
+                   "context": "shakespeare"}),
+        ("ner", {"text": "paris is big"}),
+        ("ner", {"text": "william shakespeare wrote hamlet in london "
+                         "england by the river"}),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# bucket selection
+
+
+def test_select_bucket_boundaries(engine):
+    assert engine.select_bucket(1) == 16
+    assert engine.select_bucket(16) == 16
+    assert engine.select_bucket(17) == 32
+    assert engine.select_bucket(32) == 32
+    # over-long falls back to the largest bucket (prepare() truncated).
+    assert engine.select_bucket(33) == 32
+    assert engine.max_len() == 32
+
+
+def test_prepare_truncates_to_largest_bucket(engine):
+    spec = engine.tasks["ner"]
+    long_text = " ".join(["london"] * 100)
+    features = spec.handler.prepare({"text": long_text}, engine.max_len())
+    assert len(features["input_ids"]) <= engine.max_len()
+    assert len(features["words"]) == len(features["word_starts"])
+
+
+def test_fill_mask_windows_around_late_mask(engine):
+    """An over-long text truncates AROUND the mask, never away from it."""
+    spec = engine.tasks["fill_mask"]
+    text = " ".join(["london"] * 80) + " [MASK] paris"
+    features = spec.handler.prepare({"text": text}, engine.max_len())
+    assert len(features["input_ids"]) <= engine.max_len()
+    assert features["mask_positions"]  # mask survived the windowing
+
+
+# ---------------------------------------------------------------------------
+# batcher: size vs deadline flush under a fake clock
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def _req(task="classify", n=6):
+    return Request(task, {"input_ids": list(range(2, 2 + n)),
+                          "segment_ids": [0] * n}, {})
+
+
+def test_batcher_flushes_on_deadline(monkeypatch):
+    clk = FakeClock()
+    b = Batcher(max_batch_size=4, max_wait_ms=10.0, clock=clk)
+    r = _req()
+    b.submit(r)
+    assert b.poll() is None           # fresh: under both thresholds
+    clk.t += 0.009
+    assert b.poll() is None           # 9ms < 10ms deadline
+    clk.t += 0.002
+    batch = b.poll()                  # 11ms: oldest request is due
+    assert batch == [r]
+    assert b.depth() == 0
+
+
+def test_batcher_flushes_on_size_before_deadline():
+    clk = FakeClock()
+    b = Batcher(max_batch_size=4, max_wait_ms=1000.0, clock=clk)
+    reqs = [_req() for _ in range(5)]
+    for r in reqs[:3]:
+        b.submit(r)
+    assert b.poll() is None           # 3 < 4, deadline far away
+    for r in reqs[3:]:
+        b.submit(r)
+    batch = b.poll()                  # 5 pending >= 4: flush a full batch
+    assert batch == reqs[:4]
+    assert b.depth() == 1             # the 5th waits for its own flush
+    # packed batcher flushes at max_batch_size * K
+    bp = Batcher(max_batch_size=2, max_wait_ms=1000.0,
+                 max_requests_per_pack=3, clock=clk)
+    for _ in range(5):
+        bp.submit(_req())
+    assert bp.poll() is None          # 5 < 2*3
+    bp.submit(_req())
+    assert len(bp.poll()) == 6
+
+
+def test_batcher_sheds_load_at_max_pending():
+    from bert_pytorch_tpu.serve.batcher import BatcherFull
+
+    b = Batcher(max_batch_size=4, max_wait_ms=1000.0, max_pending=3,
+                clock=FakeClock())
+    for _ in range(3):
+        b.submit(_req())
+    with pytest.raises(BatcherFull):
+        b.submit(_req())
+    assert b.depth() == 3
+
+
+def test_dispatch_skips_abandoned_requests(engine):
+    """A timed-out submitter marks its request abandoned; the dispatch
+    path must not spend a forward on it (and must not count it)."""
+    from bert_pytorch_tpu.serve import (Batcher, ServeTelemetry,
+                                        ServingService)
+
+    telemetry = ServeTelemetry()
+    service = ServingService(engine, Batcher(max_batch_size=4), telemetry)
+    spec = engine.tasks["classify"]
+    live = Request("classify",
+                   spec.handler.prepare({"text": "paris is big"},
+                                        engine.max_len()),
+                   {"text": "paris is big"})
+    dead = Request("classify",
+                   spec.handler.prepare({"text": "london is old"},
+                                        engine.max_len()),
+                   {"text": "london is old"})
+    dead.abandoned = True
+    service.process_batch([live, dead])
+    assert live.result is not None
+    assert dead.result is None and dead.error is None
+    assert telemetry.total_requests == 1
+    service.process_batch([dead])  # all-abandoned batch is a no-op
+    assert telemetry.total_batches == 1
+
+
+def test_wrap_pair_truncation_is_balanced(engine):
+    """Sentence-pair truncation pops from the LONGER side (the BERT
+    convention, data/glue.py) instead of sacrificing text_a whole."""
+    handler = engine.tasks["classify"].handler
+    text = " ".join(["paris"] * 20)
+    pair = " ".join(["london"] * 20)
+    features = handler.prepare({"text": text, "text_pair": pair}, 32)
+    n_a = sum(1 for s in features["segment_ids"] if s == 0) - 2  # CLS,SEP
+    n_b = sum(1 for s in features["segment_ids"] if s == 1) - 1  # SEP
+    assert len(features["input_ids"]) <= 32
+    assert abs(n_a - n_b) <= 1, (n_a, n_b)
+
+
+def test_batcher_groups_by_head_task(monkeypatch):
+    clk = FakeClock()
+    b = Batcher(max_batch_size=4, max_wait_ms=10.0, clock=clk)
+    c1, n1, c2 = _req("classify"), _req("ner"), _req("classify")
+    for r in (c1, n1, c2):
+        b.submit(r)
+    clk.t += 0.05                     # everyone past the deadline
+    assert b.poll() == [c1, c2]       # head task drained together...
+    assert b.poll() == [n1]           # ...other task keeps arrival order
+    # requeue_front restores FIFO position
+    b.submit(c1)
+    b.requeue_front([c2])
+    clk.t += 0.05
+    assert b.poll() == [c2, c1]
+
+
+# ---------------------------------------------------------------------------
+# batch planning
+
+
+def test_plan_batch_unpacked_picks_smallest_bucket(engine):
+    short = [_req(n=5) for _ in range(3)]
+    plan = engine.plan_batch(short, packed=False)
+    assert plan.bucket == 16 and not plan.leftover
+    assert [len(row) for row in plan.rows] == [1, 1, 1]
+    mixed = short + [_req(n=20)]
+    plan = engine.plan_batch(mixed, packed=False)
+    assert plan.bucket == 32          # one long request forces the bucket
+    over = [_req(n=5) for _ in range(BATCH + 2)]
+    plan = engine.plan_batch(over, packed=False)
+    assert len(plan.rows) == BATCH and len(plan.leftover) == 2
+
+
+def test_plan_batch_packed_rows_and_leftover(engine):
+    # 8 x 7 tokens: bucket 16 fits 2/row -> 4 rows == BATCH; smallest
+    # bucket whose packing fits wins.
+    reqs = [_req(n=7) for _ in range(8)]
+    plan = engine.plan_batch(reqs, packed=True)
+    assert plan.bucket == 16
+    assert len(plan.rows) <= BATCH
+    assert sum(len(row) for row in plan.rows) == 8
+    for row in plan.rows:
+        assert sum(r.length for r in row) <= plan.bucket
+        assert len(row) <= PACK_K
+    # Overflow: more tokens than BATCH rows of the largest bucket hold.
+    many = [_req(n=30) for _ in range(BATCH + 3)]
+    plan = engine.plan_batch(many, packed=True)
+    assert len(plan.rows) == BATCH
+    assert len(plan.leftover) == 3
+
+
+# ---------------------------------------------------------------------------
+# packed demultiplexing + parity
+
+
+def _direct_forward(engine, task, features):
+    """Unbatched, unjitted reference forward for one request."""
+    spec = engine.tasks[task]
+    n = len(features["input_ids"])
+    S = engine.select_bucket(n)
+    ids = np.zeros((1, S), np.int32)
+    seg = np.zeros((1, S), np.int32)
+    mask = np.zeros((1, S), np.int32)
+    ids[0, :n] = features["input_ids"]
+    seg[0, :n] = features["segment_ids"]
+    mask[0, :n] = 1
+    out = spec.model.apply({"params": spec.params}, ids, seg, mask)
+    if spec.handler.output_kind == "span":
+        return (np.asarray(out[0], np.float32)[0, :n],
+                np.asarray(out[1], np.float32)[0, :n])
+    if spec.handler.output_kind == "pooled":
+        return np.asarray(out, np.float32)[0]
+    return np.asarray(out, np.float32)[0, :n]
+
+
+def _assert_outputs_close(a, b, atol=ATOL):
+    if isinstance(a, tuple):
+        for x, y in zip(a, b):
+            np.testing.assert_allclose(x, y, atol=atol, rtol=0)
+    else:
+        np.testing.assert_allclose(a, b, atol=atol, rtol=0)
+
+
+@pytest.mark.parametrize("task", ["fill_mask", "classify", "squad", "ner"])
+def test_packed_demux_matches_unpacked_and_direct(engine, task):
+    """Acceptance: a packed batch's demultiplexed per-request outputs
+    match both the unpacked batched path and a direct (unjitted,
+    unbatched) forward to 1e-5 fp32."""
+    spec = engine.tasks[task]
+    payloads = [p for t, p in _payloads() if t == task] * 4  # 8-12 requests
+    requests = [Request(task, spec.handler.prepare(p, engine.max_len()), p)
+                for p in payloads]
+
+    todo = list(requests)
+    by_id_packed = {}
+    shared = False
+    while todo:
+        plan = engine.plan_batch(todo, packed=True)
+        shared = shared or any(len(row) > 1 for row in plan.rows)
+        outs, info = engine.execute(task, plan)
+        assert info["packed"]
+        for r, o in zip(plan.requests, outs):
+            by_id_packed[r.id] = o
+        todo = plan.leftover
+    assert shared, "test payloads must actually share rows"
+
+    todo = list(requests)
+    by_id_unpacked = {}
+    while todo:
+        plan = engine.plan_batch(todo, packed=False)
+        outs, info = engine.execute(task, plan)
+        assert not info["packed"]
+        for r, o in zip(plan.requests, outs):
+            by_id_unpacked[r.id] = o
+        todo = plan.leftover
+
+    for req in requests:
+        _assert_outputs_close(by_id_packed[req.id], by_id_unpacked[req.id])
+        _assert_outputs_close(by_id_packed[req.id],
+                              _direct_forward(engine, task, req.features))
+
+
+def test_postprocess_shapes(engine):
+    """Task handlers produce their documented JSON shapes end to end."""
+    out = engine.run_direct(
+        "fill_mask", {"text": "paris is [MASK]", "top_k": 3})
+    assert len(out["masks"]) == 1 and len(out["masks"][0]) == 3
+    assert {"token", "id", "score"} <= set(out["masks"][0][0])
+
+    out = engine.run_direct("classify", {"text": "paris is big"})
+    assert out["label"] in CLS_LABELS
+    assert abs(sum(out["scores"].values()) - 1.0) < 1e-6
+
+    out = engine.run_direct(
+        "squad", {"question": "what is the capital of france",
+                  "context": "the capital of france is paris"})
+    assert "answer" in out and isinstance(out["n_best"], list)
+
+    out = engine.run_direct("ner", {"text": "paris is big"})
+    assert [e["word"] for e in out["entities"]] == ["paris", "is", "big"]
+    assert all(e["tag"] in NER_LABELS + ["O"] for e in out["entities"])
+
+
+# ---------------------------------------------------------------------------
+# CPU smoke acceptance: concurrent HTTP traffic, zero post-warmup compiles,
+# schema-clean serve telemetry, telemetry-report summary
+
+
+def _approx_equal_json(a, b, atol=ATOL):
+    if isinstance(a, dict):
+        return (isinstance(b, dict) and set(a) == set(b)
+                and all(_approx_equal_json(a[k], b[k], atol) for k in a))
+    if isinstance(a, list):
+        return (isinstance(b, list) and len(a) == len(b)
+                and all(_approx_equal_json(x, y, atol)
+                        for x, y in zip(a, b)))
+    if isinstance(a, float) or isinstance(b, float):
+        return abs(float(a) - float(b)) <= atol
+    return a == b
+
+
+def test_http_smoke_concurrent_requests(engine, tmp_path):
+    import http.client
+
+    from bert_pytorch_tpu.serve import (Batcher, ServeTelemetry,
+                                        ServingService, make_server)
+    from bert_pytorch_tpu.telemetry.schema import validate_file
+    from bert_pytorch_tpu.tools.make_synthetic_data import (
+        make_request_trace)
+    from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+    trace_path = make_request_trace(
+        str(tmp_path / "requests.jsonl"), 32, seed=11, max_words=20,
+        rate_rps=0.0)
+    lines = [json.loads(line) for line in open(trace_path)]
+    assert len(lines) >= 32 and len({l["task"] for l in lines}) == 4
+
+    jsonl = str(tmp_path / "serve_telemetry.jsonl")
+    sink = JSONLHandler(jsonl, overwrite=True)
+    telemetry = ServeTelemetry(emit=sink.write_record, window=16)
+    # The smoke serves the UNPACKED path so responses are comparable to
+    # run_direct exactly; the packed path has its own acceptance below.
+    engine.pack = False
+    service = ServingService(
+        engine, Batcher(max_batch_size=BATCH, max_wait_ms=10.0),
+        telemetry)
+    events_before = len(engine.monitor.events)
+    service.start()
+    server = make_server(service, port=0, request_timeout_s=60.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    responses = [None] * len(lines)
+
+    def fire(i, line):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request("POST", f"/v1/{line['task']}",
+                         json.dumps(line["payload"]),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            responses[i] = (resp.status, json.loads(resp.read()))
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=fire, args=(i, line))
+               for i, line in enumerate(lines)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+
+        # /statsz + /healthz answer alongside the traffic
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["status"] == "ok" and health["warmed"]
+        conn.request("GET", "/statsz")
+        stats = json.loads(conn.getresponse().read())
+        conn.close()
+    finally:
+        server.shutdown()
+        service.stop()
+        sink.close()
+        engine.pack = True
+
+    assert all(r is not None and r[0] == 200 for r in responses), [
+        r for r in responses if r is None or r[0] != 200][:3]
+    # outputs match the direct forward through the same engine
+    for line, (_, result) in zip(lines, responses):
+        direct = engine.run_direct(line["task"], line["payload"])
+        assert _approx_equal_json(result, direct), (line, result, direct)
+    assert stats["requests"] >= 32 and stats["errors"] == 0
+
+    # zero NEW compiles across the whole smoke (warmup covered them all)
+    new_compiles = [e for e in engine.monitor.events[events_before:]
+                    if e.get("kind") == "compile"]
+    assert not new_compiles, new_compiles
+
+    # serve telemetry lints clean against schema v1
+    assert validate_file(jsonl) == []
+    records = [json.loads(line) for line in open(jsonl)]
+    kinds = {r.get("kind") for r in records}
+    assert "serve_window" in kinds and "serve_summary" in kinds
+
+    # telemetry-report summarizes the artifact (and its serve section)
+    from bert_pytorch_tpu.telemetry import report
+
+    summary = report.summarize_file(jsonl)
+    assert summary["serve_requests"] >= 32
+    assert summary["serve_compiles"] == 0
+    text = report.format_summary(summary)
+    assert "serve_latency_p95_ms" in text and "serve_occupancy" in text
+    # and the p95-latency regression gate trips on a slowed-down run
+    slow = dict(summary, serve_latency_p95_ms=(
+        summary["serve_latency_p95_ms"] * 10 + 100))
+    regressions, _ = report.compare(summary, slow)
+    assert any(r["metric"] == "serve_latency_p95_ms" for r in regressions)
+
+
+# ---------------------------------------------------------------------------
+# packing occupancy acceptance
+
+
+def _replay(engine, requests, packed, flush_size):
+    """Drive the engine the way the dispatch loop would: fixed-size
+    flushes, leftovers requeued at the front. Returns (outputs by request
+    id, real token total, dispatched budget total)."""
+    outputs, real, budget = {}, 0, 0
+    queue = list(requests)
+    while queue:
+        group, queue = queue[:flush_size], queue[flush_size:]
+        while group:
+            plan = engine.plan_batch(group, packed=packed)
+            outs, info = engine.execute(group[0].task, plan)
+            for r, o in zip(plan.requests, outs):
+                outputs[r.id] = o
+            real += info["real_tokens"]
+            budget += info["rows"] * info["bucket"]
+            group = plan.leftover
+    return outputs, real, budget
+
+
+def test_packing_improves_occupancy_1p5x(engine):
+    """Acceptance: on a short-biased trace the packed batcher's occupancy
+    (real tokens / dispatched slot budget) beats the unpacked batcher by
+    >= 1.5x on the SAME trace, with per-request outputs unchanged."""
+    from bert_pytorch_tpu.tools.make_synthetic_data import (
+        make_request_trace)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        trace = make_request_trace(
+            d + "/requests.jsonl", 48, seed=5, max_words=24, rate_rps=0.0)
+        lines = [json.loads(line) for line in open(trace)]
+
+    by_task = {}
+    for line in lines:
+        spec = engine.tasks[line["task"]]
+        features = spec.handler.prepare(line["payload"], engine.max_len())
+        by_task.setdefault(line["task"], []).append(
+            Request(line["task"], features, line["payload"]))
+
+    real_u = budget_u = real_p = budget_p = 0
+    for task, requests in by_task.items():
+        out_u, ru, bu = _replay(engine, requests, packed=False,
+                                flush_size=BATCH)
+        out_p, rp, bp = _replay(engine, requests, packed=True,
+                                flush_size=BATCH * PACK_K)
+        real_u += ru; budget_u += bu; real_p += rp; budget_p += bp
+        for req in requests:  # outputs unchanged under packing
+            _assert_outputs_close(out_p[req.id], out_u[req.id])
+
+    occ_unpacked = real_u / budget_u
+    occ_packed = real_p / budget_p
+    assert real_u == real_p  # same trace, same tokens
+    assert occ_packed >= 1.5 * occ_unpacked, (occ_packed, occ_unpacked)

@@ -449,3 +449,25 @@ def test_unicode_tables_match_runtime_unidata_version():
     assert m.group(1) == unicodedata.unidata_version, (
         f"tables generated for unidata {m.group(1)} but runtime has "
         f"{unicodedata.unidata_version}; regenerate (see docstring)")
+
+
+def test_concurrent_encode_matches_serial(cpp_tok, py_tok):
+    """Thread-safety audit contract (serving worker threads,
+    data/tokenization.py module docstring): concurrent encodes through one
+    SHARED tokenizer instance must be identical to serial encoding — the
+    C++ backend's per-handle result buffers are serialized by its
+    _encode_lock; the pure-Python tokenizer is read-only state."""
+    import concurrent.futures
+
+    texts = [SENTENCES[i % len(SENTENCES)] + f" tail{i}" for i in range(64)]
+
+    serial_cpp = [cpp_tok.encode(t).ids for t in texts]
+    serial_py = [py_tok.tokenize(t) for t in texts]
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as pool:
+        concurrent_cpp = list(pool.map(lambda t: cpp_tok.encode(t).ids,
+                                       texts))
+        concurrent_py = list(pool.map(py_tok.tokenize, texts))
+
+    assert concurrent_cpp == serial_cpp
+    assert concurrent_py == serial_py

@@ -9,7 +9,10 @@ claiming a schema version whose required keys are missing). The capture
 harness (scripts/retry_capture_r04.sh) also runs it over any ``*.jsonl``
 it is about to auto-commit. Legacy artifacts written before the schema
 existed carry no ``schema`` key and are held to the universal rules only
-(bert_pytorch_tpu/telemetry/schema.py).
+(bert_pytorch_tpu/telemetry/schema.py). The ``serve`` record family
+(``serve_window``/``serve_summary``, serve/stats.py) is linted with its
+consistency rules — latency percentiles ordered p50 <= p95 <= p99,
+``batch_occupancy`` in (0, 1].
 
 Usage::
 
