@@ -140,6 +140,24 @@ _P2 = PHASE == 2
 # phase-1 shape. Only meaningful for the driver's default invocation.
 DEGRADED = os.environ.get("BENCH_DEGRADED", "0") == "1"
 REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_retry_module():
+    """The shared backoff policy (bert_pytorch_tpu/utils/retry.py), loaded
+    by FILE PATH: the parent process must stay jax-free (module
+    docstring), and importing through the package ``__init__`` chain would
+    drag jax in. The module is stdlib-only by contract."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bench_retry",
+        os.path.join(REPO_ROOT, "bert_pytorch_tpu", "utils", "retry.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+_retry = _load_retry_module()
 CACHE_DIR = os.environ.get("BENCH_COMPILE_CACHE_DIR",
                            os.path.join(REPO_ROOT, ".jax_cache"))
 # Optional telemetry sink (docs/telemetry.md): the child appends its
@@ -874,7 +892,14 @@ def main():
     exactly that). A healthy backend completes on the first attempt in a
     few minutes.
     """
-    backoff_s = float(os.environ.get("BENCH_BACKOFF_S", "30"))
+    # Backoff between attempts now comes from the shared policy
+    # (utils/retry.py) instead of an ad-hoc flat sleep: base BENCH_BACKOFF_S
+    # doubling per retry, jittered so parallel capture harnesses pointed at
+    # one recovering tunnel don't re-stampede it in lockstep.
+    backoff = _retry.RetryPolicy(
+        attempts=64,  # the wall-clock budget below is the real bound
+        base_delay_s=float(os.environ.get("BENCH_BACKOFF_S", "30")),
+        max_delay_s=120.0)
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
     # Long-sequence compiles through the tunnel can alone exceed the default
     # 600s attempt window (the seq-1024 leg measured >600s), and a killed
@@ -946,7 +971,8 @@ def main():
                 print(last_err, file=sys.stderr)
                 if attempt < attempts:
                     time.sleep(min(
-                        backoff_s, max(0, normal_deadline - time.monotonic())))
+                        backoff.backoff_s(attempt - 1),
+                        max(0, normal_deadline - time.monotonic())))
                 continue
             remaining = normal_deadline - time.monotonic()
             if remaining <= 5:
@@ -977,7 +1003,8 @@ def main():
         print(last_err, file=sys.stderr)
         if attempt < attempts:
             time.sleep(min(
-                backoff_s, max(0, normal_deadline - time.monotonic())))
+                backoff.backoff_s(attempt - 1),
+                max(0, normal_deadline - time.monotonic())))
     # The entry gate must agree with the reserve sizing: for budgets small
     # enough that the reserve is under 60s, a flat 60s gate would shave
     # the normal window AND then never run the fallback it paid for.

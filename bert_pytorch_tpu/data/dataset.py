@@ -12,11 +12,20 @@ per-sequence NSP labels, with dynamic masking run per packed member.
 Deliberate deviations from the reference (SURVEY.md §7 "known quirks"):
   - mask positions are sampled WITHOUT replacement (the reference's
     ``np.random.choice`` default could duplicate positions, dataset.py:286);
-  - per-instance ``np.random.Generator`` instead of the global seed
-    (dataset.py:122-123) so worker processes don't correlate;
+  - masking draws come from a PER-SAMPLE generator seeded on
+    ``(seed, epoch, index)`` instead of one sequential stream
+    (dataset.py:122-123): draws for sample i no longer depend on how many
+    samples were read before it, so a checkpoint-resumed run reproduces
+    the exact masking an uninterrupted run would have applied (the
+    property the chaos harness asserts, docs/fault_tolerance.md), worker
+    processes decorrelate without per-worker reseeding, and epochs still
+    re-draw (dynamic masking stays dynamic);
   - the in-file index is computed from the file start (the reference's
     ``idx -= file_sample_end_idx`` negative indexing, dataset.py:171, is
-    equivalent but obscure).
+    equivalent but obscure);
+  - HDF5 shard opens/reads retry with backoff (``utils/retry.py``) and a
+    configurable skip-shard-vs-abort startup policy — transient storage
+    errors cost a delay, not the run (docs/fault_tolerance.md).
 
 No torch dependency: samples are numpy int32 arrays ready for
 ``jax.device_put`` batching.
@@ -27,10 +36,18 @@ from __future__ import annotations
 import os
 import threading
 import warnings
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 import h5py
 import numpy as np
+
+from bert_pytorch_tpu.utils.retry import RetryPolicy, retry_call
+
+
+class DataReadError(RuntimeError):
+    """A shard read failed past the retry budget (or the startup
+    verification failed under ``shard_error_policy='abort'``)."""
+
 
 NEW_FORMAT_KEYS = ("input_ids", "special_token_positions", "next_sentence_labels")
 LEGACY_FORMAT_KEYS = (
@@ -72,6 +89,10 @@ class ShardedPretrainingDataset:
         original_token_prob: float = 0.1,
         random_token_prob: float = 0.1,
         seed: Optional[int] = None,
+        read_retries: int = 2,
+        retry_base_delay_s: float = 0.2,
+        shard_error_policy: str = "skip",
+        on_fault: Optional[Callable[[dict], None]] = None,
     ):
         if mask_token_index is not None and not isinstance(mask_token_index, (int, np.integer)):
             raise ValueError("mask_token_index must be an integer")
@@ -88,6 +109,21 @@ class ShardedPretrainingDataset:
         if random_token_prob + original_token_prob > 1:
             raise ValueError("random_token_prob + original_token_prob > 1")
 
+        if shard_error_policy not in ("skip", "abort"):
+            raise ValueError(
+                f"shard_error_policy must be 'skip' or 'abort', got "
+                f"{shard_error_policy!r}")
+        # Data-path resilience knobs (docs/fault_tolerance.md): every HDF5
+        # open/read goes through utils/retry.py with these bounds, and the
+        # STARTUP verification applies the skip-vs-abort policy. A
+        # mid-stream read that stays broken past the retries always raises
+        # DataReadError — the index space is fixed at startup, so silently
+        # dropping a shard then would feed wrong samples for its range.
+        self.read_retries = max(0, int(read_retries))
+        self.retry_base_delay_s = float(retry_base_delay_s)
+        self.shard_error_policy = shard_error_policy
+        self.on_fault = on_fault
+
         if isinstance(files, str):
             files = [files]
         files = sorted(files)  # all processes must agree on the order
@@ -102,6 +138,7 @@ class ShardedPretrainingDataset:
         self.random_token_prob = float(random_token_prob)
         self.seed = seed
         self.epoch = 0
+        self._mask_seed_base = self._seed_base(seed)
         self._rng = np.random.default_rng(seed)
 
         self.file_idx: Optional[int] = None
@@ -110,17 +147,20 @@ class ShardedPretrainingDataset:
         self.file_sample_end_idx = -1
         self.data = None
         self._next_file_data = None
+        self._next_file_error: Optional[BaseException] = None
         self._next_file_thread: Optional[threading.Thread] = None
 
     # -- pickling (DataLoader worker processes) ------------------------------
 
     def __getstate__(self):
         """Drop the streaming runtime (loaded shard data, prefetch thread):
-        a worker process re-streams from its own file handles. The RNG is
-        dropped too — workers must be re-seeded (see DataLoader) so they
-        don't all replay identical masking draws."""
+        a worker process re-streams from its own file handles. The fault
+        hook is dropped too (a telemetry emit closure doesn't pickle;
+        workers fall back to warnings). Masking draws need no per-worker
+        reseeding — they derive from (seed, epoch, index)."""
         state = self.__dict__.copy()
-        for k in ("data", "_next_file_data", "_next_file_thread", "_rng"):
+        for k in ("data", "_next_file_data", "_next_file_thread", "_rng",
+                  "_next_file_error", "on_fault"):
             state[k] = None
         state["file_idx"] = None
         state["next_file_idx"] = None
@@ -132,8 +172,20 @@ class ShardedPretrainingDataset:
         self.__dict__.update(state)
         self._rng = np.random.default_rng(self.seed)
 
+    @staticmethod
+    def _seed_base(seed: Optional[int]) -> int:
+        """Base entropy for the per-sample masking derivation. ``None``
+        keeps its pre-PR-5 meaning — fresh OS entropy per dataset, so
+        unseeded runs draw run-unique masks instead of silently colliding
+        with seed=0. The base is pickled to worker processes, so every
+        reader of one dataset instance still agrees per index."""
+        if seed is not None:
+            return int(seed) % (2 ** 63)
+        return int(np.random.SeedSequence().entropy) % (2 ** 63)
+
     def reseed(self, seed: Optional[int]) -> None:
         self.seed = seed
+        self._mask_seed_base = self._seed_base(seed)
         self._rng = np.random.default_rng(seed)
 
     # -- epoch / size --------------------------------------------------------
@@ -168,12 +220,30 @@ class ShardedPretrainingDataset:
                 # Swap in the prefetched file; start loading its successor.
                 del self.data  # drop the old shard before holding two new
                 self._next_file_thread.join()
+                if self._next_file_error is not None:
+                    # The prefetch thread exhausted the retry budget; a
+                    # swallowed error here would surface later as a
+                    # baffling KeyError on stale/None data.
+                    error, self._next_file_error = self._next_file_error, None
+                    self.data = None
+                    raise DataReadError(
+                        f"shard load failed past "
+                        f"{self.read_retries + 1} attempt(s): "
+                        f"{type(error).__name__}: {error}") from error
                 self.data = self._next_file_data
                 self.file_idx = self.next_file_idx
                 self.next_file_idx = (self.next_file_idx + 1) % len(self.files)
                 self._next_file_thread = self._async_load_file(self.next_file_idx)
                 (self.file_sample_start_idx,
                  self.file_sample_end_idx) = self.file_idxs[self.file_idx]
+
+        # Per-sample masking generator, derived from (seed, epoch, index):
+        # sample i's draws are independent of read order and worker
+        # topology, so a resumed run masks exactly like an uninterrupted
+        # one (module docstring; docs/fault_tolerance.md). seed=None uses
+        # a per-instance random base (see _seed_base).
+        self._rng = np.random.default_rng(
+            (self._mask_seed_base, int(self.epoch), int(idx)))
 
         local = idx - self.file_sample_start_idx
         input_ids = np.array(self.data["input_ids"][local])
@@ -261,18 +331,66 @@ class ShardedPretrainingDataset:
         raise ValueError(f"idx ({idx}) exceeds dataset size ({len(self)})")
 
     def _async_load_file(self, file_idx: int) -> threading.Thread:
+        self._next_file_error = None
         th = threading.Thread(
             target=self._load_hdf5, args=(self.files[file_idx],), daemon=True
         )
         th.start()
         return th
 
+    # -- resilient shard IO (docs/fault_tolerance.md) ------------------------
+
+    def _emit_fault(self, record: dict) -> None:
+        """Best-effort fault telemetry (run_pretraining wires the JSONL
+        sink in via ``on_fault``); never let an emit failure mask the IO
+        error being reported."""
+        if self.on_fault is None:
+            return
+        try:
+            self.on_fault(record)
+        except Exception:
+            pass
+
+    def _retry_policy(self) -> RetryPolicy:
+        return RetryPolicy(attempts=self.read_retries + 1,
+                           base_delay_s=self.retry_base_delay_s)
+
+    def _read_shard(self, filepath: str, reader: Callable) -> dict:
+        """Run ``reader(h5py.File)`` with retry/backoff; transient storage
+        errors (and armed fault injections, testing/faults.py) cost a
+        delay, a warning, and a ``fault`` telemetry record — not the run.
+        """
+        def attempt():
+            from bert_pytorch_tpu.testing import faults
+            faults.get_plan().shard_read_check(
+                filepath, emit=self._emit_fault)
+            with h5py.File(filepath, "r") as f:
+                return reader(f)
+
+        def on_retry(n, exc, delay):
+            warnings.warn(
+                f"shard read of {filepath} failed (attempt {n}: "
+                f"{type(exc).__name__}: {exc}); retrying in {delay:.2f}s")
+            self._emit_fault({
+                "kind": "fault", "tag": "telemetry",
+                "fault": "shard_read_retry", "injected": False,
+                "path": filepath, "attempt": n,
+                "error": f"{type(exc).__name__}: {exc}"})
+
+        return retry_call(attempt, policy=self._retry_policy(),
+                          on_retry=on_retry,
+                          description=f"shard read {filepath}")
+
     def _load_hdf5(self, filepath: str) -> None:
-        data = {}
-        with h5py.File(filepath, "r") as f:
-            for key in f.keys():
-                data[key] = np.asarray(f[key][:])
-        self._next_file_data = data
+        try:
+            self._next_file_data = self._read_shard(
+                filepath,
+                lambda f: {key: np.asarray(f[key][:]) for key in f.keys()})
+        except BaseException as e:
+            # Runs on the prefetch thread: park the error for the swap in
+            # __getitem__ to re-raise (a daemon thread's traceback would
+            # otherwise vanish and the consumer would read stale data).
+            self._next_file_error = e
 
     # -- feature derivation (reference dataset.py:224-296) -------------------
 
@@ -349,36 +467,48 @@ class ShardedPretrainingDataset:
 
     # -- shard verification (dataset.py:298-338) -----------------------------
 
-    @staticmethod
-    def _verify_and_count_samples(files):
+    def _verify_and_count_samples(self, files):
+        """Open every shard (with retry) and count samples. Unreadable
+        shards follow ``shard_error_policy``: 'skip' (default) keeps the
+        reference's warn-and-skip stance; 'abort' raises — a run that
+        would rather fail fast than silently train on a subset."""
         current_idx = 0
         verified_files, verified_idxs = [], []
         packed_flags, pack_limits = [], []
         keys = ["input_ids", "next_sentence_labels"]
+
+        def skip_or_abort(fpath, why):
+            if self.shard_error_policy == "abort":
+                raise DataReadError(
+                    f"{why} (shard_error_policy='abort'): {fpath}")
+            warnings.warn(f"{why}: {fpath}. Skipping File")
+            self._emit_fault({
+                "kind": "fault", "tag": "telemetry", "fault": "shard_skipped",
+                "injected": False, "path": fpath, "error": why})
+
+        def read_counts(f):
+            counts = [len(f[key]) for key in keys]
+            is_packed = PACKED_KEY in f
+            pack_limit = 0
+            if is_packed:
+                from bert_pytorch_tpu.data.packing import (
+                    PACKED_MAX_SEQUENCES_ATTR)
+                pack_limit = int(f.attrs[PACKED_MAX_SEQUENCES_ATTR])
+            return counts, is_packed, pack_limit
+
         for fpath in files:
             if not os.path.isfile(fpath):
-                warnings.warn(f"File not found: {fpath}. Skipping File")
+                skip_or_abort(fpath, "File not found")
                 continue
             try:
-                counts = []
-                with h5py.File(fpath, "r") as f:
-                    for key in keys:
-                        counts.append(len(f[key]))
-                    is_packed = PACKED_KEY in f
-                    if is_packed:
-                        from bert_pytorch_tpu.data.packing import (
-                            PACKED_MAX_SEQUENCES_ATTR)
-                        pack_limit = int(f.attrs[PACKED_MAX_SEQUENCES_ATTR])
+                counts, is_packed, pack_limit = self._read_shard(
+                    fpath, read_counts)
             except Exception:
-                warnings.warn(
-                    f"Unable to read keys ({keys}) from {fpath}. Skipping File"
-                )
+                skip_or_abort(fpath, f"Unable to read keys ({keys})")
                 continue
             if len(set(counts)) != 1:
-                warnings.warn(
-                    f"Number of samples per key in {fpath} do not match. "
-                    "Skipping File"
-                )
+                skip_or_abort(
+                    fpath, "Number of samples per key do not match")
                 continue
             verified_files.append(fpath)
             verified_idxs.append((current_idx, current_idx + counts[0]))

@@ -20,9 +20,11 @@ forward-moving access pattern (strictly increasing indices per worker;
 forward skips allowed) match the thread path, and the live sampler.index
 tracks DELIVERED batches exactly (the thread path's runs ahead by the
 prefetch queue; resume goes through the runner's trained_index either
-way). Workers re-seed their dataset replica RNG with
-``seed + worker_id + epoch`` so masking draws neither correlate across
-workers nor repeat across epochs. NB: each strided
+way). Masking draws derive from (seed base, epoch, sample index) inside
+the dataset (data/dataset.py, PR 5) — workers need no per-worker reseed
+to decorrelate, epochs still re-draw, and thread and process paths
+produce byte-identical features (the resume-exactness invariant,
+docs/fault_tolerance.md). NB: each strided
 worker re-reads every shard file, so with the cheap vectorized masking
 the thread path is FASTER at BERT shapes; processes pay off only if
 per-sample featurization grows to dominate file IO.
@@ -69,20 +71,21 @@ def _bounded_put(q, item, stop_event) -> bool:
                 return False
 
 
-def _worker_main(dataset, index_batches, out_queue, stop_event, worker_id,
-                 base_seed):
+def _worker_main(dataset, index_batches, out_queue, stop_event, worker_id):
     """Producer process: featurize+collate its assigned batches in order.
 
     ``index_batches`` is the ordered list of (batch_number, [dataset indices])
     this worker owns. Results go out as (batch_number, batch_dict); errors as
     (batch_number, RuntimeError) so the parent re-raises at the right step.
     """
-    # Seed folds in the EPOCH (pickled into the worker via set_epoch before
-    # iteration): without it, respawned workers would replay byte-identical
-    # masking draws every epoch, silently making dynamic masking static.
-    dataset.reseed((base_seed if base_seed is not None else 0)
-                   + 1_000_003 * (worker_id + 1)
-                   + getattr(dataset, "epoch", 0))
+    # No reseed: masking draws derive from (seed base, epoch, sample
+    # index) inside the dataset (data/dataset.py), and the seed BASE rides
+    # in the pickled dataset state — so workers decorrelate per index with
+    # no per-worker fold, epochs re-draw via the pickled set_epoch state,
+    # and the process path produces BYTE-IDENTICAL features to the thread
+    # path (also for seed=None, whose random base is drawn once in the
+    # parent). That worker-topology independence is what keeps checkpoint
+    # resume exact under any worker count (docs/fault_tolerance.md).
     for bno, idxs in index_batches:
         if stop_event.is_set():
             return
@@ -198,7 +201,7 @@ class DataLoader:
             ctx.Process(
                 target=_worker_main,
                 args=(self.dataset, batches[w::n_workers], out_queues[w],
-                      stop, w, getattr(self.dataset, "seed", None)),
+                      stop, w),
                 daemon=True)
             for w in range(n_workers)
         ]

@@ -34,8 +34,11 @@ class DistributedSampler:
         self.drop_last = drop_last
         self.seed = seed
         self.epoch = 0
-        if hasattr(dataset, "seed"):
-            self.dataset.seed = seed
+        # NB: the sampler no longer overwrites dataset.seed (pre-PR-5 it
+        # assigned the attribute WITHOUT rebuilding the masking RNG, so
+        # the value silently lied). Masking entropy is owned by the
+        # dataset's own seed via the per-(seed, epoch, index) derivation
+        # (data/dataset.py); the sampler's seed is its own.
 
         n = len(dataset)
         if self.drop_last and n % num_replicas != 0:

@@ -14,7 +14,7 @@ machinery.
 from bert_pytorch_tpu.serve.batcher import Batcher, BatcherFull, Request
 from bert_pytorch_tpu.serve.engine import BatchPlan, InferenceEngine, TaskSpec
 from bert_pytorch_tpu.serve.http import make_server
-from bert_pytorch_tpu.serve.service import ServingService
+from bert_pytorch_tpu.serve.service import ServiceDraining, ServingService
 from bert_pytorch_tpu.serve.stats import ServeTelemetry
 from bert_pytorch_tpu.serve.tasks import TASK_NAMES, build_handlers
 
@@ -25,6 +25,7 @@ __all__ = [
     "InferenceEngine",
     "Request",
     "ServeTelemetry",
+    "ServiceDraining",
     "ServingService",
     "TaskSpec",
     "TASK_NAMES",

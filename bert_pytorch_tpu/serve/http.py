@@ -12,8 +12,14 @@ Routes:
 * ``POST /v1/<task>``  — task in {fill_mask, classify, squad, ner}
   (whichever the engine was configured with); JSON body is the task
   payload (serve/tasks.py docstrings); 200 with the result JSON,
-  400 on bad payloads, 404 on unknown tasks, 503 on timeout/overload;
-* ``GET  /healthz``    — liveness + the served task list;
+  400 on bad payloads, 404 on unknown tasks, 503 on
+  timeout/overload/draining;
+* ``GET  /healthz``    — DISPATCH-THREAD liveness + drain state
+  (docs/fault_tolerance.md): 200 only while the thread that actually
+  serves results is alive and accepting; 503 when draining for
+  shutdown or when dispatch died (an HTTP thread answering proves
+  nothing about the serving path) — load balancers stop routing on
+  the first failed probe;
 * ``GET  /statsz``     — the live ServeTelemetry rollup (requests,
   latency percentiles, batch occupancy, compile count).
 """
@@ -24,7 +30,7 @@ import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from bert_pytorch_tpu.serve.batcher import BatcherFull
-from bert_pytorch_tpu.serve.service import ServingService
+from bert_pytorch_tpu.serve.service import ServiceDraining, ServingService
 
 MAX_BODY_BYTES = 1 << 20  # 1 MiB: plenty for text payloads, bounds abuse
 
@@ -55,12 +61,14 @@ def _make_handler():
         def do_GET(self):
             service = self.server.service
             if self.path == "/healthz":
-                self._reply(200, {
-                    "status": "ok",
+                health = service.health()
+                health.update({
                     "tasks": sorted(service.engine.tasks),
                     "buckets": list(service.engine.buckets),
                     "warmed": service.engine.warmed,
                 })
+                self._reply(200 if health["status"] == "ok" else 503,
+                            health)
             elif self.path == "/statsz":
                 self._reply(200, service.telemetry.snapshot())
             else:
@@ -92,7 +100,7 @@ def _make_handler():
                 self._reply(code, {"error": str(exc)})
             except KeyError as exc:
                 self._reply(400, {"error": f"missing payload field {exc}"})
-            except (TimeoutError, BatcherFull) as exc:
+            except (TimeoutError, BatcherFull, ServiceDraining) as exc:
                 self._reply(503, {"error": str(exc)})
             except Exception as exc:
                 self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})

@@ -13,6 +13,13 @@ records one telemetry observation per batch.
 One dispatch thread is deliberate: JAX dispatch is not thread-safe-fast,
 and a single consumer keeps batches maximal. Concurrency lives in the
 HTTP layer (many blocked submitters) and on the device (the batch).
+
+Shutdown is a graceful DRAIN (docs/fault_tolerance.md): :meth:`stop`
+first flips the service to draining — new submissions shed with
+:class:`ServiceDraining` (the HTTP layer's 503, so load balancers stop
+routing on the next health probe) — then lets the dispatch thread flush
+every already-accepted request before stopping it and flushing the
+serve-telemetry summary. In-flight clients get answers, not resets.
 """
 
 from __future__ import annotations
@@ -24,6 +31,12 @@ from typing import Callable, List, Optional
 from bert_pytorch_tpu.serve.batcher import Batcher, Request
 from bert_pytorch_tpu.serve.engine import InferenceEngine
 from bert_pytorch_tpu.serve.stats import ServeTelemetry
+
+
+class ServiceDraining(RuntimeError):
+    """Submission rejected: the service is draining for shutdown (the
+    HTTP layer maps this to 503, like :class:`~bert_pytorch_tpu.serve.
+    batcher.BatcherFull` overload shedding)."""
 
 
 class ServingService:
@@ -40,6 +53,7 @@ class ServingService:
         self._clock = clock
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        self._draining = False
 
     # -- request side ----------------------------------------------------
 
@@ -47,7 +61,11 @@ class ServingService:
                timeout: Optional[float] = 30.0) -> dict:
         """Prepare, enqueue, and wait for one request; returns the task
         handler's JSON-able result. Raises ValueError for bad payloads /
-        unknown tasks, TimeoutError when the deadline passes."""
+        unknown tasks, TimeoutError when the deadline passes,
+        ServiceDraining once shutdown has begun."""
+        if self._draining:
+            raise ServiceDraining(
+                "service is draining for shutdown; not accepting requests")
         spec = self.engine.tasks.get(task)
         if spec is None:
             raise ValueError(
@@ -123,13 +141,53 @@ class ServingService:
             self.engine.warmup()
         self.telemetry.reset_clock()  # rps measures serving, not warmup
         self._stop.clear()
+        self._draining = False
         self._thread = threading.Thread(
             target=self._loop, name="serve-dispatch", daemon=True)
         self._thread.start()
 
+    # -- health / drain ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def dispatch_alive(self) -> bool:
+        """True while the dispatch thread exists and is running — the
+        liveness /healthz must report (an HTTP thread answering proves
+        nothing about the thread that actually serves results)."""
+        return self._thread is not None and self._thread.is_alive()
+
+    def health(self) -> dict:
+        """Liveness snapshot for /healthz (serve/http.py): ``ok`` only
+        when the dispatch thread is alive and not draining — anything
+        else is a 503 so load balancers stop routing here."""
+        if self._draining:
+            status = "draining"
+        elif self.dispatch_alive:
+            status = "ok"
+        else:
+            status = "not_serving"  # never started, or dispatch died
+        return {
+            "status": status,
+            "dispatch_alive": self.dispatch_alive,
+            "draining": self._draining,
+            "queue_depth": self.batcher.depth(),
+        }
+
+    def begin_drain(self) -> None:
+        """Flip to draining: new submissions shed with ServiceDraining /
+        HTTP 503; already-accepted requests keep being served. Called at
+        the start of :meth:`stop` (or earlier, by a signal handler that
+        wants health probes failing before the HTTP listener closes)."""
+        self._draining = True
+
     def stop(self, drain_s: float = 2.0) -> None:
-        """Stop the dispatch loop, draining already-queued requests for up
-        to ``drain_s`` seconds, and flush the serve telemetry summary."""
+        """Graceful drain: stop accepting, flush already-queued requests
+        for up to ``drain_s`` seconds, stop the dispatch thread, flush the
+        serve telemetry summary."""
+        self.begin_drain()
         deadline = self._clock() + drain_s
         while self.batcher.depth() and self._clock() < deadline:
             time.sleep(0.01)

@@ -85,6 +85,14 @@ def add_cli_args(parser, window_default: int = 50,
                              "absolute bound (0 disables) — a per-step "
                              "relative weight change near 1 is a blown "
                              "learning rate, caught before the loss NaNs")
+    parser.add_argument("--watchdog_timeout_s", type=float, default=0.0,
+                        help="hung-step watchdog (docs/fault_tolerance.md): "
+                             "flag (one fault record + warning; never a "
+                             "kill) when no step completes for this many "
+                             "seconds. Arms at the FIRST completed step, so "
+                             "the step-0 compile never counts — size it "
+                             "well above the worst healthy step time. "
+                             "0 (default) disables")
     parser.add_argument("--telemetry_cost_analysis", type=str,
                         default="auto", choices=["auto", "off", "full"],
                         help="static per-executable cost attribution "
@@ -152,6 +160,7 @@ def from_args(args, sink=None, is_primary: bool = True,
         sentinel_policy=args.sentinel_policy,
         sentinel_patience=args.sentinel_patience,
         heartbeat_path=heartbeat,
+        watchdog_timeout_s=getattr(args, "watchdog_timeout_s", 0.0),
         grad_spike_factor=args.grad_spike_factor,
         update_ratio_max=args.update_ratio_max,
         cost_analysis=args.telemetry_cost_analysis)

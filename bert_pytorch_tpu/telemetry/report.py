@@ -104,6 +104,8 @@ def summarize_records(records, name: str = "") -> dict:
     grad_health = []
     memory = []
     serve_windows = []
+    faults = []
+    resumes = []
     serve_summary: Optional[dict] = None
     run_summary: Optional[dict] = None
     n_records = 0
@@ -126,6 +128,10 @@ def summarize_records(records, name: str = "") -> dict:
             serve_windows.append(rec)
         elif kind == "serve_summary":
             serve_summary = rec
+        elif kind == "fault":
+            faults.append(rec)
+        elif kind == "resume":
+            resumes.append(rec)
         elif kind == "run_summary":
             run_summary = rec
 
@@ -227,6 +233,27 @@ def summarize_records(records, name: str = "") -> dict:
         limits = [int(rec.get("bytes_limit", 0)) for rec in supported]
         if any(limits):
             out["bytes_limit"] = max(limits)
+
+    # -- recovery section (docs/fault_tolerance.md) ---------------------
+    # Fault/resume records are operational history, not performance: the
+    # report names what went wrong (split injected vs real — a chaos-run
+    # artifact full of injected faults is healthy) and what every resume
+    # skipped, so "did the run recover cleanly" is answerable offline.
+    if faults:
+        out["faults"] = len(faults)
+        out["faults_injected"] = sum(
+            1 for rec in faults if rec.get("injected"))
+        out["fault_kinds"] = sorted(
+            {str(rec.get("fault", "?")) for rec in faults})
+    if resumes:
+        out["resumes"] = len(resumes)
+        out["resume_last_step"] = int(resumes[-1].get("step", 0))
+        skipped = [entry for rec in resumes
+                   for entry in (rec.get("skipped") or [])]
+        out["resume_skipped_checkpoints"] = len(skipped)
+        if skipped:
+            out["resume_skipped_steps"] = sorted(
+                {int(entry.get("step", -1)) for entry in skipped})
 
     # -- serve record family (serve/stats.py, docs/serving.md) ----------
     # The serve_summary record carries exact run-level percentiles; when a
@@ -371,10 +398,18 @@ def format_summary(summary: dict) -> str:
              "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",
-             "peak_bytes_in_use", "bytes_in_use_last", "bytes_limit")
+             "peak_bytes_in_use", "bytes_in_use_last", "bytes_limit",
+             "faults", "faults_injected", "resumes", "resume_last_step",
+             "resume_skipped_checkpoints")
     for key in order:
         if key in summary:
             lines.append(f"  {key:>22}: {_fmt_value(key, summary[key])}")
+    if summary.get("fault_kinds"):
+        lines.append(f"  {'fault_kinds':>22}: "
+                     + ", ".join(summary["fault_kinds"]))
+    if summary.get("resume_skipped_steps"):
+        lines.append(f"  {'resume_skipped_steps':>22}: "
+                     + ", ".join(map(str, summary["resume_skipped_steps"])))
     if summary.get("compile_cache"):
         lines.append(f"  {'compile_cache':>22}: "
                      + ", ".join(f"{k}={v}" for k, v

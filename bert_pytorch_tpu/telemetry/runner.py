@@ -48,7 +48,8 @@ from bert_pytorch_tpu.telemetry.memory import MemorySampler
 from bert_pytorch_tpu.telemetry.model_stats import (DivergenceMonitor,
                                                     health_record)
 from bert_pytorch_tpu.telemetry.profiler import ProfilerWindow
-from bert_pytorch_tpu.telemetry.sentinels import FailureSentinel, Heartbeat
+from bert_pytorch_tpu.telemetry.sentinels import (FailureSentinel, Heartbeat,
+                                                  HeartbeatWatchdog)
 from bert_pytorch_tpu.telemetry.step_timer import StepTimer
 from bert_pytorch_tpu.utils import logging as logging_util
 
@@ -72,6 +73,7 @@ class TrainTelemetry:
         sentinel_patience: int = 3,
         heartbeat_path: Optional[str] = None,
         heartbeat_every: int = 1,
+        watchdog_timeout_s: float = 0.0,
         grad_spike_factor: float = 10.0,
         update_ratio_max: float = 1.0,
         grad_warmup: int = 10,
@@ -114,6 +116,14 @@ class TrainTelemetry:
         self.memory = MemorySampler(emit=self.emit, enabled=is_primary)
         self.heartbeat = Heartbeat(heartbeat_path, is_primary=is_primary)
         self.heartbeat_every = max(1, int(heartbeat_every))
+        # Hung-step watchdog (docs/fault_tolerance.md): fed a liveness
+        # note per completed step; flags (fault record + warning, never a
+        # kill) when none lands within the timeout. Rank-0 only — one
+        # flag per job, and the collective hangs it exists to catch stall
+        # every rank anyway. Started lazily at the first step so runner
+        # setup (data/featurization, sometimes minutes) doesn't count.
+        self.watchdog = (HeartbeatWatchdog(watchdog_timeout_s, emit=self.emit)
+                        if watchdog_timeout_s and is_primary else None)
         self._loader_stats: Optional[Callable[[], Optional[dict]]] = None
         self._last_sync_target = None
         self.last_step_synced = False
@@ -211,6 +221,8 @@ class TrainTelemetry:
             self.sentinel.observe(step, finite, loss)
             if self.timer._step_index % self.heartbeat_every == 0:
                 self.heartbeat.beat(step, last_loss=loss)
+        if self.watchdog is not None:
+            self.watchdog.start().note(step)
         self.profiler.maybe_stop(
             step if profile_step is None else profile_step,
             sync_target=target)
@@ -229,6 +241,8 @@ class TrainTelemetry:
     def finish(self, step: int, summary: Optional[dict] = None) -> None:
         """End of run: stop a still-open trace, flush the partial window,
         final heartbeat, optional run summary record."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
         self.profiler.stop(sync_target=self._last_sync_target)
         window = self.timer.flush(step)
         if window is not None:
@@ -242,5 +256,7 @@ class TrainTelemetry:
         self.heartbeat.beat(step)
 
     def close(self) -> None:
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self.sink is not None:
             self.sink.close()

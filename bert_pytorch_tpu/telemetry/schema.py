@@ -63,6 +63,16 @@ KIND_REQUIRED_KEYS = {
     "compile_cost": ("fn", "shapes_digest", "analysis"),
     # end-of-run rollup
     "run_summary": ("steps",),
+    # -- fault-tolerance record family (docs/fault_tolerance.md) -------
+    # one fault observation: a preemption signal acted on, a shard-read
+    # retry, a hung-step watchdog flag, or an armed injection
+    # (testing/faults.py — those carry injected: true so chaos-run
+    # artifacts are distinguishable from real incidents)
+    "fault": ("fault", "injected"),
+    # one resume decision (utils/checkpoint.py walk-back): the step
+    # training resumed from, plus every newer retained checkpoint that
+    # was skipped as corrupt/unreadable to get there
+    "resume": ("step", "skipped"),
     # -- serve record family (serve/stats.py, docs/serving.md) ---------
     # one window of online-inference traffic: request count, e2e and
     # on-device latency percentiles (ms), batch occupancy (real tokens /
@@ -131,6 +141,10 @@ def validate_record(rec) -> list:
                     _check_token_fields(rec, errors)
                 if kind in ("serve_window", "serve_summary"):
                     _check_serve_fields(rec, errors)
+                if kind == "fault":
+                    _check_fault_fields(rec, errors)
+                if kind == "resume":
+                    _check_resume_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -175,6 +189,36 @@ def _check_serve_fields(rec, errors) -> None:
                 or not 0 < occ <= 1:
             errors.append(
                 f"batch_occupancy must be in (0, 1], got {occ!r}")
+
+
+def _check_fault_fields(rec, errors) -> None:
+    """Fault-record consistency (schema v1 addition; docs/
+    fault_tolerance.md): the fault name is a non-empty string and the
+    injection marker is a real boolean — consumers filter chaos-run
+    artifacts on ``injected`` and must be able to trust it."""
+    fault = rec.get("fault")
+    if not isinstance(fault, str) or not fault:
+        errors.append(f"fault must be a non-empty string, got {fault!r}")
+    if not isinstance(rec.get("injected"), bool):
+        errors.append(
+            f"fault record 'injected' must be a boolean, got "
+            f"{rec.get('injected')!r}")
+
+
+def _check_resume_fields(rec, errors) -> None:
+    """Resume-record consistency: ``skipped`` is a list of objects each
+    naming what was passed over and why (utils/checkpoint.py walk-back)."""
+    skipped = rec.get("skipped")
+    if not isinstance(skipped, list):
+        errors.append(f"resume 'skipped' must be a list, got "
+                      f"{type(skipped).__name__}")
+        return
+    for i, entry in enumerate(skipped):
+        if not isinstance(entry, dict) or not {"step", "path", "reason"} \
+                <= set(entry):
+            errors.append(
+                f"resume skipped[{i}] must be an object with "
+                f"step/path/reason, got {entry!r}")
 
 
 def _check_finite(key, value, errors) -> None:

@@ -30,7 +30,9 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
+import warnings
 from typing import Callable, Optional
 
 
@@ -126,3 +128,92 @@ class Heartbeat:
                 return json.load(f)
         except (OSError, ValueError):
             return None
+
+
+class HeartbeatWatchdog:
+    """Hung-step detector (docs/fault_tolerance.md).
+
+    A wedged collective, a deadlocked input queue, or a hung storage
+    mount stalls training WITHOUT crashing it — the loop just never
+    reaches the next step boundary, and nothing in-process says so (the
+    round-1 capture harness could only infer this from checkpoint mtimes
+    going stale). The watchdog is a daemon thread fed a liveness note at
+    every completed step (``TrainTelemetry.step_done``); when the age of
+    the newest note exceeds ``max_age_s`` it emits one schema-v1
+    ``fault`` record (``fault: "hung_step"``) and a warning, then
+    re-arms only after progress resumes (one flag per stall, never a
+    storm).
+
+    Arming starts at the FIRST note, so the step-0 compile (minutes at
+    BERT-large) never counts as a hang; size ``max_age_s`` generously —
+    it bounds detection, and a false positive is only a log line (the
+    watchdog flags, it never kills: the process may be seconds from
+    recovering, and killing is the scheduler's call).
+    """
+
+    def __init__(self, max_age_s: float, emit: Optional[Callable] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 poll_s: Optional[float] = None):
+        if max_age_s <= 0:
+            raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
+        self.max_age_s = float(max_age_s)
+        self._emit = emit
+        self._clock = clock
+        self._poll_s = poll_s if poll_s is not None else max(
+            0.05, self.max_age_s / 4.0)
+        self._lock = threading.Lock()
+        self._last: Optional[tuple] = None  # (clock(), step)
+        self._flagged = False
+        self.stalls_flagged = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def note(self, step: int) -> None:
+        """One completed step: refresh the liveness timestamp and re-arm."""
+        with self._lock:
+            self._last = (self._clock(), int(step))
+            self._flagged = False
+
+    def check(self) -> Optional[dict]:
+        """The ``fault`` record if the run is stalled and unflagged, else
+        None. Pure of the thread machinery so tests drive it with a fake
+        clock instead of sleeping."""
+        with self._lock:
+            if self._last is None or self._flagged:
+                return None
+            noted_at, step = self._last
+            age = self._clock() - noted_at
+            if age < self.max_age_s:
+                return None
+            self._flagged = True
+            self.stalls_flagged += 1
+        return {
+            "kind": "fault", "tag": "telemetry", "fault": "hung_step",
+            "injected": False, "step": step,
+            "age_s": round(age, 3), "max_age_s": self.max_age_s,
+        }
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            record = self.check()
+            if record is not None:
+                warnings.warn(
+                    f"watchdog: no step completed for {record['age_s']:.1f}s "
+                    f"(> {self.max_age_s:.1f}s) after step "
+                    f"{record['step']}; the run may be hung")
+                if self._emit is not None:
+                    self._emit(record)
+
+    def start(self) -> "HeartbeatWatchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="telemetry-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,11 @@
+"""Deterministic fault injection for the fault-tolerance test harness
+(docs/fault_tolerance.md; armed via ``--fault_spec`` / ``BERT_FAULTS``,
+driven end to end by ``tools/chaos_run.py``)."""
+
+from bert_pytorch_tpu.testing.faults import (  # noqa: F401
+    FAULTS_ENV,
+    FaultPlan,
+    arm,
+    corrupt_checkpoint,
+    get_plan,
+)

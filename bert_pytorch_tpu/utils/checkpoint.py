@@ -15,7 +15,11 @@ any mesh/sharding layout. Multi-host sharded state (fsdp/tp across
 processes) is gathered with ``multihost_utils.process_allgather`` — a
 collective all processes join — before rank 0 writes; restore reads the
 full file on every process and re-shards via the caller's device_put.
-Writes are atomic (tmp + rename).
+Writes are atomic (tmp + rename), and every checkpoint gets a sidecar
+integrity manifest (step, sha256, size; ``utils/integrity.py``) written
+in the same tmp+rename discipline — the load/resume paths verify it and
+walk back across ALL retained checkpoints past corrupt files
+(docs/fault_tolerance.md).
 """
 
 from __future__ import annotations
@@ -25,15 +29,21 @@ import re
 import tempfile
 import threading
 import warnings
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 from flax import serialization
 
+from bert_pytorch_tpu.utils import integrity
 from bert_pytorch_tpu.utils.dist import is_main_process
 
 CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """Raised by :func:`load_checkpoint` when the sidecar manifest exists
+    and the file fails verification (size or sha256 mismatch)."""
 
 # At most one background write in flight (async_write=True): a second save
 # joins the first, so checkpoints land in order and memory holds at most one
@@ -92,10 +102,23 @@ def _ckpt_steps(output_dir: str) -> list[int]:
     )
 
 
-def find_resume_step(output_dir: str) -> Optional[int]:
-    """Max step among ckpt_*.msgpack files (reference run_pretraining.py:246-253)."""
+def find_resume_step(output_dir: str, verify: bool = False) -> Optional[int]:
+    """Max step among ckpt_*.msgpack files (reference run_pretraining.py:246-253).
+
+    ``verify=True`` walks newest-first past checkpoints whose integrity
+    manifest fails verification (docs/fault_tolerance.md) — the step
+    returned is the newest one a resume could actually load. Manifestless
+    (legacy) checkpoints are accepted: unverifiable is not corrupt.
+    """
     steps = _ckpt_steps(output_dir)
-    return steps[-1] if steps else None
+    if not verify:
+        return steps[-1] if steps else None
+    for step in reversed(steps):
+        status, _ = integrity.verify_checkpoint(
+            checkpoint_path(output_dir, step))
+        if status != integrity.CORRUPT:
+            return step
+    return None
 
 
 def latest_checkpoint(output_dir: str) -> Optional[str]:
@@ -122,9 +145,17 @@ def load_params_only(path: str, target: Any, key: str = "model") -> Any:
     decodes nothing), and only the ``key`` span is handed to flax's
     ``msgpack_restore``. Falls back to a full restore if the file is not
     the expected top-level map (e.g. a hand-rolled artifact).
+
+    The integrity manifest is verified first when present (a serving
+    process loading a torn checkpoint should fail loudly at startup, not
+    serve a half-restored head) — :class:`CheckpointCorruptError`. The
+    bytes just read are what gets verified: one pass of IO.
     """
     with open(path, "rb") as f:
         blob = f.read()
+    status, detail = integrity.verify_blob(path, blob)
+    if status == integrity.CORRUPT:
+        raise CheckpointCorruptError(f"{path}: {detail}")
     state = _extract_toplevel_subtree(blob, key)
     if state is None:
         full = serialization.msgpack_restore(blob)
@@ -160,26 +191,39 @@ def _extract_toplevel_subtree(blob: bytes, key: str) -> Optional[Any]:
     return None
 
 
-def load_latest_checkpoint(output_dir: str):
-    """(step, state) of the newest LOADABLE checkpoint, or None.
+def load_latest_checkpoint(output_dir: str,
+                           on_skip: Optional[Callable[[dict], None]] = None):
+    """(step, state) of the newest VERIFIED-loadable checkpoint, or None.
 
     Writes are atomic (tmp + rename in :func:`_write_and_prune`), but a
     checkpoint can still arrive corrupt — a torn filesystem, a partial copy
     from another machine, bit rot. The reference would crash on it
     (torch.load of the max-step file, run_pretraining.py:246-257); here a
     bad newest file costs the training between it and the previous retained
-    checkpoint, not the run: we walk steps newest-first and warn-and-skip
-    unreadable files (the dataset layer's warn-and-skip stance, SURVEY §4).
+    checkpoint, not the run: we walk steps newest-first across ALL retained
+    checkpoints, verifying each against its integrity manifest
+    (``utils/integrity.py``) before decoding, and warn-and-skip failures
+    (the dataset layer's warn-and-skip stance, SURVEY §4). Each skip also
+    calls ``on_skip({"step", "path", "reason"})`` so the runner can emit a
+    telemetry ``resume`` record naming exactly what was passed over.
     """
+    def skip(step: int, path: str, reason: str) -> None:
+        warnings.warn(
+            f"Skipping unreadable checkpoint {path} ({reason}); "
+            "falling back to the previous retained one")
+        if on_skip is not None:
+            on_skip({"step": step, "path": path, "reason": reason})
+
     for step in reversed(_ckpt_steps(output_dir)):
         path = checkpoint_path(output_dir, step)
         try:
+            # load_checkpoint reads once and verifies those bytes; a
+            # manifestless legacy file gets the decode as its only net.
             return step, load_checkpoint(path)
-        except Exception as e:  # corrupt/truncated/unreadable
-            warnings.warn(
-                f"Skipping unreadable checkpoint {path} ({type(e).__name__}: "
-                f"{e}); falling back to the previous one"
-            )
+        except CheckpointCorruptError as e:
+            skip(step, path, f"integrity: {e}")
+        except Exception as e:  # corrupt/truncated/unreadable pre-manifest
+            skip(step, path, f"{type(e).__name__}: {e}")
     return None
 
 
@@ -252,13 +296,24 @@ def _write_and_prune(state: Any, output_dir: str, step: int, keep: int) -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    # Integrity sidecar, hashed from the in-memory blob (no re-read) and
+    # itself tmp+renamed. Blob first, manifest second: a crash in the gap
+    # leaves a manifestless blob — reported as unverifiable, like any
+    # legacy checkpoint, never as corruption (the reverse order would
+    # leave a manifest whose blob is missing: indistinguishable from a
+    # deleted checkpoint).
+    integrity.write_manifest(
+        path, integrity.build_manifest(
+            step, blob, keys=state.keys() if isinstance(state, dict) else ()))
 
     steps = _ckpt_steps(output_dir)
     for old in steps[:-keep] if keep > 0 else []:
-        try:
-            os.unlink(checkpoint_path(output_dir, old))
-        except OSError:
-            pass
+        old_path = checkpoint_path(output_dir, old)
+        for stale in (old_path, integrity.manifest_path(old_path)):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
 
 
 def save_checkpoint(
@@ -318,10 +373,24 @@ def save_checkpoint(
     return path
 
 
-def load_checkpoint(path: str) -> dict:
-    """Raw state dict (nested dicts of numpy arrays / scalars)."""
+def load_checkpoint(path: str, verify: bool = True) -> dict:
+    """Raw state dict (nested dicts of numpy arrays / scalars).
+
+    ``verify=True`` (default) checks the integrity manifest first and
+    raises :class:`CheckpointCorruptError` on a mismatch — decoding a
+    damaged msgpack can otherwise "succeed" into a silently-truncated
+    pytree. A checkpoint with no manifest (legacy, or a torn write that
+    lost the sidecar) loads with only the decode as its net.
+    """
     with open(path, "rb") as f:
-        return serialization.msgpack_restore(f.read())
+        blob = f.read()
+    if verify:
+        # Verify the bytes just read — one pass of IO, not a separate
+        # hashing read of a multi-GB state (integrity.verify_blob).
+        status, detail = integrity.verify_blob(path, blob)
+        if status == integrity.CORRUPT:
+            raise CheckpointCorruptError(f"{path}: {detail}")
+    return serialization.msgpack_restore(blob)
 
 
 def restore_tree(target: Any, state: Any) -> Any:

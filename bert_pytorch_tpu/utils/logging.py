@@ -30,6 +30,7 @@ import json
 import math
 import os
 import sys
+import threading
 import time
 import warnings
 from typing import Iterable, Optional
@@ -185,12 +186,19 @@ class JSONLHandler(Handler):
     sentinel record's ``finite`` flag carries the signal instead).
     ``tools/check_telemetry_schema.py`` lints committed artifacts against
     the schema.
+
+    Thread-safe: background threads also emit here (the hung-step
+    watchdog, the data path's shard-retry fault records — PR 5,
+    docs/fault_tolerance.md), and interleaved ``TextIOWrapper.write``
+    calls could otherwise tear two records into one invalid line. One
+    lock serializes each record's write+flush (and close).
     """
 
     def __init__(self, path: str, overwrite: bool = False, verbose: bool = True,
                  is_primary: Optional[bool] = None):
         super().__init__(verbose, is_primary)
         self.path = path
+        self._lock = threading.Lock()
         if self.is_primary:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._f = open(path, "w" if overwrite else "a")
@@ -202,19 +210,28 @@ class JSONLHandler(Handler):
 
     def write_record(self, record: dict) -> None:
         if self._f is None:
+            # Cheap unlocked fast-path for non-primary ranks: the only
+            # None transition is close(), and the locked re-check below
+            # covers that race — but serializing every hot-path record
+            # just to drop it would be per-step waste on every rank.
             return
         from bert_pytorch_tpu.telemetry.schema import SCHEMA_VERSION
 
         rec = {"schema": SCHEMA_VERSION, "ts": round(time.time(), 3)}
         rec.update(record)
-        self._f.write(json.dumps(rec, default=str, allow_nan=False,
-                                 cls=_FiniteEncoder) + "\n")
-        self._f.flush()
+        line = json.dumps(rec, default=str, allow_nan=False,
+                          cls=_FiniteEncoder) + "\n"
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line)
+            self._f.flush()
 
     def close(self) -> None:
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
 
 
 class _FiniteEncoder(json.JSONEncoder):

@@ -1,0 +1,131 @@
+"""Graceful-preemption handling shared by all five runners
+(docs/fault_tolerance.md).
+
+TPU-VM maintenance events, SLURM preemption, and Kubernetes pod eviction
+all deliver SIGTERM with a short grace period; an interactive operator
+delivers SIGINT. Dying mid-step loses the training since the last
+checkpoint — PaLM (arXiv:2204.02311) reports preemption-driven restarts
+as routine at scale, so the stop path must be a tested code path, not an
+accident. :class:`GracefulStop` is the one shared implementation:
+
+* the handler only sets a flag — nothing async-unsafe runs in signal
+  context, and the training loop acts on the flag at a STEP BOUNDARY
+  (multi-host jobs additionally agree collectively on the stop step —
+  run_pretraining.py's allgather);
+* the runner then writes an emergency checkpoint (joining any in-flight
+  async save), flushes telemetry, and exits with :data:`EXIT_PREEMPTED`
+  so the scheduler/driver can distinguish "checkpointed and ready to
+  resume" (resubmit) from success (0) and from crashes (anything else);
+* handlers stay installed through the checkpoint write — the grace
+  period may re-deliver the signal, and the default disposition would
+  kill the write mid-file — and are restored on exit even on exceptions
+  (in-process callers, like the test suite, must not inherit a handler
+  over a dead flag).
+"""
+
+from __future__ import annotations
+
+import signal
+from typing import Optional
+
+# 75 = EX_TEMPFAIL ("temporary failure; user is invited to retry") — the
+# closest sysexits.h code to "preempted cleanly, resubmit me". Distinct
+# from 0 (done), from 1/2 (crash/config error), and from 128+N (killed by
+# an unhandled signal N — the path this module exists to avoid).
+EXIT_PREEMPTED = 75
+
+_DEFAULT_SIGNALS = ("SIGTERM", "SIGINT", "SIGUSR1")
+
+
+class GracefulStop:
+    """Install flag-setting handlers for the preemption signals; use as a
+    context manager (restores previous handlers on exit)::
+
+        with GracefulStop() as stop:
+            for batch in loader:
+                ...
+                if stop.requested:
+                    break   # runner writes the emergency checkpoint
+        sys.exit(EXIT_PREEMPTED if stop.requested else 0)
+
+    ``signals`` are names resolved against the platform (``SIGUSR1`` is
+    skipped where absent). Installation failures (non-main thread — the
+    in-process test suite; restricted platforms) are silently tolerated:
+    the loop then simply never sees ``requested``, which is the
+    pre-existing behavior, not a new failure mode.
+    """
+
+    def __init__(self, signals=_DEFAULT_SIGNALS, on_signal=None):
+        self._names = tuple(signals)
+        self._on_signal = on_signal
+        self._old: dict = {}
+        self.requested = False
+        self.signum: Optional[int] = None
+
+    @property
+    def signal_name(self) -> Optional[str]:
+        if self.signum is None:
+            return None
+        try:
+            return signal.Signals(self.signum).name
+        except ValueError:
+            return str(self.signum)
+
+    def _handler(self, signum, frame):
+        # First delivery wins; repeats during the grace period are absorbed
+        # (the default disposition coming back would kill the checkpoint
+        # write this machinery exists to protect) — EXCEPT a second
+        # SIGINT: the interactive convention is first Ctrl-C = graceful,
+        # second = abort now. A wedged loop (the watchdog's stall modes)
+        # stays interruptible without SIGKILL; automation signals
+        # (SIGTERM/SIGUSR1, re-delivered by schedulers during the grace
+        # period) never escalate.
+        if self.requested:
+            if signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            return
+        self.requested = True
+        self.signum = signum
+        if self._on_signal is not None:
+            try:
+                self._on_signal(signum)
+            except Exception:
+                pass  # never raise from signal context
+
+    def install(self) -> "GracefulStop":
+        for name in self._names:
+            sig = getattr(signal, name, None)
+            if sig is None:
+                continue
+            try:
+                self._old[sig] = signal.signal(sig, self._handler)
+            except (ValueError, OSError):
+                pass  # non-main thread or platform restriction
+        return self
+
+    def restore(self) -> None:
+        for sig, handler in self._old.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+        self._old = {}
+
+    def __enter__(self) -> "GracefulStop":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.restore()
+
+
+def preemption_record(step: int, stop: GracefulStop) -> dict:
+    """The telemetry ``fault`` record a runner emits when it acts on a
+    graceful-stop request (schema v1; docs/telemetry.md)."""
+    return {
+        "kind": "fault",
+        "tag": "telemetry",
+        "fault": "preemption",
+        "step": int(step),
+        "signal": stop.signal_name,
+        "injected": False,
+    }

@@ -14,6 +14,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import jax
@@ -33,6 +35,7 @@ from bert_pytorch_tpu.models.losses import token_classification_loss
 from bert_pytorch_tpu.ops.grad_utils import clip_by_global_norm
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils import preemption
 from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 
 
@@ -54,6 +57,10 @@ def parse_arguments(argv=None):
     parser.add_argument("--batch_size", type=int, default=32)
     parser.add_argument("--max_seq_len", type=int, default=128)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--output_dir", type=str, default=None,
+                        help="where the finetuned model checkpoint lands "
+                             "(end of run, and on graceful preemption); "
+                             "omitted = no checkpoint (pre-PR-5 behavior)")
     parser.add_argument("--compile_cache_dir", type=str, default="",
                         help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
@@ -209,36 +216,63 @@ def main(args):
     key = jax.random.PRNGKey(args.seed)
     results = {}
     global_step = 0
-    for epoch in range(args.epochs):
-        t0 = time.perf_counter()
-        losses = []
-        for batch in tele.timed(
-                batches(datasets["train"], args.batch_size, True, rng)):
-            key, sub = jax.random.split(key)
-            tele.profiler.maybe_start(global_step + 1)
-            with tele.profiler.annotation(global_step + 1):
-                params, opt_state, metrics = train_step(
-                    params, opt_state, batch, sub, epoch)
-            tele.dispatch_done()
-            global_step += 1
-            tele.step_done(global_step, metrics)
-            losses.append(float(metrics["loss"]))
-        msg = (f"epoch {epoch}: train_loss={np.mean(losses):.4f} "
-               f"({time.perf_counter() - t0:.1f}s)")
-        if "val" in datasets:
-            val_loss, val_f1 = evaluate("val")
-            results["val_f1"] = val_f1
-            msg += f" val_loss={val_loss:.4f} val_f1={val_f1:.4f}"
-        logger.info(msg)
+    # Graceful preemption (docs/fault_tolerance.md): stop at the next
+    # step boundary, checkpoint (with --output_dir), exit EXIT_PREEMPTED.
+    # Handlers stay installed THROUGH the checkpoint write below (a
+    # grace-period re-delivery must not kill it); restored in the finally.
+    stop = preemption.GracefulStop().install()
+    try:
+        for epoch in range(args.epochs):
+            t0 = time.perf_counter()
+            losses = []
+            for batch in tele.timed(
+                    batches(datasets["train"], args.batch_size, True, rng)):
+                key, sub = jax.random.split(key)
+                tele.profiler.maybe_start(global_step + 1)
+                with tele.profiler.annotation(global_step + 1):
+                    params, opt_state, metrics = train_step(
+                        params, opt_state, batch, sub, epoch)
+                tele.dispatch_done()
+                global_step += 1
+                tele.step_done(global_step, metrics)
+                losses.append(float(metrics["loss"]))
+                if stop.requested:
+                    break
+            if stop.requested:
+                logger.info(
+                    f"termination signal ({stop.signal_name}) received; "
+                    "checkpointing and exiting cleanly "
+                    f"(exit code {preemption.EXIT_PREEMPTED})")
+                tele.emit(preemption.preemption_record(global_step, stop))
+                break
+            msg = (f"epoch {epoch}: train_loss={np.mean(losses):.4f} "
+                   f"({time.perf_counter() - t0:.1f}s)")
+            if "val" in datasets:
+                val_loss, val_f1 = evaluate("val")
+                results["val_f1"] = val_f1
+                msg += f" val_loss={val_loss:.4f} val_f1={val_f1:.4f}"
+            logger.info(msg)
 
-    if "test" in datasets:
-        test_loss, test_f1 = evaluate("test")
-        results["test_f1"] = test_f1
-        logger.info(f"test_loss={test_loss:.4f} test_f1={test_f1:.4f}")
-    tele.finish(global_step)
+        results["terminated_by_signal"] = stop.requested
+        if "test" in datasets and not stop.requested:
+            test_loss, test_f1 = evaluate("test")
+            results["test_f1"] = test_f1
+            logger.info(f"test_loss={test_loss:.4f} test_f1={test_f1:.4f}")
+        tele.finish(global_step)
+        if args.output_dir:
+            os.makedirs(args.output_dir, exist_ok=True)
+            ckpt.save_checkpoint(
+                args.output_dir, global_step, {"model": params})
+        # PR-5 audit: no exit until any in-flight async checkpoint write
+        # has landed (synchronous today; the guard survives async saves).
+        ckpt.wait_for_pending_save()
+    finally:
+        stop.restore()
     logger.close()
     return results
 
 
 if __name__ == "__main__":
-    main(parse_arguments())
+    outcome = main(parse_arguments())
+    if outcome.get("terminated_by_signal"):
+        sys.exit(preemption.EXIT_PREEMPTED)

@@ -20,7 +20,7 @@ from __future__ import annotations
 import argparse
 import math
 import os
-import signal
+import sys
 import time
 from pathlib import Path
 
@@ -34,8 +34,10 @@ from bert_pytorch_tpu.data import DataLoader, DistributedSampler, ShardedPretrai
 from bert_pytorch_tpu.models import BertForPreTraining
 from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
 from bert_pytorch_tpu.parallel import launcher
+from bert_pytorch_tpu.testing import faults
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils import preemption
 from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 from bert_pytorch_tpu.utils.dist import (
     agree_on_resume_step,
@@ -120,6 +122,14 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "a short run's wallclock. A checkpoint requested "
                              "by a termination signal is still written")
     parser.add_argument("--log_steps", type=int, default=1)
+    parser.add_argument("--disable_tensorboard", action="store_true",
+                        help="skip the TensorBoard sink. Its writer "
+                             "backend import (torch) costs ~25s of "
+                             "startup on a throttled CPU box — child "
+                             "processes that never read TB events (the "
+                             "chaos harness, CI smoke runs) skip it; the "
+                             "JSONL/CSV/text sinks carry every record "
+                             "anyway")
     parser.add_argument("--term_check_steps", type=int, default=10,
                         help="how often (in optimizer steps) to act on a "
                              "received SIGTERM/SIGUSR1: checkpoint and exit "
@@ -128,9 +138,33 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "runs at a fixed step cadence so multi-host "
                              "jobs agree collectively on when to stop. "
                              "0 disables graceful termination")
+    # data-path resilience (docs/fault_tolerance.md): HDF5 shard reads
+    # retry with backoff (utils/retry.py); startup verification either
+    # warn-skips unreadable shards (the reference's stance) or fails fast
+    parser.add_argument("--data_read_retries", type=int, default=2,
+                        help="retries per HDF5 shard open/read (exponential "
+                             "backoff + jitter) before the read is a hard "
+                             "failure; transient storage errors cost a "
+                             "delay, not the run")
+    parser.add_argument("--data_retry_base_s", type=float, default=0.2,
+                        help="pre-jitter base backoff for shard-read "
+                             "retries (doubles per retry, capped at 30s)")
+    parser.add_argument("--shard_error_policy", type=str, default="skip",
+                        choices=["skip", "abort"],
+                        help="a shard unreadable past the retries at "
+                             "STARTUP: 'skip' warns and trains on the "
+                             "rest (reference behavior); 'abort' fails "
+                             "fast. Mid-stream failures always abort — "
+                             "the index space is fixed at startup")
+    parser.add_argument("--fault_spec", type=str, default="",
+                        help="TEST-ONLY deterministic fault injection "
+                             "(testing/faults.py; docs/fault_tolerance.md), "
+                             "e.g. 'die@7' or 'shard_errorx2,nonfinite@5'; "
+                             "also armable via BERT_FAULTS. Empty disables")
     # telemetry (docs/telemetry.md): step-time decomposition + MFU windows,
-    # profiler trace windows, compile events, failure sentinels, heartbeat —
-    # canonical flag set shared by every runner (telemetry/cli.py)
+    # profiler trace windows, compile events, failure sentinels, heartbeat,
+    # hung-step watchdog — canonical flag set shared by every runner
+    # (telemetry/cli.py)
     telemetry.add_cli_args(parser, window_default=20, sync_every_default=4)
     # numerics / memory
     parser.add_argument("--dtype", type=str, default="bfloat16",
@@ -284,20 +318,22 @@ def setup_training(args):
         args.output_dir, "profile")
     args.telemetry_sink = logger.JSONLHandler(
         args.telemetry_jsonl, overwrite=False, is_primary=is_main_process())
-    logger.init(handlers=[
+    handlers = [
         logger.StreamHandler(verbose=is_main_process(),
                              is_primary=is_main_process()),
         logger.FileHandler(
             os.path.join(args.output_dir, args.log_prefix + ".txt"),
             overwrite=False, is_primary=is_main_process()),
-        logger.TensorBoardHandler(
-            os.path.join(args.output_dir, "tensorboard"),
-            is_primary=is_main_process()),
         logger.CSVHandler(
             os.path.join(args.output_dir, args.log_prefix + "_metrics.csv"),
             overwrite=False, is_primary=is_main_process()),
         args.telemetry_sink,
-    ])
+    ]
+    if not args.disable_tensorboard:
+        handlers.insert(2, logger.TensorBoardHandler(
+            os.path.join(args.output_dir, "tensorboard"),
+            is_primary=is_main_process()))
+    logger.init(handlers=handlers)
     logger.info(
         f"mesh initialized: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
         f"({jax.process_count()} processes, {len(jax.devices())} devices)"
@@ -373,9 +409,13 @@ def prepare_model(args, mesh):
         attention_backend=args.attention_backend,
     )
 
-    # Newest LOADABLE checkpoint: a corrupt newest file is warn-skipped and
-    # the previous retained one resumes instead of crashing the job.
-    found = ckpt.load_latest_checkpoint(args.model_output_dir)
+    # Newest VERIFIED checkpoint: the walk-back verifies each retained
+    # checkpoint's integrity manifest and skips corrupt/unreadable files
+    # (utils/checkpoint.py; docs/fault_tolerance.md) instead of crashing
+    # the job — collecting what it skipped for the resume record below.
+    skipped: list = []
+    found = ckpt.load_latest_checkpoint(
+        args.model_output_dir, on_skip=skipped.append)
     # Multi-host: all processes must resume from the SAME step even when
     # they observe the shared checkpoint dir differently (utils/dist.py).
     agreed = agree_on_resume_step(None if found is None else found[0])
@@ -389,6 +429,19 @@ def prepare_model(args, mesh):
     checkpoint = None
     global_step = 0
     args.resume_step = 0
+    if found is None and skipped:
+        # The worst recovery case — retained checkpoints exist but NONE
+        # verified/loaded — must be a loud, auditable artifact, not just
+        # transient warnings before a silent restart from step 0.
+        logger.info(
+            f"NO loadable checkpoint: all {len(skipped)} retained "
+            "checkpoint(s) failed verification/decode; training restarts "
+            "from scratch (tools/verify_checkpoint.py audits the damage)")
+        args.telemetry_sink.write_record({
+            "kind": "fault", "tag": "telemetry",
+            "fault": "resume_walk_back_exhausted", "injected": False,
+            "step": 0, "skipped": skipped,
+        })
     if found is not None:
         resume_step, checkpoint = found
         args.resume_step = resume_step
@@ -397,7 +450,16 @@ def prepare_model(args, mesh):
                 f"previous_phase_end_step={args.previous_phase_end_step} cannot "
                 f"be larger than resume_step={resume_step}")
         global_step = resume_step - args.previous_phase_end_step
-        logger.info(f"Resume from step {resume_step} checkpoint")
+        logger.info(f"Resume from step {resume_step} checkpoint"
+                    + (f" ({len(skipped)} newer checkpoint(s) skipped as "
+                       "corrupt/unreadable)" if skipped else ""))
+        # Telemetry resume record (schema v1): which step resumed and
+        # exactly what the walk-back passed over — recovery decisions
+        # become auditable artifacts, not log prose.
+        args.telemetry_sink.write_record({
+            "kind": "resume", "tag": "telemetry", "step": int(resume_step),
+            "skipped": skipped,
+        })
     return model, config, checkpoint, global_step
 
 
@@ -456,10 +518,17 @@ def prepare_dataset(args, config, checkpoint):
         logger.info("No vocab_file/mask_token_id in model config; "
                     f"using mask_token_id={mask_token_id}")
 
+    # Data-path resilience (docs/fault_tolerance.md): retried shard IO,
+    # startup skip-vs-abort policy, fault records into the telemetry JSONL.
+    resilience = dict(
+        read_retries=args.data_read_retries,
+        retry_base_delay_s=args.data_retry_base_s,
+        shard_error_policy=args.shard_error_policy,
+        on_fault=args.telemetry_sink.write_record)
     dataset = ShardedPretrainingDataset(
         input_files, int(mask_token_id), args.max_predictions_per_seq,
         args.masked_token_fraction, vocab_size=int(config.vocab_size),
-        seed=args.seed + get_rank())
+        seed=args.seed + get_rank(), **resilience)
     # Sequence packing (docs/packing.md): offline-packed shards are
     # detected from the file layout; --pack_sequences packs on the fly.
     # Either way downstream sees packed rows with sequence_ids and
@@ -502,7 +571,7 @@ def prepare_dataset(args, config, checkpoint):
         val_dataset = ShardedPretrainingDataset(
             val_files, int(mask_token_id), args.max_predictions_per_seq,
             args.masked_token_fraction, vocab_size=int(config.vocab_size),
-            seed=args.seed + 7919 + get_rank())
+            seed=args.seed + 7919 + get_rank(), **resilience)
         val_sampler = DistributedSampler(
             val_dataset, num_replicas=jax.process_count(),
             rank=jax.process_index())
@@ -765,27 +834,25 @@ def main(args) -> dict:
         samples_seen = 0
         last_metrics = {}
         done = False
-        # Graceful preemption (beyond the reference, whose only fault model
-        # is die-and-resubmit, SURVEY §5.3): TPU-VM maintenance events and
-        # SLURM preemption deliver SIGTERM/SIGUSR1 with a short grace
-        # period. The handler only sets a flag; the loop acts on it at a
-        # fixed step cadence so every host of a multi-host job reaches the
-        # agreement collective at the same step, then the normal
-        # end-of-run epilogue writes the final checkpoint.
+        # Graceful preemption (docs/fault_tolerance.md; beyond the
+        # reference, whose only fault model is die-and-resubmit, SURVEY
+        # §5.3): TPU-VM maintenance events and SLURM preemption deliver
+        # SIGTERM/SIGUSR1 with a short grace period; an operator's Ctrl-C
+        # delivers SIGINT. The shared GracefulStop handler only sets a
+        # flag; the loop acts on it at a fixed step cadence so every host
+        # of a multi-host job reaches the agreement collective at the same
+        # step, then the normal end-of-run epilogue writes the final
+        # checkpoint and __main__ exits with EXIT_PREEMPTED.
         terminated = False
-        term_flag = {"received": False}
-        old_handlers = {}
+        stop = preemption.GracefulStop()
         if args.term_check_steps:
-            def _on_term(signum, frame):
-                term_flag["received"] = True
-            for sig in (signal.SIGTERM,
-                        getattr(signal, "SIGUSR1", None)):
-                if sig is None:
-                    continue
-                try:
-                    old_handlers[sig] = signal.signal(sig, _on_term)
-                except (ValueError, OSError):
-                    pass  # non-main thread (in-process tests) or platform
+            stop.install()
+        # Deterministic fault injection (testing/faults.py): inert unless
+        # --fault_spec / BERT_FAULTS armed it — the chaos harness's hooks
+        # into this loop (die/term/hang after the checkpoint block,
+        # metric poisoning before the sentinel sees the step).
+        fault_plan = (faults.arm(args.fault_spec) if args.fault_spec
+                      else faults.get_plan())
         # The DATA sequence length (what the FLOP/MFU accounting must use;
         # phase-1 data is 128 tokens while max_position_embeddings stays 512).
         data_seq_len = None
@@ -888,10 +955,15 @@ def main(args) -> dict:
                         # for identical steady-state device throughput).
                         jax.block_until_ready(metrics)
                         train_start = time.perf_counter()
+                    if fault_plan.active:
+                        # Armed NaN injection replaces the fetched scalars
+                        # BEFORE the sentinel observes this step.
+                        metrics = fault_plan.poison_metrics(
+                            global_step, metrics, emit=tele.emit)
                     # Telemetry step close-out: device sync (per cadence) +
                     # step-window emission + sentinel policy + heartbeat +
-                    # profiler auto-stop. NonFiniteError propagates under
-                    # --sentinel_policy abort.
+                    # watchdog note + profiler auto-stop. NonFiniteError
+                    # propagates under --sentinel_policy abort.
                     tele.step_done(global_step, metrics,
                                    profile_step=step_in_run)
 
@@ -941,9 +1013,16 @@ def main(args) -> dict:
                             keep=args.keep_checkpoints, async_write=True)
                         logger.info(f"Saved checkpoint at step {save_step}")
 
+                    if fault_plan.active:
+                        # die/term/hang fire AFTER the checkpoint block:
+                        # die@N resumes from whatever N's cadence durably
+                        # wrote — the hard-preemption model under test.
+                        fault_plan.fire_process_faults(
+                            global_step, emit=tele.emit)
+
                     if (args.term_check_steps
                             and global_step % args.term_check_steps == 0):
-                        flagged = term_flag["received"]
+                        flagged = stop.requested
                         if jax.process_count() > 1:
                             # Any-host semantics: the scheduler may signal hosts
                             # at different times; stop only when agreed, at the
@@ -954,8 +1033,13 @@ def main(args) -> dict:
                                 np.asarray([flagged])).any())
                         if flagged:
                             logger.info(
-                                "termination signal received; writing the final "
-                                "checkpoint and exiting cleanly")
+                                f"termination signal "
+                                f"({stop.signal_name or 'peer host'}) "
+                                "received; writing the final checkpoint "
+                                "and exiting cleanly "
+                                f"(exit code {preemption.EXIT_PREEMPTED})")
+                            tele.emit(preemption.preemption_record(
+                                global_step, stop))
                             terminated = True
                             done = True
                             break
@@ -1024,8 +1108,7 @@ def main(args) -> dict:
             tele.finish(global_step, summary=run_summary)
             logger.close()
         finally:
-            for sig, handler in old_handlers.items():
-                signal.signal(sig, handler)
+            stop.restore()
         return {"global_step": global_step,
                 "training_seq_per_sec": seq_per_sec,
                 "training_mfu": train_mfu,
@@ -1036,4 +1119,9 @@ def main(args) -> dict:
 if __name__ == "__main__":
     arguments = parse_arguments()
     np.random.seed(arguments.seed + get_rank())
-    main(arguments)
+    outcome = main(arguments)
+    if outcome.get("terminated_by_signal"):
+        # Distinct exit code (75 = EX_TEMPFAIL): "checkpointed cleanly
+        # under preemption, resubmit me" — schedulers/drivers can key
+        # auto-resubmission on it (docs/fault_tolerance.md).
+        sys.exit(preemption.EXIT_PREEMPTED)

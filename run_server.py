@@ -209,6 +209,11 @@ def main(args):
                 "GET /statsz)")
 
     def shutdown(signum, frame):
+        # Graceful drain (docs/fault_tolerance.md): flip /healthz to 503
+        # FIRST — load balancers stop routing on their next probe while
+        # the listener is still up — then unwind through the finally
+        # below, which flushes in-flight requests before stopping.
+        service.begin_drain()
         raise KeyboardInterrupt
 
     signal.signal(signal.SIGTERM, shutdown)
@@ -217,9 +222,10 @@ def main(args):
     except KeyboardInterrupt:
         pass
     finally:
-        logger.info("shutting down")
+        logger.info("draining: rejecting new requests (healthz 503), "
+                    "flushing in-flight batches, then shutting down")
         server.shutdown()
-        service.stop()
+        service.stop()  # drain + dispatch-thread join + telemetry summary
         if sink is not None:
             sink.close()
         logger.close()

@@ -39,6 +39,7 @@ from bert_pytorch_tpu.ops.grad_utils import global_norm
 from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils import preemption
 from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 from bert_pytorch_tpu.utils.dist import is_main_process
 
@@ -349,41 +350,68 @@ def main(args):
                     yield {k: jax.device_put(v, batch_sh[k])
                            for k, v in arrays.items()}
 
-            while global_step < total_steps:
-                for batch in tele.timed(epoch_batches()):
-                    rng, sub = jax.random.split(rng)
-                    tele.profiler.maybe_start(global_step + 1)
-                    with tele.profiler.annotation(global_step + 1):
-                        params, opt_state, metrics = train_step(
-                            params, opt_state, batch, sub)
-                    tele.dispatch_done()
-                    global_step += 1
-                    seqs += args.train_batch_size
-                    loss = metrics["loss"]
-                    tele.step_done(global_step, metrics)
-                    if global_step % args.log_freq == 0:
-                        losses.append(float(loss))
-                        logger.log(tag="train", step=global_step,
-                                   step_loss=float(loss),
-                                   samples_per_second=seqs / (
-                                       time.perf_counter() - t_start))
-                    if global_step >= total_steps:
-                        break
-                epoch += 1
-                order = np.random.permutation(n)
-            train_time = time.perf_counter() - t_start
-            summary["e2e_train_time"] = train_time
-            summary["training_sequences_per_second"] = seqs / train_time
-            summary["final_loss"] = float(loss)
-            tele.finish(global_step, summary={
-                "training_seq_per_sec": round(seqs / train_time, 2)})
+            # Graceful preemption (docs/fault_tolerance.md): stop at the
+            # next step boundary, checkpoint via the normal end-of-train
+            # write below, exit EXIT_PREEMPTED from __main__.
+            # Handlers stay installed THROUGH the end-of-train checkpoint
+            # write below (a grace-period re-delivery must not kill it);
+            # restored in the finally even on exceptions.
+            stop = preemption.GracefulStop().install()
+            try:
+                while global_step < total_steps and not stop.requested:
+                    for batch in tele.timed(epoch_batches()):
+                        rng, sub = jax.random.split(rng)
+                        tele.profiler.maybe_start(global_step + 1)
+                        with tele.profiler.annotation(global_step + 1):
+                            params, opt_state, metrics = train_step(
+                                params, opt_state, batch, sub)
+                        tele.dispatch_done()
+                        global_step += 1
+                        seqs += args.train_batch_size
+                        loss = metrics["loss"]
+                        tele.step_done(global_step, metrics)
+                        if global_step % args.log_freq == 0:
+                            losses.append(float(loss))
+                            logger.log(tag="train", step=global_step,
+                                       step_loss=float(loss),
+                                       samples_per_second=seqs / (
+                                           time.perf_counter() - t_start))
+                        if global_step >= total_steps or stop.requested:
+                            break
+                    epoch += 1
+                    order = np.random.permutation(n)
+                if stop.requested:
+                    logger.info(
+                        f"termination signal ({stop.signal_name}) received; "
+                        "checkpointing and exiting cleanly "
+                        f"(exit code {preemption.EXIT_PREEMPTED})")
+                    tele.emit(
+                        preemption.preemption_record(global_step, stop))
+                    summary["terminated_by_signal"] = True
+                train_time = time.perf_counter() - t_start
+                summary["e2e_train_time"] = train_time
+                summary["training_sequences_per_second"] = seqs / train_time
+                summary["final_loss"] = float(loss)
+                tele.finish(global_step, summary={
+                    "training_seq_per_sec": round(seqs / train_time, 2)})
 
-            if not args.skip_checkpoint and is_main_process():
-                ckpt.save_checkpoint(args.output_dir, global_step,
-                                     {"model": params,
-                                      "config": config.to_dict()}, keep=1)
+                if not args.skip_checkpoint and is_main_process():
+                    # A preemption stop must still land this write — it IS
+                    # the emergency checkpoint for this runner.
+                    ckpt.save_checkpoint(args.output_dir, global_step,
+                                         {"model": params,
+                                          "config": config.to_dict()},
+                                         keep=1)
+                # PR-5 audit: join any in-flight async write BEFORE the
+                # predict path below reads checkpoints back / the process
+                # exits (synchronous today; the guard survives async).
+                ckpt.wait_for_pending_save()
+            finally:
+                stop.restore()
 
-        if args.do_predict:
+        if args.do_predict and not summary.get("terminated_by_signal"):
+            # A preempted run exits after its emergency checkpoint; the
+            # grace period is for durability, not for inference.
             eval_examples = squad.read_squad_examples(
                 args.predict_file, False, args.version_2_with_negative)
             eval_features = cached_features(
@@ -461,4 +489,6 @@ def main(args):
 
 
 if __name__ == "__main__":
-    main(parse_args())
+    outcome = main(parse_args())
+    if outcome.get("terminated_by_signal"):
+        sys.exit(preemption.EXIT_PREEMPTED)

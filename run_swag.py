@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import time
 
 import jax
@@ -34,6 +35,7 @@ from bert_pytorch_tpu.models import BertForMultipleChoice
 from bert_pytorch_tpu.ops.grad_utils import clip_by_global_norm
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
+from bert_pytorch_tpu.utils import preemption
 from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 from run_glue import batches  # padded fixed-shape batches + valid mask
 
@@ -187,43 +189,72 @@ def main(args):
     t0 = time.perf_counter()
     seen = 0
     global_step = 0
-    for epoch in range(args.epochs):
-        losses = []
-        for batch, valid in tele.timed(
-                batches(arrays["train"], args.batch_size, True, rng)):
-            key, sub = jax.random.split(key)
-            tele.profiler.maybe_start(global_step + 1)
-            with tele.profiler.annotation(global_step + 1):
-                params, opt_state, metrics = train_step(
-                    params, opt_state, batch, valid, sub)
-            tele.dispatch_done()
-            global_step += 1
-            tele.step_done(global_step, metrics)
-            losses.append(float(metrics["loss"]))
-            seen += int(valid.sum())
-        logger.info(f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
-    train_time = time.perf_counter() - t0
-    tele.finish(global_step, summary={
-        "training_seq_per_sec":
-            round(seen / train_time, 2) if train_time else 0.0})
+    # Graceful preemption (docs/fault_tolerance.md): stop at the next
+    # step boundary, checkpoint through the normal end-of-run path,
+    # exit EXIT_PREEMPTED. Handlers stay installed THROUGH the
+    # checkpoint write below (a grace-period re-delivery must not kill
+    # it); restored in the finally even on exceptions.
+    stop = preemption.GracefulStop().install()
+    try:
+        for epoch in range(args.epochs):
+            losses = []
+            for batch, valid in tele.timed(
+                    batches(arrays["train"], args.batch_size, True, rng)):
+                key, sub = jax.random.split(key)
+                tele.profiler.maybe_start(global_step + 1)
+                with tele.profiler.annotation(global_step + 1):
+                    params, opt_state, metrics = train_step(
+                        params, opt_state, batch, valid, sub)
+                tele.dispatch_done()
+                global_step += 1
+                tele.step_done(global_step, metrics)
+                losses.append(float(metrics["loss"]))
+                seen += int(valid.sum())
+                if stop.requested:
+                    break
+            if losses:
+                logger.info(
+                    f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
+            if stop.requested:
+                logger.info(
+                    f"termination signal ({stop.signal_name}) received; "
+                    "checkpointing and exiting cleanly "
+                    f"(exit code {preemption.EXIT_PREEMPTED})")
+                tele.emit(preemption.preemption_record(global_step, stop))
+                break
+        train_time = time.perf_counter() - t0
+        tele.finish(global_step, summary={
+            "training_seq_per_sec":
+                round(seen / train_time, 2) if train_time else 0.0})
 
-    results = {
-        "e2e_train_time": train_time,
-        "training_sequences_per_second": seen / train_time if train_time else 0,
-    }
-    if args.val_file:
-        results["accuracy"] = evaluate()
-    logger.info(json.dumps({"swag_summary": results}))
+        results = {
+            "e2e_train_time": train_time,
+            "training_sequences_per_second":
+                seen / train_time if train_time else 0,
+            "terminated_by_signal": stop.requested,
+        }
+        if args.val_file and not stop.requested:
+            results["accuracy"] = evaluate()
+        logger.info(json.dumps({"swag_summary": results}))
 
-    if args.output_dir:
-        os.makedirs(args.output_dir, exist_ok=True)
-        ckpt.save_checkpoint(args.output_dir, total_steps, {"model": params})
-        with open(os.path.join(args.output_dir, "eval_results_swag.json"),
-                  "w") as f:
-            json.dump(results, f, indent=2)
+        if args.output_dir:
+            os.makedirs(args.output_dir, exist_ok=True)
+            # Stamped with the step actually REACHED (see run_glue.py).
+            ckpt.save_checkpoint(
+                args.output_dir, global_step, {"model": params})
+            with open(os.path.join(args.output_dir,
+                                   "eval_results_swag.json"), "w") as f:
+                json.dump(results, f, indent=2)
+        # PR-5 audit: no exit until any in-flight async checkpoint write
+        # has landed (synchronous today; the guard survives async saves).
+        ckpt.wait_for_pending_save()
+    finally:
+        stop.restore()
     logger.close()
     return results
 
 
 if __name__ == "__main__":
-    main(parse_arguments())
+    outcome = main(parse_arguments())
+    if outcome.get("terminated_by_signal"):
+        sys.exit(preemption.EXIT_PREEMPTED)

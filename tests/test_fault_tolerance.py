@@ -1,0 +1,630 @@
+"""Fault-tolerance suite (ISSUE 5; docs/fault_tolerance.md).
+
+Covers the recovery machinery unit-by-unit — retry/backoff timing with a
+fake clock, checkpoint integrity manifests and the multi-checkpoint
+resume walk-back, deterministic fault injection, the hung-step watchdog,
+graceful preemption, serve drain — and end to end: an in-process
+pretraining run stopped by an injected SIGTERM, and the subprocess chaos
+acceptance (`tools/chaos_run.py --smoke`: SIGKILL a child mid-run,
+corrupt the newest checkpoint, resume, assert the loss trajectory
+matches an uninterrupted reference exactly, with schema-clean
+``fault``/``resume`` records).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.telemetry import schema as tschema
+from bert_pytorch_tpu.telemetry.report import summarize_records
+from bert_pytorch_tpu.telemetry.sentinels import HeartbeatWatchdog
+from bert_pytorch_tpu.testing import faults
+from bert_pytorch_tpu.utils import checkpoint as ckpt
+from bert_pytorch_tpu.utils import integrity, preemption
+from bert_pytorch_tpu.utils.retry import RetryError, RetryPolicy, retry_call
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    """No fault plan (or BERT_FAULTS leak) may outlive a test."""
+    yield
+    faults.arm("")
+
+
+# ---------------------------------------------------------------------------
+# utils/retry.py
+
+
+def test_retry_policy_delays_deterministic():
+    p = RetryPolicy(attempts=4, base_delay_s=1.0, max_delay_s=5.0,
+                    jitter=0.0, sleep=lambda s: None)
+    assert list(p.delays()) == [1.0, 2.0, 4.0]
+    assert RetryPolicy(attempts=6, base_delay_s=1.0, max_delay_s=5.0,
+                       jitter=0.0).backoff_s(4) == 5.0  # capped
+
+
+def test_retry_jitter_stays_in_band():
+    p = RetryPolicy(base_delay_s=10.0, jitter=0.5, rng=random.Random(0))
+    draws = [p.backoff_s(0) for _ in range(200)]
+    assert all(5.0 <= d < 10.0 for d in draws)
+    assert len(set(draws)) > 100  # actually jittered
+
+
+def test_retry_call_recovers_and_reports_timing():
+    slept, seen = [], []
+    p = RetryPolicy(attempts=3, base_delay_s=0.5, jitter=0.0,
+                    sleep=slept.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(f"transient {calls['n']}")
+        return "ok"
+
+    out = retry_call(flaky, policy=p,
+                     on_retry=lambda n, e, d: seen.append((n, str(e), d)))
+    assert out == "ok" and calls["n"] == 3
+    assert slept == [0.5, 1.0]  # exact backoff sequence, no real sleeping
+    assert seen == [(1, "transient 1", 0.5), (2, "transient 2", 1.0)]
+
+
+def test_retry_exhausted_raises_with_cause():
+    p = RetryPolicy(attempts=2, base_delay_s=0.0, sleep=lambda s: None)
+    with pytest.raises(RetryError, match="2 attempt") as err:
+        retry_call(lambda: (_ for _ in ()).throw(OSError("disk gone")),
+                   policy=p, description="shard read")
+    assert isinstance(err.value.__cause__, OSError)
+
+
+def test_retry_non_retryable_propagates_immediately():
+    calls = {"n": 0}
+
+    def bug():
+        calls["n"] += 1
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        retry_call(bug, policy=RetryPolicy(attempts=5, sleep=lambda s: None))
+    assert calls["n"] == 1  # no retry budget burned on a non-IO error
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity manifests + resume walk-back
+
+
+def _contents(step):
+    return {"model": {"w": np.full((4, 4), float(step), np.float32)},
+            "epoch": step}
+
+
+def test_save_checkpoint_writes_verified_manifest_and_prunes(tmp_path):
+    for step in (1, 2, 3, 4):
+        ckpt.save_checkpoint(str(tmp_path), step, _contents(step), keep=3)
+    assert ckpt._ckpt_steps(str(tmp_path)) == [2, 3, 4]
+    for step in (2, 3, 4):
+        path = ckpt.checkpoint_path(str(tmp_path), step)
+        status, detail = integrity.verify_checkpoint(path)
+        assert status == integrity.VERIFIED, (step, detail)
+        manifest = integrity.read_manifest(path)
+        assert manifest["step"] == step
+        assert "model" in manifest["keys"]
+    # pruning removed the step-1 blob AND its sidecar
+    gone = ckpt.checkpoint_path(str(tmp_path), 1)
+    assert not os.path.exists(gone)
+    assert not os.path.exists(integrity.manifest_path(gone))
+
+
+@pytest.mark.parametrize("mode,expect", [("truncate", "size mismatch"),
+                                         ("flip", "sha256 mismatch")])
+def test_corruption_detected(tmp_path, mode, expect):
+    ckpt.save_checkpoint(str(tmp_path), 1, _contents(1))
+    path = ckpt.checkpoint_path(str(tmp_path), 1)
+    faults.corrupt_checkpoint(path, mode)
+    status, detail = integrity.verify_checkpoint(path)
+    assert status == integrity.CORRUPT and expect in detail
+    with pytest.raises(ckpt.CheckpointCorruptError, match=expect):
+        ckpt.load_checkpoint(path)
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_params_only(path, _contents(1)["model"])
+
+
+def test_walk_back_skips_all_corrupt_retained(tmp_path):
+    """Both newer retained checkpoints corrupt (one truncated, one
+    bit-flipped — the size-preserving case only sha256 can catch): the
+    walk-back lands on the oldest, reporting every skip."""
+    for step in (2, 4, 6):
+        ckpt.save_checkpoint(str(tmp_path), step, _contents(step))
+    faults.corrupt_checkpoint(ckpt.checkpoint_path(str(tmp_path), 6),
+                              "truncate")
+    faults.corrupt_checkpoint(ckpt.checkpoint_path(str(tmp_path), 4),
+                              "flip")
+    skipped = []
+    with pytest.warns(UserWarning, match="Skipping unreadable checkpoint"):
+        step, state = ckpt.load_latest_checkpoint(
+            str(tmp_path), on_skip=skipped.append)
+    assert step == 2 and state["epoch"] == 2
+    assert [s["step"] for s in skipped] == [6, 4]
+    assert all("integrity" in s["reason"] for s in skipped)
+    assert ckpt.find_resume_step(str(tmp_path), verify=True) == 2
+    assert ckpt.find_resume_step(str(tmp_path)) == 6  # unverified view
+
+
+def test_legacy_checkpoint_without_manifest_still_loads(tmp_path):
+    ckpt.save_checkpoint(str(tmp_path), 3, _contents(3))
+    path = ckpt.checkpoint_path(str(tmp_path), 3)
+    os.unlink(integrity.manifest_path(path))
+    assert integrity.verify_checkpoint(path)[0] == integrity.NO_MANIFEST
+    step, state = ckpt.load_latest_checkpoint(str(tmp_path))
+    assert step == 3 and state["epoch"] == 3  # unverifiable != corrupt
+
+
+def test_verify_checkpoint_tool(tmp_path):
+    ckpt.save_checkpoint(str(tmp_path), 1, _contents(1))
+    ckpt.save_checkpoint(str(tmp_path), 2, _contents(2))
+    faults.corrupt_checkpoint(ckpt.checkpoint_path(str(tmp_path), 2),
+                              "truncate")
+    tool = os.path.join(REPO_ROOT, "tools", "verify_checkpoint.py")
+    proc = subprocess.run([sys.executable, tool, str(tmp_path)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "ckpt_1.msgpack: verified" in proc.stdout
+    assert "ckpt_2.msgpack: corrupt" in proc.stdout
+    # strict mode also rejects manifestless checkpoints
+    os.unlink(integrity.manifest_path(
+        ckpt.checkpoint_path(str(tmp_path), 2)))
+    os.unlink(ckpt.checkpoint_path(str(tmp_path), 2))
+    proc = subprocess.run([sys.executable, tool, str(tmp_path)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0
+    os.unlink(integrity.manifest_path(
+        ckpt.checkpoint_path(str(tmp_path), 1)))
+    proc = subprocess.run([sys.executable, tool, "--strict", str(tmp_path)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1 and "no_manifest" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# testing/faults.py
+
+
+def test_fault_spec_parsing_and_rejection():
+    plan = faults.FaultPlan("die@7,shard_errorx2,nonfinite@5x2,hang@3x1")
+    assert plan.active
+    assert not faults.FaultPlan("").active
+    for bad in ("bogus@3", "die", "nonfinite", "die@x"):
+        with pytest.raises(faults.FaultSpecError):
+            faults.FaultPlan(bad)
+
+
+def test_poison_metrics_window():
+    plan = faults.FaultPlan("nonfinite@5x2")
+    recs = []
+    healthy = {"loss": 1.25, "finite": 1.0}
+    assert plan.poison_metrics(4, healthy) is healthy  # untouched
+    for step in (5, 6):
+        poisoned = plan.poison_metrics(step, healthy, emit=recs.append)
+        assert np.isnan(poisoned["loss"]) and poisoned["finite"] == 0.0
+    assert plan.poison_metrics(7, healthy) is healthy
+    assert healthy["loss"] == 1.25  # original never mutated
+    assert all(r["fault"] == "injected_nonfinite" and r["injected"]
+               for r in recs)
+
+
+def test_shard_error_countdown_then_healthy():
+    plan = faults.FaultPlan("shard_errorx2")
+    for _ in range(2):
+        with pytest.raises(OSError, match="injected transient"):
+            plan.shard_read_check("/data/shard_0.hdf5")
+    plan.shard_read_check("/data/shard_0.hdf5")  # exhausted -> healthy
+
+
+def test_arm_roundtrips_through_env():
+    faults.arm("shard_errorx1")
+    assert os.environ[faults.FAULTS_ENV] == "shard_errorx1"
+    faults.arm("")
+    assert faults.FAULTS_ENV not in os.environ
+    os.environ[faults.FAULTS_ENV] = "die@9"  # a worker process's view
+    assert faults.get_plan().active
+
+
+# ---------------------------------------------------------------------------
+# data-path resilience (retry around HDF5 shard reads)
+
+
+@pytest.fixture()
+def shards(tmp_path):
+    from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
+
+    paths = []
+    for i in range(2):
+        path = str(tmp_path / f"shard_{i}.hdf5")
+        make_shard(path, 16, 32, 100, seed=i)
+        paths.append(path)
+    return paths
+
+
+def _dataset(paths, **kw):
+    from bert_pytorch_tpu.data.dataset import ShardedPretrainingDataset
+
+    kw.setdefault("retry_base_delay_s", 0.01)
+    return ShardedPretrainingDataset(
+        paths, 4, max_pred_per_seq=20, masked_lm_prob=0.15, vocab_size=100,
+        seed=0, **kw)
+
+
+def test_dataset_retries_transient_shard_errors(shards):
+    emitted = []
+    ds = _dataset(shards, read_retries=2, on_fault=emitted.append)
+    faults.arm("shard_errorx2")  # after construction: streaming reads only
+    with pytest.warns(UserWarning, match="retrying"):
+        sample = ds[0]
+    assert sample[0].shape == (32,)
+    kinds = [r["fault"] for r in emitted]
+    assert "injected_shard_error" in kinds and "shard_read_retry" in kinds
+    faults.arm("")
+    # the retried read returned EXACTLY what an unfaulted reader gets
+    clean = _dataset(shards)[0]
+    for a, b in zip(sample, clean):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dataset_read_error_after_retry_budget(shards):
+    from bert_pytorch_tpu.data.dataset import DataReadError
+
+    ds = _dataset(shards, read_retries=1)
+    faults.arm("shard_errorx10")
+    with pytest.warns(UserWarning, match="retrying"):
+        with pytest.raises(DataReadError, match="2 attempt"):
+            ds[0]
+
+
+def test_shard_error_policy_abort_vs_skip(tmp_path, shards):
+    garbage = str(tmp_path / "shard_zz.hdf5")
+    with open(garbage, "wb") as f:
+        f.write(b"not an hdf5 file")
+    from bert_pytorch_tpu.data.dataset import DataReadError
+
+    with pytest.warns(UserWarning, match="Skipping File"):
+        ds = _dataset(shards + [garbage], read_retries=0)  # default: skip
+    assert len(ds) == 32
+    with pytest.raises(DataReadError, match="abort"):
+        _dataset(shards + [garbage], read_retries=0,
+                 shard_error_policy="abort")
+
+
+def test_masking_deterministic_per_sample_index(shards):
+    """Draws for sample i depend only on (seed, epoch, i) — the property
+    resume-exactness rests on: a reader that arrives at i via a
+    different history gets identical masking."""
+    a, b = _dataset(shards), _dataset(shards)
+    for i in (0, 3, 7):  # warm `a` along a different access history
+        a[i]
+    for x, y in zip(a[8], b[8]):
+        np.testing.assert_array_equal(x, y)
+    c = _dataset(shards)
+    c.set_epoch(1)  # ...but epochs still re-draw (dynamic masking)
+    assert any(not np.array_equal(x, y) for x, y in zip(b[8], c[8]))
+
+
+# ---------------------------------------------------------------------------
+# hung-step watchdog
+
+
+def test_watchdog_flags_stall_once_with_fake_clock():
+    clock = {"t": 0.0}
+    records = []
+    dog = HeartbeatWatchdog(max_age_s=10.0, emit=records.append,
+                            clock=lambda: clock["t"])
+    assert dog.check() is None  # unarmed before the first note
+    dog.note(3)
+    clock["t"] = 9.0
+    assert dog.check() is None  # healthy
+    clock["t"] = 11.0
+    rec = dog.check()
+    assert rec["fault"] == "hung_step" and rec["step"] == 3
+    assert rec["age_s"] == 11.0 and rec["injected"] is False
+    assert dog.check() is None  # one flag per stall, never a storm
+    dog.note(4)  # progress re-arms
+    clock["t"] = 30.0
+    assert dog.check()["step"] == 4
+    assert dog.stalls_flagged == 2
+    assert tschema.validate_record({"schema": 1, "ts": 0.0, **rec}) == []
+
+
+def test_watchdog_thread_emits_on_real_stall():
+    records = []
+    dog = HeartbeatWatchdog(max_age_s=0.1, emit=records.append,
+                            poll_s=0.02)
+    dog.start().note(1)
+    deadline = time.monotonic() + 2.0
+    with pytest.warns(UserWarning, match="may be hung"):
+        while not records and time.monotonic() < deadline:
+            time.sleep(0.02)
+    dog.stop()
+    assert records and records[0]["fault"] == "hung_step"
+
+
+# ---------------------------------------------------------------------------
+# graceful preemption
+
+
+def test_graceful_stop_catches_sigterm_and_restores():
+    before = signal.getsignal(signal.SIGTERM)
+    with preemption.GracefulStop() as stop:
+        assert not stop.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 2.0
+        while not stop.requested and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert stop.requested and stop.signal_name == "SIGTERM"
+        os.kill(os.getpid(), signal.SIGTERM)  # grace-period repeat absorbed
+    assert signal.getsignal(signal.SIGTERM) is before
+    assert preemption.EXIT_PREEMPTED == 75
+    rec = preemption.preemption_record(12, stop)
+    assert rec["fault"] == "preemption" and rec["signal"] == "SIGTERM"
+    assert tschema.validate_record({"schema": 1, "ts": 0.0, **rec}) == []
+
+
+# ---------------------------------------------------------------------------
+# schema + report for the fault/resume record family
+
+
+def _rec(**kw):
+    return {"schema": 1, "ts": 0.0, **kw}
+
+
+def test_schema_lints_fault_and_resume_kinds():
+    good_fault = _rec(kind="fault", fault="preemption", injected=False)
+    assert tschema.validate_record(good_fault) == []
+    assert tschema.validate_record(
+        _rec(kind="fault", fault="", injected=False))
+    assert tschema.validate_record(
+        _rec(kind="fault", fault="hung_step", injected="yes"))
+    good_resume = _rec(kind="resume", step=4, skipped=[
+        {"step": 6, "path": "x/ckpt_6.msgpack", "reason": "integrity"}])
+    assert tschema.validate_record(good_resume) == []
+    assert tschema.validate_record(_rec(kind="resume", step=4,
+                                        skipped="ckpt_6"))
+    assert tschema.validate_record(
+        _rec(kind="resume", step=4, skipped=[{"step": 6}]))
+
+
+def test_report_recovery_section():
+    records = [
+        _rec(kind="fault", fault="injected_die", injected=True, step=7),
+        _rec(kind="fault", fault="shard_read_retry", injected=False),
+        _rec(kind="resume", step=4, skipped=[
+            {"step": 6, "path": "p", "reason": "integrity: size"}]),
+    ]
+    out = summarize_records(records)
+    assert out["faults"] == 2 and out["faults_injected"] == 1
+    assert out["fault_kinds"] == ["injected_die", "shard_read_retry"]
+    assert out["resumes"] == 1 and out["resume_last_step"] == 4
+    assert out["resume_skipped_checkpoints"] == 1
+    assert out["resume_skipped_steps"] == [6]
+    from bert_pytorch_tpu.telemetry.report import format_summary
+
+    text = format_summary(out)
+    assert "fault_kinds" in text and "resume_skipped_steps" in text
+
+
+# ---------------------------------------------------------------------------
+# serve graceful drain
+
+
+class _EchoHandler:
+    def prepare(self, payload, max_len):
+        return {"input_ids": [1, 2, 3]}
+
+    def postprocess(self, features, out, payload):
+        return {"echo": out}
+
+
+class _EchoSpec:
+    handler = _EchoHandler()
+
+
+class _FakeEngine:
+    """Just enough engine for ServingService/healthz — no jax, no model."""
+    tasks = {"echo": _EchoSpec()}
+    buckets = (8,)
+    warmed = True
+    max_requests_per_pack = 1
+
+    def max_len(self):
+        return 8
+
+    def plan_batch(self, batch):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(requests=batch, leftover=[])
+
+    def execute(self, task, plan):
+        return (["ok"] * len(plan.requests),
+                {"device_s": 0.001, "rows": len(plan.requests), "bucket": 8,
+                 "real_tokens": 3, "compiles": 0})
+
+
+def test_serve_drain_sheds_then_flushes_then_stops():
+    from bert_pytorch_tpu.serve import Batcher, ServiceDraining
+    from bert_pytorch_tpu.serve.service import ServingService
+
+    service = ServingService(_FakeEngine(),
+                             Batcher(max_batch_size=2, max_wait_ms=1.0))
+    assert service.health()["status"] == "not_serving"  # dispatch not up
+    service.start()
+    assert service.health()["status"] == "ok"
+    assert service.submit("echo", {"x": 1}, timeout=5.0) == {"echo": "ok"}
+    service.begin_drain()
+    health = service.health()
+    assert health["status"] == "draining" and health["draining"]
+    with pytest.raises(ServiceDraining):
+        service.submit("echo", {"x": 2}, timeout=5.0)
+    service.stop(drain_s=1.0)
+    assert not service.dispatch_alive
+    assert service.health()["status"] == "draining"
+
+
+def test_healthz_reflects_dispatch_liveness_and_drain():
+    import http.client
+    import threading
+
+    from bert_pytorch_tpu.serve import Batcher, make_server
+    from bert_pytorch_tpu.serve.service import ServingService
+
+    service = ServingService(_FakeEngine(),
+                             Batcher(max_batch_size=2, max_wait_ms=1.0))
+    service.start()
+    server = make_server(service, port=0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def healthz():
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+
+    try:
+        status, body = healthz()
+        assert status == 200 and body["status"] == "ok"
+        assert body["dispatch_alive"] is True
+        service.begin_drain()
+        status, body = healthz()
+        assert status == 503 and body["status"] == "draining"
+        service.stop(drain_s=0.5)
+        status, body = healthz()
+        assert status == 503 and body["dispatch_alive"] is False
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end to end: in-process preemption + sentinel injection, subprocess chaos
+
+
+@pytest.fixture()
+def pretrain_workdir(tmp_path):
+    from bert_pytorch_tpu.tools.make_synthetic_data import make_shard
+
+    data_dir = tmp_path / "data"
+    data_dir.mkdir()
+    for i in range(2):
+        make_shard(str(data_dir / f"shard_{i}.hdf5"), 64, 32, 1000, seed=i)
+    model_config = {
+        "vocab_size": 1000, "hidden_size": 32, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 64,
+        "max_position_embeddings": 32, "type_vocab_size": 2,
+        "next_sentence": True, "mask_token_id": 4,
+    }
+    config_path = tmp_path / "model.json"
+    config_path.write_text(json.dumps(model_config))
+    return {"data": str(data_dir), "out": str(tmp_path / "out"),
+            "model": str(config_path)}
+
+
+def _pretrain_args(workdir, *extra):
+    import run_pretraining
+
+    return run_pretraining.parse_arguments([
+        "--input_dir", workdir["data"], "--output_dir", workdir["out"],
+        "--model_config_file", workdir["model"],
+        "--global_batch_size", "16", "--local_batch_size", "2",
+        "--max_steps", "8", "--steps", "8", "--dtype", "float32",
+        "--seed", "7", "--num_steps_per_checkpoint", "100",
+        "--telemetry_sync_every", "1", *extra])
+
+
+def _kinds(workdir):
+    jsonl = os.path.join(workdir["out"], "pretraining_telemetry.jsonl")
+    assert tschema.validate_file(jsonl) == []
+    kinds = {}
+    for line in open(jsonl):
+        rec = json.loads(line)
+        kinds.setdefault(rec.get("kind", "metric"), []).append(rec)
+    return kinds
+
+
+def test_pretraining_term_injection_stops_and_checkpoints(
+        pretrain_workdir):
+    """Injected SIGTERM at step 3: the run must stop at the next
+    term-check boundary, write a VERIFIED emergency checkpoint, and emit
+    injected_term + preemption fault records. (That the checkpoint then
+    resumes — with a resume record — is the chaos harness's subprocess
+    assertion; re-proving it in-process would just re-pay the compile.)
+    """
+    import run_pretraining
+
+    result = run_pretraining.main(_pretrain_args(
+        pretrain_workdir, "--fault_spec", "term@3",
+        "--term_check_steps", "1"))
+    assert result["terminated_by_signal"] is True
+    stopped_at = result["global_step"]
+    assert 3 <= stopped_at < 8
+    out_ckpts = os.path.join(pretrain_workdir["out"], "pretrain_ckpts")
+    assert ckpt.find_resume_step(out_ckpts, verify=True) == stopped_at
+    kinds = _kinds(pretrain_workdir)
+    fault_names = {r["fault"] for r in kinds["fault"]}
+    assert {"injected_term", "preemption"} <= fault_names
+    preempt = next(r for r in kinds["fault"] if r["fault"] == "preemption")
+    assert preempt["signal"] == "SIGTERM" and preempt["injected"] is False
+    assert kinds["run_summary"][0]["terminated_by_signal"] is True
+
+
+@pytest.mark.slow  # ~15s compile; the poison hook and the sentinel
+# policy are each unit-tested above / in tests/test_telemetry.py
+def test_pretraining_nonfinite_injection_trips_abort_sentinel(
+        pretrain_workdir):
+    """Injected NaN metrics must flow through the host sentinel exactly
+    like a real divergence: records per bad step, NonFiniteError under
+    the abort policy."""
+    import run_pretraining
+    from bert_pytorch_tpu.telemetry.sentinels import NonFiniteError
+
+    with pytest.raises(NonFiniteError, match="2 consecutive"):
+        run_pretraining.main(_pretrain_args(
+            pretrain_workdir, "--fault_spec", "nonfinite@2x3",
+            "--sentinel_policy", "abort", "--sentinel_patience", "2"))
+    jsonl = os.path.join(pretrain_workdir["out"],
+                         "pretraining_telemetry.jsonl")
+    assert tschema.validate_file(jsonl) == []
+    records = [json.loads(line) for line in open(jsonl)]
+    injected = [r for r in records if r.get("fault") == "injected_nonfinite"]
+    sentinels = [r for r in records if r.get("kind") == "sentinel"]
+    assert len(injected) >= 2
+    assert [r["step"] for r in sentinels] == [2, 3]
+
+
+def test_chaos_kill_corrupt_resume_acceptance():
+    """ISSUE 5 acceptance: the chaos harness SIGKILLs a CPU pretraining
+    child mid-run AND corrupts the newest checkpoint; the rerun
+    auto-resumes from the previous verified checkpoint and its per-step
+    loss trajectory matches an uninterrupted reference run from that
+    step (fp32, same seed), with schema-clean fault/resume records."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "chaos_run.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=540,
+        cwd=os.path.join(REPO_ROOT, "tools"))
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    assert verdict["resume_step"] < verdict["corrupted_step"]
+    assert [e["step"] for e in verdict["skipped"]] == [
+        verdict["corrupted_step"]]
+    assert verdict["compared_steps"] >= 3

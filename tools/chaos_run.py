@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Chaos harness: kill, corrupt, resume — and PROVE the recovery was
+exact (docs/fault_tolerance.md).
+
+The fault-tolerance subsystem's acceptance gate. One invocation:
+
+1. **reference** — an uninterrupted CPU pretraining run on synthetic
+   data (tiny fp32 config, dropout 0, per-step telemetry) records the
+   ground-truth per-step loss trajectory;
+2. **chaos** — an identical run armed with ``--fault_spec die@K`` is
+   SIGKILLed mid-run (the hard-preemption model: no handlers, no
+   flushing), after transient injected shard-read errors exercised the
+   data-path retry;
+3. **corrupt** — the newest checkpoint the dead run left behind is
+   damaged in place (``--corrupt_mode truncate|flip``; the manifest
+   sidecar is left stale so only integrity verification can catch
+   ``flip``);
+4. **resume** — the same command reruns with no faults armed. It must
+   walk back past the corrupt checkpoint to the previous verified one,
+   emit a schema-clean ``resume`` record naming what it skipped, finish
+   the remaining steps, and reproduce the reference trajectory from the
+   resume step on (``--loss_rtol``, default 1e-6 — fp32 CPU reruns of
+   the same compiled step are deterministic; resume-exactness holds
+   because masking derives from (seed, epoch, index), data/dataset.py).
+
+Both telemetry artifacts are then linted against the record schema.
+Verdict is one JSON line on stdout; exit 0 = every assertion held.
+
+``--smoke`` is the documented one-command local gate (small step counts,
+tier-1-budget-friendly: three lean child processes, ~45 s total on a
+throttled 2-core CPU)::
+
+    python tools/chaos_run.py --smoke
+
+The parent is deliberately jax-free (``tools/_bootstrap.py`` file-path
+imports): a hung accelerator runtime can hang a CHILD, which the
+per-child ``--child_timeout_s`` kills — never the harness itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+from _bootstrap import REPO_ROOT, load_by_path
+
+faults = load_by_path(
+    "_chaos_faults", "bert_pytorch_tpu", "testing", "faults.py")
+integrity = load_by_path(
+    "_chaos_integrity", "bert_pytorch_tpu", "utils", "integrity.py")
+schema = load_by_path(
+    "_chaos_schema", "bert_pytorch_tpu", "telemetry", "schema.py")
+synth = load_by_path(
+    "_chaos_synth", "bert_pytorch_tpu", "tools", "make_synthetic_data.py")
+
+# Tiny fp32 model, dropout 0: deterministic across kill/resume (the
+# dropout rng chain is NOT checkpointed — with it enabled, resumed draws
+# would legitimately differ and the trajectory comparison would be
+# meaningless noise instead of a recovery proof). Sized at the floor
+# that still exercises the full step (encoder + MLM + NSP): each of the
+# three children pays the train-step compile, which dominates the
+# harness's wall-clock inside the tier-1 budget.
+MODEL_CONFIG = {
+    "vocab_size": 1000, "hidden_size": 16, "num_hidden_layers": 1,
+    "num_attention_heads": 2, "intermediate_size": 32,
+    "max_position_embeddings": 32, "type_vocab_size": 2,
+    "next_sentence": True, "mask_token_id": 4,
+    "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+}
+
+
+class ChaosFailure(AssertionError):
+    pass
+
+
+def check(cond, what):
+    if not cond:
+        raise ChaosFailure(what)
+
+
+def make_data(data_dir: str, seq_len: int, n_per_shard: int = 64) -> None:
+    os.makedirs(data_dir, exist_ok=True)
+    for i in range(2):
+        synth.make_shard(os.path.join(data_dir, f"shard_{i}.hdf5"),
+                         n_per_shard, seq_len,
+                         MODEL_CONFIG["vocab_size"], seed=i)
+
+
+def child_cmd(args, out_dir: str, fault_spec: str = "") -> list:
+    cmd = [
+        sys.executable, os.path.join(REPO_ROOT, "run_pretraining.py"),
+        "--input_dir", args.data_dir, "--output_dir", out_dir,
+        "--model_config_file", args.config_path,
+        "--global_batch_size", "16", "--local_batch_size", "16",
+        "--max_steps", str(args.steps), "--steps", str(args.steps),
+        "--learning_rate", "1e-3", "--warmup_proportion", "0.25",
+        "--num_steps_per_checkpoint", str(args.ckpt_every),
+        "--keep_checkpoints", "3",
+        "--dtype", "float32", "--seed", str(args.seed),
+        "--log_steps", "1", "--telemetry_sync_every", "1",
+        "--telemetry_window", "5", "--term_check_steps", "1",
+        # Keep the children lean — the gate's evidence is the loss
+        # trajectory + fault/resume records, so skip the sinks/extras
+        # with heavy fixed costs: the TensorBoard backend import (torch,
+        # ~25s/child on a throttled CPU), the cost-analysis extra
+        # compile, the in-jit grad stats. Wall-clock is tier-1 budget
+        # (tests/test_fault_tolerance.py runs this harness).
+        "--disable_tensorboard",
+        "--telemetry_cost_analysis", "off", "--grad_stats_every", "0",
+    ]
+    if fault_spec:
+        cmd += ["--fault_spec", fault_spec]
+    return cmd
+
+
+def run_child(args, out_dir: str, fault_spec: str = "") -> int:
+    env = dict(os.environ)
+    # The chaos proof is a single-device CPU-determinism gate; never let
+    # a TPU plugin, the test harness's virtual 8-device mesh flag, or a
+    # fault spec leaked from an outer environment change that.
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop(faults.FAULTS_ENV, None)
+    xla_flags = " ".join(
+        flag for flag in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in flag)
+    if xla_flags:
+        env["XLA_FLAGS"] = xla_flags
+    else:
+        env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        child_cmd(args, out_dir, fault_spec), env=env,
+        timeout=args.child_timeout_s,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    if args.verbose:
+        sys.stderr.write(proc.stdout[-4000:] + "\n")
+    return proc.returncode
+
+
+def telemetry_records(out_dir: str) -> list:
+    path = os.path.join(out_dir, "pretraining_telemetry.jsonl")
+    records = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                records.append(json.loads(line))
+    return records
+
+
+def train_losses(records) -> dict:
+    return {int(r["step"]): float(r["step_loss"]) for r in records
+            if r.get("tag") == "train" and r.get("step_loss") is not None}
+
+
+def lint(out_dir: str) -> None:
+    path = os.path.join(out_dir, "pretraining_telemetry.jsonl")
+    errors = schema.validate_file(path)
+    check(errors == [], f"schema lint failed for {path}: {errors[:3]}")
+
+
+def compare_trajectories(ref: dict, new: dict, steps, rtol: float,
+                         what: str) -> None:
+    for step in steps:
+        check(step in ref, f"{what}: reference has no step {step}")
+        check(step in new, f"{what}: run has no step {step}")
+        check(math.isclose(ref[step], new[step], rel_tol=rtol),
+              f"{what}: loss diverged at step {step}: "
+              f"reference {ref[step]!r} vs {new[step]!r} (rtol {rtol})")
+
+
+def ckpt_steps(out_dir: str) -> list:
+    d = os.path.join(out_dir, "pretrain_ckpts")
+    steps = []
+    for name in os.listdir(d):
+        if name.startswith("ckpt_") and name.endswith(".msgpack"):
+            steps.append(int(name[len("ckpt_"):-len(".msgpack")]))
+    return sorted(steps)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="kill->corrupt->resume chaos harness")
+    parser.add_argument("--smoke", action="store_true",
+                        help="the one-command local gate: small step "
+                             "counts sized for a laptop CPU / the tier-1 "
+                             "budget")
+    parser.add_argument("--steps", type=int, default=None,
+                        help="total optimizer steps (default 20; 8 "
+                             "under --smoke)")
+    parser.add_argument("--die_at", type=int, default=None,
+                        help="SIGKILL the chaos child at this step "
+                             "(default: steps - 3)")
+    parser.add_argument("--ckpt_every", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=7)
+    parser.add_argument("--seq_len", type=int, default=32)
+    parser.add_argument("--shard_errors", type=int, default=2,
+                        help="transient injected shard-read errors in the "
+                             "chaos run (0 disables)")
+    parser.add_argument("--corrupt_mode", type=str, default="truncate",
+                        choices=["truncate", "flip"])
+    parser.add_argument("--loss_rtol", type=float, default=1e-6)
+    parser.add_argument("--child_timeout_s", type=float, default=300.0)
+    parser.add_argument("--workdir", type=str, default="",
+                        help="keep artifacts here (default: a fresh "
+                             "temp dir, removed on success)")
+    parser.add_argument("--verbose", action="store_true",
+                        help="echo child output")
+    args = parser.parse_args(argv)
+
+    args.steps = args.steps or (8 if args.smoke else 20)
+    args.die_at = args.die_at or max(3, args.steps - 3)
+    check(args.die_at < args.steps, "--die_at must be before --steps")
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_run_")
+    os.makedirs(workdir, exist_ok=True)
+    args.data_dir = os.path.join(workdir, "data")
+    args.config_path = os.path.join(workdir, "model.json")
+    ref_dir = os.path.join(workdir, "reference")
+    chaos_dir = os.path.join(workdir, "chaos")
+    verdict = {"metric": "chaos_kill_corrupt_resume", "workdir": workdir,
+               "steps": args.steps, "die_at": args.die_at,
+               "corrupt_mode": args.corrupt_mode}
+    try:
+        make_data(args.data_dir, args.seq_len)
+        with open(args.config_path, "w") as f:
+            json.dump(MODEL_CONFIG, f)
+
+        # 1. reference trajectory (uninterrupted)
+        rc = run_child(args, ref_dir)
+        check(rc == 0, f"reference run failed (rc {rc})")
+        ref = train_losses(telemetry_records(ref_dir))
+        check(len(ref) == args.steps,
+              f"reference logged {len(ref)} steps, wanted {args.steps}")
+
+        # 2. chaos run: transient shard errors early, SIGKILL at die_at
+        spec = f"die@{args.die_at}"
+        if args.shard_errors:
+            spec += f",shard_errorx{args.shard_errors}"
+        rc = run_child(args, chaos_dir, fault_spec=spec)
+        check(rc in (-9, 137),
+              f"chaos child should die by SIGKILL, got rc {rc}")
+        chaos_records = telemetry_records(chaos_dir)
+        chaos = train_losses(chaos_records)
+        fault_kinds = {r.get("fault") for r in chaos_records
+                       if r.get("kind") == "fault"}
+        check("injected_die" in fault_kinds,
+              f"no injected_die fault record (saw {sorted(fault_kinds)})")
+        if args.shard_errors:
+            check("injected_shard_error" in fault_kinds,
+                  "no injected_shard_error fault record")
+            check("shard_read_retry" in fault_kinds,
+                  "retry wrapper emitted no shard_read_retry record")
+        compare_trajectories(
+            ref, chaos, range(1, args.die_at), args.loss_rtol,
+            "pre-kill prefix (shard retries must not change the data)")
+
+        # 3. corrupt the newest surviving checkpoint
+        steps = ckpt_steps(chaos_dir)
+        check(len(steps) >= 2,
+              f"need >=2 retained checkpoints to corrupt+walk back, "
+              f"have {steps}")
+        newest, expect_resume = steps[-1], steps[-2]
+        newest_path = os.path.join(
+            chaos_dir, "pretrain_ckpts", f"ckpt_{newest}.msgpack")
+        faults.corrupt_checkpoint(newest_path, args.corrupt_mode)
+        status, detail = integrity.verify_checkpoint(newest_path)
+        check(status == integrity.CORRUPT,
+              f"corruption undetected: {status} ({detail})")
+        verdict.update(corrupted_step=newest, resume_step=expect_resume)
+
+        # 4. resume: walk back past the corruption, finish, match
+        rc = run_child(args, chaos_dir)
+        check(rc == 0, f"resume run failed (rc {rc})")
+        records = telemetry_records(chaos_dir)
+        resumes = [r for r in records if r.get("kind") == "resume"]
+        check(resumes, "resume run emitted no resume record")
+        resume = resumes[-1]
+        check(int(resume["step"]) == expect_resume,
+              f"resumed from step {resume['step']}, expected "
+              f"{expect_resume} (walk-back past corrupt {newest})")
+        skipped_steps = [int(e["step"]) for e in resume["skipped"]]
+        check(newest in skipped_steps,
+              f"resume record does not name corrupt step {newest} "
+              f"(skipped: {skipped_steps})")
+        resumed = train_losses(records)
+        compare_trajectories(
+            ref, resumed, range(expect_resume + 1, args.steps + 1),
+            args.loss_rtol, "post-resume trajectory")
+
+        # 5. both artifacts schema-clean
+        lint(ref_dir)
+        lint(chaos_dir)
+
+        verdict.update(ok=True, skipped=resume["skipped"],
+                       compared_steps=args.steps - expect_resume)
+        print(json.dumps(verdict))
+        if not args.workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return 0
+    except (ChaosFailure, subprocess.TimeoutExpired, OSError,
+            ValueError, KeyError) as exc:
+        verdict.update(ok=False, error=f"{type(exc).__name__}: {exc}")
+        print(json.dumps(verdict))
+        print(f"chaos_run: FAILED — artifacts kept in {workdir}",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

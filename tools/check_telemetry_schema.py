@@ -12,7 +12,11 @@ existed carry no ``schema`` key and are held to the universal rules only
 (bert_pytorch_tpu/telemetry/schema.py). The ``serve`` record family
 (``serve_window``/``serve_summary``, serve/stats.py) is linted with its
 consistency rules — latency percentiles ordered p50 <= p95 <= p99,
-``batch_occupancy`` in (0, 1].
+``batch_occupancy`` in (0, 1] — and the fault-tolerance family
+(``fault``/``resume``, docs/fault_tolerance.md) with its own: a real
+boolean ``injected`` marker, and every ``resume.skipped`` entry naming
+step/path/reason. The chaos harness (tools/chaos_run.py) lints its
+kill->corrupt->resume artifacts through this same module.
 
 Usage::
 

@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""Verify checkpoint integrity manifests offline (docs/fault_tolerance.md).
+
+For every ``ckpt_*.msgpack`` named (or found under a named directory),
+check its sidecar manifest (``utils/integrity.py``: size, then sha256)
+and print one ``path: status (detail)`` line. Statuses:
+
+* ``verified``    — manifest present, bytes match;
+* ``no_manifest`` — loadable but unverifiable (pre-manifest legacy
+  checkpoint, or a write torn between the blob and sidecar renames);
+* ``corrupt``     — size/sha mismatch or unreadable manifest. The resume
+  walk-back (``utils/checkpoint.py``) will skip these.
+
+Usage::
+
+    python tools/verify_checkpoint.py out/pretrain_ckpts [more paths...]
+    python tools/verify_checkpoint.py --strict out/   # no_manifest fails too
+
+Exit 0 = nothing corrupt (``--strict``: everything verified), 1 =
+corruption found (or unverified under ``--strict``), 2 = a named path is
+missing. Imports only the stdlib integrity module — no jax — so it runs
+anywhere, including cron health checks on storage-only machines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+from _bootstrap import load_by_path
+
+integrity = load_by_path(
+    "_ckpt_integrity", "bert_pytorch_tpu", "utils", "integrity.py")
+
+
+def expand(paths):
+    """Named files, plus every ckpt_*.msgpack under named directories."""
+    out = []
+    for path in paths:
+        if os.path.isdir(path):
+            out.extend(sorted(
+                glob.glob(os.path.join(path, "**", "ckpt_*.msgpack"),
+                          recursive=True)))
+        else:
+            out.append(path)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="verify checkpoint integrity manifests")
+    parser.add_argument("paths", nargs="+",
+                        help="checkpoint files or directories to scan")
+    parser.add_argument("--strict", action="store_true",
+                        help="treat no_manifest (unverifiable) as failure")
+    args = parser.parse_args(argv)
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"verify_checkpoint: {path}: no such file or directory")
+            return 2
+    ckpts = expand(args.paths)
+    if not ckpts:
+        print("verify_checkpoint: no ckpt_*.msgpack files found")
+        return 2
+
+    failed = False
+    for path in ckpts:
+        status, detail = integrity.verify_checkpoint(path)
+        print(f"{path}: {status} ({detail})")
+        if status == integrity.CORRUPT or (
+                args.strict and status != integrity.VERIFIED):
+            failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
