@@ -120,6 +120,26 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 # (default 256), BENCH_SERVE_BATCH (default 8), BENCH_SERVE_BUCKETS
 # (default "32,64,128"), BENCH_SERVE_RATE (req/s arrival rate; 0 =
 # saturation replay, the default).
+# BENCH_ASYNC=1 switches to the ASYNC-CHECKPOINT leg (docs/telemetry.md
+# "checkpoint-step p95"): a deliberately large synthetic train state is
+# saved on a fixed cadence during a paced step loop, once with blocking
+# writes and once with async device-snapshot writes
+# (utils/checkpoint.py save_checkpoint(async_write=True)), and the result
+# stamps both checkpoint-step p95s against the steady-state step p95 —
+# async should collapse the ratio toward 1x while blocking holds it at a
+# multiple. Knobs: BENCH_ASYNC_STATE_MB (default 128), BENCH_ASYNC_STEPS
+# (default 30), BENCH_ASYNC_STEP_MS (default 50), BENCH_ASYNC_CKPT_EVERY
+# (default 5).
+# Defaults keep two invariants on a throttled CPU box: the inter-save
+# interval (step_ms * ckpt_every) exceeds the background write time (else
+# saves legitimately join their predecessor — the designed backpressure),
+# and the step time dwarfs the snapshot memcpy (on CPU the "device copy"
+# is a real memcpy; on TPU it is a sub-ms D2D dispatch).
+ASYNC = os.environ.get("BENCH_ASYNC", "0") == "1"
+ASYNC_STATE_MB = int(os.environ.get("BENCH_ASYNC_STATE_MB", "96"))
+ASYNC_STEPS = int(os.environ.get("BENCH_ASYNC_STEPS", "24"))
+ASYNC_STEP_MS = float(os.environ.get("BENCH_ASYNC_STEP_MS", "400"))
+ASYNC_CKPT_EVERY = int(os.environ.get("BENCH_ASYNC_CKPT_EVERY", "6"))
 SERVE = os.environ.get("BENCH_SERVE", "0") == "1"
 SERVE_PACK = os.environ.get("BENCH_SERVE_PACK", "0") == "1"
 SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", "256"))
@@ -204,6 +224,11 @@ def _config_digest(degraded=None, local_batch=None):
         # appended outside the tuple for the same marker-stability reason.
         key += (f"+serve{SERVE_BATCH}x{SERVE_BUCKETS}"
                 + ("+spack" if SERVE_PACK else ""))
+    if ASYNC:
+        # The async-checkpoint leg compiles nothing heavy (the snapshot
+        # identity only); keyed so its marker never collides with a
+        # training config's.
+        key += f"+async{ASYNC_STATE_MB}"
     return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 
@@ -709,6 +734,106 @@ def _serve_child_main():
     print(_json.dumps(result))
 
 
+def _async_child_main():
+    """BENCH_ASYNC leg: checkpoint-step p95 vs steady-state p95, blocking
+    vs async device-snapshot saves, on an injected large synthetic state.
+
+    The stall under test is host-side (D2H fetch + msgpack + disk), so the
+    leg is meaningful on any backend — the CPU-reproducible counterpart of
+    the production win, measured through the same StepTimer/ckpt_step
+    telemetry the runners emit (docs/telemetry.md). Steps are paced
+    sleeps: the point is the ratio between a step that carried a save and
+    one that didn't, not the step time itself.
+    """
+    import json as _json
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.telemetry.report import summarize_records
+    from bert_pytorch_tpu.telemetry.step_timer import StepTimer
+    from bert_pytorch_tpu.utils import checkpoint as ckpt
+
+    n_leaves = 8
+    leaf_elems = ASYNC_STATE_MB * (1 << 20) // 4 // n_leaves
+    state = {"model": {f"w{i}": jnp.ones((leaf_elems,), jnp.float32)
+                       for i in range(n_leaves)},
+             "epoch": 0}
+
+    def run_mode(async_write: bool):
+        tmp = tempfile.mkdtemp(prefix="bench_async_")
+        timer = StepTimer(window=10, sync_every=0)
+        records = []
+        try:
+            for step in range(1, ASYNC_STEPS + 1):
+                timer.data_start()
+                timer.data_end()
+                time.sleep(ASYNC_STEP_MS / 1000.0)
+                timer.dispatch_end()
+                rec = timer.step_done(step)
+                if rec:
+                    records.append(rec)
+                if step % ASYNC_CKPT_EVERY == 0:
+                    t0 = time.perf_counter()
+                    ckpt.save_checkpoint(tmp, step, state, keep=2,
+                                         async_write=async_write)
+                    timer.note_ckpt_stall(time.perf_counter() - t0)
+            ckpt.wait_for_pending_save(tmp)
+            rec = timer.flush(ASYNC_STEPS)
+            if rec:
+                records.append(rec)
+        finally:
+            ckpt.wait_for_pending_save()
+            shutil.rmtree(tmp, ignore_errors=True)
+        for rec in records:
+            rec.update({"kind": "step_window", "tag": "telemetry"})
+        return records
+
+    sync_records = run_mode(async_write=False)
+    async_records = run_mode(async_write=True)
+    sync_sum = summarize_records(sync_records)
+    async_sum = summarize_records(async_records)
+    steady = async_sum.get("step_p95_s") or 1e-9
+    sync_ratio = (sync_sum.get("ckpt_step_p95_s") or 0.0) / (
+        sync_sum.get("step_p95_s") or 1e-9)
+    async_ratio = (async_sum.get("ckpt_step_p95_s") or 0.0) / steady
+    metric = "ckpt_step_p95_over_steady_async"
+    result = {
+        "metric": metric,
+        "value": round(async_ratio, 3),
+        "unit": "x steady-state step p95",
+        "sync_ratio": round(sync_ratio, 3),
+        "sync_ckpt_step_p95_s": sync_sum.get("ckpt_step_p95_s"),
+        "async_ckpt_step_p95_s": async_sum.get("ckpt_step_p95_s"),
+        "step_p95_s": async_sum.get("step_p95_s"),
+        "state_mb": ASYNC_STATE_MB,
+        "steps": ASYNC_STEPS,
+        "ckpt_every": ASYNC_CKPT_EVERY,
+        # The acceptance shape: async within 20% of steady state while
+        # blocking stays a clear multiple (tests/test_async_hotpath.py
+        # asserts the same through the report gating path).
+        "ok": bool(async_ratio <= 1.2 < sync_ratio),
+    }
+    if TELEMETRY_JSONL:
+        from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+        sink = JSONLHandler(TELEMETRY_JSONL, overwrite=False)
+        for rec in async_records:
+            sink.write_record(rec)
+        sink.write_record({
+            "kind": "run_summary", "tag": "telemetry",
+            "step": ASYNC_STEPS, "steps": ASYNC_STEPS, "metric": metric,
+            "ckpt_step_p95_s": async_sum.get("ckpt_step_p95_s")})
+        sink.close()
+    try:
+        with open(_warm_marker_path(), "w") as f:
+            f.write("ok\n")
+    except OSError:
+        pass
+    print(_json.dumps(result))
+
+
 def _metric_name_and_anchor():
     kfac_tag = "_kfac" if KFAC else ""
     pack_tag = "_packed" if PACK else ""
@@ -928,7 +1053,7 @@ def main():
     degrade_ok = (os.environ.get("BENCH_DEGRADE", "auto") != "0"
                   and not DEGRADED and PHASE == 1 and not KFAC
                   and not LONG_SEQ and not N_DEVICES and not PACK
-                  and not SERVE)
+                  and not SERVE and not ASYNC)
     degraded_warm = degrade_ok and os.path.exists(
         os.path.join(CACHE_DIR, f"warm_{_degraded_digest()}"))
     if not degrade_ok:
@@ -1043,6 +1168,11 @@ def main():
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
-        _serve_child_main() if SERVE else _child_main()
+        if ASYNC:
+            _async_child_main()
+        elif SERVE:
+            _serve_child_main()
+        else:
+            _child_main()
     else:
         main()

@@ -11,6 +11,7 @@ from bert_pytorch_tpu.data.dataset import (
     NEW_FORMAT_KEYS,
     ShardedPretrainingDataset,
 )
+from bert_pytorch_tpu.data.device_prefetch import DevicePrefetcher
 from bert_pytorch_tpu.data.loader import (
     BATCH_KEYS,
     PACKED_EXTRA_KEYS,
@@ -28,6 +29,7 @@ from bert_pytorch_tpu.data.sampler import DistributedSampler
 __all__ = [
     "BATCH_KEYS",
     "DataLoader",
+    "DevicePrefetcher",
     "DistributedSampler",
     "LEGACY_FORMAT_KEYS",
     "NEW_FORMAT_KEYS",

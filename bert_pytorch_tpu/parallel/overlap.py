@@ -1,0 +1,62 @@
+"""Bucketed data-parallel gradient reduction, shaped for compute/comm
+overlap.
+
+Under plain jit the cross-replica gradient reduction is implicit: XLA
+inserts the all-reduces wherever its SPMD partitioner likes, typically
+fused into one tree-wide reduction that cannot start until the whole
+backward has finished. The ZeRO/DDP lineage (PAPERS.md; Rajbhandari 2020)
+overlaps instead: gradients for the layers that finish their backward
+FIRST are reduced while the remaining backward still computes.
+
+This module gives the graph that shape explicitly: gradients are grouped
+into availability-ordered buckets — task heads (whose grads exit the
+backward first), the encoder stack, embeddings (last) — and each bucket
+gets its OWN ``lax.psum``. The psums depend only on their bucket's leaves,
+so XLA's latency-hiding scheduler is free to run the heads' collective
+under the encoder backward. It is used from inside a ``shard_map`` over
+the batch axes where the per-shard backward produces LOCAL gradient sums
+(pretrain.py ``overlap_grad_buckets``); numerically the bucketed psum of
+local sums equals the implicit global reduction to fp32 roundoff (the
+parity test pins 1e-6).
+"""
+
+from __future__ import annotations
+
+import jax
+
+# Availability order of the top-level parameter groups during the backward
+# pass: head gradients materialize first, embeddings last. Bucket ids
+# double as emission order — earlier buckets' collectives get the longest
+# window of remaining backward compute to hide under.
+_BUCKET_EMBEDDINGS = 2
+_BUCKET_ENCODER = 1
+_BUCKET_HEADS = 0
+N_BUCKETS = 3
+
+
+def _bucket_of(path) -> int:
+    names = {str(getattr(entry, "key", entry)) for entry in path}
+    if "embeddings" in names:
+        return _BUCKET_EMBEDDINGS
+    if "encoder" in names:
+        return _BUCKET_ENCODER
+    return _BUCKET_HEADS
+
+
+def bucketed_psum(tree, axis_names):
+    """``lax.psum(tree, axis_names)``, one collective per availability
+    bucket instead of whatever single fusion XLA would pick. Exact: psum
+    is psum; only the grouping (and therefore the schedulable order)
+    changes."""
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    leaves = [leaf for _, leaf in leaves_with_path]
+    buckets = [[] for _ in range(N_BUCKETS)]
+    for i, (path, _) in enumerate(leaves_with_path):
+        buckets[_bucket_of(path)].append(i)
+    for bucket in buckets:  # heads -> encoder -> embeddings
+        if not bucket:
+            continue
+        reduced = jax.lax.psum([leaves[i] for i in bucket], axis_names)
+        for i, leaf in zip(bucket, reduced):
+            leaves[i] = leaf
+    return jax.tree_util.tree_unflatten(treedef, leaves)

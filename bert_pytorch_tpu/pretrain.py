@@ -100,17 +100,13 @@ def _mlm_positions(labels, max_pred_per_seq):
     return labels, masked_positions
 
 
-def _apply_pretraining_loss(model, variables, mb, rng, next_sentence,
-                            max_pred_per_seq, mutable=False):
-    """The one shared apply+loss(+accuracy) sequence behind every
-    pretraining loss path — the plain train-step loss, the fused-capture
-    tapped loss, and the K-FAC stats pass. One definition, so a loss or
-    signature change cannot silently diverge between them.
-
-    Returns (loss, acc, mutated); ``mutated`` is None unless ``mutable``
-    names collections. ``acc`` is always computed — XLA dead-code
-    eliminates it in consumers that drop it.
-    """
+def _apply_model(model, variables, mb, rng, max_pred_per_seq,
+                 mutable=False):
+    """Shared masked-position extraction + model apply: returns
+    ``((mlm_logits, nsp_logits), labels, mutated)`` where ``labels`` are
+    the (possibly position-gathered) MLM labels the loss must score
+    against. Factored out of :func:`_apply_pretraining_loss` so the
+    bucketed-overlap path (same apply, sum-form loss) cannot drift."""
     labels, masked_positions = _mlm_positions(
         mb["masked_lm_labels"], max_pred_per_seq
     )
@@ -129,9 +125,25 @@ def _apply_pretraining_loss(model, variables, mb, rng, next_sentence,
         **({"mutable": mutable} if mutable else {}),
     )
     if mutable:
-        (mlm_logits, nsp_logits), mutated = out
+        logits, mutated = out
     else:
-        (mlm_logits, nsp_logits), mutated = out, None
+        logits, mutated = out, None
+    return logits, labels, mutated
+
+
+def _apply_pretraining_loss(model, variables, mb, rng, next_sentence,
+                            max_pred_per_seq, mutable=False):
+    """The one shared apply+loss(+accuracy) sequence behind every
+    pretraining loss path — the plain train-step loss, the fused-capture
+    tapped loss, and the K-FAC stats pass. One definition, so a loss or
+    signature change cannot silently diverge between them.
+
+    Returns (loss, acc, mutated); ``mutated`` is None unless ``mutable``
+    names collections. ``acc`` is always computed — XLA dead-code
+    eliminates it in consumers that drop it.
+    """
+    (mlm_logits, nsp_logits), labels, mutated = _apply_model(
+        model, variables, mb, rng, max_pred_per_seq, mutable=mutable)
     loss = pretraining_loss(
         mlm_logits,
         nsp_logits if next_sentence else None,
@@ -203,6 +215,134 @@ def _jit_train_step(step_fn, shardings, batch_shardings_, kfac,
     )
 
 
+def _make_overlap_step_fn(model, tx, mesh, schedule, next_sentence,
+                          max_pred_per_seq, stats_every, stats_phase):
+    """Train step whose data-parallel gradient reduction is EXPLICIT and
+    bucketed for compute/communication overlap (parallel/overlap.py).
+
+    The microbatch backward runs per shard inside a ``shard_map`` over the
+    batch axes, producing LOCAL gradient sums; each availability bucket
+    (heads -> encoder -> embeddings) then gets its own ``lax.psum``, so
+    XLA's latency-hiding scheduler can run early buckets' collectives
+    under the remaining backward compute — the ZeRO/DDP overlap shape the
+    implicit one-shot reduction of plain jit cannot express.
+
+    Numerics: each microbatch's local SUM loss is divided by the GLOBAL
+    valid-token count (a psum of label counts — no gradient flows through
+    it) before the backward, so per-shard grads psum to exactly the
+    global-mean gradient; bucketed == unbucketed to fp32 roundoff (the
+    parity test pins 1e-6). Dropout draws fold in the shard index — valid
+    streams, but not bit-identical to the unbucketed path's (the same
+    caveat as --rng_impl rbg).
+    """
+    from jax.sharding import PartitionSpec as P  # noqa: F811 (local alias)
+
+    from bert_pytorch_tpu.models.losses import pretraining_loss_sums
+    from bert_pytorch_tpu.parallel.overlap import bucketed_psum
+    from bert_pytorch_tpu.parallel.pipeline import shard_map
+
+    axes = ("data", "fsdp")
+
+    def local_grads(params, batch, step_rng):
+        # Runs PER SHARD: ``batch`` is the local [A, b_local, ...] slice.
+        # Dropout decorrelates over BOTH batch axes — the batch shards
+        # over ('data','fsdp') even under dp rules (params replicated),
+        # so folding in only 'data' would hand every fsdp shard sharing a
+        # data index identical masks for different examples.
+        shard = (jax.lax.axis_index("data") * mesh.shape["fsdp"]
+                 + jax.lax.axis_index("fsdp"))
+        rng0 = jax.random.fold_in(step_rng, shard)
+        zero_grads = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+        def body(carry, mb):
+            grads_acc, rng = carry
+            rng, sub = jax.random.split(rng)
+            # Global per-microbatch normalizers, from labels alone (the
+            # position gather caps masked counts per row, so count AFTER
+            # it — exactly what the mean-form loss divides by).
+            gathered = _mlm_positions(
+                mb["masked_lm_labels"], max_pred_per_seq)[0]
+            c_mlm = jnp.maximum(
+                jax.lax.psum(jnp.sum(gathered != -1), axes), 1
+            ).astype(jnp.float32)
+            c_nsp = jnp.maximum(
+                jax.lax.psum(
+                    jnp.sum(mb["next_sentence_labels"] != -1), axes), 1
+            ).astype(jnp.float32) if next_sentence else jnp.float32(1)
+
+            def local_loss(p):
+                (mlm_logits, nsp_logits), labels, _ = _apply_model(
+                    model, {"params": p}, mb, sub, max_pred_per_seq)
+                mlm_sum, _, nsp_sum, _, correct = pretraining_loss_sums(
+                    mlm_logits, nsp_logits if next_sentence else None,
+                    labels,
+                    mb["next_sentence_labels"] if next_sentence else None)
+                loss = mlm_sum / c_mlm
+                if next_sentence:
+                    loss = loss + nsp_sum / c_nsp
+                return loss, (mlm_sum, nsp_sum, correct)
+
+            (_, aux), grads = jax.value_and_grad(
+                local_loss, has_aux=True)(params)
+            grads_acc = jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(a.dtype), grads_acc, grads)
+            mlm_sum, nsp_sum, correct = aux
+            return (grads_acc, rng), (mlm_sum, nsp_sum, correct,
+                                      c_mlm, c_nsp)
+
+        (grads_acc, _), (mlm_sums, nsp_sums, corrects, c_mlms, c_nsps) = (
+            jax.lax.scan(body, (zero_grads, rng0), batch))
+        # Metric sums are scalars-per-microbatch: one cheap psum for all.
+        g_mlm, g_nsp, g_correct = jax.lax.psum(
+            (mlm_sums, nsp_sums, corrects.astype(jnp.float32)), axes)
+        losses = g_mlm / c_mlms
+        if next_sentence:
+            losses = losses + g_nsp / c_nsps
+        accs = g_correct / c_mlms
+        # The overlap surface: availability-ordered per-bucket collectives.
+        grads = bucketed_psum(grads_acc, axes)
+        return grads, losses, accs
+
+    def step_fn(state: TrainState, batch: dict):
+        accum_steps = batch["input_ids"].shape[0]
+        step_rng, new_rng = jax.random.split(state.rng)
+        batch_specs = {
+            k: P(*([None, axes] + [None] * (v.ndim - 2)))
+            for k, v in batch.items()}
+        grads, losses, accs = shard_map(
+            local_grads, mesh=mesh, axis_names={"data", "fsdp"},
+            in_specs=(P(), batch_specs, P()),
+            out_specs=(P(), P(), P()))(state.params, batch, step_rng)
+        grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        gnorm = global_norm(grads)
+        metrics = {
+            "loss": jnp.mean(losses),
+            "mlm_accuracy": jnp.mean(accs),
+            "grad_norm": gnorm,
+            # Same sentinel/padding contracts as make_train_step.
+            "finite": (jnp.isfinite(jnp.sum(losses))
+                       & jnp.isfinite(gnorm)).astype(jnp.float32),
+            "real_tokens": jnp.sum(batch["input_mask"]).astype(jnp.float32),
+        }
+        if schedule is not None:
+            metrics["learning_rate"] = schedule(
+                opt_step_count(state.opt_state))
+        if stats_every:
+            from bert_pytorch_tpu.telemetry import model_stats
+
+            metrics["grad_health"] = model_stats.gated_grad_health(
+                state.params, grads, updates,
+                opt_step_count(state.opt_state), stats_every,
+                phase=stats_phase)
+        return TrainState(
+            params=params, opt_state=opt_state, rng=new_rng), metrics
+
+    return step_fn
+
+
 def make_train_step(
     model,
     tx: optax.GradientTransformation,
@@ -220,6 +360,8 @@ def make_train_step(
     loss_scale: bool = False,
     stats_every: int = 0,
     stats_phase: int = 0,
+    mesh=None,
+    overlap_grad_buckets: bool = False,
 ):
     """Build the jitted train step.
 
@@ -267,6 +409,13 @@ def make_train_step(
     state's current scale before differentiating and the wrapper
     unscales, finite-checks, and skips/backs off.
 
+    ``overlap_grad_buckets=True`` (requires ``mesh``; data-parallel
+    first-order path only) replaces the implicit tree-wide gradient
+    reduction with explicit availability-ordered per-bucket psums so the
+    early buckets' collectives overlap the remaining backward
+    (:func:`_make_overlap_step_fn`; parallel/overlap.py). Exact to fp32
+    roundoff against this function's default path.
+
     ``stats_every > 0`` splices the in-jit grad-health block
     (telemetry/model_stats.py: per-layer-group grad/param norms and
     update:weight ratios) into ``metrics["grad_health"]``, lax.cond-gated
@@ -296,6 +445,21 @@ def make_train_step(
         raise ValueError(
             f"kfac_capture_microbatches must be first|all, got "
             f"{kfac_capture_microbatches!r}")
+    if overlap_grad_buckets:
+        if kfac is not None or loss_scale:
+            raise ValueError(
+                "overlap_grad_buckets composes with the plain first-order "
+                "dp path only (no K-FAC, no fp16 loss scaling)")
+        if mesh is None or shardings is None or batch_shardings_ is None:
+            raise ValueError(
+                "overlap_grad_buckets requires mesh + shardings (the "
+                "explicit per-bucket collectives are defined over the "
+                "mesh batch axes)")
+        return _jit_train_step(
+            _make_overlap_step_fn(
+                model, tx, mesh, schedule, next_sentence, max_pred_per_seq,
+                stats_every, stats_phase),
+            shardings, batch_shardings_, None, None)
 
     def loss_fn(params, mb, rng):
         loss, acc, _ = _apply_pretraining_loss(
@@ -811,29 +975,30 @@ def put_batch(batch: dict, shardings: dict) -> dict:
     }
 
 
-def device_prefetch(loader, accum_steps: int, shardings: dict, depth: int = 2):
-    """Yield device-resident stacked batches, keeping ``depth`` in flight.
+def device_prefetch(loader, accum_steps: int, shardings: dict,
+                    depth: int = 2):
+    """Device-resident stacked batches, staged ``depth`` ahead.
 
-    ``device_put`` is an async dispatch, so staging the NEXT batch onto the
-    device while the current step runs hides the H2D transfer and the
-    per-call dispatch latency behind device compute — the role the
-    reference's 4 pinned-memory DataLoader workers + non_blocking copies play
-    on GPU (run_pretraining.py:394-395,539). With this in place the real
-    input pipeline matches the synthetic-resident-batch bench (~400 seq/s,
-    BERT-large phase 1 batch 56 on one v5e).
+    A :class:`~bert_pytorch_tpu.data.device_prefetch.DevicePrefetcher`
+    over the loader: a background thread stacks the microbatches and
+    dispatches ``device_put`` with the step's input shardings, so the H2D
+    transfer (and the per-call dispatch latency) hides behind device
+    compute — the role the reference's 4 pinned-memory DataLoader workers
+    + non_blocking copies play on GPU (run_pretraining.py:394-395,539).
+    With this in place the real input pipeline matches the
+    synthetic-resident-batch bench (~400 seq/s, BERT-large phase 1 batch
+    56 on one v5e), the loop's ``data_wait`` measures only true producer
+    stalls, and the staging share reports as telemetry's ``h2d_wait``
+    sub-phase (attach the returned prefetcher to TrainTelemetry).
+    ``depth <= 0`` stages inline on the loop thread.
     """
-    it = iter(loader)
-    buf: list = []
-    while True:
-        while len(buf) < depth:
-            try:
-                host = next(it)
-            except StopIteration:
-                break
-            buf.append(put_batch(stack_microbatches(host, accum_steps), shardings))
-        if not buf:
-            return
-        yield buf.pop(0)
+    from bert_pytorch_tpu.data.device_prefetch import DevicePrefetcher
+
+    return DevicePrefetcher(
+        iter(loader),
+        stage=lambda host: put_batch(
+            stack_microbatches(host, accum_steps), shardings),
+        depth=depth)
 
 
 def stack_microbatches(batch: dict, accum_steps: int) -> dict:

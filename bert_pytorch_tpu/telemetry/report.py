@@ -148,8 +148,8 @@ def summarize_records(records, name: str = "") -> dict:
         if wall > 0:
             out["wall_s"] = round(wall, 3)
             out["steps_per_sec"] = round(steps / wall, 4)
-        for key in ("step_p50_s", "data_wait_p50_s", "host_p50_s",
-                    "device_p50_s"):
+        for key in ("step_p50_s", "data_wait_p50_s", "h2d_wait_p50_s",
+                    "host_p50_s", "device_p50_s"):
             med = _weighted_median(
                 [(float(w[key]), int(w.get("window_steps", 1)))
                  for w in windows if key in w])
@@ -164,6 +164,22 @@ def summarize_records(records, name: str = "") -> dict:
         p95s = [float(w["step_p95_s"]) for w in tail if "step_p95_s" in w]
         if p95s:
             out["step_p95_s"] = round(max(p95s), 6)
+        # Checkpoint-step accounting (step_timer.py note_ckpt_stall):
+        # steps that carried a save, with the save's host stall folded in.
+        # Aggregated over ALL windows — saves are sparse, and dropping the
+        # first window could drop the only flagged one in a short run.
+        # ``ckpt_step_p95_s`` vs ``step_p95_s`` is the async-checkpoint
+        # acceptance comparison (docs/telemetry.md): blocking saves hold
+        # it at a multiple of the steady-state tail; async saves collapse
+        # it toward parity.
+        ckpt_windows = [w for w in windows if w.get("ckpt_steps")]
+        if ckpt_windows:
+            out["ckpt_steps"] = sum(
+                int(w["ckpt_steps"]) for w in ckpt_windows)
+            vals = [float(w["ckpt_step_p95_s"]) for w in ckpt_windows
+                    if "ckpt_step_p95_s" in w]
+            if vals:
+                out["ckpt_step_p95_s"] = round(max(vals), 6)
         mfus = [(float(w["mfu"]), int(w.get("window_steps", 1)))
                 for w in tail
                 if w.get("mfu") and w.get("mfu_basis") not in (None, "none")]
@@ -314,6 +330,9 @@ def summarize_records(records, name: str = "") -> dict:
 _CHECKS = (
     ("step_p50_s", "step-time p50", "up", "step"),
     ("step_p95_s", "step-time p95", "up", "p95"),
+    # Checkpoint-step tail: the number async checkpoint snapshots exist to
+    # collapse — a revert to blocking saves trips this by name.
+    ("ckpt_step_p95_s", "checkpoint-step p95", "up", "p95"),
     ("steps_per_sec", "throughput (steps/s)", "down", "step"),
     ("training_seq_per_sec", "training seq/s", "down", "step"),
     ("mfu", "MFU", "down", "mfu"),
@@ -388,7 +407,9 @@ def format_summary(summary: dict) -> str:
     lines = [f"== {summary.get('name') or 'telemetry'} "
              f"({summary.get('records', 0)} records)"]
     order = ("steps", "wall_s", "steps_per_sec", "step_p50_s", "step_p95_s",
-             "data_wait_p50_s", "host_p50_s", "device_p50_s", "mfu",
+             "ckpt_steps", "ckpt_step_p95_s",
+             "data_wait_p50_s", "h2d_wait_p50_s", "host_p50_s",
+             "device_p50_s", "mfu",
              "training_seq_per_sec", "padding_efficiency", "tokens_per_s",
              "real_tokens_per_sec",
              "serve_requests", "serve_rps", "serve_latency_p50_ms",

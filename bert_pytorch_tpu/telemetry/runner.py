@@ -39,6 +39,7 @@ Minimal loop integration::
 
 from __future__ import annotations
 
+import contextlib
 import math
 import time
 from typing import Callable, Iterator, Optional
@@ -81,6 +82,7 @@ class TrainTelemetry:
         clock: Callable[[], float] = time.perf_counter,
     ):
         self.is_primary = is_primary
+        self._clock = clock
         # Rank-0 writes the artifacts; other ranks keep a disabled sink so
         # the loop code is rank-agnostic. An already-open handler can be
         # shared in via ``sink`` (the runners register the same handler
@@ -125,6 +127,7 @@ class TrainTelemetry:
         self.watchdog = (HeartbeatWatchdog(watchdog_timeout_s, emit=self.emit)
                         if watchdog_timeout_s and is_primary else None)
         self._loader_stats: Optional[Callable[[], Optional[dict]]] = None
+        self._prefetcher = None
         self._last_sync_target = None
         self.last_step_synced = False
 
@@ -147,6 +150,27 @@ class TrainTelemetry:
         if callable(snapshot):
             self._loader_stats = snapshot
 
+    def attach_prefetcher(self, prefetcher) -> None:
+        """Attribute the H2D share of each step's data wait to the
+        ``h2d_wait`` sub-phase (data/device_prefetch.py DevicePrefetcher),
+        and fold the prefetcher's gauges into window records."""
+        self._prefetcher = prefetcher
+
+    @contextlib.contextmanager
+    def checkpoint_stall(self):
+        """Context manager timing a checkpoint save's host stall; the
+        measured block lands on the step it rode on as a ``ckpt_step``
+        sample (step_timer.py note_ckpt_stall). Wrap every IN-LOOP
+        ``save_checkpoint`` call with it — async saves then show up as
+        checkpoint-step p95 collapsing toward steady-state p95. Only
+        meaningful before :meth:`finish` (the flush there is what emits a
+        stall noted after the last full window)."""
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            self.timer.note_ckpt_stall(self._clock() - t0)
+
     # -- per-step protocol ----------------------------------------------
 
     def timed(self, iterator: Iterator) -> Iterator:
@@ -159,6 +183,11 @@ class TrainTelemetry:
             except StopIteration:
                 return
             self.timer.data_end()
+            if self._prefetcher is not None:
+                # The batch just delivered came through the device
+                # prefetcher; record how much of the wait was H2D staging
+                # (0.0 when the batch was already resident).
+                self.timer.note_h2d(self._prefetcher.pop_h2d_wait_s())
             yield item
 
     def dispatch_done(self) -> None:
@@ -232,6 +261,10 @@ class TrainTelemetry:
                 gauges = self._loader_stats()
                 if gauges:
                     window["loader"] = gauges
+            if self._prefetcher is not None:
+                gauges = self._prefetcher.snapshot()
+                if gauges:
+                    window["prefetch"] = gauges
             self.emit(window)
             self.memory.flush(step)  # one memory record per window
         return window

@@ -139,6 +139,7 @@ def validate_record(rec) -> list:
                             f"loader gauges missing keys {missing}")
                 if kind == "step_window":
                     _check_token_fields(rec, errors)
+                    _check_async_fields(rec, errors)
                 if kind in ("serve_window", "serve_summary"):
                     _check_serve_fields(rec, errors)
                 if kind == "fault":
@@ -168,6 +169,41 @@ def _check_token_fields(rec, errors) -> None:
                 f"padding_efficiency must be in (0, 1], got {eff!r}")
     if "mfu_real_tokens" in rec and "padding_efficiency" not in rec:
         errors.append("mfu_real_tokens requires padding_efficiency")
+
+
+def _check_async_fields(rec, errors) -> None:
+    """Async-hot-path consistency (schema v1 addition; step_timer.py,
+    data/device_prefetch.py, utils/checkpoint.py async_write).
+
+    ``h2d_wait_*`` is a SUB-phase of ``data_wait_*`` — an artifact where
+    the host->device share exceeds the wait it is part of is mismeasured,
+    not just noisy. ``ckpt_steps`` flags how many steps in the window
+    carried a checkpoint save; the ``ckpt_step_*`` percentiles only mean
+    anything over at least one such step."""
+    for suffix in ("p50_s", "p95_s", "max_s"):
+        h2d, data = rec.get(f"h2d_wait_{suffix}"), rec.get(
+            f"data_wait_{suffix}")
+        if h2d is None:
+            continue
+        if not isinstance(h2d, (int, float)) or isinstance(h2d, bool):
+            errors.append(f"h2d_wait_{suffix} must be a number, got {h2d!r}")
+        elif not isinstance(data, (int, float)) or isinstance(data, bool):
+            errors.append(
+                f"h2d_wait_{suffix} requires a numeric data_wait_{suffix}")
+        elif h2d > data:
+            errors.append(
+                f"h2d_wait_{suffix} ({h2d}) exceeds data_wait_{suffix} "
+                f"({data}): h2d_wait is a sub-phase of data_wait")
+    ckpt_steps = rec.get("ckpt_steps")
+    has_ckpt_stats = any(f"ckpt_step_{s}" in rec
+                         for s in ("p50_s", "p95_s", "max_s"))
+    if ckpt_steps is not None:
+        if not isinstance(ckpt_steps, int) or isinstance(ckpt_steps, bool) \
+                or ckpt_steps < 1:
+            errors.append(
+                f"ckpt_steps must be a positive integer, got {ckpt_steps!r}")
+    elif has_ckpt_stats:
+        errors.append("ckpt_step_* percentiles require ckpt_steps")
 
 
 def _check_serve_fields(rec, errors) -> None:

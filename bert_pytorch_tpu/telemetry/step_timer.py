@@ -43,6 +43,15 @@ divided out; "all" — raw budget rate, the pre-packing convention), and
 ``mfu_real_tokens`` (MFU scaled to count only real-token FLOPs as useful
 work, while ``mfu`` keeps reporting hardware occupancy).
 
+Async-hot-path accounting (docs/telemetry.md): with a device prefetcher
+attached, :meth:`note_h2d` records the host->device share of each step's
+data wait and windows carry ``h2d_wait_*`` percentiles (clamped so
+``h2d_wait <= data_wait`` always holds — it is a sub-phase);
+:meth:`note_ckpt_stall` folds a checkpoint save's host stall into the step
+it rode on, and windows with such steps carry ``ckpt_steps`` +
+``ckpt_step_*`` percentiles — the checkpoint-step vs steady-state
+comparison that async checkpointing (utils/checkpoint.py) collapses.
+
 The clock is injectable for tests (``clock=fake``); the timer never calls
 into JAX except through the ``sync`` callable handed to it.
 """
@@ -103,6 +112,9 @@ class StepTimer:
         self._reset_window()
         self._t_data0 = self._t_data1 = self._t_dispatch1 = None
         self._t_device1 = None
+        self._pending_h2d = None
+        self._h2d_attached = False
+        self._last_step_s = 0.0
 
     def _reset_window(self):
         self._data_waits: list = []
@@ -110,6 +122,8 @@ class StepTimer:
         self._devices: list = []
         self._steps: list = []
         self._real_tokens: list = []
+        self._h2ds: list = []
+        self._ckpt_steps_s: list = []
         self._window_t0 = None
 
     # -- per-step marks, in order --------------------------------------
@@ -129,6 +143,24 @@ class StepTimer:
         if self.sync_every == 0:
             return False
         return self._step_index % self.sync_every == 0
+
+    def note_h2d(self, h2d_wait_s: float) -> None:
+        """Record the host->device share of THIS step's data wait (the
+        device-prefetch stage's attribution, data/device_prefetch.py).
+        Called by the telemetry facade right after ``data_end``; clamped
+        to the step's measured data_wait at :meth:`step_done`, so the
+        ``h2d_wait_* <= data_wait_*`` invariant holds by construction."""
+        self._pending_h2d = max(0.0, float(h2d_wait_s))
+        self._h2d_attached = True
+
+    def note_ckpt_stall(self, stall_s: float) -> None:
+        """Record a checkpoint save's host stall, attributed to the step
+        it rode on (the one that just finished). Window records then carry
+        ``ckpt_steps`` and ``ckpt_step_*`` percentiles over step+stall
+        durations — the number async checkpointing exists to collapse
+        toward the steady-state step time (docs/telemetry.md)."""
+        base = self._steps[-1] if self._steps else self._last_step_s
+        self._ckpt_steps_s.append(base + max(0.0, float(stall_s)))
 
     def note_tokens(self, real_tokens: float) -> None:
         """Record one step's REAL (non-pad) token count. Called by the
@@ -168,6 +200,13 @@ class StepTimer:
         if self._t_data0 is None or self._t_data1 is None:
             return None  # marks were skipped (e.g. epoch boundary)
         self._data_waits.append(max(0.0, self._t_data1 - self._t_data0))
+        if self._h2d_attached:
+            # Clamp to the step's own data_wait: h2d is a SUB-phase of it
+            # (steps with no note contribute 0 — the prefetcher reported
+            # nothing to attribute).
+            self._h2ds.append(min(self._pending_h2d or 0.0,
+                                  self._data_waits[-1]))
+            self._pending_h2d = None
         if self._t_dispatch1 is not None:
             self._hosts.append(max(0.0, self._t_dispatch1 - self._t_data1))
             if self._t_device1 is not None and \
@@ -177,6 +216,7 @@ class StepTimer:
             else (self._t_dispatch1 if self._t_dispatch1 is not None
                   else self._t_data1)
         self._steps.append(max(0.0, end - self._t_data0))
+        self._last_step_s = self._steps[-1]
         self._t_data0 = self._t_data1 = self._t_dispatch1 = None
         self._t_device1 = None
         self._step_index += 1
@@ -189,7 +229,9 @@ class StepTimer:
 
     def flush(self, step: int) -> Optional[dict]:
         """Emit a final partial-window record (end of run)."""
-        if not self._steps:
+        if not self._steps and not self._ckpt_steps_s:
+            # A checkpoint stall noted after the last full window rolled
+            # (the end-of-run save) must still land in a record.
             return None
         record = self._window_record(step, None)
         self._reset_window()
@@ -211,9 +253,27 @@ class StepTimer:
             "steps_per_sec": round(n / wall, 4),
         }
         record.update(_stats(self._data_waits, "data_wait"))
+        if self._h2d_attached:
+            # H2D sub-phase of data_wait (device prefetch attribution).
+            # Per-step samples are clamped to that step's data_wait, and the
+            # emitted percentiles are clamped pairwise again so the
+            # h2d_wait <= data_wait invariant survives rounding and
+            # unequal sample counts (schema.py lints it).
+            h2d = _stats(self._h2ds, "h2d_wait")
+            for suffix in ("p50_s", "p95_s", "max_s"):
+                h2d[f"h2d_wait_{suffix}"] = min(
+                    h2d[f"h2d_wait_{suffix}"], record[f"data_wait_{suffix}"])
+            record.update(h2d)
         record.update(_stats(self._hosts, "host"))
         record.update(_stats(self._devices, "device"))
         record.update(_stats(self._steps, "step"))
+        if self._ckpt_steps_s:
+            # Steps a checkpoint save rode on, with the save's host stall
+            # folded in: the checkpoint-step vs steady-state comparison
+            # telemetry-report aggregates (async saves collapse these
+            # toward step_p95_s).
+            record["ckpt_steps"] = len(self._ckpt_steps_s)
+            record.update(_stats(self._ckpt_steps_s, "ckpt_step"))
         record["mfu"], record["mfu_basis"] = self._window_mfu(wall, n)
         if self.seq_per_step:
             record["seq_per_sec"] = round(self.seq_per_step * n / wall, 2)

@@ -19,7 +19,10 @@ Writes are atomic (tmp + rename), and every checkpoint gets a sidecar
 integrity manifest (step, sha256, size; ``utils/integrity.py``) written
 in the same tmp+rename discipline — the load/resume paths verify it and
 walk back across ALL retained checkpoints past corrupt files
-(docs/fault_tolerance.md).
+(docs/fault_tolerance.md). ``async_write=True`` saves snapshot the state
+on device and stream + write from a background thread (one in flight per
+output directory, blob committed before manifest), so a periodic save
+stalls training for the device-side copy only.
 """
 
 from __future__ import annotations
@@ -45,44 +48,81 @@ class CheckpointCorruptError(RuntimeError):
     """Raised by :func:`load_checkpoint` when the sidecar manifest exists
     and the file fails verification (size or sha256 mismatch)."""
 
-# At most one background write in flight (async_write=True): a second save
-# joins the first, so checkpoints land in order and memory holds at most one
-# extra host copy of the state.
-_pending_save: Optional[threading.Thread] = None
-_pending_error: list = []
+# Pending async writes, KEYED BY OUTPUT DIRECTORY: at most one background
+# write in flight per save target — a second save to the same directory
+# joins the first, so that directory's checkpoints land in order and memory
+# holds at most one extra copy of its state. Distinct targets (chaos
+# harness reference + child runs, serve+train in one process, parallel
+# tests) are independent: one slot per directory, never shared.
+_pending_saves: dict = {}   # abspath(output_dir) -> threading.Thread
+_pending_errors: dict = {}  # abspath(output_dir) -> [BaseException]
 _pending_lock = threading.Lock()
 
 
-def _join_pending_save() -> Optional[BaseException]:
-    """Join any in-flight async write; return its error instead of raising
-    (the collective save path must delay the raise until after the gather —
-    see :func:`save_checkpoint`)."""
-    global _pending_save
+def _pending_key(output_dir: str) -> str:
+    return os.path.abspath(output_dir)
+
+
+def _join_pending_save(key: Optional[str] = None) -> Optional[BaseException]:
+    """Join in-flight async writes — all of them, or one directory's —
+    and return the first recorded error instead of raising (the collective
+    save path must delay the raise until after the gather — see
+    :func:`save_checkpoint`)."""
     with _pending_lock:
-        thread = _pending_save
-        _pending_save = None
-    if thread is not None:
+        if key is None:
+            threads = list(_pending_saves.values())
+            _pending_saves.clear()
+        else:
+            thread = _pending_saves.pop(key, None)
+            threads = [thread] if thread is not None else []
+    for thread in threads:
         thread.join()
     with _pending_lock:
-        if _pending_error:
-            error = _pending_error.pop()
-            _pending_error.clear()
-            return error
-    return None
+        if key is None:
+            errors = [(k, e) for k in list(_pending_errors)
+                      for e in _pending_errors.pop(k)]
+        else:
+            errors = [(key, e) for e in _pending_errors.pop(key, [])]
+    for where, extra in errors[1:]:
+        # Only the first error propagates as the raise; the per-directory
+        # registry can genuinely hold several — name the rest instead of
+        # silently dropping a second target's lost checkpoint.
+        warnings.warn(
+            f"additional async checkpoint write failure for {where}: "
+            f"{type(extra).__name__}: {extra}")
+    return errors[0][1] if errors else None
 
 
-def wait_for_pending_save() -> None:
-    """Block until any in-flight async checkpoint write has finished; raise
-    if it failed.
+def _start_pending_save(key: str, step: int, work: Callable[[], None]) -> None:
+    def run():
+        try:
+            work()
+        except BaseException as e:  # surfaced by wait_for_pending_save
+            with _pending_lock:
+                _pending_errors.setdefault(key, []).append(e)
+
+    thread = threading.Thread(target=run, name=f"ckpt-write-{step}",
+                              daemon=False)
+    with _pending_lock:
+        _pending_saves[key] = thread
+    thread.start()
+
+
+def wait_for_pending_save(output_dir: Optional[str] = None) -> None:
+    """Block until in-flight async checkpoint writes have finished; raise
+    if any failed. With ``output_dir`` joins only that save target's write;
+    the default joins ALL of them (what every pre-exit guard wants).
 
     Call before reading checkpoints back, at end of training, and before
     process exit — an unjoined write may otherwise be truncated by
     interpreter teardown (the write itself is atomic, so a killed process
     loses only the newest checkpoint, never corrupts one). A failed write
-    (disk full, permissions) re-raises here / at the next save rather than
-    letting training run on while no checkpoints land.
+    (disk full, permissions) re-raises here / at the next save to the same
+    directory rather than letting training run on while no checkpoints
+    land.
     """
-    error = _join_pending_save()
+    key = None if output_dir is None else _pending_key(output_dir)
+    error = _join_pending_save(key)
     if error is not None:
         raise RuntimeError("async checkpoint write failed") from error
 
@@ -285,6 +325,39 @@ def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(get, tree)
 
 
+# Jitted identity: the device-side snapshot primitive. jit never aliases an
+# un-donated input into an output, so every leaf comes back as a FRESH
+# buffer with its sharding preserved — the next train step can donate the
+# live state without invalidating the snapshot. One dispatch for the whole
+# tree; returns before the copies complete (the background fetch blocks).
+_snapshot_identity = None
+
+
+def _device_snapshot(tree: Any) -> Any:
+    """Donation-safe copy of ``tree``: jax.Array leaves are copied ON
+    DEVICE (cheap D2D, async dispatch) and their device->host streams are
+    kicked off immediately (``copy_to_host_async``); numpy leaves are
+    host-copied (the caller may reuse those buffers too); everything else
+    passes through by value. The returned tree is owned by the caller —
+    safe to fetch, serialize, and write from a background thread while
+    training overwrites the source state."""
+    global _snapshot_identity
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    device_idx = [i for i, x in enumerate(leaves) if isinstance(x, jax.Array)]
+    if device_idx:
+        if _snapshot_identity is None:
+            _snapshot_identity = jax.jit(lambda xs: xs)
+        copies = _snapshot_identity([leaves[i] for i in device_idx])
+        for i, copy in zip(device_idx, copies):
+            leaves[i] = copy
+            try:
+                copy.copy_to_host_async()  # start D2H behind the dispatch
+            except Exception:
+                pass  # backend without async host copies: device_get later
+    leaves = [x.copy() if isinstance(x, np.ndarray) else x for x in leaves]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def _write_and_prune(state: Any, output_dir: str, step: int, keep: int) -> None:
     blob = serialization.msgpack_serialize(state)
     path = checkpoint_path(output_dir, step)
@@ -327,49 +400,73 @@ def save_checkpoint(
     ``ckpt_{step}.msgpack``. Main-process-only; prunes to the newest ``keep``
     checkpoints (reference cadence + retention, run_pretraining.py:496-528).
 
-    ``async_write=True`` fetches the state to host synchronously (it must be
-    snapshotted before the donated train-state buffers are overwritten by the
-    next step), then serializes and writes in a background thread so the
-    train loop only pays for the device->host gather, not the multi-second
-    msgpack+disk write of a BERT-large state. At most one write is in
-    flight; a newer save (or :func:`wait_for_pending_save`) joins it first.
+    ``async_write=True`` snapshots the live state ON DEVICE (a jitted
+    identity copy — cheap, donation-safe, sharding-preserving) and returns
+    as soon as that dispatch and the device->host streams are enqueued; a
+    background thread then fetches the snapshot to host, serializes, and
+    writes blob-then-manifest. The train loop pays only the device-side
+    copy, not the D2H fetch or the multi-second msgpack+disk write of a
+    BERT-large state. Errors surface at the next save to the same
+    directory or at :func:`wait_for_pending_save`. At most one write per
+    output directory is in flight; a newer save joins it first. Multi-host
+    SHARDED state (non-addressable leaves) still gathers synchronously —
+    the gather is a collective every process must join at the same point —
+    and only the serialize+write goes to the background.
     """
-    global _pending_save
     # Multi-host sharded state: the gather below is a COLLECTIVE, so every
     # process must run it (with the same tree) before non-main processes
     # bail out. Single-host / replicated state skips straight to rank 0.
     collective = _needs_collective_gather(contents)
     if not collective and not is_main_process():
         return None
-    # Join any in-flight write BEFORE gathering the next snapshot — gathering
-    # first would hold two multi-GB host copies exactly when the disk is
-    # slow (the one-extra-copy invariant of the module comment). A failed
-    # write re-raises only AFTER the gather: raising rank-0-only first would
-    # abandon a collective the other ranks have already entered, turning a
-    # clean disk error into a whole-job rendezvous hang.
-    pending_error = _join_pending_save()
+    key = _pending_key(output_dir)
+    # Join any in-flight write to THIS directory before snapshotting the
+    # next state — so its checkpoints land in order and memory holds at
+    # most one extra copy per save target. A failed previous write
+    # re-raises only AFTER this save's own work: the CURRENT state is the
+    # one worth persisting (an emergency checkpoint must not be
+    # sacrificed to report a stale periodic-write error — the disk may
+    # have recovered), and on the collective path raising rank-0-only
+    # before the gather would abandon a collective the other ranks have
+    # already entered, turning a clean disk error into a whole-job
+    # rendezvous hang.
+    pending_error = _join_pending_save(key)
+
+    def raise_pending():
+        if pending_error is not None:
+            raise RuntimeError(
+                "async checkpoint write failed") from pending_error
+
+    if async_write and not collective:
+        # Device-side snapshot; the background thread owns the only
+        # reference, so the device copies free as soon as their host
+        # fetch lands (the box.pop() below drops the closure's handle).
+        box = [_device_snapshot(contents)]
+        os.makedirs(output_dir, exist_ok=True)
+        path = checkpoint_path(output_dir, step)
+
+        def fetch_and_write():
+            snapshot = box.pop()
+            state = serialization.to_state_dict(_to_host(snapshot))
+            del snapshot
+            _write_and_prune(state, output_dir, step, keep)
+
+        _start_pending_save(key, step, fetch_and_write)
+        raise_pending()
+        return path
+
     state = serialization.to_state_dict(_to_host(contents))
-    if pending_error is not None:
-        raise RuntimeError("async checkpoint write failed") from pending_error
     if not is_main_process():
         return None
     os.makedirs(output_dir, exist_ok=True)
     path = checkpoint_path(output_dir, step)
     if not async_write:
         _write_and_prune(state, output_dir, step, keep)
+        raise_pending()
         return path
-
-    def run():
-        try:
-            _write_and_prune(state, output_dir, step, keep)
-        except BaseException as e:  # surfaced by wait_for_pending_save
-            with _pending_lock:
-                _pending_error.append(e)
-
-    with _pending_lock:
-        _pending_save = threading.Thread(
-            target=run, name=f"ckpt-write-{step}", daemon=False)
-        _pending_save.start()
+    _start_pending_save(
+        key, step, lambda: _write_and_prune(state, output_dir, step, keep))
+    raise_pending()
     return path
 
 

@@ -29,7 +29,7 @@ import optax
 
 from bert_pytorch_tpu import optim, telemetry
 from bert_pytorch_tpu.config import BertConfig
-from bert_pytorch_tpu.data import glue
+from bert_pytorch_tpu.data import DevicePrefetcher, glue
 from bert_pytorch_tpu.data.tokenization import (
     get_bpe_tokenizer,
     get_wordpiece_tokenizer,
@@ -68,6 +68,17 @@ def parse_arguments(argv=None):
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
     parser.add_argument("--skip_eval", action="store_true")
+    parser.add_argument("--save_steps", type=int, default=0,
+                        help="periodic checkpoint cadence (optimizer "
+                             "steps): saves ride the ASYNC write path "
+                             "(device snapshot + background write, "
+                             "utils/checkpoint.py) so the loop never "
+                             "blocks on disk; the final/emergency "
+                             "checkpoint stays synchronous. 0 disables")
+    # device prefetch: stage batches onto device ahead of the loop
+    # (data/device_prefetch.py; one flag shared by every runner)
+    from bert_pytorch_tpu.data import device_prefetch as dp_cli
+    dp_cli.add_cli_args(parser)
     # telemetry (docs/telemetry.md)
     # telemetry: canonical flag set shared by every runner; this loop
     # fetches the loss every step anyway, so per-step sync is free
@@ -238,11 +249,20 @@ def main(args):
     # the signal; the default disposition would kill the write mid-file)
     # and are restored in the finally even on exceptions.
     stop = preemption.GracefulStop().install()
+    prefetcher = None
     try:
         for epoch in range(args.epochs):
             losses = []
-            for batch, valid in tele.timed(
-                    batches(arrays["train"], args.batch_size, True, rng)):
+            # Device prefetch: the batch is staged onto device by a
+            # background thread while the previous step runs; data_wait
+            # then measures only featurization stalls, with the staging
+            # share attributed to the h2d_wait sub-phase.
+            prefetcher = DevicePrefetcher(
+                batches(arrays["train"], args.batch_size, True, rng),
+                stage=lambda bv: (jax.device_put(bv[0]), bv[1]),
+                depth=args.device_prefetch)
+            tele.attach_prefetcher(prefetcher)
+            for batch, valid in tele.timed(iter(prefetcher)):
                 key, sub = jax.random.split(key)
                 tele.profiler.maybe_start(global_step + 1)
                 with tele.profiler.annotation(global_step + 1):
@@ -253,8 +273,18 @@ def main(args):
                 tele.step_done(global_step, metrics)
                 losses.append(float(metrics["loss"]))
                 seen += int(valid.sum())
+                if args.save_steps and args.output_dir \
+                        and global_step % args.save_steps == 0:
+                    # Periodic save, async: the loop pays the device-side
+                    # snapshot only; the write overlaps training
+                    # (wait_for_pending_save below joins it before exit).
+                    with tele.checkpoint_stall():
+                        ckpt.save_checkpoint(
+                            args.output_dir, global_step,
+                            {"model": params}, async_write=True)
                 if stop.requested:
                     break
+            prefetcher.close()
             if losses:
                 logger.info(
                     f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
@@ -285,18 +315,23 @@ def main(args):
             os.makedirs(args.output_dir, exist_ok=True)
             # Stamped with the step actually REACHED — a preempted run's
             # emergency checkpoint must not masquerade as a fully-trained
-            # ckpt_<total_steps> artifact.
+            # ckpt_<total_steps> artifact. SYNCHRONOUS on purpose: this is
+            # the durability write before exit, and it joins any in-flight
+            # periodic async write to the same directory first. (No
+            # checkpoint_stall wrapper: telemetry is already flushed —
+            # only in-loop saves feed the ckpt_step windows.)
             ckpt.save_checkpoint(
                 args.output_dir, global_step, {"model": params})
             with open(os.path.join(args.output_dir,
                                    f"eval_results_{args.task}.json"),
                       "w") as f:
                 json.dump(results, f, indent=2)
-        # PR-5 audit: no exit until any in-flight async checkpoint write
-        # has landed — the save above is synchronous today, but this guard
-        # keeps a fast exit from ever truncating one if it goes async.
+        # No exit until any in-flight async periodic write has landed — a
+        # fast exit must never truncate one (docs/fault_tolerance.md).
         ckpt.wait_for_pending_save()
     finally:
+        if prefetcher is not None:
+            prefetcher.close()
         stop.restore()
     logger.close()
     return results

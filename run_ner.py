@@ -25,6 +25,7 @@ import optax
 
 from bert_pytorch_tpu import optim, telemetry
 from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.data import DevicePrefetcher
 from bert_pytorch_tpu.data.ner_dataset import NERDataset
 from bert_pytorch_tpu.data.tokenization import (
     get_bpe_tokenizer,
@@ -65,6 +66,14 @@ def parse_arguments(argv=None):
                         help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
+    parser.add_argument("--save_steps", type=int, default=0,
+                        help="periodic checkpoint cadence (optimizer "
+                             "steps): async writes (device snapshot + "
+                             "background write); final/emergency stays "
+                             "synchronous. 0 disables")
+    # device prefetch (data/device_prefetch.py; shared runner flag)
+    from bert_pytorch_tpu.data import device_prefetch as dp_cli
+    dp_cli.add_cli_args(parser)
     # telemetry (docs/telemetry.md) — this runner has no output dir, so the
     # file sinks are opt-in
     # telemetry: canonical flag set shared by every runner; this loop
@@ -221,12 +230,17 @@ def main(args):
     # Handlers stay installed THROUGH the checkpoint write below (a
     # grace-period re-delivery must not kill it); restored in the finally.
     stop = preemption.GracefulStop().install()
+    prefetcher = None
     try:
         for epoch in range(args.epochs):
             t0 = time.perf_counter()
             losses = []
-            for batch in tele.timed(
-                    batches(datasets["train"], args.batch_size, True, rng)):
+            # Device prefetch + h2d_wait attribution (run_glue pattern).
+            prefetcher = DevicePrefetcher(
+                batches(datasets["train"], args.batch_size, True, rng),
+                stage=jax.device_put, depth=args.device_prefetch)
+            tele.attach_prefetcher(prefetcher)
+            for batch in tele.timed(iter(prefetcher)):
                 key, sub = jax.random.split(key)
                 tele.profiler.maybe_start(global_step + 1)
                 with tele.profiler.annotation(global_step + 1):
@@ -236,8 +250,16 @@ def main(args):
                 global_step += 1
                 tele.step_done(global_step, metrics)
                 losses.append(float(metrics["loss"]))
+                if args.save_steps and args.output_dir \
+                        and global_step % args.save_steps == 0:
+                    # Periodic async save (joined before exit below).
+                    with tele.checkpoint_stall():
+                        ckpt.save_checkpoint(
+                            args.output_dir, global_step,
+                            {"model": params}, async_write=True)
                 if stop.requested:
                     break
+            prefetcher.close()
             if stop.requested:
                 logger.info(
                     f"termination signal ({stop.signal_name}) received; "
@@ -261,12 +283,15 @@ def main(args):
         tele.finish(global_step)
         if args.output_dir:
             os.makedirs(args.output_dir, exist_ok=True)
+            # Synchronous on purpose: the durability write before exit;
+            # joins any in-flight periodic async write first.
             ckpt.save_checkpoint(
                 args.output_dir, global_step, {"model": params})
-        # PR-5 audit: no exit until any in-flight async checkpoint write
-        # has landed (synchronous today; the guard survives async saves).
+        # No exit until any in-flight async periodic write has landed.
         ckpt.wait_for_pending_save()
     finally:
+        if prefetcher is not None:
+            prefetcher.close()
         stop.restore()
     logger.close()
     return results

@@ -111,9 +111,34 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                         help="optimizer steps between validation passes")
     parser.add_argument("--eval_batches", type=int, default=16,
                         help="validation batches per pass")
+    # device prefetch (data/device_prefetch.py): keep N batches resident
+    # on device so data_wait measures only true producer stalls — the one
+    # flag shared by every runner
+    from bert_pytorch_tpu.data import device_prefetch as dp_cli
+    dp_cli.add_cli_args(parser)
+    # overlapped data-parallel gradient collectives (parallel/overlap.py):
+    # bucket the backward's psum so early layer groups' all-reduces hide
+    # under the remaining backward compute (ZeRO lineage, PAPERS.md)
+    parser.add_argument("--overlap_grad_reduce", action="store_true",
+                        help="explicit availability-ordered per-bucket "
+                             "gradient collectives instead of the implicit "
+                             "tree-wide reduction (dp strategy, first-order "
+                             "optimizers; numerically exact vs the default "
+                             "path at fp32 roundoff)")
     # checkpoint / logging cadence
     parser.add_argument("--num_steps_per_checkpoint", type=int, default=200)
     parser.add_argument("--keep_checkpoints", type=int, default=3)
+    parser.add_argument("--checkpoint_write", type=str, default="async",
+                        choices=["async", "sync"],
+                        help="periodic checkpoint write mode: 'async' "
+                             "snapshots the state on device and writes "
+                             "from a background thread (the step pays only "
+                             "the device-side copy; utils/checkpoint.py), "
+                             "'sync' blocks the step for the full "
+                             "fetch+serialize+write — the before/after the "
+                             "BENCH_ASYNC leg and checkpoint-step p95 "
+                             "telemetry compare. Final/emergency "
+                             "checkpoints are always synchronous")
     parser.add_argument("--skip_final_checkpoint", action="store_true",
                         help="skip the end-of-run checkpoint write. For "
                              "benchmark/capture runs whose artifact is the "
@@ -381,6 +406,15 @@ def setup_training(args):
         raise ValueError(
             f"--pack_sequences is not supported with --parallel_strategy "
             f"{args.parallel_strategy}; use dp/fsdp/tp/tp_fsdp")
+    if args.overlap_grad_reduce and (
+            args.parallel_strategy != "dp" or args.kfac
+            or args.dtype == "float16"):
+        # The bucketed collectives are defined over the batch axes with
+        # fully-replicated params: sharded-param strategies, K-FAC's
+        # fused capture, and the fp16 scaler keep the default path.
+        raise ValueError(
+            "--overlap_grad_reduce requires --parallel_strategy dp with a "
+            "first-order optimizer (no --kfac) and bf16/fp32")
     if (args.parallel_strategy == "sp" and mesh.shape["seq"] > 1
             and args.attention_backend != "ring"):
         # sp exists to avoid O(S^2) dense attention; never silently densify
@@ -755,7 +789,9 @@ def main(args) -> dict:
                 kfac_capture_microbatches=args.kfac_capture_microbatches,
                 loss_scale=fp16,
                 stats_every=telemetry.stats_every(args),
-                stats_phase=stats_phase)
+                stats_phase=stats_phase,
+                mesh=mesh,
+                overlap_grad_buckets=args.overlap_grad_reduce)
 
         # Telemetry (docs/telemetry.md): JSONL sink shared with the logger,
         # step-time decomposition windows, profiler trace window, compile
@@ -915,11 +951,22 @@ def main(args) -> dict:
         # the default disposition would kill the write mid-file. The
         # finally also un-installs them on exceptions (in-process
         # callers must not inherit a handler over a dead flag).
+        prefetcher = None
         try:
             while not done:
                 sampler.set_epoch(epoch)
-                for batch in tele.timed(iter(pretrain.device_prefetch(
-                        loader, args.accumulation_steps, b_shardings))):
+                # Device prefetch (data/device_prefetch.py): a background
+                # thread keeps --device_prefetch batches resident on
+                # device, so data_wait below measures only true producer
+                # stalls and the staging share reports as the h2d_wait
+                # sub-phase. One prefetcher per epoch (the iterator is
+                # one-shot); closed in the finally so an abandoned epoch
+                # never leaks its thread.
+                prefetcher = pretrain.device_prefetch(
+                    loader, args.accumulation_steps, b_shardings,
+                    depth=args.device_prefetch)
+                tele.attach_prefetcher(prefetcher)
+                for batch in tele.timed(iter(prefetcher)):
                     # Profiler window (steps are step_in_run indices; this
                     # iteration runs step step_in_run + 1).
                     tele.profiler.maybe_start(step_in_run + 1)
@@ -1006,11 +1053,18 @@ def main(args) -> dict:
                                     "epoch": epoch}
                         if kfac_state is not None:
                             contents["preconditioner"] = kfac_state
-                        # Async: the loop pays only the device->host gather; the
-                        # msgpack+disk write overlaps the next training steps.
-                        ckpt.save_checkpoint(
-                            args.model_output_dir, save_step, contents,
-                            keep=args.keep_checkpoints, async_write=True)
+                        # Async (default): the loop pays only the
+                        # device-side snapshot copy; the D2H fetch +
+                        # msgpack + disk write overlap the next training
+                        # steps. The stall context flags this step's
+                        # duration (+ the save block) as a ckpt_step in
+                        # the telemetry windows either way — what the
+                        # checkpoint-step p95 comparison reads.
+                        with tele.checkpoint_stall():
+                            ckpt.save_checkpoint(
+                                args.model_output_dir, save_step, contents,
+                                keep=args.keep_checkpoints,
+                                async_write=args.checkpoint_write == "async")
                         logger.info(f"Saved checkpoint at step {save_step}")
 
                     if fault_plan.active:
@@ -1086,9 +1140,14 @@ def main(args) -> dict:
                             "epoch": epoch}
                 if kfac_state is not None:
                     contents["preconditioner"] = kfac_state
-                ckpt.save_checkpoint(
-                    args.model_output_dir, save_step, contents,
-                    keep=args.keep_checkpoints)
+                # Final/emergency checkpoint stays SYNCHRONOUS: durability
+                # before exit is the point (docs/fault_tolerance.md), and
+                # save_checkpoint joins this directory's in-flight async
+                # write first so checkpoints land in order.
+                with tele.checkpoint_stall():
+                    ckpt.save_checkpoint(
+                        args.model_output_dir, save_step, contents,
+                        keep=args.keep_checkpoints)
             ckpt.wait_for_pending_save()
             # Flush the partial telemetry window + final heartbeat + run
             # summary (the JSONL sink itself is closed by logger.close()).
@@ -1108,6 +1167,8 @@ def main(args) -> dict:
             tele.finish(global_step, summary=run_summary)
             logger.close()
         finally:
+            if prefetcher is not None:
+                prefetcher.close()
             stop.restore()
         return {"global_step": global_step,
                 "training_seq_per_sec": seq_per_sec,

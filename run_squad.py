@@ -96,6 +96,15 @@ def parse_args(argv=None):
     # telemetry: canonical flag set shared by every runner
     # (telemetry/cli.py; docs/telemetry.md)
     telemetry.add_cli_args(parser)
+    # device prefetch (data/device_prefetch.py; shared runner flag)
+    from bert_pytorch_tpu.data import device_prefetch as dp_cli
+    dp_cli.add_cli_args(parser)
+    parser.add_argument("--save_steps", type=int, default=0,
+                        help="periodic checkpoint cadence (optimizer "
+                             "steps): async writes (device snapshot + "
+                             "background write); the end-of-train/"
+                             "emergency checkpoint stays synchronous. "
+                             "0 disables")
     parser.add_argument("--json_summary", type=str, default="squad_log.json")
     parser.add_argument("--eval_script", type=str, default=None)
     parser.add_argument("--skip_checkpoint", action="store_true")
@@ -340,15 +349,27 @@ def main(args):
             losses = []
 
             def epoch_batches():
-                """Featurize + device_put one epoch's batches; host time
-                spent here is telemetry's data_wait (tele.timed)."""
+                """Featurize one epoch's HOST batches; the device
+                prefetcher below stages them onto device ahead of the
+                loop, so data_wait measures featurization stalls only
+                (staging reports as the h2d_wait sub-phase)."""
                 for i in range(0, n - args.train_batch_size + 1,
                                args.train_batch_size):
                     idx = order[i:i + args.train_batch_size]
                     feats = [train_features[j] for j in idx]
-                    arrays = features_to_arrays(feats, True)
-                    yield {k: jax.device_put(v, batch_sh[k])
-                           for k, v in arrays.items()}
+                    yield features_to_arrays(feats, True)
+
+            from bert_pytorch_tpu.data import DevicePrefetcher
+
+            def epoch_prefetcher():
+                p = DevicePrefetcher(
+                    epoch_batches(),
+                    stage=lambda arrays: {
+                        k: jax.device_put(v, batch_sh[k])
+                        for k, v in arrays.items()},
+                    depth=args.device_prefetch)
+                tele.attach_prefetcher(p)
+                return p
 
             # Graceful preemption (docs/fault_tolerance.md): stop at the
             # next step boundary, checkpoint via the normal end-of-train
@@ -357,9 +378,11 @@ def main(args):
             # write below (a grace-period re-delivery must not kill it);
             # restored in the finally even on exceptions.
             stop = preemption.GracefulStop().install()
+            prefetcher = None
             try:
                 while global_step < total_steps and not stop.requested:
-                    for batch in tele.timed(epoch_batches()):
+                    prefetcher = epoch_prefetcher()
+                    for batch in tele.timed(iter(prefetcher)):
                         rng, sub = jax.random.split(rng)
                         tele.profiler.maybe_start(global_step + 1)
                         with tele.profiler.annotation(global_step + 1):
@@ -376,8 +399,21 @@ def main(args):
                                        step_loss=float(loss),
                                        samples_per_second=seqs / (
                                            time.perf_counter() - t_start))
+                        if args.save_steps and not args.skip_checkpoint \
+                                and is_main_process() \
+                                and global_step % args.save_steps == 0:
+                            # Periodic async save (device snapshot +
+                            # background write; joined before the final
+                            # write / predict reads below).
+                            with tele.checkpoint_stall():
+                                ckpt.save_checkpoint(
+                                    args.output_dir, global_step,
+                                    {"model": params,
+                                     "config": config.to_dict()},
+                                    keep=1, async_write=True)
                         if global_step >= total_steps or stop.requested:
                             break
+                    prefetcher.close()
                     epoch += 1
                     order = np.random.permutation(n)
                 if stop.requested:
@@ -397,16 +433,21 @@ def main(args):
 
                 if not args.skip_checkpoint and is_main_process():
                     # A preemption stop must still land this write — it IS
-                    # the emergency checkpoint for this runner.
+                    # the emergency checkpoint for this runner. Synchronous
+                    # on purpose; it joins any in-flight periodic async
+                    # write to the same directory first, so checkpoints
+                    # land in order. (No checkpoint_stall wrapper:
+                    # telemetry is already flushed.)
                     ckpt.save_checkpoint(args.output_dir, global_step,
                                          {"model": params,
                                           "config": config.to_dict()},
                                          keep=1)
-                # PR-5 audit: join any in-flight async write BEFORE the
-                # predict path below reads checkpoints back / the process
-                # exits (synchronous today; the guard survives async).
+                # Join any in-flight async write BEFORE the predict path
+                # below reads checkpoints back / the process exits.
                 ckpt.wait_for_pending_save()
             finally:
+                if prefetcher is not None:
+                    prefetcher.close()
                 stop.restore()
 
         if args.do_predict and not summary.get("terminated_by_signal"):

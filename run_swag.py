@@ -37,6 +37,7 @@ from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
 from bert_pytorch_tpu.utils import preemption
 from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
+from bert_pytorch_tpu.data import DevicePrefetcher
 from run_glue import batches  # padded fixed-shape batches + valid mask
 
 
@@ -62,6 +63,14 @@ def parse_arguments(argv=None):
                         help="persistent XLA compilation cache directory; empty disables")
     parser.add_argument("--dtype", type=str, default="bfloat16",
                         choices=["bfloat16", "float32"])
+    parser.add_argument("--save_steps", type=int, default=0,
+                        help="periodic checkpoint cadence (optimizer "
+                             "steps): async writes (device snapshot + "
+                             "background write); final/emergency stays "
+                             "synchronous. 0 disables")
+    # device prefetch (data/device_prefetch.py; shared runner flag)
+    from bert_pytorch_tpu.data import device_prefetch as dp_cli
+    dp_cli.add_cli_args(parser)
     # telemetry (docs/telemetry.md)
     # telemetry: canonical flag set shared by every runner; this loop
     # fetches the loss every step anyway, so per-step sync is free
@@ -195,11 +204,17 @@ def main(args):
     # checkpoint write below (a grace-period re-delivery must not kill
     # it); restored in the finally even on exceptions.
     stop = preemption.GracefulStop().install()
+    prefetcher = None
     try:
         for epoch in range(args.epochs):
             losses = []
-            for batch, valid in tele.timed(
-                    batches(arrays["train"], args.batch_size, True, rng)):
+            # Device prefetch + h2d_wait attribution (run_glue pattern).
+            prefetcher = DevicePrefetcher(
+                batches(arrays["train"], args.batch_size, True, rng),
+                stage=lambda bv: (jax.device_put(bv[0]), bv[1]),
+                depth=args.device_prefetch)
+            tele.attach_prefetcher(prefetcher)
+            for batch, valid in tele.timed(iter(prefetcher)):
                 key, sub = jax.random.split(key)
                 tele.profiler.maybe_start(global_step + 1)
                 with tele.profiler.annotation(global_step + 1):
@@ -210,8 +225,16 @@ def main(args):
                 tele.step_done(global_step, metrics)
                 losses.append(float(metrics["loss"]))
                 seen += int(valid.sum())
+                if args.save_steps and args.output_dir \
+                        and global_step % args.save_steps == 0:
+                    # Periodic async save (joined before exit below).
+                    with tele.checkpoint_stall():
+                        ckpt.save_checkpoint(
+                            args.output_dir, global_step,
+                            {"model": params}, async_write=True)
                 if stop.requested:
                     break
+            prefetcher.close()
             if losses:
                 logger.info(
                     f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
@@ -240,15 +263,19 @@ def main(args):
         if args.output_dir:
             os.makedirs(args.output_dir, exist_ok=True)
             # Stamped with the step actually REACHED (see run_glue.py).
+            # Synchronous on purpose: the durability write before exit;
+            # joins any in-flight periodic async write first. (No
+            # checkpoint_stall wrapper: telemetry is already flushed.)
             ckpt.save_checkpoint(
                 args.output_dir, global_step, {"model": params})
             with open(os.path.join(args.output_dir,
                                    "eval_results_swag.json"), "w") as f:
                 json.dump(results, f, indent=2)
-        # PR-5 audit: no exit until any in-flight async checkpoint write
-        # has landed (synchronous today; the guard survives async saves).
+        # No exit until any in-flight async periodic write has landed.
         ckpt.wait_for_pending_save()
     finally:
+        if prefetcher is not None:
+            prefetcher.close()
         stop.restore()
     logger.close()
     return results

@@ -1,0 +1,423 @@
+"""Async hot-path suite (ISSUE 6; docs/telemetry.md "async the hot path").
+
+Covers the three overlapped phases end to end on CPU:
+
+* async checkpointing — per-directory pending-save keying, device-snapshot
+  donation safety, and the acceptance comparison: with a deliberately
+  large injected state, checkpoint-step p95 collapses from a multiple of
+  the steady-state step p95 (blocking writes) to within 20% of it (async
+  writes), gated through the telemetry-report regression path by name;
+* double-buffered device prefetch — a fast producer drives data_wait p50
+  to ~0, a slow producer still attributes the stall to data_wait, and a
+  slow staging function reports as the h2d_wait sub-phase (always <= the
+  data_wait it is part of — the schema lint invariant);
+* overlapped data-parallel gradients — the bucketed explicit-psum step
+  (pretrain.make_train_step(overlap_grad_buckets=True)) is numerically
+  identical to the implicit-reduction step at fp32 tolerance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bert_pytorch_tpu.data.device_prefetch import DevicePrefetcher
+from bert_pytorch_tpu.telemetry import schema as tschema
+from bert_pytorch_tpu.telemetry import report as treport
+from bert_pytorch_tpu.telemetry.runner import TrainTelemetry
+from bert_pytorch_tpu.telemetry.step_timer import StepTimer
+from bert_pytorch_tpu.utils import checkpoint as ckpt
+from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+
+# ---------------------------------------------------------------------------
+# async checkpointing: pending-save registry + device snapshot
+
+
+def test_pending_saves_keyed_per_directory(tmp_path, monkeypatch):
+    """Two save targets in one process must not share a pending slot: a
+    wait on one directory leaves the other's write untouched, and a
+    failure surfaces for its own directory only."""
+    import threading
+
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    release_b = threading.Event()
+    real_write = ckpt._write_and_prune
+
+    def gated_write(state, output_dir, step, keep):
+        if output_dir == dir_b:
+            assert release_b.wait(10.0)
+        real_write(state, output_dir, step, keep)
+
+    monkeypatch.setattr(ckpt, "_write_and_prune", gated_write)
+    state = {"model": {"w": np.ones((8,), np.float32)}}
+    ckpt.save_checkpoint(dir_a, 1, state, async_write=True)
+    ckpt.save_checkpoint(dir_b, 2, state, async_write=True)
+    # Joining A must complete without B's gate ever opening.
+    ckpt.wait_for_pending_save(dir_a)
+    assert ckpt.find_resume_step(dir_a) == 1
+    assert ckpt.find_resume_step(dir_b) is None  # still gated
+    release_b.set()
+    ckpt.wait_for_pending_save()  # joins ALL remaining
+    assert ckpt.find_resume_step(dir_b) == 2
+
+
+def test_pending_save_error_stays_with_its_directory(tmp_path, monkeypatch):
+    dir_a, dir_b = str(tmp_path / "a"), str(tmp_path / "b")
+    real_write = ckpt._write_and_prune
+
+    def failing_for_a(state, output_dir, step, keep):
+        if output_dir == dir_a:
+            raise OSError("disk full")
+        real_write(state, output_dir, step, keep)
+
+    monkeypatch.setattr(ckpt, "_write_and_prune", failing_for_a)
+    state = {"model": {"w": np.ones((8,), np.float32)}}
+    ckpt.save_checkpoint(dir_a, 1, state, async_write=True)
+    ckpt.save_checkpoint(dir_b, 1, state, async_write=True)
+    ckpt.wait_for_pending_save(dir_b)  # B is healthy: no raise
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        ckpt.wait_for_pending_save(dir_a)
+    ckpt.wait_for_pending_save()  # error consumed; all joined
+
+
+def test_failed_async_write_does_not_block_emergency_save(tmp_path,
+                                                          monkeypatch):
+    """A stale periodic-write failure must not cost the CURRENT state:
+    the next (emergency) sync save writes its checkpoint FIRST, then
+    re-raises the background failure — durability before diagnostics
+    (docs/fault_tolerance.md)."""
+    real_write = ckpt._write_and_prune
+
+    def failing_once(state, output_dir, step, keep):
+        if step == 1:
+            raise OSError("disk full")
+        real_write(state, output_dir, step, keep)
+
+    monkeypatch.setattr(ckpt, "_write_and_prune", failing_once)
+    state = {"model": {"w": np.ones((8,), np.float32)}}
+    ckpt.save_checkpoint(str(tmp_path), 1, state, async_write=True)
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        ckpt.save_checkpoint(str(tmp_path), 2, state)  # emergency: sync
+    # The raise reported the OLD failure; the NEW state landed anyway.
+    assert ckpt.find_resume_step(str(tmp_path), verify=True) == 2
+
+
+def test_async_snapshot_survives_donated_device_buffers(tmp_path):
+    """The tentpole invariant: save_checkpoint(async_write=True) returns
+    after a DEVICE-side snapshot, so the train loop may immediately donate
+    the live buffers to the next step without corrupting the write."""
+    import jax
+    import jax.numpy as jnp
+
+    state = {"model": {"w": jnp.full((64, 64), 3.0)}, "epoch": 5}
+    ckpt.save_checkpoint(str(tmp_path), 7, state, async_write=True)
+    # Donate-and-overwrite the source buffer, as the next train step does.
+    bump = jax.jit(lambda t: jax.tree_util.tree_map(lambda x: x * -1.0, t),
+                   donate_argnums=0)
+    mutated = bump(state["model"])
+    jax.block_until_ready(mutated)
+    ckpt.wait_for_pending_save(str(tmp_path))
+    loaded = ckpt.load_checkpoint(ckpt.checkpoint_path(str(tmp_path), 7))
+    np.testing.assert_array_equal(loaded["model"]["w"],
+                                  np.full((64, 64), 3.0))
+    assert int(loaded["epoch"]) == 5
+
+
+# ---------------------------------------------------------------------------
+# device prefetch: data_wait attribution
+
+
+def _drive_loop(tmp_path, producer_sleep_s, stage_sleep_s, consumer_sleep_s,
+                n_items=12, window=10, depth=2):
+    """Run a synthetic loop through TrainTelemetry.timed with an attached
+    DevicePrefetcher; return the step_window records (schema-validated)."""
+    jsonl = str(tmp_path / "telemetry.jsonl")
+
+    def source():
+        for i in range(n_items):
+            if producer_sleep_s:
+                time.sleep(producer_sleep_s)
+            yield {"x": np.full((4,), i)}
+
+    def stage(item):
+        if stage_sleep_s:
+            time.sleep(stage_sleep_s)
+        return item
+
+    tele = TrainTelemetry(jsonl_path=jsonl, window=window, sync_every=0)
+    prefetcher = DevicePrefetcher(source(), stage=stage, depth=depth)
+    tele.attach_prefetcher(prefetcher)
+    step = 0
+    for _ in tele.timed(iter(prefetcher)):
+        if consumer_sleep_s:
+            time.sleep(consumer_sleep_s)
+        tele.dispatch_done()
+        step += 1
+        tele.step_done(step, None)
+    tele.finish(step)
+    tele.close()
+    assert tschema.validate_file(jsonl) == []
+    return [rec for rec in map(json.loads, open(jsonl))
+            if rec.get("kind") == "step_window"]
+
+
+def test_prefetch_fast_producer_drives_data_wait_to_zero(tmp_path):
+    """With the producer ahead of the loop, the consumer never waits:
+    data_wait p50 ~ 0 even though featurization takes real time per item
+    (it hides behind the consumer's step)."""
+    windows = _drive_loop(tmp_path, producer_sleep_s=0.004,
+                          stage_sleep_s=0.0, consumer_sleep_s=0.02)
+    assert windows, "no window record emitted"
+    assert windows[0]["data_wait_p50_s"] < 0.004
+    # h2d fields ride along (prefetcher attached), bounded by data_wait.
+    assert windows[0]["h2d_wait_p50_s"] <= windows[0]["data_wait_p50_s"]
+
+
+def test_prefetch_slow_producer_still_attributes_data_wait(tmp_path):
+    """A producer slower than the loop is a real stall and must stay
+    attributed to data_wait (not hidden), with only a small h2d share."""
+    windows = _drive_loop(tmp_path, producer_sleep_s=0.03,
+                          stage_sleep_s=0.0, consumer_sleep_s=0.0)
+    w = windows[0]
+    assert w["data_wait_p50_s"] >= 0.015
+    assert w["h2d_wait_p50_s"] <= 0.5 * w["data_wait_p50_s"]
+
+
+def test_prefetch_slow_staging_reports_as_h2d_subphase(tmp_path):
+    """When the H2D staging call is the bottleneck, the wait lands in
+    data_wait AND is attributed to the h2d_wait sub-phase."""
+    windows = _drive_loop(tmp_path, producer_sleep_s=0.0,
+                          stage_sleep_s=0.02, consumer_sleep_s=0.0)
+    w = windows[0]
+    assert w["data_wait_p50_s"] >= 0.01
+    assert w["h2d_wait_p50_s"] >= 0.5 * w["data_wait_p50_s"]
+    assert w["h2d_wait_p95_s"] <= w["data_wait_p95_s"]
+
+
+def test_prefetch_inline_depth_zero_same_contract(tmp_path):
+    windows = _drive_loop(tmp_path, producer_sleep_s=0.0,
+                          stage_sleep_s=0.01, consumer_sleep_s=0.0,
+                          depth=0)
+    w = windows[0]
+    assert w["h2d_wait_p50_s"] >= 0.005
+    assert w["h2d_wait_p50_s"] <= w["data_wait_p50_s"]
+
+
+def test_prefetch_propagates_producer_error():
+    def source():
+        yield 1
+        raise RuntimeError("shard exploded")
+
+    p = DevicePrefetcher(source(), stage=lambda x: x, depth=2)
+    it = iter(p)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="shard exploded"):
+        next(it)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: checkpoint-step p95 collapses under async writes
+
+
+def _ckpt_run(jsonl_path, async_write, state, n_steps=12, step_s=0.45,
+              every=4):
+    """Paced synthetic training loop with periodic saves of a large
+    state, emitting real step_window records (the bench BENCH_ASYNC leg's
+    shape, through the same StepTimer + ckpt_step accounting)."""
+    import shutil
+    import tempfile
+
+    sink = JSONLHandler(jsonl_path, overwrite=False)
+    timer = StepTimer(window=8, sync_every=0)
+    out_dir = tempfile.mkdtemp(prefix="ckpt_accept_")
+    try:
+        # Un-measured warmup save: first-call effects (allocator growth,
+        # directory creation, thread spawn) must not land in the measured
+        # p95 — with a handful of saves, p95 is the max.
+        warm_dir = tempfile.mkdtemp(prefix="ckpt_accept_warm_")
+        ckpt.save_checkpoint(warm_dir, 0, state, async_write=async_write)
+        ckpt.wait_for_pending_save(warm_dir)
+        shutil.rmtree(warm_dir, ignore_errors=True)
+        for step in range(1, n_steps + 1):
+            timer.data_start()
+            timer.data_end()
+            time.sleep(step_s)
+            timer.dispatch_end()
+            rec = timer.step_done(step)
+            if rec:
+                sink.write_record(rec)
+            if step % every == 0:
+                t0 = time.perf_counter()
+                ckpt.save_checkpoint(out_dir, step, state, keep=2,
+                                     async_write=async_write)
+                timer.note_ckpt_stall(time.perf_counter() - t0)
+        ckpt.wait_for_pending_save(out_dir)
+        rec = timer.flush(n_steps)
+        if rec:
+            sink.write_record(rec)
+        sink.write_record({"kind": "run_summary", "tag": "telemetry",
+                           "step": n_steps, "steps": n_steps})
+    finally:
+        ckpt.wait_for_pending_save()
+        shutil.rmtree(out_dir, ignore_errors=True)
+        sink.close()
+
+
+def test_checkpoint_step_p95_collapses_and_report_gates(tmp_path):
+    """ISSUE 6 acceptance: with async checkpointing and a deliberately
+    large state, checkpoint-step p95 lands within 20% of steady-state p95
+    while blocking writes hold it at >= 2x — and diffing the blocking run
+    against the async baseline trips the telemetry-report regression gate
+    BY NAME (the same path the bench gate uses)."""
+    # ~96 MB of DEVICE state, like a real runner's: the async foreground
+    # cost is the jitted-identity snapshot DISPATCH (enqueued, ms-scale —
+    # the copy itself executes on the backend while the step sleeps),
+    # while a blocking save pays the full device_get + serialize + hash +
+    # write (~7-10 ms/MB on this box) — both ratio thresholds keep a
+    # wide margin.
+    import jax.numpy as jnp
+
+    state = {"model": {f"w{i}": jnp.ones((4_000_000,), jnp.float32)
+                       for i in range(6)}, "epoch": 1}
+    sync_jsonl = str(tmp_path / "sync_telemetry.jsonl")
+    async_jsonl = str(tmp_path / "async_telemetry.jsonl")
+    _ckpt_run(sync_jsonl, async_write=False, state=state)
+    _ckpt_run(async_jsonl, async_write=True, state=state)
+    for path in (sync_jsonl, async_jsonl):
+        assert tschema.validate_file(path) == []
+
+    def ratios(summary):
+        return (summary["ckpt_step_p95_s"] / summary["step_p95_s"], summary)
+
+    sync_ratio, sync_sum = ratios(treport.summarize_file(sync_jsonl))
+    async_ratio, async_sum = ratios(treport.summarize_file(async_jsonl))
+    assert sync_sum["ckpt_steps"] == async_sum["ckpt_steps"] == 3
+    assert sync_ratio >= 2.0, (sync_sum, "blocking saves should stall")
+    if async_ratio > 1.2:
+        # p95 over 3 saves is the max: one background-load spike on this
+        # throttled 2-core box (another test's teardown, a page-cache
+        # flush) can poison a single snapshot memcpy. Re-measure once —
+        # a real regression (a blocking write on the async path) fails
+        # both times by a wide margin, noise doesn't.
+        async_jsonl = str(tmp_path / "async_retry_telemetry.jsonl")
+        _ckpt_run(async_jsonl, async_write=True, state=state)
+        assert tschema.validate_file(async_jsonl) == []
+        async_ratio, async_sum = ratios(treport.summarize_file(async_jsonl))
+    assert async_ratio <= 1.2, (async_sum, "async saves should overlap")
+
+    # Injected-regression gating path: blocking run vs async baseline
+    # must exit nonzero and NAME the checkpoint-step regression.
+    regressions, _ = treport.compare(async_sum, sync_sum)
+    assert any(r["metric"] == "ckpt_step_p95_s" for r in regressions), (
+        regressions)
+    rc = treport.main([sync_jsonl, async_jsonl])
+    assert rc == 1
+    # And the async run against itself is clean.
+    assert treport.main([async_jsonl, async_jsonl]) == 0
+
+
+# ---------------------------------------------------------------------------
+# overlapped data-parallel gradients: bucketed == unbucketed
+
+
+def test_bucketed_overlap_gradients_match_unbucketed():
+    """Acceptance: the explicit availability-ordered per-bucket psum path
+    produces gradients (observed through one optimizer step: params,
+    loss, grad_norm) numerically identical to the implicit-reduction path
+    at fp32 tolerance (1e-6)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu import optim, pretrain
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.parallel import (MeshConfig, create_mesh,
+                                           logical_axis_rules)
+
+    # A fresh config (never the shared session fixture — it would leak
+    # the dropout override into later tests). Dropout off: the bucketed
+    # path folds the shard index into the dropout stream (valid draws,
+    # different from the unbucketed path), so exact parity is defined on
+    # the deterministic graph.
+    config = BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2, next_sentence=True,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    model = BertForPreTraining(config, dtype=jnp.float32)
+    mesh = create_mesh(MeshConfig(data=-1))
+    rules = logical_axis_rules("dp")
+    seq = 32
+    sample = (jnp.zeros((1, seq), jnp.int32),) * 3
+    tx = optim.lamb(optim.make_schedule("poly", 1e-3, 0.1, 10),
+                    weight_decay=0.01, weight_decay_mask=optim.no_decay_mask,
+                    max_grad_norm=1.0)
+    rng = np.random.default_rng(0)
+    accum, rows = 2, 16
+    batch = {
+        "input_ids": rng.integers(
+            0, config.vocab_size, (accum, rows, seq)).astype(np.int32),
+        "segment_ids": rng.integers(0, 2, (accum, rows, seq)).astype(np.int32),
+        "input_mask": np.ones((accum, rows, seq), np.int32),
+        "masked_lm_labels": np.where(
+            rng.random((accum, rows, seq)) < 0.15,
+            rng.integers(0, config.vocab_size, (accum, rows, seq)),
+            -1).astype(np.int32),
+        "next_sentence_labels": rng.integers(
+            0, 2, (accum, rows)).astype(np.int32),
+    }
+    spec = {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+            "masked_lm_labels": 3, "next_sentence_labels": 2}
+    with mesh:
+        shardings = pretrain.state_shardings(mesh, model, rules, sample)
+        b_sh = pretrain.batch_shardings(mesh, spec)
+        init_fn = pretrain.make_init_fn(model, tx, sample, shardings)
+        kwargs = dict(schedule=None, next_sentence=True, shardings=shardings,
+                      batch_shardings_=b_sh, max_pred_per_seq=8)
+        step_ref = pretrain.make_train_step(model, tx, **kwargs)
+        step_ovl = pretrain.make_train_step(
+            model, tx, mesh=mesh, overlap_grad_buckets=True, **kwargs)
+        s_ref, m_ref = step_ref(init_fn(jax.random.PRNGKey(0)),
+                                pretrain.put_batch(batch, b_sh))
+        s_ovl, m_ovl = step_ovl(init_fn(jax.random.PRNGKey(0)),
+                                pretrain.put_batch(batch, b_sh))
+    for key in ("loss", "mlm_accuracy", "grad_norm", "real_tokens"):
+        np.testing.assert_allclose(float(m_ref[key]), float(m_ovl[key]),
+                                   rtol=1e-6, atol=1e-7, err_msg=key)
+    assert float(m_ovl["finite"]) == 1.0
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), s_ref.params,
+        s_ovl.params)
+    assert max(jax.tree_util.tree_leaves(diffs)) < 1e-6
+
+
+def test_overlap_rejects_unsupported_compositions(tiny_config):
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu import optim, pretrain
+    from bert_pytorch_tpu.models import BertForPreTraining
+
+    model = BertForPreTraining(tiny_config, dtype=jnp.float32)
+    tx = optim.adamw(optim.make_schedule("poly", 1e-3, 0.1, 10))
+    with pytest.raises(ValueError, match="requires mesh"):
+        pretrain.make_train_step(model, tx, overlap_grad_buckets=True)
+
+
+def test_gradient_buckets_cover_tree_in_availability_order():
+    from bert_pytorch_tpu.parallel import overlap
+
+    grads = {"bert": {"embeddings": {"w": 1}, "encoder": {"layers": {"k": 2}},
+                      "pooler": {"d": 3}},
+             "predictions": {"b": 4}, "seq_relationship": {"k": 5}}
+    flat, _ = __import__("jax").tree_util.tree_flatten_with_path(grads)
+    buckets = {}
+    for path, leaf in flat:
+        buckets.setdefault(overlap._bucket_of(path), []).append(leaf)
+    assert buckets[overlap._BUCKET_EMBEDDINGS] == [1]
+    assert buckets[overlap._BUCKET_ENCODER] == [2]
+    assert sorted(buckets[overlap._BUCKET_HEADS]) == [3, 4, 5]

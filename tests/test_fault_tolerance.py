@@ -586,6 +586,70 @@ def test_pretraining_term_injection_stops_and_checkpoints(
     assert kinds["run_summary"][0]["terminated_by_signal"] is True
 
 
+@pytest.mark.slow  # ~50s: full runner startup + deliberately slowed
+# writes. The join-ordering invariant it exercises end-to-end is carried
+# in tier-1 by test_async_hotpath.py's per-directory pending-save units
+# and test_sync_save_joins_inflight_async_write_first below.
+def test_preemption_joins_inflight_async_save(pretrain_workdir, monkeypatch):
+    """ISSUE 6 satellite: GracefulStop fires while a periodic ASYNC
+    checkpoint write is still in flight. The emergency checkpoint must
+    join it first (saves land in order — the step-2 write can never
+    clobber or outlive the step-3 emergency state), and the manifest
+    walk-back must still see a VERIFIED newest checkpoint."""
+    import run_pretraining
+
+    real_write = ckpt._write_and_prune
+
+    def slow_write(state, output_dir, step, keep):
+        # Stretch every background write past a step time, so the step-2
+        # periodic save is guaranteed still in flight when term@3 stops
+        # the run at the next boundary.
+        time.sleep(1.0)
+        real_write(state, output_dir, step, keep)
+
+    monkeypatch.setattr(ckpt, "_write_and_prune", slow_write)
+    result = run_pretraining.main(_pretrain_args(
+        pretrain_workdir, "--fault_spec", "term@3",
+        "--term_check_steps", "1", "--num_steps_per_checkpoint", "2"))
+    assert result["terminated_by_signal"] is True
+    stopped_at = result["global_step"]
+    out_ckpts = os.path.join(pretrain_workdir["out"], "pretrain_ckpts")
+    # Newest VERIFIED checkpoint is the emergency one; the async periodic
+    # write it joined landed verified too (blob-then-manifest held).
+    assert ckpt.find_resume_step(out_ckpts, verify=True) == stopped_at
+    for step in ckpt._ckpt_steps(out_ckpts):
+        path = ckpt.checkpoint_path(out_ckpts, step)
+        status, detail = integrity.verify_checkpoint(path)
+        assert status == integrity.VERIFIED, (step, detail)
+    assert set(ckpt._ckpt_steps(out_ckpts)) == {2, stopped_at}
+    # The walk-back story survives async saves: corrupt the newest and
+    # resume must land on the verified periodic checkpoint below it.
+    faults.corrupt_checkpoint(
+        ckpt.checkpoint_path(out_ckpts, stopped_at), "flip")
+    assert ckpt.find_resume_step(out_ckpts, verify=True) == 2
+
+
+def test_sync_save_joins_inflight_async_write_first(tmp_path, monkeypatch):
+    """The emergency-checkpoint invariant, unit-level: a SYNCHRONOUS save
+    to a directory with an async write in flight joins that write before
+    writing its own state — checkpoints land in order, and the sync
+    save's (newer) step ends up the verified newest."""
+    order = []
+    real_write = ckpt._write_and_prune
+
+    def slow_logged_write(state, output_dir, step, keep):
+        if step == 1:
+            time.sleep(0.3)  # keep the async write in flight
+        order.append(step)
+        real_write(state, output_dir, step, keep)
+
+    monkeypatch.setattr(ckpt, "_write_and_prune", slow_logged_write)
+    ckpt.save_checkpoint(str(tmp_path), 1, _contents(1), async_write=True)
+    ckpt.save_checkpoint(str(tmp_path), 2, _contents(2))  # emergency: sync
+    assert order == [1, 2]
+    assert ckpt.find_resume_step(str(tmp_path), verify=True) == 2
+
+
 @pytest.mark.slow  # ~15s compile; the poison hook and the sentinel
 # policy are each unit-tested above / in tests/test_telemetry.py
 def test_pretraining_nonfinite_injection_trips_abort_sentinel(

@@ -15,8 +15,13 @@ consistency rules — latency percentiles ordered p50 <= p95 <= p99,
 ``batch_occupancy`` in (0, 1] — and the fault-tolerance family
 (``fault``/``resume``, docs/fault_tolerance.md) with its own: a real
 boolean ``injected`` marker, and every ``resume.skipped`` entry naming
-step/path/reason. The chaos harness (tools/chaos_run.py) lints its
-kill->corrupt->resume artifacts through this same module.
+step/path/reason. The async-hot-path step_window fields are held to
+their invariants too: ``h2d_wait_*`` must be numeric and never exceed
+the ``data_wait_*`` it is a sub-phase of, and ``ckpt_step_*``
+percentiles require a positive ``ckpt_steps`` checkpoint-step flag
+(docs/telemetry.md "Checkpoint-step p95"). The chaos harness
+(tools/chaos_run.py) lints its kill->corrupt->resume artifacts through
+this same module.
 
 Usage::
 
