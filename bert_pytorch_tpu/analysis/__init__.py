@@ -1,0 +1,47 @@
+"""jaxlint — a pure-AST, jax-free static analyzer for TPU-hazard patterns
+(docs/static_analysis.md).
+
+Six PRs of jit-compiled hot paths and background-thread subsystems created
+a failure surface the runtime tooling only *observes* after the fact: a
+stray ``.item()`` in a step loop silently costs a host sync every step
+(the CompileMonitor and StepTimer would show the symptom, not the line),
+an unhashable static arg turns into a recompile storm, and the lock
+discipline of the five background-thread subsystems (async checkpoint
+writer, DevicePrefetcher, watchdog, JSONL sink, serve dispatch) was
+enforced only by review memory — PR 5 and PR 6 each shipped a review-pass
+fix for exactly such a bug. This package makes those invariants
+machine-checked, BEFORE the code runs.
+
+Design constraints:
+
+* **Pure AST** — files are parsed, never imported. Scanning a module that
+  imports jax/h5py/matplotlib costs milliseconds and no dependencies.
+* **jax-free** — importing ``bert_pytorch_tpu.analysis`` pulls only the
+  stdlib (the package ``__init__`` chain is stdlib-only by design), so
+  the linter runs on pre-commit hooks and CI boxes without the
+  accelerator stack, and inside the tier-1 budget on the 2-core box.
+* **Stable check IDs** — every finding carries an ID (HS101, RC201, ...)
+  suppressible inline with ``# jaxlint: disable=ID`` and grandfatherable
+  in a committed baseline file (``jaxlint_baseline.json``).
+
+Check families (one module each):
+
+* ``host_sync``       HS101 — blocking host transfers in step-loop hot paths
+* ``recompile``       RC201/RC202/RC203 — jit recompile / retrace hazards
+* ``rng``             RN301/RN302 — PRNG key reuse and wall-clock seeds
+* ``tracer_leak``     TL401 — traced values assigned to self/globals in jit
+* ``lock_discipline`` LK501/LK502/LK503 — accesses of registered shared
+  state outside its declared guard (``analysis/concurrency.py``)
+"""
+
+from bert_pytorch_tpu.analysis.core import (  # noqa: F401
+    ALL_CHECK_IDS,
+    Finding,
+    run_files,
+    run_paths,
+)
+from bert_pytorch_tpu.analysis.baseline import (  # noqa: F401
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)

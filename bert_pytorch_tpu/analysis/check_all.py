@@ -1,0 +1,122 @@
+"""bert-lint — the ONE standalone gate: jaxlint + telemetry-schema lint.
+
+Before PR 7 the repo had two separately-invoked checkers (jaxlint did
+not exist; ``tools/check_telemetry_schema.py`` linted JSONL artifacts)
+and every caller — the tier-1 suite, the capture harness's
+``commit_artifacts``, pre-commit hooks — had to know which to run when.
+This module is the single entry point: it runs
+
+1. **jaxlint** over the canonical code targets (the whole
+   ``bert_pytorch_tpu`` package, the five repo-root runners, and
+   ``tools/``), honoring the committed baseline; and
+2. the **telemetry schema lint** over the given ``*.jsonl`` artifacts
+   (default: every ``*.jsonl`` in the repo root — the same set tier-1
+   lints and the capture harness is about to commit).
+
+Exit 0 only when both pass. Installed as the ``bert-lint`` console
+script; ``tools/check_all.py`` is the uninstalled repo-root wrapper;
+``scripts/lint.sh`` is the pre-commit convenience alias.
+
+jax-free like everything in this package: the schema engine is loaded
+from ``telemetry/schema.py`` by FILE PATH (the ``tools/_bootstrap.py``
+technique), never through ``bert_pytorch_tpu.telemetry.__init__``,
+whose sibling imports pull jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import importlib.util
+import os
+import sys
+from typing import List, Optional
+
+from bert_pytorch_tpu.analysis import cli as jaxlint_cli
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+# The canonical jaxlint target set — what the tier-1 gate, the
+# acceptance command, and commit hooks all mean by "lint the repo".
+JAXLINT_TARGETS = ("bert_pytorch_tpu", "run_glue.py", "run_ner.py",
+                   "run_pretraining.py", "run_server.py", "run_squad.py",
+                   "run_swag.py", "tools")
+
+
+def _load_schema_module():
+    root = _repo_root()
+    path = os.path.join(root, "bert_pytorch_tpu", "telemetry", "schema.py")
+    spec = importlib.util.spec_from_file_location("_bert_lint_schema", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["_bert_lint_schema"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _lint_jsonls(paths: List[str]) -> int:
+    # Deliberately NOT delegating to tools/check_telemetry_schema.py:
+    # that script is repo-root tooling (sys.path tricks, rc-2-on-missing
+    # contract its own callers rely on), while this function must work
+    # from an INSTALLED bert-lint console script where tools/ does not
+    # exist — only the packaged schema.py does. The shared engine is
+    # schema.validate_file; everything here is presentation. A missing
+    # file counts as a plain failure (rc 1): one gate, one exit
+    # contract.
+    schema = _load_schema_module()
+    root = _repo_root()
+    failed = 0
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"bert-lint: {path}: no such file", file=sys.stderr)
+            failed += 1
+            continue
+        errors = schema.validate_file(path)
+        rel = os.path.relpath(path, root)
+        if errors:
+            failed += 1
+            for lineno, err in errors:
+                print(f"{rel}:{lineno}: {err}")
+        else:
+            print(f"{rel}: ok")
+    return failed
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="bert-lint",
+        description="Unified repo gate: jaxlint (docs/static_analysis.md) "
+                    "over the package + runners + tools, then the "
+                    "telemetry record schema over JSONL artifacts.")
+    parser.add_argument(
+        "jsonls", nargs="*",
+        help="JSONL artifacts to schema-lint (default: <repo>/*.jsonl)")
+    parser.add_argument("--skip-jaxlint", action="store_true",
+                        help="only schema-lint the JSONL artifacts")
+    parser.add_argument("--skip-schema", action="store_true",
+                        help="only run jaxlint over the code targets")
+    args = parser.parse_args(argv)
+
+    rc = 0
+    if not args.skip_jaxlint:
+        print("== jaxlint ==")
+        targets = [os.path.join(_repo_root(), t) for t in JAXLINT_TARGETS]
+        if jaxlint_cli.main(targets) != 0:
+            rc = 1
+    if not args.skip_schema:
+        paths = list(args.jsonls) or sorted(
+            glob.glob(os.path.join(_repo_root(), "*.jsonl")))
+        print("== telemetry schema ==")
+        if not paths:
+            print("bert-lint: no *.jsonl artifacts to lint")
+        elif _lint_jsonls(paths):
+            rc = 1
+    print("bert-lint: " + ("OK" if rc == 0 else "FAILED"))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,125 @@
+"""jaxlint command line (``tools/jaxlint.py`` is the repo-root wrapper).
+
+Exit codes: 0 = clean (baseline-covered findings allowed), 1 = findings,
+2 = usage / missing path / malformed baseline.
+
+This module — like the whole analysis package — must never import jax:
+the tier-1 gate asserts it, and the pre-commit wrapper runs on boxes
+without the accelerator stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from bert_pytorch_tpu.analysis import baseline as baseline_mod
+from bert_pytorch_tpu.analysis import core
+
+
+def _repo_root() -> str:
+    # analysis/cli.py -> analysis -> bert_pytorch_tpu -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="jaxlint",
+        description="Pure-AST TPU-hazard linter (docs/static_analysis.md): "
+                    "host-sync, recompile, RNG, tracer-leak, and "
+                    "lock-discipline checks with stable IDs.")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories; a bare name that does not exist is "
+             "retried under bert_pytorch_tpu/ (so 'serve' works)")
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline file (default: <repo>/jaxlint_baseline.json when "
+             "present); entries suppress matching findings")
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file: report every finding")
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current UNSUPPRESSED findings to the baseline "
+             "file and exit 0 (stale entries are pruned)")
+    parser.add_argument(
+        "--list-checks", action="store_true",
+        help="print every check ID with its description and exit")
+    parser.add_argument(
+        "-q", "--quiet", action="store_true",
+        help="suppress the summary line (findings still print)")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.list_checks:
+        for check_id in sorted(core.ALL_CHECK_IDS):
+            print(f"{check_id}  {core.ALL_CHECK_IDS[check_id]}")
+        return 0
+    if not args.paths:
+        parser.error("the following arguments are required: paths")
+
+    repo_root = _repo_root()
+    try:
+        files = core.expand_paths(args.paths, repo_root=repo_root)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    findings = core.run_files(files, repo_root=repo_root)
+
+    baseline_path = args.baseline or os.path.join(
+        repo_root, baseline_mod.DEFAULT_BASENAME)
+    entries: List[dict] = []
+    if not args.no_baseline:
+        try:
+            entries = baseline_mod.load_baseline(baseline_path)
+        except ValueError as e:
+            if not args.write_baseline:
+                print(str(e), file=sys.stderr)
+                return 2
+            # Rewriting is the recovery path for a corrupt baseline.
+            entries = []
+
+    if args.write_baseline:
+        # MERGE, not overwrite: a subset run (jaxlint run_glue.py
+        # --write-baseline) must keep other files' entries and every
+        # still-matching entry's hand-written justification; only
+        # stale entries for the files actually linted are pruned.
+        linted = {os.path.relpath(p, repo_root).replace(os.sep, "/")
+                  for p in files}
+        merged = baseline_mod.merge_entries(entries, findings, linted)
+        n = baseline_mod.write_entries(baseline_path, merged)
+        print(f"jaxlint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {baseline_path}")
+        return 0
+
+    new, matched, stale = baseline_mod.apply_baseline(findings, entries)
+    # Only entries for files this run actually linted can be judged
+    # stale — a subset run must not advertise other files' entries as
+    # prunable.
+    linted = {os.path.relpath(p, repo_root).replace(os.sep, "/")
+              for p in files}
+    stale = [e for e in stale if e["path"] in linted]
+    for f in new:
+        print(f.format())
+    if not args.quiet:
+        parts = [f"jaxlint: {len(new)} finding"
+                 f"{'' if len(new) == 1 else 's'} in {len(files)} files"]
+        if matched:
+            parts.append(f"{len(matched)} baselined")
+        if stale:
+            parts.append(f"{len(stale)} stale baseline entr"
+                         f"{'y' if len(stale) == 1 else 'ies'} "
+                         "(run --write-baseline to prune)")
+        print("; ".join(parts))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,176 @@
+"""The concurrency registry: every shared mutable attribute in the
+background-thread subsystems, with its declared guard.
+
+This is the single place the codebase states its lock discipline. PR 5
+and PR 6 each shipped a review-pass fix for a violation nobody's tests
+caught (the unsynchronized JSONL writes; the stale module-global
+``_pending_save`` slot) — review memory does not scale, a registry the
+linter enforces does. ``analysis/lock_discipline.py`` reads these
+entries and flags, per owning module, any access that breaks the
+declared discipline (LK501/LK502/LK503).
+
+Three guard kinds:
+
+* ``lock`` — the attribute may only be read or written inside a
+  ``with <lock>:`` block (any of the ``locks`` names; a Condition
+  wraps its lock, so either spelling of the same mutex is accepted).
+  ``allow`` lists functions where unguarded access is fine —
+  ``__init__`` (no other thread can hold a reference yet) being the
+  canonical case.
+
+* ``frozen`` — the attribute is bound once in ``__init__`` and never
+  reassigned; cross-thread sharing is safe because the *binding* is
+  immutable (the object it names does its own locking). Any later
+  assignment is a violation: it would race every reader.
+
+* ``confined`` — the attribute belongs to ONE thread. ``forbidden_in``
+  names the functions that run on *other* threads (thread targets);
+  any access there is a violation. This documents single-owner state
+  honestly instead of wrapping it in a lock it does not need on the
+  hot path.
+
+Entries are keyed by module path suffix (repo-relative, '/'-separated)
+and, for instance attributes, the owning class. Keep this registry in
+sync with the modules it names — a registered attribute that disappears
+costs nothing, an unregistered shared attribute is invisible to the
+checker.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class Entry:
+    module: str                    # path suffix, e.g. "utils/checkpoint.py"
+    attr: str                      # global name, or self.<attr> with cls
+    kind: str                      # "lock" | "frozen" | "confined"
+    cls: str = ""                  # owning class ("" = module global)
+    locks: Tuple[str, ...] = ()    # accepted guard names (kind="lock")
+    allow: Tuple[str, ...] = ()    # functions where unguarded access is OK
+    forbidden_in: Tuple[str, ...] = field(default=())  # kind="confined"
+    why: str = ""                  # one-line rationale (docs + messages)
+
+
+REGISTRY: Tuple[Entry, ...] = (
+    # -- utils/checkpoint.py: async checkpoint writer ----------------------
+    # The per-directory pending-write registry is touched by the train
+    # loop (save/join) and by every background writer thread's error
+    # handler; PR 6's review pass fixed a stale shared slot here.
+    Entry("bert_pytorch_tpu/utils/checkpoint.py", "_pending_saves",
+          kind="lock", locks=("_pending_lock",),
+          why="train loop + background writer threads mutate the "
+              "per-directory pending-write map"),
+    Entry("bert_pytorch_tpu/utils/checkpoint.py", "_pending_errors",
+          kind="lock", locks=("_pending_lock",),
+          why="background writer threads append errors the next save/join "
+              "raises"),
+
+    # -- data/device_prefetch.py: double-buffered H2D staging --------------
+    # The gauges and iterator state are CONSUMER-thread property; the
+    # producer communicates only through the Queue and the stop Event
+    # (both internally synchronized). Confinement, not locking, is the
+    # discipline — a per-step lock on the hot path would buy nothing.
+    Entry("bert_pytorch_tpu/data/device_prefetch.py", "_stats",
+          cls="DevicePrefetcher", kind="confined",
+          forbidden_in=("_produce",),
+          why="telemetry gauges read/reset by the consumer (snapshot/"
+              "_observe); the producer must stay off them"),
+    Entry("bert_pytorch_tpu/data/device_prefetch.py", "_last_h2d_wait_s",
+          cls="DevicePrefetcher", kind="confined",
+          forbidden_in=("_produce",),
+          why="h2d attribution handoff between __next__ and "
+              "pop_h2d_wait_s, both consumer-side"),
+    Entry("bert_pytorch_tpu/data/device_prefetch.py", "_done",
+          cls="DevicePrefetcher", kind="confined",
+          forbidden_in=("_produce",),
+          why="iterator-exhausted latch owned by the consumer; the "
+              "producer signals completion via the queue sentinel"),
+    Entry("bert_pytorch_tpu/data/device_prefetch.py", "_thread",
+          cls="DevicePrefetcher", kind="confined",
+          forbidden_in=("_produce",),
+          why="created in __next__ and joined in close(), both "
+              "consumer-side; the thread never touches its own handle"),
+
+    # -- telemetry/runner.py: the facade shared with the watchdog ----------
+    # The watchdog daemon thread calls self.emit -> self.sink.write_record
+    # concurrently with the train loop; safety rests on these bindings
+    # never changing after __init__ (JSONLHandler locks its own file).
+    Entry("bert_pytorch_tpu/telemetry/runner.py", "sink",
+          cls="TrainTelemetry", kind="frozen",
+          why="read concurrently by the watchdog thread via emit(); a "
+              "rebind would race every background emitter"),
+    Entry("bert_pytorch_tpu/telemetry/runner.py", "watchdog",
+          cls="TrainTelemetry", kind="frozen",
+          why="step_done notes liveness on it from the train loop while "
+              "its own thread polls; the binding must be stable"),
+
+    # -- telemetry/sentinels.py: the watchdog's own shared state -----------
+    Entry("bert_pytorch_tpu/telemetry/sentinels.py", "_last",
+          cls="HeartbeatWatchdog", kind="lock", locks=("_lock",),
+          why="written by the train loop (note), read by the watchdog "
+              "thread (check)"),
+    Entry("bert_pytorch_tpu/telemetry/sentinels.py", "_flagged",
+          cls="HeartbeatWatchdog", kind="lock", locks=("_lock",),
+          why="re-arm latch shared by note (train loop) and check "
+              "(watchdog thread)"),
+    Entry("bert_pytorch_tpu/telemetry/sentinels.py", "stalls_flagged",
+          cls="HeartbeatWatchdog", kind="lock", locks=("_lock",),
+          why="stall counter incremented by the watchdog thread, read by "
+              "runners/tests"),
+
+    # -- serve/service.py: HTTP workers vs dispatch vs signal handler ------
+    Entry("bert_pytorch_tpu/serve/service.py", "_draining",
+          cls="ServingService", kind="lock", locks=("_state_lock",),
+          why="flipped by begin_drain (signal handler / run_server) while "
+              "every HTTP worker reads it in submit/health"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_thread",
+          cls="ServingService", kind="lock", locks=("_state_lock",),
+          why="start/stop rebind it while HTTP workers read liveness "
+              "through dispatch_alive for /healthz"),
+
+    # -- serve/batcher.py: request FIFO + gauges ---------------------------
+    Entry("bert_pytorch_tpu/serve/batcher.py", "_pending",
+          cls="Batcher", kind="lock", locks=("_cond", "_lock"),
+          allow=("_take_head_task_locked",),
+          why="HTTP workers append, the dispatch thread drains; "
+              "_take_head_task_locked is called with _cond held (the "
+              "_locked suffix is the contract its name states)"),
+    Entry("bert_pytorch_tpu/serve/batcher.py", "depth_max",
+          cls="Batcher", kind="lock", locks=("_cond", "_lock"),
+          why="gauge updated under submit/requeue, read by telemetry"),
+    Entry("bert_pytorch_tpu/serve/batcher.py", "submitted",
+          cls="Batcher", kind="lock", locks=("_cond", "_lock"),
+          why="gauge updated by every submitting thread"),
+    Entry("bert_pytorch_tpu/serve/batcher.py", "_closed",
+          cls="Batcher", kind="lock", locks=("_cond", "_lock"),
+          why="close() (drain path) flips it while submit/next_batch "
+              "check it"),
+
+    # -- serve/stats.py: dispatch thread vs /statsz scrapes ----------------
+    Entry("bert_pytorch_tpu/serve/stats.py", "total_requests",
+          cls="ServeTelemetry", kind="lock", locks=("_lock",),
+          why="observe_batch (dispatch thread) increments while HTTP "
+              "workers snapshot for /statsz"),
+    Entry("bert_pytorch_tpu/serve/stats.py", "total_batches",
+          cls="ServeTelemetry", kind="lock", locks=("_lock",),
+          why="same writer/reader split as total_requests"),
+    Entry("bert_pytorch_tpu/serve/stats.py", "total_errors",
+          cls="ServeTelemetry", kind="lock", locks=("_lock",),
+          why="observe_error is called from HTTP worker threads too"),
+
+    # -- utils/logging.py: the JSONL sink background emitters write --------
+    Entry("bert_pytorch_tpu/utils/logging.py", "_f",
+          cls="JSONLHandler", kind="lock", locks=("_lock",),
+          allow=("__init__",),
+          why="watchdog/shard-retry/async-writer threads emit records "
+              "concurrently with the train loop (PR 5 review fix)"),
+)
+
+
+def entries_for(rel_path: str) -> Tuple[Entry, ...]:
+    """Registry entries whose module suffix matches ``rel_path``."""
+    rel = rel_path.replace("\\", "/")
+    return tuple(e for e in REGISTRY if rel.endswith(e.module))

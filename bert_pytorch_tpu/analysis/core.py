@@ -1,0 +1,256 @@
+"""Shared analyzer machinery: parsed-module model, suppression comments,
+check registry, and the file-walking entry points.
+
+Everything here is stdlib-only (ast/re/os) — see the package docstring
+for why that is a hard constraint.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Iterable, List, Optional
+
+# Inline suppression: a trailing comment on the flagged line (or a comment
+# on its own line DIRECTLY above, for statements too long to annotate
+# inline). Multiple IDs comma-separate. An unknown ID is itself an error
+# (JL000) — a typo'd suppression must not silently stop suppressing.
+DISABLE_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+# Hot-path marker: a comment on (or directly above) a ``def`` line opts
+# that function into the host-sync scan even when it is not reachable
+# from a ``tele.timed`` loop in the same module (host_sync.py).
+HOT_RE = re.compile(r"#\s*jaxlint:\s*hot\b")
+
+JL_BAD_ID = "JL000"
+JL_PARSE = "JL001"
+
+
+@dataclass(frozen=True)
+class Finding:
+    check: str
+    path: str          # path as reported (relative when possible)
+    line: int
+    col: int
+    message: str
+    source: str        # stripped source of the flagged line (baseline key)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.check} {self.message}"
+
+
+class Module:
+    """One parsed source file plus the derived maps every check shares:
+    parent links, import-alias resolution, suppression/hot comment lines."""
+
+    def __init__(self, path: str, text: str, rel: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.parents: dict = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.aliases = self._import_aliases()
+        self.suppressions, self.bad_ids, self.hot_lines = \
+            self._scan_comments()
+
+    # -- comments --------------------------------------------------------
+
+    def _comment_tokens(self):
+        """(line, text) of every actual COMMENT token — a ``# jaxlint:``
+        marker quoted inside a docstring must not count."""
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.string
+        except tokenize.TokenError:
+            return  # ast.parse already succeeded; be permissive here
+
+    def _scan_comments(self):
+        suppressions: dict = {}   # line -> set of IDs
+        bad: list = []            # (line, bad_id)
+        hot_lines: set = set()
+        for lineno, comment in self._comment_tokens():
+            if HOT_RE.search(comment):
+                hot_lines.add(lineno)
+            m = DISABLE_RE.search(comment)
+            if not m:
+                continue
+            ids = {tok.strip() for tok in m.group(1).split(",") if tok.strip()}
+            for check_id in ids:
+                # ALL_CHECK_IDS is a module global populated at import
+                # time; Modules are only built at analysis time, after.
+                if check_id not in ALL_CHECK_IDS:
+                    bad.append((lineno, check_id))
+            suppressions[lineno] = ids
+        return suppressions, bad, hot_lines
+
+    def suppressed(self, line: int, check_id: str) -> bool:
+        for at in (line, line - 1):
+            ids = self.suppressions.get(at)
+            if ids and check_id in ids:
+                return True
+        return False
+
+    def source_line(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, check: str, node: ast.AST, message: str) -> Finding:
+        return Finding(check=check, path=self.rel, line=node.lineno,
+                       col=node.col_offset, message=message,
+                       source=self.source_line(node.lineno))
+
+    # -- name resolution -------------------------------------------------
+
+    def _import_aliases(self) -> dict:
+        """Map local names to canonical dotted roots: ``import numpy as
+        np`` -> {"np": "numpy"}; ``from jax import random as jrandom`` ->
+        {"jrandom": "jax.random"}."""
+        aliases: dict = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of an attribute chain / name, resolved
+        through the module's import aliases; None for anything else."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+
+# -- check registry ------------------------------------------------------
+
+def _checks():
+    # Local imports keep core importable before the check modules exist
+    # in partial environments, and break the package import cycle.
+    from bert_pytorch_tpu.analysis import (host_sync, lock_discipline,
+                                           recompile, rng, tracer_leak)
+    return (host_sync, recompile, rng, tracer_leak, lock_discipline)
+
+
+def all_check_ids() -> dict:
+    """{check_id: one-line description} over every registered check,
+    plus the analyzer's own JL error codes."""
+    ids = {
+        JL_BAD_ID: "unknown check ID in a jaxlint disable comment",
+        JL_PARSE: "file failed to parse",
+    }
+    for mod in _checks():
+        ids.update(mod.CHECKS)
+    return ids
+
+
+# Computed once at import; the package __init__ re-exports it and the
+# suppression parser checks typo'd IDs against it.
+ALL_CHECK_IDS = all_check_ids()
+
+
+def run_module(module: Module, registry=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for line, bad_id in module.bad_ids:
+        findings.append(Finding(
+            check=JL_BAD_ID, path=module.rel, line=line, col=0,
+            message=f"unknown check ID {bad_id!r} in disable comment "
+                    f"(known: {', '.join(sorted(ALL_CHECK_IDS))})",
+            source=module.source_line(line)))
+    for mod in _checks():
+        for f in mod.check(module, registry=registry):
+            # JL000 is deliberately unsuppressable; everything else
+            # honors the inline disable comment.
+            if f.check == JL_BAD_ID or not module.suppressed(f.line, f.check):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return findings
+
+
+def run_files(paths: Iterable[str], repo_root: Optional[str] = None,
+              registry=None) -> List[Finding]:
+    """Analyze the given FILES (no directory expansion — see run_paths).
+    Unparseable files produce a JL001 finding instead of crashing the
+    run: a syntax error in lint-scope code must fail the gate loudly."""
+    findings: List[Finding] = []
+    for path in paths:
+        rel = os.path.relpath(path, repo_root) if repo_root else path
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            module = Module(path, text, rel)
+        except (SyntaxError, ValueError) as e:
+            findings.append(Finding(
+                check=JL_PARSE, path=rel.replace(os.sep, "/"),
+                line=getattr(e, "lineno", 0) or 0, col=0,
+                message=f"parse error: {e}", source=""))
+            continue
+        findings.extend(run_module(module, registry=registry))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return findings
+
+
+def expand_paths(args: Iterable[str], repo_root: Optional[str] = None
+                 ) -> List[str]:
+    """Resolve CLI path arguments to a sorted .py file list. Directories
+    recurse (skipping __pycache__ and hidden dirs); a bare name that does
+    not exist is retried under ``<repo_root>/bert_pytorch_tpu/`` so
+    ``jaxlint serve`` means the serving subsystem from anywhere."""
+    files: List[str] = []
+    for arg in args:
+        path = arg
+        if not os.path.exists(path) and repo_root:
+            for base in (repo_root, os.path.join(repo_root,
+                                                 "bert_pytorch_tpu")):
+                candidate = os.path.join(base, arg)
+                if os.path.exists(candidate):
+                    path = candidate
+                    break
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                files.extend(os.path.join(dirpath, n)
+                             for n in sorted(filenames) if n.endswith(".py"))
+        elif os.path.isfile(path):
+            files.append(path)
+        else:
+            raise FileNotFoundError(f"jaxlint: no such path: {arg}")
+    # De-duplicate while preserving order (bert_pytorch_tpu + serve both
+    # naming serve/ files must not double-report).
+    seen = set()
+    unique = []
+    for f in files:
+        key = os.path.abspath(f)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+def run_paths(args: Iterable[str], repo_root: Optional[str] = None,
+              registry=None) -> List[Finding]:
+    return run_files(expand_paths(args, repo_root), repo_root=repo_root,
+                     registry=registry)

@@ -1,0 +1,243 @@
+"""HS101 — blocking host transfers in step-loop hot paths.
+
+The whole performance story of this codebase (FlashAttention-lineage
+kernels, MFU accounting, async checkpointing, device prefetch) assumes
+the train loop dispatches work and lets telemetry own the device sync at
+a declared cadence. One stray ``.item()`` / ``float(loss)`` / ``np.
+asarray(x)`` on a device value inside the step loop silently inserts a
+host round-trip EVERY step — the exact failure mode the CompileMonitor
+and StepTimer can only observe after the fact.
+
+**What is hot.** Purely lexical, so it is cheap and predictable:
+
+* the body of any ``for`` loop iterating ``...tele.timed(...)`` — the
+  canonical step loop every runner and bench leg uses;
+* any function whose ``def`` line (or the line above) carries a
+  ``# jaxlint: hot`` marker;
+* transitively: any same-module function called BY NAME from a
+  non-exempt hot statement (``dispatch_step(...)`` in run_pretraining).
+
+**Declared sync-cadence sites** (exempt — the body only, the test still
+runs per step and is scanned):
+
+* ``if`` blocks whose test references ``last_step_synced`` /
+  ``should_sync`` / ``force_sync`` — the telemetry facade's explicit
+  "this step already paid the sync" signals;
+* ``if`` blocks whose test contains a ``%`` cadence gate
+  (``global_step % args.log_steps == 0`` — amortized by construction);
+* ``if`` blocks testing equality against an integer literal
+  (``if step_in_run == 1:`` — a once-per-run warmup gate).
+
+**What is flagged** inside non-exempt hot code:
+
+* ``x.item()``, ``x.tolist()``, ``x.block_until_ready()``;
+* ``jax.device_get(...)``, ``jax.block_until_ready(...)``,
+  ``np.asarray(...)``, ``np.array(...)``;
+* ``float(x)`` / ``int(x)`` unless ``x`` is host-known: a constant,
+  arithmetic over constants, ``len(...)``, ``x.shape[...]``/``.ndim``/
+  ``.size`` (shape metadata is host-side even for device arrays),
+  wall-clock calls, or an ``args.*`` attribute (argparse values).
+
+False positives on genuinely-host values (a numpy ``valid`` mask) are
+expected occasionally; that is what ``# jaxlint: disable=HS101`` with a
+justifying comment is for — the suppression documents the host-ness.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from bert_pytorch_tpu.analysis.core import Finding, Module
+
+CHECKS = {
+    "HS101": "blocking host transfer (.item/float/np.asarray/device_get/"
+             "block_until_ready) in a step-loop hot path",
+}
+
+# Dotted callables that force a device->host transfer or a sync.
+_SYNC_CALLS = {
+    "numpy.asarray", "numpy.array", "jax.device_get",
+    "jax.block_until_ready",
+}
+# Method names that sync regardless of receiver spelling.
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# Names whose appearance in an if-test declares a sync-cadence site.
+_SYNC_GATES = {"last_step_synced", "should_sync", "force_sync"}
+# Host-only callables float()/int() may safely wrap.
+_HOST_CALLS = {"len", "round", "min", "max", "abs", "time.time",
+               "time.perf_counter", "time.monotonic", "time.time_ns"}
+_HOST_ATTRS = {"shape", "ndim", "size"}
+
+
+def _is_exempt_test(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+            return True
+        if isinstance(node, ast.Name) and node.id in _SYNC_GATES:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _SYNC_GATES:
+            return True
+        if (isinstance(node, ast.Compare)
+                and len(node.ops) == 1 and isinstance(node.ops[0], ast.Eq)
+                and any(isinstance(c, ast.Constant)
+                        and isinstance(c.value, int)
+                        for c in node.comparators)):
+            return True
+    return False
+
+
+def _host_safe(module: Module, node: ast.AST) -> bool:
+    """Conservatively: True only when the expression provably lives on
+    the host (so float()/int() of it is free)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _host_safe(module, node.operand)
+    if isinstance(node, ast.BinOp):
+        return _host_safe(module, node.left) and _host_safe(module, node.right)
+    if isinstance(node, ast.BoolOp):
+        return all(_host_safe(module, v) for v in node.values)
+    if isinstance(node, ast.IfExp):
+        return (_host_safe(module, node.body)
+                and _host_safe(module, node.orelse))
+    if isinstance(node, ast.Subscript):
+        return _host_safe(module, node.value)
+    if isinstance(node, ast.Attribute):
+        if node.attr in _HOST_ATTRS:
+            return True
+        dotted = module.dotted(node)
+        # argparse namespaces hold parsed host scalars.
+        return bool(dotted) and dotted.split(".")[0] in ("args", "self_args")
+    if isinstance(node, ast.Call):
+        dotted = module.dotted(node.func)
+        if dotted == "len":
+            return True
+        if dotted in _HOST_CALLS:
+            return all(_host_safe(module, a) for a in node.args)
+    return False
+
+
+def _function_defs(module: Module) -> dict:
+    defs: dict = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Last definition wins on name collisions — matches runtime
+            # rebinding closely enough for a lint.
+            defs[node.name] = node
+    return defs
+
+
+def _is_timed_loop(node: ast.AST) -> bool:
+    if not isinstance(node, ast.For):
+        return False
+    for sub in ast.walk(node.iter):
+        if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "timed"):
+            return True
+    return False
+
+
+class _HotScanner:
+    def __init__(self, module: Module):
+        self.module = module
+        self.defs = _function_defs(module)
+        self.findings: List[Finding] = []
+        self._scanned_fns: Set[str] = set()
+        self._pending_fns: List[str] = []
+
+    def run(self) -> List[Finding]:
+        for node in ast.walk(self.module.tree):
+            if _is_timed_loop(node):
+                self._scan_stmts(node.body)
+        for name, fn in self.defs.items():
+            marker_lines = {fn.lineno, fn.lineno - 1}
+            if fn.decorator_list:
+                marker_lines.add(fn.decorator_list[0].lineno - 1)
+            if marker_lines & self.module.hot_lines:
+                self._queue_fn(name)
+        while self._pending_fns:
+            fn = self.defs[self._pending_fns.pop()]
+            self._scan_stmts(fn.body)
+        return self.findings
+
+    def _queue_fn(self, name: str) -> None:
+        if name in self.defs and name not in self._scanned_fns:
+            self._scanned_fns.add(name)
+            self._pending_fns.append(name)
+
+    def _scan_stmts(self, stmts: List[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # a def is not execution; calls propagate hotness
+            if isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test)
+                if _is_exempt_test(stmt.test):
+                    # The body is a declared sync-cadence site; the else
+                    # branch is the common per-step path and stays hot.
+                    self._scan_stmts(stmt.orelse)
+                else:
+                    self._scan_stmts(stmt.body)
+                    self._scan_stmts(stmt.orelse)
+                continue
+            for expr in ast.iter_child_nodes(stmt):
+                if isinstance(expr, ast.stmt):
+                    continue
+                self._scan_expr(expr)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub \
+                        and isinstance(sub[0], ast.stmt):
+                    self._scan_stmts(sub)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._scan_stmts(handler.body)
+
+    def _scan_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._check_call(node)
+            if isinstance(node.func, ast.Name):
+                self._queue_fn(node.func.id)
+
+    def _check_call(self, node: ast.Call) -> None:
+        module = self.module
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
+            dotted = module.dotted(func)
+            self.findings.append(module.finding(
+                "HS101", node,
+                f"'{dotted or '...' + func.attr}()' forces a device sync "
+                "in a step-loop hot path; fetch on the telemetry sync "
+                "cadence instead"))
+            return
+        dotted = module.dotted(func)
+        if dotted in _SYNC_CALLS:
+            self.findings.append(module.finding(
+                "HS101", node,
+                f"'{dotted}(...)' forces a device->host transfer in a "
+                "step-loop hot path; stage/accumulate on device and fetch "
+                "on the sync cadence"))
+            return
+        if dotted in ("float", "int") and len(node.args) == 1 \
+                and not node.keywords \
+                and not _host_safe(module, node.args[0]):
+            self.findings.append(module.finding(
+                "HS101", node,
+                f"'{dotted}(...)' on a (possibly device) value in a "
+                "step-loop hot path is a blocking host fetch; accumulate "
+                "on device, or suppress with a comment proving the value "
+                "is host-resident"))
+
+
+def check(module: Module, registry=None) -> List[Finding]:
+    # A timed loop inside a hot-marked function is scanned by both entry
+    # points; report each flagged node once.
+    seen: Set[Finding] = set()
+    out: List[Finding] = []
+    for f in _HotScanner(module).run():
+        if f not in seen:
+            seen.add(f)
+            out.append(f)
+    return out

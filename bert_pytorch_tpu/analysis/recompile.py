@@ -1,0 +1,260 @@
+"""RC201/RC202/RC203 — recompile and retrace hazards at jit boundaries.
+
+Every recompile of a BERT-large step costs minutes; the CompileMonitor
+attributes them after they happen, these checks prevent the three
+classic causes from landing:
+
+* **RC201** — a *collection-typed* argument (list/dict/set literal or
+  comprehension) passed at a position a ``jax.jit``/``pjit`` declared
+  static (``static_argnums``/``static_argnames``). Unhashable statics
+  raise at best; hashable-but-freshly-built collections (tuples of
+  tuples) silently miss the jit cache every call. Pass a hashable
+  singleton (module constant, frozen dataclass) instead.
+
+* **RC202** — a jitted function closing over *module-level mutable
+  state* (a lowercase module global bound to a list/dict/set). The
+  closure value is baked in at trace time: mutations after the first
+  call are silently ignored, and rebinding the global forces a retrace.
+  ALL_CAPS module constants are exempt by convention — the name says
+  "never mutated".
+
+* **RC203** — a *numeric Python literal* passed at a static position.
+  Each distinct value compiles a new executable; a value that belongs
+  in the computation should be a weak-typed array argument (dynamic),
+  and a true constant belongs in the function, not the call site.
+  String/bool/None statics are mode flags with tiny cardinality and are
+  not flagged.
+
+All three are resolved lexically per module: ``g = jax.jit(f,
+static_argnames=("mode",))`` records g's static signature; later
+``g(x, mode=[...])`` call sites are checked against it. Decorated defs
+(``@jax.jit``, ``@partial(jax.jit, ...)``) are handled the same way.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from bert_pytorch_tpu.analysis.core import Finding, Module
+
+CHECKS = {
+    "RC201": "collection-typed argument at a jit static position "
+             "(unhashable or cache-missing every call)",
+    "RC202": "jitted function closes over module-level mutable state",
+    "RC203": "numeric Python literal at a jit static position "
+             "(per-value recompile; pass a weak-typed array instead)",
+}
+
+_JIT_CALLS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "pjit",
+              "jit"}
+_PARTIAL_CALLS = {"functools.partial", "partial"}
+_COLLECTION_NODES = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp, ast.GeneratorExp)
+_MUTABLE_CTORS = {"list", "dict", "set", "collections.defaultdict",
+                  "collections.deque", "collections.OrderedDict",
+                  "collections.Counter"}
+
+
+@dataclass
+class _JitSig:
+    static_nums: Tuple[int, ...] = ()
+    static_names: Tuple[str, ...] = ()
+    wrapped: Optional[str] = None  # name of the wrapped FunctionDef
+    node: Optional[ast.AST] = None
+
+    @property
+    def has_statics(self) -> bool:
+        return bool(self.static_nums or self.static_names)
+
+
+def _literal_ints(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _literal_strs(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(elt.value for elt in node.elts
+                     if isinstance(elt, ast.Constant)
+                     and isinstance(elt.value, str))
+    return ()
+
+
+def _jit_sig_from_call(module: Module, call: ast.Call) -> Optional[_JitSig]:
+    """The static signature when ``call`` is jax.jit/pjit(...), else None.
+    ``partial(jax.jit, ...)`` unwraps one level (the decorator idiom)."""
+    dotted = module.dotted(call.func)
+    if dotted in _PARTIAL_CALLS and call.args:
+        inner_dotted = module.dotted(call.args[0])
+        if inner_dotted in _JIT_CALLS:
+            sig = _JitSig(node=call)
+            for kw in call.keywords:
+                _fill_sig(sig, kw)
+            return sig
+        return None
+    if dotted not in _JIT_CALLS:
+        return None
+    sig = _JitSig(node=call)
+    if call.args and isinstance(call.args[0], ast.Name):
+        sig.wrapped = call.args[0].id
+    if call.args and isinstance(call.args[0], ast.Lambda):
+        sig.wrapped = None
+    for kw in call.keywords:
+        _fill_sig(sig, kw)
+    return sig
+
+
+def _fill_sig(sig: _JitSig, kw: ast.keyword) -> None:
+    if kw.arg == "static_argnums":
+        sig.static_nums = _literal_ints(kw.value)
+    elif kw.arg == "static_argnames":
+        sig.static_names = _literal_strs(kw.value)
+
+
+class _State:
+    def __init__(self, module: Module):
+        self.module = module
+        # name -> static signature, for names bound to a jit result.
+        self.jitted_names: Dict[str, _JitSig] = {}
+        # FunctionDefs that are traced under jit (decorated, or passed
+        # to a jit call by name, incl. lambdas handled inline).
+        self.jitted_fns: List[ast.FunctionDef] = []
+        self.jitted_lambdas: List[ast.Lambda] = []
+        # lowercase module-level names bound to mutable collections.
+        self.mutable_globals: Set[str] = set()
+
+
+def _collect(module: Module) -> _State:
+    state = _State(module)
+    fn_defs = {n.name: n for n in ast.walk(module.tree)
+               if isinstance(n, ast.FunctionDef)}
+
+    for stmt in module.tree.body:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            if value is None:
+                continue
+            is_mutable = isinstance(value, _COLLECTION_NODES) or (
+                isinstance(value, ast.Call)
+                and module.dotted(value.func) in _MUTABLE_CTORS)
+            if is_mutable:
+                for t in targets:
+                    if isinstance(t, ast.Name) and not t.id.isupper():
+                        state.mutable_globals.add(t.id)
+
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            sig = _jit_sig_from_call(module, node)
+            if sig is None:
+                continue
+            if sig.wrapped and sig.wrapped in fn_defs:
+                state.jitted_fns.append(fn_defs[sig.wrapped])
+            if node.args and isinstance(node.args[0], ast.Lambda):
+                state.jitted_lambdas.append(node.args[0])
+            parent = module.parents.get(node)
+            # name = jax.jit(f, ...) records the callable's static sig.
+            if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                    and isinstance(parent.targets[0], ast.Name):
+                state.jitted_names[parent.targets[0].id] = sig
+            # tele.instrument(jax.jit(f, ...), "name") and similar
+            # wrappers: the sig follows the enclosing assignment.
+            if isinstance(parent, ast.Call):
+                outer = module.parents.get(parent)
+                if isinstance(outer, ast.Assign) \
+                        and len(outer.targets) == 1 \
+                        and isinstance(outer.targets[0], ast.Name):
+                    state.jitted_names[outer.targets[0].id] = sig
+        elif isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                dotted = module.dotted(dec)
+                if dotted in _JIT_CALLS:
+                    state.jitted_fns.append(node)
+                elif isinstance(dec, ast.Call):
+                    sig = _jit_sig_from_call(module, dec)
+                    if sig is not None:
+                        state.jitted_fns.append(node)
+                        state.jitted_names[node.name] = sig
+    return state
+
+
+def _check_call_sites(state: _State) -> List[Finding]:
+    module = state.module
+    findings: List[Finding] = []
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id in state.jitted_names):
+            continue
+        sig = state.jitted_names[node.func.id]
+        if not sig.has_statics:
+            continue
+        static_args = [(f"position {i}", node.args[i])
+                       for i in sig.static_nums if i < len(node.args)]
+        static_args += [(f"'{kw.arg}'", kw.value) for kw in node.keywords
+                        if kw.arg in sig.static_names]
+        for where, arg in static_args:
+            if isinstance(arg, _COLLECTION_NODES):
+                findings.append(module.finding(
+                    "RC201", arg,
+                    f"collection literal passed at static {where} of "
+                    f"jitted '{node.func.id}': unhashable statics raise, "
+                    "freshly-built ones miss the jit cache every call"))
+            elif isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, (int, float)) \
+                    and not isinstance(arg.value, bool):
+                findings.append(module.finding(
+                    "RC203", arg,
+                    f"numeric literal {arg.value!r} at static {where} of "
+                    f"jitted '{node.func.id}' recompiles per value; pass "
+                    "it as a weak-typed array argument or hoist it into "
+                    "the function"))
+    return findings
+
+
+def _check_closures(state: _State) -> List[Finding]:
+    module = state.module
+    findings: List[Finding] = []
+    if not state.mutable_globals:
+        return findings
+
+    def scan(fn_node: ast.AST, body, name: str) -> None:
+        # Names rebound locally shadow the module global.
+        local: Set[str] = set()
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.arg):
+                local.add(sub.arg)
+            elif isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                local.add(sub.id)
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                    and sub.id in state.mutable_globals \
+                    and sub.id not in local:
+                findings.append(module.finding(
+                    "RC202", sub,
+                    f"jitted {name} reads module-level mutable "
+                    f"'{sub.id}': its value is baked in at trace time "
+                    "(mutations ignored, rebinds retrace); pass it as an "
+                    "argument or make it an ALL_CAPS constant"))
+
+    for fn in state.jitted_fns:
+        scan(fn, fn.body, f"function '{fn.name}'")
+    for lam in state.jitted_lambdas:
+        scan(lam, [lam.body], "lambda")
+    return findings
+
+
+def check(module: Module, registry=None) -> List[Finding]:
+    state = _collect(module)
+    return _check_call_sites(state) + _check_closures(state)

@@ -1,0 +1,207 @@
+"""RN301/RN302 — PRNG hygiene.
+
+* **RN301** — a PRNG key consumed twice without an intervening
+  ``split``. JAX keys are values, not stateful generators: drawing from
+  the same key twice yields IDENTICAL randomness (correlated dropout
+  masks, repeated initializations) with no runtime error. Tracked per
+  function scope: names bound from ``jax.random.PRNGKey/split/fold_in``
+  are keys; passing one to a consuming ``jax.random.*`` call (or into an
+  ``rngs={...}`` dict / ``.apply``/``.init`` call) consumes it; a second
+  consumption without a re-bind flags. Loop bodies are scanned twice, so
+  a consumption inside a loop of a key created outside it flags on the
+  simulated second iteration — the classic "same dropout mask every
+  step" bug. ``fold_in`` does not consume (folding distinct data into
+  one base key is its purpose); ``split`` consumes its argument and its
+  targets become fresh keys.
+
+* **RN302** — a seed derived from wall-clock time
+  (``PRNGKey(int(time.time()))``, ``default_rng(time.time_ns())``).
+  Wall-clock seeds destroy the bit-exact resume/replay story the fault
+  tolerance layer depends on (PR 5: (seed, epoch, index)-derived
+  masking), and two processes started in the same second silently share
+  a stream. Seeds come from config, never from the clock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from bert_pytorch_tpu.analysis.core import Finding, Module
+
+CHECKS = {
+    "RN301": "PRNG key consumed twice without an intervening split",
+    "RN302": "PRNG seed derived from wall-clock time",
+}
+
+_KEY_MAKERS = {"jax.random.PRNGKey", "jax.random.key", "jax.random.split",
+               "jax.random.fold_in", "jax.random.clone"}
+# jax.random.* callables that do NOT consume their key argument.
+_NON_CONSUMING = {"PRNGKey", "key", "fold_in", "key_data", "wrap_key_data",
+                  "clone", "key_impl"}
+_SEED_SINKS = {"jax.random.PRNGKey", "jax.random.key", "numpy.random.seed",
+               "numpy.random.default_rng", "random.seed", "random.Random"}
+_CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
+                "time.monotonic_ns", "time.perf_counter",
+                "datetime.datetime.now", "datetime.datetime.utcnow",
+                "datetime.datetime.today"}
+
+
+def _wallclock_seed_findings(module: Module) -> List[Finding]:
+    findings = []
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if module.dotted(node.func) not in _SEED_SINKS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Call) \
+                        and module.dotted(sub.func) in _CLOCK_CALLS:
+                    findings.append(module.finding(
+                        "RN302", node,
+                        "seed derived from wall-clock time breaks "
+                        "bit-exact resume/replay; take the seed from "
+                        "config (and fold_in run identifiers if needed)"))
+                    break
+    return findings
+
+
+class _KeyTracker:
+    """Linear abstract interpretation of one function body: which names
+    hold PRNG keys, and has each been consumed since its last bind."""
+
+    def __init__(self, module: Module):
+        self.module = module
+        self.findings: List[Finding] = []
+
+    # state: name -> consumed? (True after one consumption)
+
+    def scan_function(self, body: List[ast.stmt]) -> None:
+        self._scan(body, {})
+
+    def _scan(self, stmts: List[ast.stmt], state: Dict[str, bool]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope; scanned by its own tracker
+            if isinstance(stmt, ast.If):
+                self._consume_in_expr(stmt.test, state)
+                body_state = dict(state)
+                else_state = dict(state)
+                self._scan(stmt.body, body_state)
+                self._scan(stmt.orelse, else_state)
+                # Merge: consumed in either branch counts as consumed
+                # after the join (one dynamic path uses it; a later use
+                # would be that path's second). Keys created in only one
+                # branch are dropped — conservatively untracked.
+                for name in list(state):
+                    state[name] = body_state.get(name, True) \
+                        or else_state.get(name, True)
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                if isinstance(stmt, ast.For):
+                    self._consume_in_expr(stmt.iter, state)
+                else:
+                    self._consume_in_expr(stmt.test, state)
+                # Two passes simulate the second iteration: a key made
+                # outside the loop and consumed inside it flags here.
+                self._scan(stmt.body, state)
+                self._scan(stmt.body, state)
+                self._scan(stmt.orelse, state)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._consume_in_expr(item.context_expr, state)
+                self._scan(stmt.body, state)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._scan(stmt.body, state)
+                for handler in stmt.handlers:
+                    self._scan(handler.body, state)
+                self._scan(stmt.orelse, state)
+                self._scan(stmt.finalbody, state)
+                continue
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = stmt.value
+                if value is not None:
+                    self._consume_in_expr(value, state)
+                targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                    else [stmt.target]
+                fresh = value is not None and isinstance(value, ast.Call) \
+                    and self.module.dotted(value.func) in _KEY_MAKERS
+                for t in targets:
+                    names = [t] if isinstance(t, ast.Name) else (
+                        [e for e in t.elts if isinstance(e, ast.Name)]
+                        if isinstance(t, (ast.Tuple, ast.List)) else [])
+                    for n in names:
+                        if fresh:
+                            state[n.id] = False
+                        else:
+                            state.pop(n.id, None)
+                continue
+            # Any other statement: scan its expressions for consumption.
+            for node in ast.iter_child_nodes(stmt):
+                if not isinstance(node, ast.stmt):
+                    self._consume_in_expr(node, state)
+
+    def _consume_in_expr(self, expr: ast.AST, state: Dict[str, bool]) -> None:
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = self.module.dotted(node.func) or ""
+            consumed_names: List[ast.Name] = []
+            if dotted.startswith("jax.random.") \
+                    and dotted.rsplit(".", 1)[1] not in _NON_CONSUMING:
+                for arg in node.args[:1]:  # the key is the first argument
+                    if isinstance(arg, ast.Name):
+                        consumed_names.append(arg)
+            # rngs={"dropout": key} / .apply(..., rngs=...) / .init(key, ...)
+            if dotted.endswith((".apply", ".init")):
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        consumed_names.append(arg)
+                    elif isinstance(arg, ast.Dict):
+                        consumed_names.extend(
+                            v for v in arg.values if isinstance(v, ast.Name))
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "rngs":
+                        if isinstance(kw.value, ast.Name):
+                            consumed_names.append(kw.value)
+                        elif isinstance(kw.value, ast.Dict):
+                            consumed_names.extend(
+                                v for v in kw.value.values
+                                if isinstance(v, ast.Name))
+            for name_node in consumed_names:
+                name = name_node.id
+                if name not in state:
+                    continue
+                if state[name]:
+                    self.findings.append(self.module.finding(
+                        "RN301", node,
+                        f"PRNG key '{name}' consumed again without an "
+                        "intervening split: identical randomness both "
+                        "times (split the key, or fold_in distinguishing "
+                        "data)"))
+                else:
+                    state[name] = True
+
+
+def check(module: Module, registry=None) -> List[Finding]:
+    findings = _wallclock_seed_findings(module)
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            tracker = _KeyTracker(module)
+            tracker.scan_function(node.body)
+            findings.extend(tracker.findings)
+    tracker = _KeyTracker(module)
+    tracker.scan_function(
+        [s for s in module.tree.body
+         if not isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef))])
+    findings.extend(tracker.findings)
+    return findings

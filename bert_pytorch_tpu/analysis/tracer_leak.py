@@ -1,0 +1,85 @@
+"""TL401 — traced values assigned to ``self.*`` or globals inside jitted
+functions.
+
+Inside a jit trace every intermediate is a tracer. Storing one on
+``self`` or a module global "works" at trace time, then either leaks a
+``UnexpectedTracerError`` much later (jax >= 0.4 with leak checking) or
+— worse — silently pins the FIRST trace's value forever: the attribute
+holds a stale tracer/constant while every subsequent call recomputes
+fresh values that go nowhere. State leaves a jitted function through its
+return value, never through side effects.
+
+Detection is lexical: for every function traced under jit in the module
+(``@jax.jit`` decorated, ``jax.jit(f)``-wrapped by name, or a lambda
+passed to jit — the same resolution recompile.py uses), flag
+
+* ``self.<attr> = value`` / ``self.<attr> += value``,
+* assignment to a name declared ``global`` in that function,
+
+unless the assigned value is a plain constant (setting a flag to a
+literal is config, not a leak).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from bert_pytorch_tpu.analysis.core import Finding, Module
+from bert_pytorch_tpu.analysis.recompile import _collect
+
+CHECKS = {
+    "TL401": "traced value assigned to self.*/global inside a jitted "
+             "function (state must leave jit via the return value)",
+}
+
+
+def _scan_jitted(module: Module, fn: ast.AST, label: str) -> List[Finding]:
+    findings: List[Finding] = []
+    global_names = {
+        name
+        for node in ast.walk(fn) if isinstance(node, ast.Global)
+        for name in node.names
+    }
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        if isinstance(value, ast.Constant):
+            continue
+        for t in targets:
+            leaks = None
+            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                leaks = f"self.{t.attr}"
+            elif isinstance(t, ast.Name) and t.id in global_names:
+                leaks = f"global '{t.id}'"
+            elif isinstance(t, ast.Subscript):
+                base = t.value
+                if isinstance(base, ast.Attribute) \
+                        and isinstance(base.value, ast.Name) \
+                        and base.value.id == "self":
+                    leaks = f"self.{base.attr}[...]"
+                elif isinstance(base, ast.Name) and base.id in global_names:
+                    leaks = f"global '{base.id}[...]'"
+            if leaks:
+                findings.append(module.finding(
+                    "TL401", node,
+                    f"{label} assigns a traced value to {leaks}: the "
+                    "stored tracer is stale after the first trace (or "
+                    "raises UnexpectedTracerError); return the value "
+                    "instead"))
+    return findings
+
+
+def check(module: Module, registry=None) -> List[Finding]:
+    state = _collect(module)
+    findings: List[Finding] = []
+    for fn in state.jitted_fns:
+        findings.extend(_scan_jitted(module, fn, f"jitted '{fn.name}'"))
+    for lam in state.jitted_lambdas:
+        findings.extend(_scan_jitted(module, lam, "jitted lambda"))
+    return findings

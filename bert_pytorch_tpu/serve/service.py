@@ -51,6 +51,11 @@ class ServingService:
         self.batcher = batcher
         self.telemetry = telemetry or ServeTelemetry()
         self._clock = clock
+        # Guards _thread and _draining (the concurrency registry,
+        # analysis/concurrency.py, enforced by jaxlint LK501): begin_drain
+        # runs on a signal-handling/main thread while every HTTP worker
+        # reads _draining in submit and /healthz reads _thread liveness.
+        self._state_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._draining = False
@@ -63,7 +68,9 @@ class ServingService:
         handler's JSON-able result. Raises ValueError for bad payloads /
         unknown tasks, TimeoutError when the deadline passes,
         ServiceDraining once shutdown has begun."""
-        if self._draining:
+        with self._state_lock:
+            draining = self._draining
+        if draining:
             raise ServiceDraining(
                 "service is draining for shutdown; not accepting requests")
         spec = self.engine.tasks.get(task)
@@ -141,38 +148,50 @@ class ServingService:
             self.engine.warmup()
         self.telemetry.reset_clock()  # rps measures serving, not warmup
         self._stop.clear()
-        self._draining = False
-        self._thread = threading.Thread(
+        thread = threading.Thread(
             target=self._loop, name="serve-dispatch", daemon=True)
-        self._thread.start()
+        with self._state_lock:
+            self._draining = False
+            self._thread = thread
+        thread.start()
 
     # -- health / drain ----------------------------------------------------
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        with self._state_lock:
+            return self._draining
 
     @property
     def dispatch_alive(self) -> bool:
         """True while the dispatch thread exists and is running — the
         liveness /healthz must report (an HTTP thread answering proves
         nothing about the thread that actually serves results)."""
-        return self._thread is not None and self._thread.is_alive()
+        with self._state_lock:
+            thread = self._thread
+        return thread is not None and thread.is_alive()
 
     def health(self) -> dict:
         """Liveness snapshot for /healthz (serve/http.py): ``ok`` only
         when the dispatch thread is alive and not draining — anything
-        else is a 503 so load balancers stop routing here."""
-        if self._draining:
+        else is a 503 so load balancers stop routing here. One lock
+        acquisition reads a CONSISTENT (draining, thread) pair — the
+        status string and the boolean fields must not disagree mid-drain.
+        """
+        with self._state_lock:
+            draining = self._draining
+            thread = self._thread
+        alive = thread is not None and thread.is_alive()
+        if draining:
             status = "draining"
-        elif self.dispatch_alive:
+        elif alive:
             status = "ok"
         else:
             status = "not_serving"  # never started, or dispatch died
         return {
             "status": status,
-            "dispatch_alive": self.dispatch_alive,
-            "draining": self._draining,
+            "dispatch_alive": alive,
+            "draining": draining,
             "queue_depth": self.batcher.depth(),
         }
 
@@ -181,7 +200,8 @@ class ServingService:
         HTTP 503; already-accepted requests keep being served. Called at
         the start of :meth:`stop` (or earlier, by a signal handler that
         wants health probes failing before the HTTP listener closes)."""
-        self._draining = True
+        with self._state_lock:
+            self._draining = True
 
     def stop(self, drain_s: float = 2.0) -> None:
         """Graceful drain: stop accepting, flush already-queued requests
@@ -193,7 +213,10 @@ class ServingService:
             time.sleep(0.01)
         self._stop.set()
         self.batcher.close()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        # Detach under the lock, join OUTSIDE it: holding _state_lock
+        # through a 5s join would block every /healthz probe mid-shutdown.
+        with self._state_lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
         self.telemetry.finish()

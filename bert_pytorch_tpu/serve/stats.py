@@ -200,10 +200,14 @@ class ServeTelemetry:
     def finish(self) -> Optional[dict]:
         """Flush the partial window and emit the serve_summary record."""
         self.flush_window()
-        if not self.total_requests:
+        # snapshot() reads the run totals under the lock — the bare
+        # total_requests read that used to sit here raced the dispatch
+        # thread's observe_batch (jaxlint LK501 finding, fixed in PR 7).
+        snap = self.snapshot()
+        if not snap["requests"]:
             return None
         record = {"kind": "serve_summary", "tag": "serve"}
-        record.update(self.snapshot())
+        record.update(snap)
         if self.emit is not None:
             self.emit(record)
         return record

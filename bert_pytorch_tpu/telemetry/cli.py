@@ -5,9 +5,11 @@ and builds its :class:`~bert_pytorch_tpu.telemetry.runner.TrainTelemetry`
 via :func:`from_args` — one copy of the flags, help text, and
 default-path fallbacks instead of five drifting ones. Per-runner knobs are
 constructor arguments (``window_default``: pretraining logs denser windows
-than the short finetune runs; ``sync_every_default``: runners whose loop
-already fetches the loss every step keep the full per-step decomposition,
-runners with an async hot loop sample it).
+than the short finetune runs; ``sync_every_default``: the small-model
+finetune runners keep the full per-step decomposition — a per-step sync
+is cheap there and buys step-exact sentinels — while the pretraining hot
+loop samples it; since PR 7 no loop fetches the loss outside the sync
+cadence, jaxlint HS101 enforces it).
 """
 
 from __future__ import annotations

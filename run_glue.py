@@ -80,8 +80,12 @@ def parse_arguments(argv=None):
     from bert_pytorch_tpu.data import device_prefetch as dp_cli
     dp_cli.add_cli_args(parser)
     # telemetry (docs/telemetry.md)
-    # telemetry: canonical flag set shared by every runner; this loop
-    # fetches the loss every step anyway, so per-step sync is free
+    # telemetry: canonical flag set shared by every runner. Default
+    # sync cadence stays 1: these are small models where a per-step
+    # sync is cheap and step-exact sentinels are worth it — but since
+    # PR 7 the loop itself no longer fetches the loss per step (it
+    # accumulates on device; jaxlint HS101), so a user-set
+    # --telemetry_sync_every N genuinely syncs only every Nth step
     # (telemetry/cli.py; docs/telemetry.md)
     telemetry.add_cli_args(parser, sync_every_default=1)
     args = parser.parse_args(argv)
@@ -252,7 +256,14 @@ def main(args):
     prefetcher = None
     try:
         for epoch in range(args.epochs):
-            losses = []
+            # Epoch loss accumulates ON DEVICE: one scalar add rides each
+            # step's dispatch, and the only host fetch is the epoch-end
+            # mean. A per-step float(loss) here would be a blocking host
+            # sync every step — jaxlint HS101 (docs/static_analysis.md)
+            # now enforces what used to be a review-memory rule, and
+            # --telemetry_sync_every > 1 actually buys something.
+            loss_sum = None
+            n_steps = 0
             # Device prefetch: the batch is staged onto device by a
             # background thread while the previous step runs; data_wait
             # then measures only featurization stalls, with the staging
@@ -271,8 +282,12 @@ def main(args):
                 tele.dispatch_done()
                 global_step += 1
                 tele.step_done(global_step, metrics)
-                losses.append(float(metrics["loss"]))
-                seen += int(valid.sum())
+                loss = metrics["loss"]
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                n_steps += 1
+                # valid is the host-side numpy padding mask from
+                # batches() — the stage fn device_puts only the batch.
+                seen += int(valid.sum())  # jaxlint: disable=HS101
                 if args.save_steps and args.output_dir \
                         and global_step % args.save_steps == 0:
                     # Periodic save, async: the loop pays the device-side
@@ -285,9 +300,10 @@ def main(args):
                 if stop.requested:
                     break
             prefetcher.close()
-            if losses:
+            if n_steps:
                 logger.info(
-                    f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
+                    f"epoch {epoch}: "
+                    f"train_loss={float(loss_sum) / n_steps:.4f}")
             if stop.requested:
                 logger.info(
                     f"termination signal ({stop.signal_name}) received; "

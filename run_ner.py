@@ -76,8 +76,12 @@ def parse_arguments(argv=None):
     dp_cli.add_cli_args(parser)
     # telemetry (docs/telemetry.md) — this runner has no output dir, so the
     # file sinks are opt-in
-    # telemetry: canonical flag set shared by every runner; this loop
-    # fetches the loss every step anyway, so per-step sync is free
+    # telemetry: canonical flag set shared by every runner. Default
+    # sync cadence stays 1: these are small models where a per-step
+    # sync is cheap and step-exact sentinels are worth it — but since
+    # PR 7 the loop itself no longer fetches the loss per step (it
+    # accumulates on device; jaxlint HS101), so a user-set
+    # --telemetry_sync_every N genuinely syncs only every Nth step
     # (telemetry/cli.py; docs/telemetry.md)
     telemetry.add_cli_args(parser, sync_every_default=1)
     args = parser.parse_args(argv)
@@ -234,7 +238,11 @@ def main(args):
     try:
         for epoch in range(args.epochs):
             t0 = time.perf_counter()
-            losses = []
+            # Device-side epoch loss accumulation (run_glue pattern): a
+            # per-step float(loss) would block on the device every step
+            # (jaxlint HS101); the epoch-end mean is the only fetch.
+            loss_sum = None
+            n_steps = 0
             # Device prefetch + h2d_wait attribution (run_glue pattern).
             prefetcher = DevicePrefetcher(
                 batches(datasets["train"], args.batch_size, True, rng),
@@ -249,7 +257,9 @@ def main(args):
                 tele.dispatch_done()
                 global_step += 1
                 tele.step_done(global_step, metrics)
-                losses.append(float(metrics["loss"]))
+                loss = metrics["loss"]
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                n_steps += 1
                 if args.save_steps and args.output_dir \
                         and global_step % args.save_steps == 0:
                     # Periodic async save (joined before exit below).
@@ -267,7 +277,8 @@ def main(args):
                     f"(exit code {preemption.EXIT_PREEMPTED})")
                 tele.emit(preemption.preemption_record(global_step, stop))
                 break
-            msg = (f"epoch {epoch}: train_loss={np.mean(losses):.4f} "
+            mean_loss = float(loss_sum) / n_steps if n_steps else float("nan")
+            msg = (f"epoch {epoch}: train_loss={mean_loss:.4f} "
                    f"({time.perf_counter() - t0:.1f}s)")
             if "val" in datasets:
                 val_loss, val_f1 = evaluate("val")

@@ -72,8 +72,12 @@ def parse_arguments(argv=None):
     from bert_pytorch_tpu.data import device_prefetch as dp_cli
     dp_cli.add_cli_args(parser)
     # telemetry (docs/telemetry.md)
-    # telemetry: canonical flag set shared by every runner; this loop
-    # fetches the loss every step anyway, so per-step sync is free
+    # telemetry: canonical flag set shared by every runner. Default
+    # sync cadence stays 1: these are small models where a per-step
+    # sync is cheap and step-exact sentinels are worth it — but since
+    # PR 7 the loop itself no longer fetches the loss per step (it
+    # accumulates on device; jaxlint HS101), so a user-set
+    # --telemetry_sync_every N genuinely syncs only every Nth step
     # (telemetry/cli.py; docs/telemetry.md)
     telemetry.add_cli_args(parser, sync_every_default=1)
     args = parser.parse_args(argv)
@@ -207,7 +211,11 @@ def main(args):
     prefetcher = None
     try:
         for epoch in range(args.epochs):
-            losses = []
+            # Device-side epoch loss accumulation (run_glue pattern): a
+            # per-step float(loss) would block on the device every step
+            # (jaxlint HS101); the epoch-end mean is the only fetch.
+            loss_sum = None
+            n_steps = 0
             # Device prefetch + h2d_wait attribution (run_glue pattern).
             prefetcher = DevicePrefetcher(
                 batches(arrays["train"], args.batch_size, True, rng),
@@ -223,8 +231,12 @@ def main(args):
                 tele.dispatch_done()
                 global_step += 1
                 tele.step_done(global_step, metrics)
-                losses.append(float(metrics["loss"]))
-                seen += int(valid.sum())
+                loss = metrics["loss"]
+                loss_sum = loss if loss_sum is None else loss_sum + loss
+                n_steps += 1
+                # valid is the host-side numpy padding mask from
+                # batches() — the stage fn device_puts only the batch.
+                seen += int(valid.sum())  # jaxlint: disable=HS101
                 if args.save_steps and args.output_dir \
                         and global_step % args.save_steps == 0:
                     # Periodic async save (joined before exit below).
@@ -235,9 +247,10 @@ def main(args):
                 if stop.requested:
                     break
             prefetcher.close()
-            if losses:
+            if n_steps:
                 logger.info(
-                    f"epoch {epoch}: train_loss={np.mean(losses):.4f}")
+                    f"epoch {epoch}: "
+                    f"train_loss={float(loss_sum) / n_steps:.4f}")
             if stop.requested:
                 logger.info(
                     f"termination signal ({stop.signal_name}) received; "

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Pre-commit convenience wrapper for the unified lint gate:
+#   jaxlint (docs/static_analysis.md) over the package + runners + tools,
+#   then the telemetry record schema over repo-root *.jsonl artifacts.
+#
+#   scripts/lint.sh                # everything
+#   scripts/lint.sh FOO.jsonl      # code + just this artifact
+#
+# jax-free and fast (~5 s): safe as a git pre-commit hook on machines
+# without the accelerator stack:
+#   ln -s ../../scripts/lint.sh .git/hooks/pre-commit
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python tools/check_all.py "$@"

@@ -1,0 +1,29 @@
+"""HS101 negative: every fetch sits at a declared sync-cadence site
+(modulus gate, last_step_synced guard, once-per-run equality gate) or is
+host-safe (shape metadata, len, args scalars)."""
+import jax
+import numpy as np
+
+
+def evaluate(params, batches):
+    # Not reachable from a timed loop and not marked hot: eval loops
+    # sync per batch by design.
+    return [float(np.asarray(b).mean()) for b in batches]
+
+
+def train(tele, loader, train_step, state, args):
+    step = 0
+    for batch in tele.timed(iter(loader)):
+        state, metrics = train_step(state, batch)
+        step += 1
+        seq_len = int(batch["input_ids"].shape[-1])
+        n = len(batch)
+        lr = float(args.lr)
+        if step == 1:
+            jax.block_until_ready(metrics)
+        if step % args.log_steps == 0:
+            loss = float(metrics["loss"])
+        if tele.last_step_synced:
+            grad_norm = float(metrics["grad_norm"])
+        tele.step_done(step, metrics)
+    return state, seq_len, n, lr

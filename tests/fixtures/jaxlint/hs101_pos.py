@@ -1,0 +1,27 @@
+"""HS101 positive: blocking host fetches inside a tele.timed step loop,
+including one reached through same-module call propagation and one in a
+# jaxlint: hot marked function."""
+import jax
+import numpy as np
+
+
+def fetch_norm(metrics):
+    # Reached from the hot loop below by bare-name call: hot by
+    # propagation.
+    return metrics["grad_norm"].item()
+
+
+# jaxlint: hot
+def consume_outputs(outputs):
+    return np.asarray(outputs)
+
+
+def train(tele, loader, train_step, state):
+    losses = []
+    for batch in tele.timed(iter(loader)):
+        state, metrics = train_step(state, batch)
+        tele.step_done(1, metrics)
+        losses.append(float(metrics["loss"]))
+        grad_norm = fetch_norm(metrics)
+        host = jax.device_get(metrics)
+    return state, losses, grad_norm, host

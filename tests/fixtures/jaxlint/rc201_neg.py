@@ -1,0 +1,21 @@
+"""RC201 negative: hashable statics (tuple literal, module constant,
+plain name) and collections at DYNAMIC positions are fine."""
+import jax
+
+MODES = ("train", "eval")
+
+
+def forward(x, cfg):
+    return x
+
+
+g = jax.jit(forward, static_argnames=("cfg",))
+plain = jax.jit(forward)
+
+
+def call(x, cfg_obj):
+    a = g(x, cfg=(1, 2, 3))
+    b = g(x, cfg=MODES)
+    c = g(x, cfg=cfg_obj)
+    d = plain(x, [1, 2, 3])  # dynamic position: a list is just a pytree
+    return a, b, c, d

@@ -1,0 +1,21 @@
+"""RC201 positive: collection literals passed at jit static positions
+(by static_argnames keyword and by static_argnums position)."""
+import jax
+
+
+def forward(x, cfg):
+    return x
+
+
+def forward2(x, dims):
+    return x
+
+
+g = jax.jit(forward, static_argnames=("cfg",))
+h = jax.jit(forward2, static_argnums=(1,))
+
+
+def call(x):
+    a = g(x, cfg=[1, 2, 3])
+    b = h(x, {"hidden": 4})
+    return a, b

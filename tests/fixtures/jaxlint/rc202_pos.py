@@ -1,0 +1,20 @@
+"""RC202 positive: jitted functions (decorated, wrapped, lambda) closing
+over lowercase module-level mutable state."""
+import jax
+
+_scale_table = {}
+_history = []
+
+
+@jax.jit
+def apply_scale(x):
+    return x * _scale_table["s"]
+
+
+def step(x):
+    return x + len(_history)
+
+
+step_jit = jax.jit(step)
+
+identity = jax.jit(lambda xs: (xs, _scale_table))

@@ -1,0 +1,19 @@
+"""RC203 negative: numeric literals at DYNAMIC positions trace as
+weak-typed arrays (no per-value recompile); string/bool statics are
+small-cardinality mode flags."""
+import jax
+
+
+def scaled(x, factor, mode="train"):
+    return x * factor
+
+
+g = jax.jit(scaled, static_argnames=("mode",))
+plain = jax.jit(scaled)
+
+
+def call(x, n):
+    a = plain(x, 0.5)
+    b = g(x, 0.5, mode="eval")
+    c = g(x, n)
+    return a, b, c

@@ -1,0 +1,14 @@
+"""RC203 positive: numeric Python literals at jit static positions —
+each distinct value compiles a fresh executable."""
+import jax
+
+
+def scaled(x, factor):
+    return x * factor
+
+
+g = jax.jit(scaled, static_argnums=(1,))
+
+
+def call(x):
+    return g(x, 0.5)

@@ -1,0 +1,35 @@
+"""RN301 negative: proper key hygiene — split before every draw,
+fold_in for derived streams (non-consuming), per-branch single use."""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    key, a_key, b_key = jax.random.split(key, 3)
+    a = jax.random.normal(a_key, shape)
+    b = jax.random.uniform(b_key, shape)
+    return a, b
+
+
+def loop(n):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for i in range(n):
+        key, sub = jax.random.split(key)
+        out.append(jax.random.normal(sub, ()))
+    return out
+
+
+def folded(base_key, steps):
+    # fold_in derives an independent stream per step without consuming
+    # the base key.
+    return [jax.random.normal(jax.random.fold_in(base_key, i), ())
+            for i in range(steps)]
+
+
+def branches(flag, shape):
+    key = jax.random.PRNGKey(2)
+    if flag:
+        return jax.random.normal(key, shape)
+    else:
+        return jax.random.uniform(key, shape)

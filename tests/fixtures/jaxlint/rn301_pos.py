@@ -1,0 +1,19 @@
+"""RN301 positive: the same key drawn from twice (identical randomness),
+and a key created outside a loop consumed inside it (same dropout mask
+every iteration)."""
+import jax
+
+
+def sample(shape):
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)
+    return a, b
+
+
+def loop(n):
+    key = jax.random.PRNGKey(1)
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, ()))
+    return out

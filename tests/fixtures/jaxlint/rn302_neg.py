@@ -1,0 +1,20 @@
+"""RN302 negative: seeds from config/arguments, with fold_in for derived
+per-step streams; clock calls used for TIMING are not seeds."""
+import time
+
+import jax
+import numpy as np
+
+
+def make_key(args):
+    return jax.random.PRNGKey(args.seed)
+
+
+def make_rng(seed):
+    return np.random.default_rng(seed)
+
+
+def timed_draw(key, shape):
+    t0 = time.perf_counter()
+    out = jax.random.normal(key, shape)
+    return out, time.perf_counter() - t0

@@ -1,0 +1,14 @@
+"""RN302 positive: seeds derived from wall-clock time — two processes
+started in the same second share a stream, and no run can be replayed."""
+import time
+
+import jax
+import numpy as np
+
+
+def make_key():
+    return jax.random.PRNGKey(int(time.time()))
+
+
+def make_rng():
+    return np.random.default_rng(time.time_ns())

@@ -1,0 +1,22 @@
+"""TL401 negative: state leaves jit through return values; non-jitted
+methods may cache on self; constant flag assignments are config, not
+tracer leaks."""
+import jax
+
+
+class Model:
+    @jax.jit
+    def step(self, x):
+        y = x * 2
+        self.compiled = True  # constant: a flag, not a traced value
+        return y
+
+    def cache_result(self, x):
+        # Host-side method, not traced: caching is fine here.
+        self.cache = self.step(x)
+        return self.cache
+
+
+@jax.jit
+def accum(x, total):
+    return x, total + x

@@ -1,0 +1,20 @@
+"""TL401 positive: traced values stored on self / a global inside jitted
+functions — the stored tracer is stale after the first trace."""
+import jax
+
+_last_loss = None
+
+
+class Model:
+    @jax.jit
+    def step(self, x):
+        y = x * 2
+        self.cache = y
+        return y
+
+
+@jax.jit
+def accum(x):
+    global _last_loss
+    _last_loss = x * 0.5
+    return x

@@ -113,6 +113,20 @@ def child_cmd(args, out_dir: str, fault_spec: str = "") -> list:
         # (tests/test_fault_tolerance.py runs this harness).
         "--disable_tensorboard",
         "--telemetry_cost_analysis", "off", "--grad_stats_every", "0",
+        # SYNCHRONOUS checkpoint writes, deliberately overriding the
+        # async default (PR 6): die@N fires right after step N's
+        # checkpoint block, and with async writes the SIGKILL can land
+        # before the background writer commits the newest manifest —
+        # leaving a TORN pair (blob, no sidecar) that verify_checkpoint
+        # reports as no_manifest, so the harness's "corrupt the newest
+        # VERIFIED checkpoint and walk back" setup becomes a coin flip
+        # on a loaded box (observed flaking tier-1). Losing the newest
+        # checkpoint to a kill mid-async-write is BY-DESIGN durability
+        # behavior with its own PR 6 tests
+        # (test_preemption_joins_inflight_async_save etc.); this gate
+        # tests corruption recovery, which needs a deterministic,
+        # durably-manifested checkpoint layout at kill time.
+        "--checkpoint_write", "sync",
     ]
     if fault_spec:
         cmd += ["--fault_spec", fault_spec]

@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Repo-root wrapper for the unified lint gate (``bert-lint``): jaxlint
+over the package + runners + tools, then the telemetry record schema
+over JSONL artifacts. One command for tier-1, the capture harness's
+``commit_artifacts``, and pre-commit hooks::
+
+    python tools/check_all.py                 # lint code + all repo JSONLs
+    python tools/check_all.py CAPTURE.jsonl   # code + just this artifact
+    python tools/check_all.py --skip-jaxlint CAPTURE.jsonl
+
+jax-free — see bert_pytorch_tpu/analysis/check_all.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from bert_pytorch_tpu.analysis.check_all import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

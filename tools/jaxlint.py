@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Repo-root jaxlint wrapper — the command the acceptance gate, tier-1
+test, and pre-commit hook run::
+
+    python tools/jaxlint.py bert_pytorch_tpu run_*.py serve tools
+
+Pure-AST TPU-hazard linter (docs/static_analysis.md): host-sync,
+recompile, RNG, tracer-leak, and lock-discipline checks. The analysis
+package and the ``bert_pytorch_tpu`` package ``__init__`` chain are
+stdlib-only, so this runs in milliseconds with NO jax import — on
+pre-commit hooks, CI boxes, and the 2-core tier-1 box alike (the tier-1
+test asserts the no-jax property).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from bert_pytorch_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
