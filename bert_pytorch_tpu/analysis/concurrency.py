@@ -160,6 +160,11 @@ REGISTRY: Tuple[Entry, ...] = (
     Entry("bert_pytorch_tpu/serve/stats.py", "total_errors",
           cls="ServeTelemetry", kind="lock", locks=("_lock",),
           why="observe_error is called from HTTP worker threads too"),
+    Entry("bert_pytorch_tpu/serve/stats.py", "_cold_start",
+          cls="ServeTelemetry", kind="lock", locks=("_lock",),
+          why="engine-startup stats written once by observe_cold_start "
+              "(the thread that ran warmup) while HTTP workers read them "
+              "via snapshot() for /statsz"),
 
     # -- utils/logging.py: the JSONL sink background emitters write --------
     Entry("bert_pytorch_tpu/utils/logging.py", "_f",

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from bert_pytorch_tpu.config import BertConfig
 from bert_pytorch_tpu import ops
+from bert_pytorch_tpu.ops import quant as quant_ops
 from bert_pytorch_tpu.ops.activations import ACT2FN
 
 Array = jnp.ndarray
@@ -160,19 +161,19 @@ class LinearActivation(nn.Module):
     dtype: Dtype = jnp.float32
     kernel_init_stddev: float = 0.02
     kernel_axes: tuple = ("embed", "mlp")
+    # Inference weight quantization (ops/quant.py): None keeps the exact
+    # fp32-param training module; "bf16"/"int8" are serve-only storage
+    # modes selected by serve/engine.py.
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        y = nn.Dense(
+        y = quant_ops.make_dense(
+            self.quant,
             self.features,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                bert_normal_init(self.kernel_init_stddev), self.kernel_axes
-            ),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, (self.kernel_axes[-1],)
-            ),
+            init_stddev=self.kernel_init_stddev,
+            kernel_axes=self.kernel_axes,
             name="dense",
         )(x)
         # 'bias_gelu'/'bias_tanh' name the reference's fused bias+act CUDA
@@ -271,6 +272,7 @@ class BertSelfAttention(nn.Module):
     dtype: Dtype = jnp.bfloat16
     attention_backend: str = "xla"
     kfac_tap: bool = False
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(
@@ -279,19 +281,15 @@ class BertSelfAttention(nn.Module):
     ) -> Array:
         cfg = self.config
         heads, head_dim = cfg.num_attention_heads, cfg.head_dim
-        init = bert_normal_init(cfg.initializer_range)
 
         def qkv_proj(name):
-            return nn.DenseGeneral(
-                features=(heads, head_dim),
+            return quant_ops.make_dense(
+                self.quant,
+                (heads, head_dim),
                 dtype=self.dtype,
-                param_dtype=jnp.float32,
-                kernel_init=nn.with_logical_partitioning(
-                    init, ("embed", "heads", "kv")
-                ),
-                bias_init=nn.with_logical_partitioning(
-                    nn.initializers.zeros, ("heads", "kv")
-                ),
+                init_stddev=cfg.initializer_range,
+                kernel_axes=("embed", "heads", "kv"),
+                bias_axes=("heads", "kv"),
                 name=name,
             )
 
@@ -328,13 +326,14 @@ class BertSelfAttention(nn.Module):
                 _kfac_input_stat(context, feature_ndim=2),
             )
         # Output projection [B,S,H,D] -> [B,S,hidden] (BertSelfOutput dense).
-        out = nn.DenseGeneral(
-            features=cfg.hidden_size,
+        out = quant_ops.make_dense(
+            self.quant,
+            cfg.hidden_size,
             axis=(-2, -1),
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(init, ("heads", "kv", "embed")),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            init_stddev=cfg.initializer_range,
+            kernel_axes=("heads", "kv", "embed"),
+            bias_axes=("embed",),
             name="output",
         )(context)
         if self.kfac_tap:
@@ -359,17 +358,18 @@ class BertLayer(nn.Module):
     dtype: Dtype = jnp.bfloat16
     attention_backend: str = "xla"
     kfac_tap: bool = False
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, hidden: Array, bias: Array, deterministic: bool = True,
                  sequence_ids: Optional[Array] = None):
         cfg = self.config
-        init = bert_normal_init(cfg.initializer_range)
         attn_out = BertSelfAttention(
             cfg,
             dtype=self.dtype,
             attention_backend=self.attention_backend,
             kfac_tap=self.kfac_tap,
+            quant=self.quant,
             name="attention",
         )(hidden, bias, deterministic, sequence_ids)
         intermediate = LinearActivation(
@@ -378,16 +378,17 @@ class BertLayer(nn.Module):
             dtype=self.dtype,
             kernel_init_stddev=cfg.initializer_range,
             kernel_axes=("embed", "mlp"),
+            quant=self.quant,
             name="intermediate",
         )(attn_out)
         if self.kfac_tap:
             self.sow(KFAC_A_COLLECTION, "mlp_in_a", _kfac_input_stat(intermediate))
-        out = nn.Dense(
+        out = quant_ops.make_dense(
+            self.quant,
             cfg.hidden_size,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(init, ("mlp", "embed")),
-            bias_init=nn.with_logical_partitioning(nn.initializers.zeros, ("embed",)),
+            init_stddev=cfg.initializer_range,
+            kernel_axes=("mlp", "embed"),
             name="output",
         )(intermediate)
         if self.kfac_tap:
@@ -415,6 +416,7 @@ class BertEncoder(nn.Module):
     remat: str = "none"  # 'none' | 'full' | 'dots'
     attention_backend: str = "xla"
     kfac_tap: bool = False
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, hidden: Array, bias: Array, deterministic: bool = True,
@@ -450,6 +452,7 @@ class BertEncoder(nn.Module):
             dtype=self.dtype,
             attention_backend=self.attention_backend,
             kfac_tap=self.kfac_tap,
+            quant=self.quant,
             name="layers",
         )
         hidden, _ = scanned(hidden, bias, deterministic, sequence_ids)
@@ -467,6 +470,7 @@ class BertPooler(nn.Module):
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, sequence_output: Array,
@@ -485,6 +489,7 @@ class BertPooler(nn.Module):
             dtype=self.dtype,
             kernel_init_stddev=self.config.initializer_range,
             kernel_axes=("embed", "embed_out"),
+            quant=self.quant,
             name="dense_act",
         )(cls)
 
@@ -502,6 +507,9 @@ class BertModel(nn.Module):
     remat: str = "none"
     attention_backend: str = "xla"
     kfac_tap: bool = False
+    # Inference weight quantization (ops/quant.py; serve/engine.py sets
+    # it). None = the fp32-param training layout, untouched.
+    quant: Optional[str] = None
 
     def setup(self):
         cfg = self.config
@@ -512,9 +520,11 @@ class BertModel(nn.Module):
             remat=self.remat,
             attention_backend=self.attention_backend,
             kfac_tap=self.kfac_tap,
+            quant=self.quant,
         )
         if cfg.next_sentence:
-            self.pooler = BertPooler(cfg, dtype=self.dtype)
+            self.pooler = BertPooler(cfg, dtype=self.dtype,
+                                     quant=self.quant)
 
     def __call__(
         self,
@@ -550,6 +560,7 @@ class BertPredictionHeadTransform(nn.Module):
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, hidden: Array) -> Array:
@@ -560,6 +571,7 @@ class BertPredictionHeadTransform(nn.Module):
             dtype=self.dtype,
             kernel_init_stddev=cfg.initializer_range,
             kernel_axes=("embed", "embed_out"),
+            quant=self.quant,
             name="dense_act",
         )(hidden)
         return LayerNorm(
@@ -577,11 +589,13 @@ class BertLMPredictionHead(nn.Module):
 
     config: BertConfig
     dtype: Dtype = jnp.bfloat16
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, hidden: Array, word_embedding: Array) -> Array:
         cfg = self.config
-        x = BertPredictionHeadTransform(cfg, dtype=self.dtype, name="transform")(
+        x = BertPredictionHeadTransform(cfg, dtype=self.dtype,
+                                        quant=self.quant, name="transform")(
             hidden
         )
         bias = self.param(
@@ -693,6 +707,7 @@ class BertForMaskedLM(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: str = "none"
     attention_backend: str = "xla"
+    quant: Optional[str] = None
 
     def setup(self):
         self.bert = BertModel(
@@ -700,8 +715,10 @@ class BertForMaskedLM(nn.Module):
             dtype=self.dtype,
             remat=self.remat,
             attention_backend=self.attention_backend,
+            quant=self.quant,
         )
-        self.predictions = BertLMPredictionHead(self.config, dtype=self.dtype)
+        self.predictions = BertLMPredictionHead(self.config, dtype=self.dtype,
+                                                quant=self.quant)
 
     def __call__(
         self,
@@ -764,20 +781,20 @@ class _ClassifierHead(nn.Module):
     dropout_rate: float
     initializer_range: float
     dtype: Dtype = jnp.bfloat16
+    quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: Array, deterministic: bool = True) -> Array:
         x = nn.Dropout(rate=self.dropout_rate)(x, deterministic=deterministic)
-        return nn.Dense(
+        # Output layers skip int8 (ops/quant.py EXCLUDE_MODULES): a
+        # [hidden, num_labels] kernel saves no bytes worth pre-softmax
+        # quantization noise; int8 engines store it bf16 instead.
+        return quant_ops.make_dense(
+            quant_ops.exclude(self.quant),
             self.num_labels,
             dtype=self.dtype,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                bert_normal_init(self.initializer_range), ("embed", "classes")
-            ),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ("classes",)
-            ),
+            init_stddev=self.initializer_range,
+            kernel_axes=("embed", "classes"),
             name="classifier",
         )(x)
 
@@ -796,6 +813,7 @@ class BertForSequenceClassification(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: str = "none"
     attention_backend: str = "xla"
+    quant: Optional[str] = None
 
     def setup(self):
         self.bert = BertModel(
@@ -803,12 +821,14 @@ class BertForSequenceClassification(nn.Module):
             dtype=self.dtype,
             remat=self.remat,
             attention_backend=self.attention_backend,
+            quant=self.quant,
         )
         self.head = _ClassifierHead(
             self.num_labels,
             self.config.hidden_dropout_prob,
             self.config.initializer_range,
             dtype=self.dtype,
+            quant=self.quant,
         )
 
     def __call__(
@@ -878,6 +898,7 @@ class BertForTokenClassification(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: str = "none"
     attention_backend: str = "xla"
+    quant: Optional[str] = None
 
     def setup(self):
         self.bert = BertModel(
@@ -885,12 +906,14 @@ class BertForTokenClassification(nn.Module):
             dtype=self.dtype,
             remat=self.remat,
             attention_backend=self.attention_backend,
+            quant=self.quant,
         )
         self.head = _ClassifierHead(
             self.num_labels,
             self.config.hidden_dropout_prob,
             self.config.initializer_range,
             dtype=self.dtype,
+            quant=self.quant,
         )
 
     def __call__(
@@ -923,6 +946,7 @@ class BertForQuestionAnswering(nn.Module):
     dtype: Dtype = jnp.bfloat16
     remat: str = "none"
     attention_backend: str = "xla"
+    quant: Optional[str] = None
 
     def setup(self):
         self.bert = BertModel(
@@ -930,17 +954,15 @@ class BertForQuestionAnswering(nn.Module):
             dtype=self.dtype,
             remat=self.remat,
             attention_backend=self.attention_backend,
+            quant=self.quant,
         )
-        self.qa_outputs = nn.Dense(
+        self.qa_outputs = quant_ops.make_dense(
+            quant_ops.exclude(self.quant),
             2,
             dtype=jnp.float32,
-            param_dtype=jnp.float32,
-            kernel_init=nn.with_logical_partitioning(
-                bert_normal_init(self.config.initializer_range), ("embed", "classes")
-            ),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros, ("classes",)
-            ),
+            init_stddev=self.config.initializer_range,
+            kernel_axes=("embed", "classes"),
+            name="qa_outputs",
         )
 
     def __call__(

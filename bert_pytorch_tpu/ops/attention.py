@@ -76,6 +76,22 @@ def dot_product_attention(
         backend = (
             "pallas" if q.shape[1] >= 256 and not interpret_mode() else "xla"
         )
+    if backend == "pallas_infer":
+        # INFERENCE-ONLY fused forward (ops/pallas/attention.py
+        # flash_attention_infer): no dropout plumbing, no lse/residuals
+        # for a backward that never runs — selected by serve/engine.py's
+        # forwards. Deliberately NOT reachable from training (no vjp is
+        # defined); dropout args are rejected rather than ignored so a
+        # misrouted training call fails loudly.
+        from bert_pytorch_tpu.ops.pallas.attention import flash_attention_infer
+
+        if not deterministic and dropout_rate > 0.0:
+            raise ValueError(
+                "backend='pallas_infer' is forward-only; training "
+                "dropout needs backend='pallas' or 'xla'")
+        kbias = None if sequence_ids is not None else bias
+        return flash_attention_infer(q, k, v, bias=kbias,
+                                     sequence_ids=sequence_ids)
     if backend == "pallas":
         # Fused kernel incl. in-kernel dropout from the TPU hardware PRNG
         # (the [B,H,S,S] mask never reaches HBM; see ops/pallas/attention.py).

@@ -452,6 +452,119 @@ def _flash_bwd(scale, rate, segmented, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _infer_fwd_kernel(
+    q_ref, k_ref, v_ref, bias_ref, seg_ref, out_ref,
+    *, block_k, scale, bh_block, segmented
+):
+    """INFERENCE-ONLY forward (docs/serving.md "Inference fast path").
+
+    The training kernel (:func:`_flash_fwd_kernel`) carries three things
+    a serving forward never uses: the dropout PRNG plumbing (seed ref,
+    per-tile mask regeneration), the ``lse`` output written for the
+    backward kernels, and the unmasked-``l`` bookkeeping that keeps that
+    lse exact. This variant drops all of it — no seed input, no second
+    output, one accumulator pair — while keeping the packed
+    block-diagonal tile mask (``segmented``; serve-side request packing
+    reuses it). Same tile geometry as training (_pick_blocks /
+    _pick_bh_block), so the VMEM/grid reasoning there carries over.
+    """
+    qb = pl.program_id(1)
+    seq_k = k_ref.shape[1]
+    num_kb = seq_k // block_k
+
+    for g in range(bh_block):
+        q = q_ref[g]
+        if segmented:
+            block_q = q.shape[0]
+            q_seg = seg_ref[g, 0, pl.ds(qb * block_q, block_q)]
+
+        def body(j, carry):
+            m_prev, l_prev, acc = carry
+            k = k_ref[g, pl.ds(j * block_k, block_k), :]
+            v = v_ref[g, pl.ds(j * block_k, block_k), :]
+            b = bias_ref[g, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale + b[None, :]
+            if segmented:
+                k_seg = seg_ref[g, 0, pl.ds(j * block_k, block_k)]
+                s = s + _seg_mask(q_seg, k_seg)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc
+
+        m0 = jnp.full((q.shape[0],), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((q.shape[0],), jnp.float32)
+        acc0 = jnp.zeros(q.shape, jnp.float32)
+        _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+        out_ref[g] = (acc / l[:, None]).astype(out_ref.dtype)
+
+
+def flash_attention_infer(q, k, v, bias=None, sequence_ids=None):
+    """Forward-only fused attention over [B, S, H, D] tensors — the
+    serving path's kernel (``backend='pallas_infer'``,
+    ops/attention.py). Contract matches :func:`flash_attention` at
+    ``dropout_rate=0`` minus everything the backward needs: no residuals
+    are saved, no lse is written, and no vjp is defined (differentiating
+    through it is an error by design — training keeps its own kernel).
+    ``sequence_ids`` retains the packed block-diagonal tile mask so
+    packed serve batches (serve/engine.py) stay contamination-free
+    without a [B, 1, S, S] mask in HBM. Runs in interpret mode on CPU
+    (no PRNG primitives involved), which is how tier-1 tests parity.
+    """
+    batch, seq, heads, depth = q.shape
+    scale = 1.0 / float(depth) ** 0.5
+
+    def to3(t):
+        return t.transpose(0, 2, 1, 3).reshape(batch * heads, seq, depth)
+
+    segmented = sequence_ids is not None
+    if segmented and bias is not None:
+        raise ValueError(
+            "flash_attention_infer: pass either bias (padded batches) or "
+            "sequence_ids (packed batches), not both")
+    if segmented:
+        seg3 = jnp.repeat(
+            sequence_ids.astype(jnp.float32), heads, axis=0)[:, None, :]
+    else:
+        seg3 = jnp.zeros((batch * heads, 1, seq), jnp.float32)
+    if bias is None:
+        bias3 = jnp.zeros((batch * heads, 1, seq), jnp.float32)
+    else:
+        key_bias = bias.reshape(batch, -1)[:, -seq:]  # [B, S]
+        bias3 = jnp.repeat(
+            key_bias.astype(jnp.float32), heads, axis=0)[:, None, :]
+
+    q3, k3, v3 = to3(q), to3(k), to3(v)
+    bh = batch * heads
+    block_q, block_k = _pick_blocks(seq)
+    g = _pick_bh_block(seq, bh)
+    out3 = pl.pallas_call(
+        partial(_infer_fwd_kernel, block_k=block_k, scale=scale,
+                bh_block=g, segmented=segmented),
+        grid=(bh // g, seq // block_q),
+        in_specs=[
+            pl.BlockSpec((g, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, seq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((g, block_q, depth), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, depth), q3.dtype),
+        interpret=interpret_mode(),
+    )(q3, k3, v3, bias3, seg3)
+    return out3.reshape(batch, heads, seq, depth).transpose(0, 2, 1, 3)
+
+
 def flash_attention(q, k, v, bias=None, dropout_rate=0.0, dropout_rng=None,
                     sequence_ids=None):
     """Fused attention over [B, S, H, D] tensors.

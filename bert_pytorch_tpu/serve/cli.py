@@ -1,0 +1,36 @@
+"""Shared CLI surface for the inference fast path (docs/serving.md).
+
+One flag helper next to the engine options so every entry point that
+builds an :class:`~bert_pytorch_tpu.serve.engine.InferenceEngine` —
+``run_server.py`` online, ``tools/batch_infer.py`` offline, bench legs —
+exposes the SAME quantization/kernel knobs with the same spellings, and
+``/statsz`` reports the mode a replica is actually serving (the router
+work reads it to tell a cheap int8 replica from an fp32 one).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+QUANTIZE_CHOICES = ("none", "bf16", "int8")
+ATTENTION_BACKENDS = ("xla", "pallas", "pallas_infer", "auto")
+
+
+def add_fast_path_args(parser: argparse.ArgumentParser) -> None:
+    """The inference-fast-path engine options (ops/quant.py,
+    ops/pallas/attention.py ``flash_attention_infer``)."""
+    parser.add_argument(
+        "--quantize", type=str, default="none", choices=QUANTIZE_CHOICES,
+        help="inference weight format: bf16 halves weight bytes, int8 "
+             "quarters the matmul weights and serves int8 GEMMs "
+             "(per-tensor symmetric scales applied while the checkpoint "
+             "streams in; embeddings/LayerNorm stay fp32). Parity bounds "
+             "per level: docs/serving.md")
+    parser.add_argument(
+        "--attention_backend", type=str, default="xla",
+        choices=ATTENTION_BACKENDS,
+        help="encoder attention kernel for the serve forwards; "
+             "pallas_infer is the forward-only fused kernel (TPU; "
+             "interpret-mode on CPU)")
+# The engine itself normalizes the "none" spelling to None
+# (InferenceEngine.__init__) — entry points pass args.quantize verbatim.

@@ -146,6 +146,13 @@ class ServingService:
     def start(self) -> None:
         if not self.engine.warmed:
             self.engine.warmup()
+        # One serve_cold_start record per engine start: how long warmup
+        # took and how many compiles were real vs persistent-cache hits
+        # (docs/serving.md "Inference fast path"); also lands in /statsz.
+        # getattr: test fakes (and pre-warmed engines from older callers)
+        # may not carry startup stats — a missing record beats a crash.
+        self.telemetry.observe_cold_start(
+            getattr(self.engine, "startup", None))
         self.telemetry.reset_clock()  # rps measures serving, not warmup
         self._stop.clear()
         thread = threading.Thread(

@@ -90,6 +90,12 @@ class ServeTelemetry:
         self._run_budget_tokens = 0
         self._run_depth_max = 0
         self._run_compiles = 0
+        # Engine startup stats (cold_start_s, warm/cold compile split,
+        # quantize mode, weight bytes): written once by observe_cold_start
+        # on the thread that ran warmup, read by HTTP workers via
+        # snapshot() for /statsz — same lock as the other rollup state
+        # (concurrency registry, analysis/concurrency.py).
+        self._cold_start: Optional[dict] = None
 
     # -- producer --------------------------------------------------------
 
@@ -124,6 +130,32 @@ class ServeTelemetry:
     def observe_error(self) -> None:
         with self._lock:
             self.total_errors += 1
+
+    def observe_cold_start(self, startup: dict) -> Optional[dict]:
+        """Record the engine's startup stats (``InferenceEngine.startup``)
+        and emit one ``serve_cold_start`` record: how long the AOT warmup
+        took and how many of its compiles were real XLA compiles vs
+        persistent-cache hits — THE restart-cost signal (a warm replica
+        shows ``compiles_cold == 0``; the cache counter events behind the
+        split are the authority, docs/serving.md). Fields also ride
+        ``snapshot()``/``/statsz`` so a router can see each replica's
+        quantize mode and startup cost."""
+        if not startup:
+            return None
+        with self._lock:
+            if self._cold_start == startup:
+                # A stop()/start() cycle re-observes the SAME engine
+                # start (warmup didn't run again); re-emitting would
+                # double-count cold compiles in the report's summed
+                # warm-restart gate. A genuine re-warmup produces a
+                # fresh stats dict (new cold_start_s) and is recorded.
+                return None
+            self._cold_start = dict(startup)
+        record = {"kind": "serve_cold_start", "tag": "serve"}
+        record.update(startup)
+        if self.emit is not None:
+            self.emit(record)
+        return record
 
     def reset_clock(self) -> None:
         """Restart the run/window wall-clock base. Called by the service
@@ -195,6 +227,19 @@ class ServeTelemetry:
                                   self._run_budget_tokens)
             if occ is not None:
                 record["batch_occupancy"] = occ
+            if self._cold_start is not None:
+                # 'compiles' here is the STEADY-STATE count (zero after
+                # warmup — the serve acceptance); the warmup compile
+                # split keeps its own prefix.
+                cs = self._cold_start
+                record["cold_start_s"] = cs.get("cold_start_s")
+                for key in ("compiles", "compiles_cold", "compiles_warm"):
+                    if cs.get(key) is not None:
+                        record[f"warmup_{key}"] = cs[key]
+                for key in ("quantize", "attention_backend",
+                            "weight_bytes"):
+                    if cs.get(key) is not None:
+                        record[key] = cs[key]
             return record
 
     def finish(self) -> Optional[dict]:

@@ -104,6 +104,7 @@ def summarize_records(records, name: str = "") -> dict:
     grad_health = []
     memory = []
     serve_windows = []
+    serve_cold_starts = []
     faults = []
     resumes = []
     serve_summary: Optional[dict] = None
@@ -126,6 +127,8 @@ def summarize_records(records, name: str = "") -> dict:
             memory.append(rec)
         elif kind == "serve_window":
             serve_windows.append(rec)
+        elif kind == "serve_cold_start":
+            serve_cold_starts.append(rec)
         elif kind == "serve_summary":
             serve_summary = rec
         elif kind == "fault":
@@ -311,6 +314,22 @@ def summarize_records(records, name: str = "") -> dict:
         out["serve_compiles"] = sum(
             int(w.get("compiles", 0)) for w in serve_windows)
 
+    if serve_cold_starts:
+        # A multi-start artifact (e.g. the BENCH_SERVE quant leg runs
+        # fp32 then int8 engines) gates on the WORST start; the cold
+        # compile count sums — the warm-restart acceptance is "zero cold
+        # compiles", and any start that compiled breaks it.
+        out["serve_cold_start_s"] = round(max(
+            float(r.get("cold_start_s", 0.0)) for r in serve_cold_starts), 3)
+        out["serve_compiles_cold"] = sum(
+            int(r.get("compiles_cold", 0)) for r in serve_cold_starts)
+        out["serve_compiles_warm"] = sum(
+            int(r.get("compiles_warm", 0)) for r in serve_cold_starts)
+        modes = sorted({str(r["quantize"]) for r in serve_cold_starts
+                        if r.get("quantize")})
+        if modes:
+            out["serve_quantize"] = ",".join(modes)
+
     if run_summary:
         for key, value in run_summary.items():
             if key in ("schema", "ts", "kind", "tag"):
@@ -339,12 +358,18 @@ _CHECKS = (
     ("peak_bytes_in_use", "peak device memory", "up", "mem"),
     ("grad_norm_max", "grad-norm envelope", "up", "grad"),
     ("update_ratio_max", "update-ratio envelope", "up", "grad"),
-    # serve record family (docs/serving.md): the latency gate is p95 —
-    # p50 hides tail regressions and p99 is too noisy at smoke-test
-    # request counts; throughput guards the batching path.
+    # serve record family (docs/serving.md): p95 is the tail gate; p50
+    # is the INFERENCE-FAST-PATH gate — the quantized/fused-kernel work
+    # targets the median forward, and a p50 regression there is the
+    # optimization silently reverting even while the tail stays in tol.
+    ("serve_latency_p50_ms", "serve p50 latency", "up", "p95"),
     ("serve_latency_p95_ms", "serve p95 latency", "up", "p95"),
     ("serve_rps", "serve throughput (req/s)", "down", "step"),
     ("serve_occupancy", "serve batch occupancy", "down", "step"),
+    # Cold start: the persisted-AOT-cache win. A regression here means a
+    # restarted replica is recompiling (cache key drift — e.g. a renamed
+    # forward — or the persistence bar filtering serve executables).
+    ("serve_cold_start_s", "serve cold start", "up", "p95"),
 )
 
 
@@ -378,9 +403,13 @@ def compare(base: dict, new: dict, tolerances: Optional[dict] = None):
         if worse:
             regressions.append(entry)
     # Health counters: any NEW occurrence where the baseline had none is
-    # a regression regardless of tolerance.
+    # a regression regardless of tolerance. serve_compiles_cold rides
+    # here too: a warm-cache baseline (0 cold compiles) against a run
+    # that recompiled is the cold-start acceptance breaking, no matter
+    # how fast the recompiles happened to be.
     for key, label in (("nonfinite_steps", "non-finite steps"),
-                       ("divergence_warnings", "divergence warnings")):
+                       ("divergence_warnings", "divergence warnings"),
+                       ("serve_compiles_cold", "serve cold compiles")):
         b, n = int(base.get(key, 0)), int(new.get(key, 0))
         if n > b:
             entry = {"metric": key, "label": label, "base": b, "new": n,
@@ -415,7 +444,8 @@ def format_summary(summary: dict) -> str:
              "serve_requests", "serve_rps", "serve_latency_p50_ms",
              "serve_latency_p95_ms", "serve_latency_p99_ms",
              "serve_device_p50_ms", "serve_occupancy", "serve_compiles",
-             "serve_errors",
+             "serve_errors", "serve_cold_start_s", "serve_compiles_cold",
+             "serve_compiles_warm", "serve_quantize",
              "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",

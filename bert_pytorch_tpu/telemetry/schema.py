@@ -88,6 +88,13 @@ KIND_REQUIRED_KEYS = {
         "requests", "batches",
         "latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
     ),
+    # one engine startup (serve/stats.py observe_cold_start): AOT warmup
+    # wall time + its compiles split cold (real XLA compiles) vs warm
+    # (persistent-cache hits, the counter-event authority) — a restarted
+    # replica with a warm cache shows compiles_cold == 0
+    "serve_cold_start": (
+        "cold_start_s", "compiles", "compiles_cold", "compiles_warm",
+    ),
 }
 
 # Serve-kind consistency rules (lintable offline): percentiles must be
@@ -142,6 +149,8 @@ def validate_record(rec) -> list:
                     _check_async_fields(rec, errors)
                 if kind in ("serve_window", "serve_summary"):
                     _check_serve_fields(rec, errors)
+                if kind == "serve_cold_start":
+                    _check_cold_start_fields(rec, errors)
                 if kind == "fault":
                     _check_fault_fields(rec, errors)
                 if kind == "resume":
@@ -225,6 +234,28 @@ def _check_serve_fields(rec, errors) -> None:
                 or not 0 < occ <= 1:
             errors.append(
                 f"batch_occupancy must be in (0, 1], got {occ!r}")
+
+
+def _check_cold_start_fields(rec, errors) -> None:
+    """Cold-start consistency (serve/stats.py observe_cold_start): the
+    warm/cold split must add up — consumers assert "zero cold compiles"
+    on the split, so a record where cold + warm exceeds the total would
+    let a broken producer fake a warm start."""
+    numbers = {}
+    for key in ("cold_start_s", "compiles", "compiles_cold",
+                "compiles_warm"):
+        v = rec.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            errors.append(f"{key} must be a non-negative number, got {v!r}")
+        else:
+            numbers[key] = v
+    if {"compiles", "compiles_cold", "compiles_warm"} <= set(numbers) and \
+            numbers["compiles_cold"] + numbers["compiles_warm"] \
+            > numbers["compiles"]:
+        errors.append(
+            "compiles_cold + compiles_warm exceeds compiles "
+            f"({rec.get('compiles_cold')} + {rec.get('compiles_warm')} > "
+            f"{rec.get('compiles')})")
 
 
 def _check_fault_fields(rec, errors) -> None:

@@ -11,7 +11,11 @@ Output is one JSONL line per request: ``{"id", "task", "result"}`` (or
 through the SAME bucket-compiled, optionally packed batched path as the
 server (serve/engine.py ``plan_batch``/``execute``), so offline scores
 are bit-identical to served ones — this tool is the regression harness
-for the serving path as much as a utility.
+for the serving path as much as a utility. The engine flags are
+``run_server.py``'s, including the inference fast path's
+``--quantize {none,bf16,int8}`` / ``--attention_backend``
+(serve/cli.py; docs/serving.md "Inference fast path") — scoring a file
+under int8 vs fp32 is the offline parity check.
 
 ::
 
@@ -135,6 +139,10 @@ def main(argv=None):
         if sink is not None:
             sink.close()
     stats["wall_s"] = round(time.perf_counter() - t0, 3)
+    startup = service.engine.startup or {}
+    stats["quantize"] = startup.get("quantize", args.quantize)
+    if startup.get("cold_start_s") is not None:
+        stats["cold_start_s"] = startup["cold_start_s"]
     print(json.dumps({"batch_infer": stats}), file=sys.stderr)
     return stats
 

@@ -48,6 +48,12 @@ class CheckpointCorruptError(RuntimeError):
     """Raised by :func:`load_checkpoint` when the sidecar manifest exists
     and the file fails verification (size or sha256 mismatch)."""
 
+
+class CheckpointShapeError(ValueError):
+    """Raised by the streaming params load when a checkpoint leaf's shape
+    does not match the target template — distinct from the layout
+    oddities that fall back to a full restore."""
+
 # Pending async writes, KEYED BY OUTPUT DIRECTORY: at most one background
 # write in flight per save target — a second save to the same directory
 # joins the first, so that directory's checkpoints land in order and memory
@@ -172,7 +178,8 @@ def latest_checkpoint(output_dir: str) -> Optional[str]:
     return None if step is None else checkpoint_path(output_dir, step)
 
 
-def load_params_only(path: str, target: Any, key: str = "model") -> Any:
+def load_params_only(path: str, target: Any, key: str = "model",
+                     quantize: Optional[str] = None) -> Any:
     """Restore ONLY the ``key`` (model-params) subtree of a checkpoint onto
     ``target``, without materializing the optimizer/preconditioner pytrees.
 
@@ -182,21 +189,42 @@ def load_params_only(path: str, target: Any, key: str = "model") -> Any:
     state a serving process (serve/engine.py) must never pay host memory
     for. The top-level msgpack map is walked with a streaming unpacker:
     every subtree except ``key`` is skipped byte-wise (``Unpacker.skip``
-    decodes nothing), and only the ``key`` span is handed to flax's
-    ``msgpack_restore``. Falls back to a full restore if the file is not
-    the expected top-level map (e.g. a hand-rolled artifact).
+    decodes nothing), and the ``key`` subtree itself is decoded LEAF BY
+    LEAF with dtype conversion applied as each tensor's bytes arrive —
+    the transient cost is one fp32 tensor, never a second full fp32
+    model tree:
+
+    * ``quantize=None`` — each decoded leaf casts to the dtype of the
+      matching ``target`` leaf inside the decode (a bf16-param target
+      never materializes the fp32 tree), then the state restores onto
+      ``target`` via flax ``from_state_dict``;
+    * ``quantize="bf16" | "int8"`` — each dense module converts per the
+      rules in :mod:`bert_pytorch_tpu.ops.quant` (int8 kernels +
+      per-tensor symmetric scales / bf16 storage) and the QUANTIZED
+      tree is returned as a plain dict for the quant model's ``apply``;
+      ``target`` is the fp32-layout template used for shape checking.
+
+    Falls back to a full restore (plus host-side
+    :func:`~bert_pytorch_tpu.ops.quant.quantize_params`) if the file is
+    not the expected top-level map (e.g. a hand-rolled artifact).
 
     The integrity manifest is verified first when present (a serving
     process loading a torn checkpoint should fail loudly at startup, not
     serve a half-restored head) — :class:`CheckpointCorruptError`. The
     bytes just read are what gets verified: one pass of IO.
     """
+    if quantize is not None:
+        from bert_pytorch_tpu.ops import quant as quant_ops
+
+        quant_ops.check_mode(quantize)
     with open(path, "rb") as f:
         blob = f.read()
     status, detail = integrity.verify_blob(path, blob)
     if status == integrity.CORRUPT:
         raise CheckpointCorruptError(f"{path}: {detail}")
-    state = _extract_toplevel_subtree(blob, key)
+    convert = _make_module_converter(
+        serialization.to_state_dict(target), quantize)
+    state = _extract_toplevel_subtree(blob, key, convert=convert)
     if state is None:
         full = serialization.msgpack_restore(blob)
         if not isinstance(full, dict) or key not in full:
@@ -204,14 +232,98 @@ def load_params_only(path: str, target: Any, key: str = "model") -> Any:
                 f"checkpoint {path} has no top-level {key!r} subtree "
                 f"(keys: {sorted(full) if isinstance(full, dict) else type(full).__name__})")
         state = full[key]
+        if quantize is not None:
+            from bert_pytorch_tpu.ops import quant as quant_ops
+
+            return quant_ops.quantize_params(state, quantize)
+    if quantize is not None:
+        return state
     return serialization.from_state_dict(target, state)
 
 
-def _extract_toplevel_subtree(blob: bytes, key: str) -> Optional[Any]:
+def _make_module_converter(target_sd: Any, quantize: Optional[str]):
+    """Per-module conversion hook for the streaming decode: receives each
+    innermost decoded dict (a flax module's array leaves) with its path,
+    returns the dict to keep. With ``quantize`` set, dense modules
+    convert through :func:`bert_pytorch_tpu.ops.quant.convert_module`;
+    without it, each leaf casts to the matching ``target`` leaf's dtype.
+    Shapes are checked against the target template either way — a
+    mismatched checkpoint must fail loudly, not quantize garbage."""
+
+    def target_leaf(path, name):
+        node = target_sd
+        for part in path + (name,):
+            if not isinstance(node, dict) or part not in node:
+                return None
+            node = node[part]
+        return node
+
+    def convert(path, module):
+        checked = {}
+        for name, leaf in module.items():
+            want = target_leaf(path, name)
+            if hasattr(leaf, "shape") and hasattr(want, "shape") \
+                    and tuple(want.shape) != tuple(leaf.shape):
+                raise CheckpointShapeError(
+                    f"checkpoint leaf {'/'.join(map(str, path + (name,)))} "
+                    f"has shape {tuple(leaf.shape)}, target expects "
+                    f"{tuple(want.shape)}")
+            if quantize is None and hasattr(leaf, "dtype") \
+                    and hasattr(want, "dtype") and want.dtype != leaf.dtype:
+                leaf = leaf.astype(want.dtype)
+            checked[name] = leaf
+        if quantize is None:
+            return checked
+        from bert_pytorch_tpu.ops import quant as quant_ops
+
+        return quant_ops.convert_module(path, checked, quantize)
+
+    return convert
+
+
+# msgpack type tags that open a map: fixmap (0x80-0x8f), map16, map32.
+# Used to distinguish nested state-dict dicts (recurse) from array/scalar
+# leaves (decode the span) without decoding anything first.
+_MSGPACK_MAP_TAGS = frozenset(range(0x80, 0x90)) | {0xDE, 0xDF}
+
+
+def _extract_toplevel_subtree(blob: bytes, key: str,
+                              convert=None) -> Optional[Any]:
     """Decode one value of the checkpoint's top-level msgpack map,
     byte-skipping the others; None when the layout is unexpected (the
-    caller then falls back to a full restore)."""
+    caller then falls back to a full restore).
+
+    The ``key`` subtree is decoded by recursive map-walk, one LEAF at a
+    time: each leaf's span is located with ``Unpacker.skip`` (which
+    decodes nothing) and handed to flax's ``msgpack_restore``
+    individually, and ``convert(path, module_dict)`` — when given — runs
+    on every innermost dict as soon as its leaves decode, so dtype
+    conversion/quantization happens while streaming and the peak
+    transient is one fp32 tensor, not the whole subtree.
+    """
     import msgpack
+
+    def walk(unpacker, path):
+        n = unpacker.read_map_header()
+        out = {}
+        any_leaves = False
+        for _ in range(n):
+            name = unpacker.unpack()
+            start = unpacker.tell()
+            if blob[start] in _MSGPACK_MAP_TAGS:
+                out[name] = walk(unpacker, path + (name,))
+            else:
+                unpacker.skip()
+                out[name] = serialization.msgpack_restore(
+                    blob[start:unpacker.tell()])
+                any_leaves = True
+        if convert is not None and any_leaves:
+            leaves = {k: v for k, v in out.items()
+                      if not isinstance(v, dict)}
+            for k in leaves:
+                del out[k]
+            out.update(convert(path, leaves))
+        return out
 
     try:
         unpacker = msgpack.Unpacker(max_buffer_size=len(blob) or 1,
@@ -222,10 +334,16 @@ def _extract_toplevel_subtree(blob: bytes, key: str) -> Optional[Any]:
             name = unpacker.unpack()
             if name == key:
                 start = unpacker.tell()
-                unpacker.skip()
-                return serialization.msgpack_restore(
-                    blob[start:unpacker.tell()])
+                if blob[start] not in _MSGPACK_MAP_TAGS:
+                    # A non-dict model subtree (hand-rolled artifact):
+                    # decode the span whole, no per-leaf conversion.
+                    unpacker.skip()
+                    return serialization.msgpack_restore(
+                        blob[start:unpacker.tell()])
+                return walk(unpacker, ())
             unpacker.skip()
+    except CheckpointShapeError:
+        raise  # a real target/checkpoint mismatch, not a layout oddity
     except Exception:
         return None
     return None

@@ -26,11 +26,23 @@ import tempfile
 MIN_COMPILE_TIME_SECS = 10.0
 
 
-def enable_compile_cache(cache_dir: str) -> bool:
+def enable_compile_cache(cache_dir: str,
+                         min_compile_secs: float = MIN_COMPILE_TIME_SECS
+                         ) -> bool:
     """Point JAX's persistent compilation cache at ``cache_dir``.
 
     Returns True if enabled; prints a diagnostic and returns False when the
     directory cannot be created or written (the caller runs uncached).
+
+    ``min_compile_secs`` sets the persistence bar. Training keeps the
+    default (only the multi-minute train-step executables are worth the
+    round trip); SERVING passes 0.0 — a replica's per-(task, bucket)
+    forwards each compile in seconds, but a fresh replica compiles dozens
+    of them, and the cold-start acceptance ("second start performs zero
+    cold compiles", docs/serving.md) needs every one persisted. Below-bar
+    compiles fire no cache-miss counter (they are never written), so they
+    would read as "uncached" forever and the warm-start proof could never
+    hold.
     """
     if not cache_dir:
         return False
@@ -45,7 +57,7 @@ def enable_compile_cache(cache_dir: str) -> bool:
 
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update(
-        "jax_persistent_cache_min_compile_time_secs", MIN_COMPILE_TIME_SECS)
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_secs))
     # jax latches cache-enablement at the first compile of the process
     # (_cache_used): if anything compiled before this call — a warmup probe,
     # an eager op that triggered jit — the new cache dir would be silently

@@ -64,6 +64,11 @@ def parse_arguments(argv=None):
                         help="micro-batch deadline: a partial batch "
                              "dispatches when its oldest request has "
                              "waited this long")
+    # Inference fast path (docs/serving.md): --quantize/--attention_backend,
+    # shared with tools/batch_infer.py via one helper.
+    from bert_pytorch_tpu.serve.cli import add_fast_path_args
+
+    add_fast_path_args(parser)
     parser.add_argument("--pack_requests", action="store_true",
                         help="pack several short requests per row with "
                              "block-diagonal attention (data/packing.py)")
@@ -116,7 +121,11 @@ def build_service(args):
     if args.compile_cache_dir:
         from bert_pytorch_tpu.utils.compile_cache import enable_compile_cache
 
-        enable_compile_cache(args.compile_cache_dir)
+        # min_compile_secs=0: persist EVERY per-(task, bucket) forward —
+        # the warm-restart acceptance is "second start performs zero cold
+        # compiles", and the training-oriented default bar would filter
+        # the seconds-scale serve executables out of the cache.
+        enable_compile_cache(args.compile_cache_dir, min_compile_secs=0.0)
 
     config = BertConfig.from_json_file(args.model_config_file)
     if config.vocab_size % 8 != 0:
@@ -178,6 +187,8 @@ def build_service(args):
         dtype=jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32,
         seed=args.seed,
         monitor=monitor,
+        quantize=args.quantize,  # "none" normalizes to None in the engine
+        attention_backend=args.attention_backend,
     )
     batcher = Batcher(
         max_batch_size=args.max_batch_size,
@@ -196,10 +207,17 @@ def main(args):
     logger.info(
         f"warming {len(service.engine.tasks)} task heads over buckets "
         f"{service.engine.buckets} "
-        f"(pack={service.engine.max_requests_per_pack})")
-    compiles = service.engine.warmup()
-    logger.info(f"warmup done: {compiles} compile events; steady-state "
-                "serving recompiles nothing")
+        f"(pack={service.engine.max_requests_per_pack}, "
+        f"quantize={service.engine.quantize or 'none'}, "
+        f"attention={service.engine.attention_backend})")
+    service.engine.warmup()
+    startup = service.engine.startup or {}
+    logger.info(
+        f"warmup done in {startup.get('cold_start_s')}s: "
+        f"{startup.get('compiles_cold')} cold compiles / "
+        f"{startup.get('compiles_warm')} persistent-cache hits "
+        f"({startup.get('weight_bytes', 0) / (1 << 20):.1f} MiB weights); "
+        "steady-state serving recompiles nothing")
     service.start()
     server = make_server(service, host=args.host, port=args.port,
                          request_timeout_s=args.request_timeout_s)
