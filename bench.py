@@ -116,10 +116,16 @@ A100_PHASE2_SEQ_PER_SEC = 72.0
 # trace (tools/make_synthetic_data.py --requests shape) through the
 # serve/ engine — AOT bucket warmup, dynamic batching, optional packing
 # (BENCH_SERVE_PACK=1) — and stamps latency p50/p95/p99 (ms), requests/s,
-# and batch occupancy into the result JSON. Knobs: BENCH_SERVE_REQUESTS
-# (default 256), BENCH_SERVE_BATCH (default 8), BENCH_SERVE_BUCKETS
-# (default "32,64,128"), BENCH_SERVE_RATE (req/s arrival rate; 0 =
-# saturation replay, the default). BENCH_SERVE_QUANT=1 runs the
+# batch occupancy, and the trace-derived latency decomposition
+# (queue_wait_share + per-phase p95s, serve/tracing.py — so the perf
+# trajectory records WHERE serve time goes) into the result JSON. Knobs:
+# BENCH_SERVE_REQUESTS (default 256), BENCH_SERVE_BATCH (default 8),
+# BENCH_SERVE_BUCKETS (default "32,64,128"), BENCH_SERVE_RATE (req/s
+# arrival rate; 0 = saturation replay, the default),
+# BENCH_SERVE_TRACE_RATE (serve_trace head-sampling fraction, default
+# 0.1), BENCH_SERVE_SLO_MS (p99 SLO target; 0 = disabled, the default
+# — over-SLO requests are always traced), BENCH_SERVE_SLO_BUDGET
+# (error-budget fraction for the report's burn verdict, default 0.01). BENCH_SERVE_QUANT=1 runs the
 # INFERENCE-FAST-PATH comparison instead: fp32 vs quantized
 # (BENCH_SERVE_QUANT_MODE, default int8) on the SAME trace, stamping
 # per-leg p50/p95 + cold_start_s + weight bytes, the p50 speedup, and
@@ -667,6 +673,10 @@ def _serve_child_main():
     lines = [_json.loads(line) for line in open(trace)]
 
     def build_service(quantize, monitor):
+        import argparse
+
+        from bert_pytorch_tpu.serve.cli import build_tracer
+
         engine = InferenceEngine(
             config, tokenizer,
             tasks={"fill_mask": {}, "classify": {"labels": ["0", "1"]},
@@ -675,11 +685,23 @@ def _serve_child_main():
             max_requests_per_pack=pack_k if SERVE_PACK else 1,
             dtype=jnp.bfloat16, monitor=monitor, quantize=quantize)
         telemetry = ServeTelemetry(emit=emit, window=64)
+        # Request tracing rides every serve leg so the perf trajectory
+        # records WHERE serve time goes (queue vs execute vs postprocess),
+        # not just how much (docs/serving.md "Request tracing & metrics").
+        tracer = build_tracer(
+            argparse.Namespace(
+                trace_sample_rate=float(
+                    os.environ.get("BENCH_SERVE_TRACE_RATE", "0.1")),
+                slo_p99_ms=float(
+                    os.environ.get("BENCH_SERVE_SLO_MS", "0")),
+                slo_error_budget=float(
+                    os.environ.get("BENCH_SERVE_SLO_BUDGET", "0.01"))),
+            emit=emit, window=64)
         return ServingService(
             engine,
             Batcher(max_batch_size=SERVE_BATCH, max_wait_ms=5.0,
                     max_requests_per_pack=engine.max_requests_per_pack),
-            telemetry)
+            telemetry, tracer=tracer)
 
     def replay(service):
         t_warm = time.perf_counter()
@@ -710,9 +732,27 @@ def _serve_child_main():
         for t in threads:
             t.join()
         wall = time.perf_counter() - t0
-        snap = service.telemetry.snapshot()
+        # include_phases=False: the phase rollup is taken ONCE below,
+        # after stop() — computing it here too would sort the tracer's
+        # whole sample history while the dispatch thread still runs.
+        snap = service.telemetry.snapshot(include_phases=False)
         service.stop()
+        # After stop(): run-level phase rollup survives the drain, and
+        # the tracer's partial serve_phase windows are flushed by it.
+        snap["phases"] = service.tracer.phase_snapshot() or {}
         return snap, wall, warmup_s, errors
+
+    def phase_stamp(snap):
+        """Trace-derived latency-decomposition stamp for the result JSON:
+        queue-wait share + per-phase p95s (serve/tracing.py)."""
+        phases = snap.get("phases") or {}
+        return {
+            "queue_wait_share": phases.get("queue_wait_share"),
+            "phase_p95_ms": {
+                name: phases.get(f"{name}_p95_ms")
+                for name in ("queue", "assembly", "execute", "postprocess")
+            },
+        }
 
     quant_mode = os.environ.get("BENCH_SERVE_QUANT_MODE", "int8")
     if os.environ.get("BENCH_SERVE_QUANT", "0") == "1":
@@ -733,6 +773,7 @@ def _serve_child_main():
                 "weight_bytes": startup.get("weight_bytes"),
                 "serve_errors": len(errors),
             }
+            legs[tag].update(phase_stamp(snap))
         # Warm-restart proof: a fresh engine against the persisted AOT
         # cache — the cache counter events must report zero cold
         # compiles (every forward is a persistent-cache hit).
@@ -803,6 +844,7 @@ def _serve_child_main():
         "latency_p99_ms": snap.get("latency_p99_ms"),
         "device_p50_ms": snap.get("device_p50_ms"),
         "batch_occupancy": snap.get("batch_occupancy"),
+        **phase_stamp(snap),
         "warmup_s": round(warmup_s, 2),
         "cold_start_s": (engine.startup or {}).get("cold_start_s"),
         "serve_errors": len(errors),

@@ -149,6 +149,27 @@ REGISTRY: Tuple[Entry, ...] = (
           why="close() (drain path) flips it while submit/next_batch "
               "check it"),
 
+    # -- serve/tracing.py: dispatch thread vs /metricsz scrapes ------------
+    # The per-task stats map is the request tracer's ONLY shared mutable
+    # state: observe() (dispatch thread) and observe_error() (HTTP worker
+    # threads) mutate it while metrics_text()/phase_snapshot() (/metricsz
+    # and /statsz scrape threads) iterate it.
+    Entry("bert_pytorch_tpu/serve/tracing.py", "_tasks",
+          cls="TraceCollector", kind="lock", locks=("_lock",),
+          why="dispatch-thread observe + HTTP-worker observe_error mutate "
+              "the per-task aggregates while scrape threads render "
+              "/metricsz and /statsz from them"),
+
+    # -- serve/service.py: the dispatch loop's heartbeat -------------------
+    # The Heartbeat object itself is only ever beaten by one thread at a
+    # time (start() before the loop thread exists, then the loop, then
+    # stop() after the join); safety rests on the binding being stable.
+    Entry("bert_pytorch_tpu/serve/service.py", "_heartbeat",
+          cls="ServingService", kind="frozen",
+          why="beaten by the dispatch loop while stop()/start() run on "
+              "other threads; the binding must never change after "
+              "__init__ (beats are serialized by the thread lifecycle)"),
+
     # -- serve/stats.py: dispatch thread vs /statsz scrapes ----------------
     Entry("bert_pytorch_tpu/serve/stats.py", "total_requests",
           cls="ServeTelemetry", kind="lock", locks=("_lock",),
@@ -165,6 +186,10 @@ REGISTRY: Tuple[Entry, ...] = (
           why="engine-startup stats written once by observe_cold_start "
               "(the thread that ran warmup) while HTTP workers read them "
               "via snapshot() for /statsz"),
+    Entry("bert_pytorch_tpu/serve/stats.py", "_tracer",
+          cls="ServeTelemetry", kind="lock", locks=("_lock",),
+          why="attached once by the service before dispatch starts, read "
+              "by snapshot()/finish() on scrape and shutdown threads"),
 
     # -- utils/logging.py: the JSONL sink background emitters write --------
     Entry("bert_pytorch_tpu/utils/logging.py", "_f",

@@ -6,9 +6,10 @@ load, AOT warmup per (task, length-bucket), optional request packing via
 ``data/packing.py``), a dynamically micro-batching :class:`Batcher`
 (flush on size or deadline), per-task pre/post-processing
 (:mod:`~bert_pytorch_tpu.serve.tasks`), a stdlib JSON-over-HTTP front end
-(:mod:`~bert_pytorch_tpu.serve.http`), and the ``serve`` telemetry record
+(:mod:`~bert_pytorch_tpu.serve.http`), the ``serve`` telemetry record
 family (:class:`ServeTelemetry`) flowing through the schema-v1 JSONL
-machinery.
+machinery, and request-level tracing + the Prometheus /metricsz export
+plane (:class:`TraceCollector`, :mod:`~bert_pytorch_tpu.serve.tracing`).
 """
 
 from bert_pytorch_tpu.serve.batcher import Batcher, BatcherFull, Request
@@ -17,6 +18,7 @@ from bert_pytorch_tpu.serve.http import make_server
 from bert_pytorch_tpu.serve.service import ServiceDraining, ServingService
 from bert_pytorch_tpu.serve.stats import ServeTelemetry
 from bert_pytorch_tpu.serve.tasks import TASK_NAMES, build_handlers
+from bert_pytorch_tpu.serve.tracing import TraceCollector
 
 __all__ = [
     "Batcher",
@@ -28,6 +30,7 @@ __all__ = [
     "ServiceDraining",
     "ServingService",
     "TaskSpec",
+    "TraceCollector",
     "TASK_NAMES",
     "build_handlers",
     "make_server",

@@ -55,13 +55,24 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, task: str, features: dict, payload: dict,
-                 enqueued_at: float = 0.0):
+                 enqueued_at: Optional[float] = None):
         self.id = next(Request._ids)
         self.task = task
         self.features = features
         self.payload = payload
         self.length = len(features["input_ids"])
+        # None until Batcher.submit stamps it (or process_batch, for
+        # directly-constructed requests that never enter the queue) —
+        # a sentinel, not 0.0, so an injected clock legitimately reading
+        # 0.0 is never mistaken for "unstamped".
         self.enqueued_at = enqueued_at
+        # Stamped by the batcher when the dispatch thread pops the
+        # request (re-stamped after a plan-leftover requeue, so the
+        # trace's queue span covers the whole time spent waiting).
+        self.dequeued_at = enqueued_at
+        # Host prepare() time measured by the submitting thread
+        # (serve/service.py) — pre-queue, so trace context, not a span.
+        self.prepare_s: float = 0.0
         self.completed_at: Optional[float] = None
         self.result: Optional[dict] = None
         self.error: Optional[str] = None
@@ -163,6 +174,10 @@ class Batcher:
             else:
                 keep.append(req)
         self._pending = keep
+        now = self._clock()
+        for req in take:
+            # Trace queue span: enqueued_at -> this pop (serve/tracing.py).
+            req.dequeued_at = now
         return take
 
     def poll(self) -> Optional[List[Request]]:

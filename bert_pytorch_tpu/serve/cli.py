@@ -34,3 +34,40 @@ def add_fast_path_args(parser: argparse.ArgumentParser) -> None:
              "interpret-mode on CPU)")
 # The engine itself normalizes the "none" spelling to None
 # (InferenceEngine.__init__) — entry points pass args.quantize verbatim.
+
+
+def add_tracing_args(parser: argparse.ArgumentParser) -> None:
+    """The request-tracing / metrics-plane knobs (serve/tracing.py),
+    shared by run_server.py, tools/batch_infer.py (its engine flags flow
+    through run_server.parse_arguments), and the BENCH_SERVE legs."""
+    parser.add_argument(
+        "--trace_sample_rate", type=float, default=0.01,
+        help="fraction of requests exported as serve_trace span trees "
+             "(deterministic head sampling on the request id; requests "
+             "over the SLO are ALWAYS traced). 0 disables trace export "
+             "while the serve_phase aggregates and /metricsz keep "
+             "working")
+    parser.add_argument(
+        "--slo_p99_ms", type=float, default=500.0,
+        help="per-request latency SLO target (ms): drives the "
+             "always-sample-slow rule, the over-SLO counters on "
+             "/metricsz, and telemetry-report's SLO verdict. 0 disables "
+             "SLO accounting")
+    parser.add_argument(
+        "--slo_error_budget", type=float, default=0.01,
+        help="fraction of requests allowed over the SLO target before "
+             "the error budget is burned (telemetry-report's "
+             "budget-burn verdict)")
+
+
+def build_tracer(args, emit=None, window: int = 64):
+    """One TraceCollector from the add_tracing_args flags (the single
+    construction point run_server/bench share)."""
+    from bert_pytorch_tpu.serve.tracing import TraceCollector
+
+    return TraceCollector(
+        emit=emit,
+        sample_rate=args.trace_sample_rate,
+        slo_p99_ms=args.slo_p99_ms or None,
+        error_budget=args.slo_error_budget,
+        window=window)

@@ -356,10 +356,13 @@ class InferenceEngine:
                 ) -> Tuple[List[object], dict]:
         """Run one planned batch; returns (per-request output slices in
         ``plan.requests`` order, info dict with bucket/rows/real_tokens/
-        device_s/compiles)."""
+        device_s/compiles, plus ``pack_s`` — the host time spent packing
+        the group into the fixed compile shape, the engine's share of
+        the trace's ``assembly`` span)."""
         import jax
 
         spec = self.tasks[task]
+        t_host0 = self._clock()
         B, S = self.max_batch_size, plan.bucket
         ids = np.zeros((B, S), np.int32)
         seg = np.zeros((B, S), np.int32)
@@ -427,6 +430,7 @@ class InferenceEngine:
             "rows": B,
             "real_tokens": sum(r.length for r in plan.requests),
             "device_s": device_s,
+            "pack_s": t0 - t_host0,
             "compiles": compiles,
             "packed": plan.packed,
         }

@@ -21,7 +21,14 @@ Routes:
   nothing about the serving path) — load balancers stop routing on
   the first failed probe;
 * ``GET  /statsz``     — the live ServeTelemetry rollup (requests,
-  latency percentiles, batch occupancy, compile count).
+  latency percentiles, batch occupancy, compile count; with tracing
+  enabled, the ``phases`` sub-object carries the run-level queue-wait
+  share and per-phase p95s);
+* ``GET  /metricsz``   — Prometheus text exposition (serve/tracing.py):
+  per-task request/error/over-SLO counters, per-(task, phase) latency
+  histograms, queue depth / occupancy / cold-start gauges — the scrape
+  surface the router and standard collectors consume. 404 when the
+  service was built without a tracer.
 """
 
 from __future__ import annotations
@@ -51,9 +58,13 @@ def _make_handler():
             pass
 
         def _reply(self, code: int, payload: dict) -> None:
-            body = json.dumps(payload).encode("utf-8")
+            self._reply_text(code, json.dumps(payload), "application/json")
+
+        def _reply_text(self, code: int, text: str,
+                        content_type: str) -> None:
+            body = text.encode("utf-8")
             self.send_response(code)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -71,6 +82,19 @@ def _make_handler():
                             health)
             elif self.path == "/statsz":
                 self._reply(200, service.telemetry.snapshot())
+            elif self.path == "/metricsz":
+                text = service.metrics_text()
+                if text is None:
+                    self._reply(404, {
+                        "error": "metrics export disabled: the service "
+                                 "has no tracer (--trace_sample_rate / "
+                                 "serve/tracing.py)"})
+                else:
+                    # The Prometheus text-exposition content type
+                    # (version 0.0.4 — the format every scraper speaks).
+                    self._reply_text(
+                        200, text,
+                        "text/plain; version=0.0.4; charset=utf-8")
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 

@@ -31,6 +31,7 @@ from typing import Callable, List, Optional
 from bert_pytorch_tpu.serve.batcher import Batcher, Request
 from bert_pytorch_tpu.serve.engine import InferenceEngine
 from bert_pytorch_tpu.serve.stats import ServeTelemetry
+from bert_pytorch_tpu.serve.tracing import TraceCollector
 
 
 class ServiceDraining(RuntimeError):
@@ -45,11 +46,37 @@ class ServingService:
         engine: InferenceEngine,
         batcher: Batcher,
         telemetry: Optional[ServeTelemetry] = None,
+        tracer: Optional[TraceCollector] = None,
+        heartbeat=None,
+        heartbeat_interval_s: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
     ):
+        """``tracer`` enables request-level span tracing + the /metricsz
+        export (serve/tracing.py); None skips all trace bookkeeping (the
+        overhead guard's baseline). Note one deliberate measurement
+        change vs the pre-tracing dispatch loop, tracer or not: each
+        request's completion is now stamped AFTER its own postprocess
+        (previously one batch-wide timestamp taken before any
+        postprocess), so e2e latency honestly includes the decode the
+        client actually waited for — a few ms per request at most, but
+        visible against pre-PR-9 serve baselines. NOTE: phase spans subtract
+        timestamps the batcher stamped, so a tracer-carrying service and
+        its batcher must share one ``clock`` (both default to
+        ``time.monotonic``). ``heartbeat`` is an optional
+        :class:`~bert_pytorch_tpu.telemetry.sentinels.Heartbeat` the
+        dispatch loop beats at most every ``heartbeat_interval_s`` — the
+        same resumable liveness file the training runners write, so the
+        capture harness covers serving processes too."""
         self.engine = engine
         self.batcher = batcher
         self.telemetry = telemetry or ServeTelemetry()
+        self.tracer = tracer
+        if tracer is not None:
+            # /statsz then carries the run-level phase rollup, keeping
+            # one scrape surface consistent with /metricsz.
+            self.telemetry.attach_tracer(tracer)
+        self._heartbeat = heartbeat
+        self._heartbeat_interval_s = float(heartbeat_interval_s)
         self._clock = clock
         # Guards _thread and _draining (the concurrency registry,
         # analysis/concurrency.py, enforced by jaxlint LK501): begin_drain
@@ -78,14 +105,18 @@ class ServingService:
             raise ValueError(
                 f"unknown task {task!r}; serving: "
                 f"{sorted(self.engine.tasks)}")
+        t_prep0 = self._clock()
         features = spec.handler.prepare(payload, self.engine.max_len())
         request = Request(task, features, payload)
+        request.prepare_s = self._clock() - t_prep0
         self.batcher.submit(request)
         if not request.wait(timeout):
             # Nobody will read the result: let the dispatch thread skip
             # the forward instead of spending device time on it.
             request.abandoned = True
             self.telemetry.observe_error()
+            if self.tracer is not None:
+                self.tracer.observe_error(task)
             raise TimeoutError(f"request timed out after {timeout}s")
         if request.error is not None:
             raise RuntimeError(request.error)
@@ -96,16 +127,32 @@ class ServingService:
     def process_batch(self, batch: List[Request]) -> None:
         """Plan, execute, demultiplex, postprocess, observe one flushed
         group (callable directly for deterministic tests and offline
-        scoring — the background thread just loops it)."""
+        scoring — the background thread just loops it).
+
+        With a tracer attached, each completed request is decomposed
+        into the serve/tracing.py span taxonomy: ``queue`` (enqueue ->
+        batcher pop), ``assembly`` (pop -> device dispatch: planning,
+        bucket choice, packing/padding, plus the demux host conversion),
+        ``execute`` (the batch's jitted forward incl. device sync,
+        shared), and ``postprocess`` (the request's own handler decode).
+        """
         batch = [r for r in batch if not r.abandoned]
         if not batch:
             return
+        entry = self._clock()
+        for req in batch:
+            if req.enqueued_at is None:
+                # Directly-constructed requests (offline scoring, tests)
+                # never passed through Batcher.submit/pop — anchor their
+                # life at batch entry so e2e latency and trace spans
+                # measure this call, not clock-origin process uptime
+                # (which would also force-trace every one as over-SLO).
+                req.enqueued_at = req.dequeued_at = entry
         task = batch[0].task
         spec = self.engine.tasks[task]
         plan = self.engine.plan_batch(batch)
         if plan.leftover:
             self.batcher.requeue_front(plan.leftover)
-        now = self._clock()
         try:
             outputs, info = self.engine.execute(task, plan)
         except Exception as exc:  # fulfil waiters; the server stays up
@@ -113,23 +160,68 @@ class ServingService:
             for req in plan.requests:
                 req.set_error(f"{type(exc).__name__}: {exc}", now)
                 self.telemetry.observe_error()
+                if self.tracer is not None:
+                    self.tracer.observe_error(task)
             return
-        now = self._clock()
+        exec_done = self._clock()
+        device_s = info["device_s"]
+        budget = info["rows"] * info["bucket"]
+        occupancy = (info["real_tokens"] / budget) if budget else None
         e2e = []
+        now = exec_done
         for req, out in zip(plan.requests, outputs):
+            # Fresh read, not the previous iteration's `now`: the prior
+            # request's tracer emit happens between iterations and must
+            # not be attributed to THIS request's postprocess span.
+            pp_start = self._clock()
             try:
                 result = spec.handler.postprocess(
                     req.features, out, req.payload)
-                req.device_s = info["device_s"]
+                now = self._clock()
+                req.device_s = device_s
                 req.set_result(result, now)
-                e2e.append(now - req.enqueued_at)
+                total_s = now - req.enqueued_at
+                e2e.append(total_s)
             except Exception as exc:
+                now = self._clock()
                 req.set_error(f"{type(exc).__name__}: {exc}", now)
                 self.telemetry.observe_error()
+                if self.tracer is not None:
+                    self.tracer.observe_error(task)
+                continue
+            if self.tracer is None:
+                continue
+            try:
+                # Outside the fulfilment try: the result is already
+                # delivered, and a telemetry emit failure (sink closed
+                # mid-shutdown, disk full) must not flip a fulfilled
+                # request into the error path.
+                queue_s = max(0.0, req.dequeued_at - req.enqueued_at)
+                self.tracer.observe(
+                    task, req.id,
+                    phases_s={
+                        "queue": queue_s,
+                        # Everything between the pop and the forward
+                        # returning that was not device time.
+                        "assembly": max(
+                            0.0, exec_done - req.dequeued_at - device_s),
+                        "execute": device_s,
+                        "postprocess": now - pp_start,
+                    },
+                    total_s=total_s,
+                    bucket=info["bucket"],
+                    packed=info["packed"],
+                    batch_requests=len(plan.requests),
+                    occupancy=occupancy,
+                    prepare_s=req.prepare_s,
+                    pack_s=info.get("pack_s"),
+                )
+            except Exception:
+                pass  # observability must never break serving
         if e2e:
             self.telemetry.observe_batch(
                 e2e_s=e2e,
-                device_s=info["device_s"],
+                device_s=device_s,
                 rows=info["rows"],
                 bucket=info["bucket"],
                 real_tokens=info["real_tokens"],
@@ -138,10 +230,21 @@ class ServingService:
             )
 
     def _loop(self) -> None:
+        # last_beat stays a local: heartbeat cadence state is owned by
+        # this thread alone (the Heartbeat binding itself is frozen
+        # after __init__ — concurrency registry).
+        last_beat = 0.0
         while not self._stop.is_set():
             batch = self.batcher.next_batch(timeout=0.1)
             if batch:
                 self.process_batch(batch)
+            if self._heartbeat is not None:
+                now = self._clock()
+                if now - last_beat >= self._heartbeat_interval_s:
+                    last_beat = now
+                    # step = requests served so far: the serving analog
+                    # of the training step counter the harness reads.
+                    self._heartbeat.beat(self.telemetry.request_count())
 
     def start(self) -> None:
         if not self.engine.warmed:
@@ -154,6 +257,11 @@ class ServingService:
         self.telemetry.observe_cold_start(
             getattr(self.engine, "startup", None))
         self.telemetry.reset_clock()  # rps measures serving, not warmup
+        if self._heartbeat is not None:
+            # First beat before any traffic: liveness is visible the
+            # moment the dispatch thread exists, not after the first
+            # request (the training runners beat from step 1 onward).
+            self._heartbeat.beat(self.telemetry.request_count())
         self._stop.clear()
         thread = threading.Thread(
             target=self._loop, name="serve-dispatch", daemon=True)
@@ -226,4 +334,48 @@ class ServingService:
             thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=5.0)
-        self.telemetry.finish()
+        self.telemetry.finish()  # also flushes the attached tracer
+        if self._heartbeat is not None and (
+                thread is None or not thread.is_alive()):
+            # Final beat only once the loop thread is provably gone:
+            # Heartbeat.beat is not thread-safe (it relies on the thread
+            # lifecycle for serialization), and a join that timed out
+            # would leave the loop free to beat concurrently — skipping
+            # one last beat beats tearing the liveness file.
+            self._heartbeat.beat(self.telemetry.request_count())
+
+    # -- metrics export ---------------------------------------------------
+
+    def metrics_text(self) -> Optional[str]:
+        """The full /metricsz payload (Prometheus text format): the
+        tracer's per-task counters + phase histograms, then the
+        service-level gauges a router wants in the same scrape — queue
+        depth, dispatch liveness, run occupancy, cold-start cost. None
+        when no tracer is attached (the HTTP layer 404s)."""
+        if self.tracer is None:
+            return None
+        lines = [self.tracer.metrics_text().rstrip("\n")]
+        # Base gauges only: the phases sub-object would recompute the
+        # tracer's whole percentile rollup per scrape and be discarded.
+        snap = self.telemetry.snapshot(include_phases=False)
+
+        def gauge(name, value, help_text):
+            if value is None:
+                return
+            lines.append(f"# HELP bert_serve_{name} {help_text}")
+            lines.append(f"# TYPE bert_serve_{name} gauge")
+            lines.append(f"bert_serve_{name} {float(value):g}")
+
+        gauge("queue_depth", self.batcher.depth(),
+              "Requests pending in the batcher queue.")
+        gauge("dispatch_alive", 1.0 if self.dispatch_alive else 0.0,
+              "1 while the dispatch thread is running.")
+        gauge("draining", 1.0 if self.draining else 0.0,
+              "1 once shutdown drain has begun.")
+        gauge("batch_occupancy", snap.get("batch_occupancy"),
+              "Run-level real tokens / dispatched slot budget.")
+        gauge("cold_start_seconds", snap.get("cold_start_s"),
+              "Engine AOT warmup wall time (serve_cold_start record).")
+        gauge("warmup_compiles_cold", snap.get("warmup_compiles_cold"),
+              "Real XLA compiles during warmup (0 = warm restart).")
+        return "\n".join(lines) + "\n"

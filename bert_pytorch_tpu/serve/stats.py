@@ -96,6 +96,10 @@ class ServeTelemetry:
         # snapshot() for /statsz — same lock as the other rollup state
         # (concurrency registry, analysis/concurrency.py).
         self._cold_start: Optional[dict] = None
+        # Optional request tracer (serve/tracing.py): attached once by
+        # the service before dispatch starts, read by snapshot()/finish()
+        # on scrape threads — guarded by the same lock (registry entry).
+        self._tracer = None
 
     # -- producer --------------------------------------------------------
 
@@ -130,6 +134,23 @@ class ServeTelemetry:
     def observe_error(self) -> None:
         with self._lock:
             self.total_errors += 1
+
+    def attach_tracer(self, tracer) -> None:
+        """Fold a :class:`~bert_pytorch_tpu.serve.tracing.TraceCollector`
+        into this rollup: ``snapshot()``/``/statsz`` gain the run-level
+        ``phases`` sub-object (queue-wait share, per-phase p95s, SLO
+        accounting) and ``finish()`` flushes the tracer's partial
+        serve_phase windows — one scrape surface stays consistent with
+        /metricsz."""
+        with self._lock:
+            self._tracer = tracer
+
+    def request_count(self) -> int:
+        """Completed-request total, read under the lock (the serve
+        heartbeat's step counter — a bare ``total_requests`` read would
+        race the dispatch thread, jaxlint LK501)."""
+        with self._lock:
+            return self.total_requests
 
     def observe_cold_start(self, startup: dict) -> Optional[dict]:
         """Record the engine's startup stats (``InferenceEngine.startup``)
@@ -209,9 +230,16 @@ class ServeTelemetry:
             self.emit(record)
         return record
 
-    def snapshot(self) -> dict:
-        """Run-level rollup for /statsz and the serve_summary record."""
+    def snapshot(self, include_phases: bool = True) -> dict:
+        """Run-level rollup for /statsz and the serve_summary record.
+        With a tracer attached, carries its run-level phase rollup as
+        the ``phases`` sub-object (same numbers /metricsz exports);
+        ``include_phases=False`` skips that merge for callers that only
+        want the base gauges (the /metricsz renderer — computing the
+        tracer's full percentile rollup per scrape just to discard it
+        would hold the tracer lock against the dispatch thread)."""
         with self._lock:
+            tracer = self._tracer if include_phases else None
             wall = max(self._clock() - self._t0, 1e-9)
             record = {
                 "requests": self.total_requests,
@@ -240,10 +268,21 @@ class ServeTelemetry:
                             "weight_bytes"):
                     if cs.get(key) is not None:
                         record[key] = cs[key]
-            return record
+        # Outside the lock: the tracer takes its own lock, and nesting
+        # the two buys nothing (the binding was read consistently above).
+        if tracer is not None:
+            phases = tracer.phase_snapshot()
+            if phases:
+                record["phases"] = phases
+        return record
 
     def finish(self) -> Optional[dict]:
-        """Flush the partial window and emit the serve_summary record."""
+        """Flush the partial window and emit the serve_summary record
+        (and the attached tracer's partial serve_phase windows)."""
+        with self._lock:
+            tracer = self._tracer
+        if tracer is not None:
+            tracer.finish()
         self.flush_window()
         # snapshot() reads the run totals under the lock — the bare
         # total_requests read that used to sit here raced the dispatch

@@ -25,6 +25,15 @@ Every ``postprocess`` consumes fp32 numpy slices already demultiplexed per
 request by the engine (packed or not), so results are bit-identical
 between the padded/packed batched path and a direct single-request
 forward — the parity tests/test_serve.py asserts.
+
+Tracing contract (serve/tracing.py, docs/serving.md "Request tracing &
+metrics"): ``prepare`` runs on the submitting HTTP worker BEFORE the
+request is enqueued, so its cost rides sampled trace records as
+``prepare_ms`` context; ``postprocess`` runs on the dispatch thread
+after the forward and IS the trace's ``postprocess`` span — a handler
+that grows an expensive decode shows up per-request in the span tree
+and per-task in the /metricsz phase histograms, attributed, not folded
+into an opaque end-to-end number.
 """
 
 from __future__ import annotations

@@ -3,6 +3,8 @@ verdict over the JSONL artifacts the telemetry layer writes.
 
 ``summarize_file`` folds one artifact's records (``step_window``,
 ``compile``, ``sentinel``, ``grad_health``, ``divergence``, ``memory``,
+``serve_*`` — including the request-tracing ``serve_phase``/
+``serve_trace`` decomposition and its SLO verdict — and
 ``run_summary``) into a flat summary; ``compare`` diffs two summaries
 against relative tolerances and returns named regressions. The CLI
 (`tools/telemetry_report.py`, console entry ``telemetry-report``) prints
@@ -105,6 +107,8 @@ def summarize_records(records, name: str = "") -> dict:
     memory = []
     serve_windows = []
     serve_cold_starts = []
+    serve_phases = []
+    serve_traces = []
     faults = []
     resumes = []
     serve_summary: Optional[dict] = None
@@ -129,6 +133,10 @@ def summarize_records(records, name: str = "") -> dict:
             serve_windows.append(rec)
         elif kind == "serve_cold_start":
             serve_cold_starts.append(rec)
+        elif kind == "serve_phase":
+            serve_phases.append(rec)
+        elif kind == "serve_trace":
+            serve_traces.append(rec)
         elif kind == "serve_summary":
             serve_summary = rec
         elif kind == "fault":
@@ -314,6 +322,75 @@ def summarize_records(records, name: str = "") -> dict:
         out["serve_compiles"] = sum(
             int(w.get("compiles", 0)) for w in serve_windows)
 
+    # -- request-tracing section (serve/tracing.py, docs/serving.md) ----
+    # serve_phase windows carry the latency DECOMPOSITION the coarse
+    # serve_window records can't: where a request's time went (queue vs
+    # execute vs postprocess), the queue-wait share a router balances
+    # on, and the rolling-window SLO accounting. Aggregation follows the
+    # step-window conventions: request-weighted means for shares, max
+    # over windows for tails (a p99 breach anywhere in the run must not
+    # average away).
+    if serve_phases:
+        reqs = sum(int(w.get("window_requests", 1)) for w in serve_phases)
+        shares = [(float(w["queue_wait_share"]),
+                   int(w.get("window_requests", 1)))
+                  for w in serve_phases if "queue_wait_share" in w]
+        if shares:
+            total_w = sum(w for _, w in shares)
+            out["serve_queue_wait_share"] = round(
+                sum(v * w for v, w in shares) / total_w, 4)
+        for phase in ("queue", "assembly", "execute", "postprocess"):
+            vals = [float(w[f"{phase}_p95_ms"]) for w in serve_phases
+                    if f"{phase}_p95_ms" in w]
+            if vals:
+                out[f"serve_{phase}_p95_ms"] = round(max(vals), 3)
+        p99s = [float(w["total_p99_ms"]) for w in serve_phases
+                if "total_p99_ms" in w]
+        if p99s:
+            # The metric behind the "serve SLO p99" gate: worst window
+            # tail of the traced decomposition.
+            out["serve_slo_p99_ms"] = round(max(p99s), 3)
+        targets = [float(w["slo_target_ms"]) for w in serve_phases
+                   if w.get("slo_target_ms")]
+        if targets:
+            target = targets[-1]
+            over = sum(int(w.get("over_slo", 0)) for w in serve_phases)
+            budgets = [float(w["slo_budget"]) for w in serve_phases
+                       if w.get("slo_budget")]
+            budget_frac = budgets[-1] if budgets else 0.01
+            out["serve_slo_target_ms"] = target
+            out["serve_slo_over"] = over
+            allowed = budget_frac * reqs
+            if allowed > 0:
+                # >1 = the error budget for this run is spent.
+                out["serve_slo_budget_burn"] = round(over / allowed, 4)
+            p99 = out.get("serve_slo_p99_ms")
+            out["serve_slo_verdict"] = (
+                "breach" if (p99 is not None and p99 > target)
+                or out.get("serve_slo_budget_burn", 0) > 1.0 else "ok")
+    if serve_traces:
+        out["serve_traces"] = len(serve_traces)
+        out["serve_traces_slow"] = sum(
+            1 for t in serve_traces if t.get("sample_reason") == "slow")
+        # Critical path of the slowest decile: among the worst 10% of
+        # sampled traces by total latency, which phase dominated each —
+        # the "what do I fix first" summary ("The Tail at Scale").
+        by_total = sorted(
+            (t for t in serve_traces if t.get("spans")),
+            key=lambda t: float(t.get("total_ms", 0.0)), reverse=True)
+        decile = by_total[: max(1, len(by_total) // 10)] if by_total else []
+        path: dict = {}
+        for t in decile:
+            spans = [s for s in t["spans"]
+                     if isinstance(s, dict) and "dur_ms" in s]
+            if not spans:
+                continue
+            worst = max(spans, key=lambda s: float(s["dur_ms"]))
+            path[worst["name"]] = path.get(worst["name"], 0) + 1
+        if path:
+            out["serve_critical_path"] = dict(
+                sorted(path.items(), key=lambda kv: -kv[1]))
+
     if serve_cold_starts:
         # A multi-start artifact (e.g. the BENCH_SERVE quant leg runs
         # fp32 then int8 engines) gates on the WORST start; the cold
@@ -366,6 +443,13 @@ _CHECKS = (
     ("serve_latency_p95_ms", "serve p95 latency", "up", "p95"),
     ("serve_rps", "serve throughput (req/s)", "down", "step"),
     ("serve_occupancy", "serve batch occupancy", "down", "step"),
+    # Request-tracing gates (serve/tracing.py): the queue-wait share is
+    # the admission-control signal — a dispatch/batching change that
+    # parks requests in the queue moves it even when the device time is
+    # unchanged; the SLO p99 is the worst traced-window tail, the number
+    # the serving SLO is written against.
+    ("serve_queue_wait_share", "serve queue-wait share", "up", "p95"),
+    ("serve_slo_p99_ms", "serve SLO p99", "up", "p95"),
     # Cold start: the persisted-AOT-cache win. A regression here means a
     # restarted replica is recompiling (cache key drift — e.g. a renamed
     # forward — or the persistence bar filtering serve executables).
@@ -446,6 +530,11 @@ def format_summary(summary: dict) -> str:
              "serve_device_p50_ms", "serve_occupancy", "serve_compiles",
              "serve_errors", "serve_cold_start_s", "serve_compiles_cold",
              "serve_compiles_warm", "serve_quantize",
+             "serve_queue_wait_share", "serve_queue_p95_ms",
+             "serve_assembly_p95_ms", "serve_execute_p95_ms",
+             "serve_postprocess_p95_ms", "serve_traces",
+             "serve_traces_slow", "serve_slo_target_ms", "serve_slo_p99_ms",
+             "serve_slo_over", "serve_slo_budget_burn", "serve_slo_verdict",
              "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",
@@ -455,6 +544,11 @@ def format_summary(summary: dict) -> str:
     for key in order:
         if key in summary:
             lines.append(f"  {key:>22}: {_fmt_value(key, summary[key])}")
+    if summary.get("serve_critical_path"):
+        lines.append(f"  {'serve_critical_path':>22}: "
+                     + ", ".join(f"{k}={v}" for k, v
+                                 in summary["serve_critical_path"].items())
+                     + " (dominant phase, slowest decile)")
     if summary.get("fault_kinds"):
         lines.append(f"  {'fault_kinds':>22}: "
                      + ", ".join(summary["fault_kinds"]))

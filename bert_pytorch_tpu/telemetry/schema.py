@@ -95,7 +95,35 @@ KIND_REQUIRED_KEYS = {
     "serve_cold_start": (
         "cold_start_s", "compiles", "compiles_cold", "compiles_warm",
     ),
+    # one sampled request's span tree (serve/tracing.py): head-sampled
+    # at --trace_sample_rate, or force-sampled by the always-sample-slow
+    # rule when the request exceeded the SLO target
+    "serve_trace": (
+        "trace_id", "task", "total_ms", "queue_wait_ms", "sampled",
+        "spans",
+    ),
+    # one per-task window of request-latency decomposition: per-phase
+    # p50/p95, total percentiles, and the queue-wait share a router
+    # balances on (serve/tracing.py)
+    "serve_phase": (
+        "task", "window_requests", "queue_wait_share",
+        "queue_p50_ms", "queue_p95_ms",
+        "assembly_p50_ms", "assembly_p95_ms",
+        "execute_p50_ms", "execute_p95_ms",
+        "postprocess_p50_ms", "postprocess_p95_ms",
+        "total_p50_ms", "total_p95_ms", "total_p99_ms",
+    ),
 }
+
+# serve_trace span names (serve/tracing.py PHASES, mirrored here so the
+# schema module stays stdlib-only/jax-free — tools/check_telemetry_schema
+# loads it by file path).
+TRACE_PHASES = ("queue", "assembly", "execute", "postprocess")
+
+# Rounding slack for the serve_trace additive invariants: spans and the
+# total are independently rounded to 3 decimals at emission, so exact <=
+# comparisons would flag sub-microsecond rounding noise as corruption.
+_TRACE_EPS_MS = 0.01
 
 # Serve-kind consistency rules (lintable offline): percentiles must be
 # ordered, and occupancy is a ratio of real work to dispatched budget —
@@ -151,6 +179,10 @@ def validate_record(rec) -> list:
                     _check_serve_fields(rec, errors)
                 if kind == "serve_cold_start":
                     _check_cold_start_fields(rec, errors)
+                if kind == "serve_trace":
+                    _check_trace_fields(rec, errors)
+                if kind == "serve_phase":
+                    _check_phase_fields(rec, errors)
                 if kind == "fault":
                     _check_fault_fields(rec, errors)
                 if kind == "resume":
@@ -256,6 +288,115 @@ def _check_cold_start_fields(rec, errors) -> None:
             "compiles_cold + compiles_warm exceeds compiles "
             f"({rec.get('compiles_cold')} + {rec.get('compiles_warm')} > "
             f"{rec.get('compiles')})")
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_trace_fields(rec, errors) -> None:
+    """serve_trace consistency (serve/tracing.py): the span tree must be
+    a real decomposition of the request — non-negative durations summing
+    to no more than the end-to-end total, a queue wait bounded by that
+    total, and a genuine boolean ``sampled`` flag (consumers split
+    head-sampled from slow-forced traces on it; the critical-path
+    analysis in telemetry-report trusts the arithmetic)."""
+    total = rec.get("total_ms")
+    if not _is_number(total) or total < 0:
+        errors.append(
+            f"total_ms must be a non-negative number, got {total!r}")
+        total = None
+    queue = rec.get("queue_wait_ms")
+    if not _is_number(queue) or queue < 0:
+        errors.append(
+            f"queue_wait_ms must be a non-negative number, got {queue!r}")
+    elif total is not None and queue > total + _TRACE_EPS_MS:
+        errors.append(
+            f"queue_wait_ms ({queue}) exceeds total_ms ({total})")
+    if not isinstance(rec.get("sampled"), bool):
+        errors.append(
+            f"serve_trace 'sampled' must be a boolean, got "
+            f"{rec.get('sampled')!r}")
+    reason = rec.get("sample_reason")
+    if reason is not None and reason not in ("head", "slow"):
+        errors.append(
+            f"sample_reason must be 'head' or 'slow', got {reason!r}")
+    spans = rec.get("spans")
+    if not isinstance(spans, list) or not spans:
+        errors.append(
+            f"serve_trace 'spans' must be a non-empty list, got {spans!r}")
+        return
+    dur_sum = 0.0
+    for i, span in enumerate(spans):
+        if not isinstance(span, dict) or not {"name", "start_ms",
+                                              "dur_ms"} <= set(span):
+            errors.append(
+                f"spans[{i}] must be an object with name/start_ms/dur_ms, "
+                f"got {span!r}")
+            continue
+        if not isinstance(span["name"], str) or not span["name"]:
+            errors.append(
+                f"spans[{i}].name must be a non-empty string, got "
+                f"{span['name']!r}")
+        for key in ("start_ms", "dur_ms"):
+            v = span[key]
+            if not _is_number(v) or v < 0:
+                errors.append(
+                    f"spans[{i}].{key} must be a non-negative number, "
+                    f"got {v!r}")
+                break
+        else:
+            dur_sum += span["dur_ms"]
+    if total is not None and dur_sum > total + _TRACE_EPS_MS:
+        errors.append(
+            f"sum of span durations ({round(dur_sum, 3)}) exceeds "
+            f"total_ms ({total}): spans must be sub-intervals of the "
+            "request")
+
+
+def _check_phase_fields(rec, errors) -> None:
+    """serve_phase consistency (serve/tracing.py window records)."""
+    task = rec.get("task")
+    if not isinstance(task, str) or not task:
+        errors.append(f"task must be a non-empty string, got {task!r}")
+    n = rec.get("window_requests")
+    if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+        errors.append(
+            f"window_requests must be a positive integer, got {n!r}")
+    share = rec.get("queue_wait_share")
+    if not _is_number(share) or not 0 <= share <= 1:
+        errors.append(
+            f"queue_wait_share must be in [0, 1], got {share!r}")
+    for prefix in TRACE_PHASES:
+        p50 = rec.get(f"{prefix}_p50_ms")
+        p95 = rec.get(f"{prefix}_p95_ms")
+        for key, v in ((f"{prefix}_p50_ms", p50), (f"{prefix}_p95_ms",
+                                                   p95)):
+            if v is not None and (not _is_number(v) or v < 0):
+                errors.append(
+                    f"{key} must be a non-negative number, got {v!r}")
+        if _is_number(p50) and _is_number(p95) and p50 > p95:
+            errors.append(
+                f"{prefix} percentiles not ordered (p50 <= p95): "
+                f"[{p50}, {p95}]")
+    totals = [rec.get(f"total_{p}_ms") for p in ("p50", "p95", "p99")]
+    if all(_is_number(v) for v in totals) and \
+            not (totals[0] <= totals[1] <= totals[2]):
+        errors.append(
+            f"total percentiles not ordered (p50 <= p95 <= p99): {totals}")
+    over = rec.get("over_slo")
+    if over is not None:
+        if not isinstance(over, int) or isinstance(over, bool) or over < 0:
+            errors.append(
+                f"over_slo must be a non-negative integer, got {over!r}")
+        elif isinstance(n, int) and not isinstance(n, bool) and over > n:
+            errors.append(
+                f"over_slo ({over}) exceeds window_requests ({n})")
+        if not _is_number(rec.get("slo_target_ms")) or \
+                rec.get("slo_target_ms") <= 0:
+            errors.append(
+                "over_slo requires a positive slo_target_ms, got "
+                f"{rec.get('slo_target_ms')!r}")
 
 
 def _check_fault_fields(rec, errors) -> None:

@@ -15,6 +15,7 @@ request packing::
         -d '{"text": "the capital of [MASK] is paris"}'
     curl -s localhost:8000/healthz
     curl -s localhost:8000/statsz
+    curl -s localhost:8000/metricsz   # Prometheus text format
 
 Per-task ``--<task>_checkpoint`` accepts either a ``ckpt_*.msgpack`` file
 or a directory (the newest checkpoint is picked via
@@ -65,10 +66,12 @@ def parse_arguments(argv=None):
                              "dispatches when its oldest request has "
                              "waited this long")
     # Inference fast path (docs/serving.md): --quantize/--attention_backend,
-    # shared with tools/batch_infer.py via one helper.
-    from bert_pytorch_tpu.serve.cli import add_fast_path_args
+    # shared with tools/batch_infer.py via one helper. Tracing/SLO knobs
+    # (docs/serving.md "Request tracing & metrics") ride the same way.
+    from bert_pytorch_tpu.serve.cli import add_fast_path_args, add_tracing_args
 
     add_fast_path_args(parser)
+    add_tracing_args(parser)
     parser.add_argument("--pack_requests", action="store_true",
                         help="pack several short requests per row with "
                              "block-diagonal attention (data/packing.py)")
@@ -88,6 +91,13 @@ def parse_arguments(argv=None):
     parser.add_argument("--telemetry_jsonl", type=str, default="",
                         help="serve telemetry JSONL sink; default "
                              "<output_dir>/serve_telemetry.jsonl")
+    parser.add_argument("--heartbeat_file", type=str, default="",
+                        help="resumable liveness file the dispatch loop "
+                             "maintains (telemetry/sentinels.py Heartbeat "
+                             "— the same file the training runners write, "
+                             "read by the capture harness); default "
+                             "<output_dir>/heartbeat.json, disabled "
+                             "without an output_dir")
     parser.add_argument("--telemetry_window", type=int, default=64,
                         help="requests per serve_window record")
     parser.add_argument("--compile_cache_dir", type=str, default="",
@@ -175,6 +185,21 @@ def build_service(args):
         window=args.telemetry_window)
     monitor = CompileMonitor(
         emit=sink.write_record if sink else (lambda rec: None))
+    # Request tracing + /metricsz (docs/serving.md "Request tracing &
+    # metrics"): spans for the head-sampled fraction (and EVERY over-SLO
+    # request), serve_phase decomposition windows, Prometheus export.
+    from bert_pytorch_tpu.serve.cli import build_tracer
+
+    tracer = build_tracer(args, emit=sink.write_record if sink else None,
+                          window=args.telemetry_window)
+    # Serve heartbeat: the same resumable liveness file the five training
+    # runners maintain, so the capture harness covers serving processes.
+    from bert_pytorch_tpu.telemetry.sentinels import Heartbeat
+
+    heartbeat_path = args.heartbeat_file or (
+        os.path.join(args.output_dir, "heartbeat.json")
+        if args.output_dir else None)
+    heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
 
     engine = InferenceEngine(
         config,
@@ -195,7 +220,8 @@ def build_service(args):
         max_wait_ms=args.max_wait_ms,
         max_requests_per_pack=engine.max_requests_per_pack,
         max_pending=args.max_pending)
-    service = ServingService(engine, batcher, serve_tele)
+    service = ServingService(engine, batcher, serve_tele, tracer=tracer,
+                             heartbeat=heartbeat)
     return service, sink
 
 
@@ -224,7 +250,9 @@ def main(args):
     host, port = server.server_address[:2]
     logger.info(f"serving {sorted(service.engine.tasks)} on "
                 f"http://{host}:{port} (POST /v1/<task>, GET /healthz, "
-                "GET /statsz)")
+                "GET /statsz, GET /metricsz) — tracing "
+                f"{args.trace_sample_rate:.0%} head-sampled, "
+                f"SLO p99 {args.slo_p99_ms:g}ms (over-SLO always traced)")
 
     def shutdown(signum, frame):
         # Graceful drain (docs/fault_tolerance.md): flip /healthz to 503

@@ -1,0 +1,631 @@
+"""Request-level tracing, the /metricsz export plane, and the SLO gates
+(serve/tracing.py, docs/serving.md "Request tracing & metrics").
+
+Covers the ISSUE-9 acceptance surface on CPU:
+
+* >= 32 concurrent HTTP requests with sampling ON: every sampled trace's
+  span tree is complete (the four-phase taxonomy, additive invariants)
+  and the artifact lints schema-clean; /metricsz parses as Prometheus
+  text with per-task phase histograms CONSISTENT with /statsz, and its
+  counters are monotonic across scrapes;
+* telemetry-report exits nonzero NAMING "serve SLO p99" when the same
+  trace replays against a baseline with an injected queue-delay
+  regression;
+* the always-sample-slow rule (over-SLO requests traced at rate 0);
+* a tracing-off overhead guard (tracer-None p50 within noise of the
+  traced path);
+* the serve heartbeat satellite (resumable liveness file from the
+  dispatch loop);
+* fixture-backed schema-lint coverage for the new record kinds.
+
+One module-scoped TWO-task engine (classify + ner, tiny config, buckets
+(16, 32), batch 4) keeps the AOT warmup cost down — the tracing layer is
+task-generic, and test_serve.py already exercises all four heads.
+"""
+
+import json
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from bert_pytorch_tpu.config import BertConfig
+from bert_pytorch_tpu.serve.batcher import Batcher, Request
+from bert_pytorch_tpu.serve.tracing import (HIST_BUCKETS_MS, PHASES,
+                                            TraceCollector)
+from bert_pytorch_tpu.telemetry import report
+from bert_pytorch_tpu.telemetry.schema import validate_file, validate_record
+
+BUCKETS = (16, 32)
+BATCH = 4
+NER_LABELS = ["O", "B-LOC", "B-PER"]
+CLS_LABELS = ["neg", "pos"]
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    from bert_pytorch_tpu.tools.make_synthetic_data import write_trace_vocab
+
+    d = tmp_path_factory.mktemp("trace_vocab")
+    return write_trace_vocab(str(d / "vocab.txt"))
+
+
+@pytest.fixture(scope="module")
+def tokenizer(vocab_file):
+    from bert_pytorch_tpu.data.tokenization import BertTokenizer
+
+    return BertTokenizer(vocab_file, do_lower_case=True)
+
+
+@pytest.fixture(scope="module")
+def config():
+    from bert_pytorch_tpu.tools.make_synthetic_data import TRACE_WORDS
+
+    vocab = 5 + len(TRACE_WORDS)
+    vocab += (8 - vocab % 8) % 8
+    return BertConfig(
+        vocab_size=vocab, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2, next_sentence=True,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+
+
+@pytest.fixture(scope="module")
+def engine(config, tokenizer):
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.serve import InferenceEngine
+
+    eng = InferenceEngine(
+        config, tokenizer,
+        tasks={"classify": {"labels": CLS_LABELS},
+               "ner": {"labels": NER_LABELS}},
+        buckets=BUCKETS, max_batch_size=BATCH, dtype=jnp.float32, seed=3)
+    eng.warmup()
+    return eng
+
+
+def _payloads(n):
+    """n mixed classify/ner payloads over the trace vocabulary."""
+    texts = [
+        "paris is big",
+        "the river runs through london",
+        "william shakespeare wrote hamlet in london england",
+        "england is old",
+        "the capital of france is paris",
+    ]
+    out = []
+    for i in range(n):
+        task = "classify" if i % 2 == 0 else "ner"
+        out.append((task, {"text": texts[i % len(texts)]}))
+    return out
+
+
+def _serve(engine, sink=None, tracer=None, max_wait_ms=5.0,
+           batcher_batch=BATCH, heartbeat=None):
+    from bert_pytorch_tpu.serve import ServeTelemetry, ServingService
+
+    telemetry = ServeTelemetry(
+        emit=sink.write_record if sink else None, window=16)
+    service = ServingService(
+        engine, Batcher(max_batch_size=batcher_batch,
+                        max_wait_ms=max_wait_ms),
+        telemetry, tracer=tracer, heartbeat=heartbeat,
+        heartbeat_interval_s=0.0)
+    return service
+
+
+# ---------------------------------------------------------------------------
+# collector units (no engine, no jax)
+
+
+def _phases(queue=0.002, assembly=0.001, execute=0.010, postprocess=0.001):
+    return {"queue": queue, "assembly": assembly, "execute": execute,
+            "postprocess": postprocess}
+
+
+def test_head_sampling_is_deterministic_and_rate_bounded():
+    records = []
+    tc = TraceCollector(emit=records.append, sample_rate=0.5, window=1000)
+    for i in range(200):
+        tc.observe("classify", i, _phases(), total_s=0.02)
+    first = [r["trace_id"] for r in records if r["kind"] == "serve_trace"]
+    assert 40 < len(first) < 160  # ~half, hash-dependent but bounded
+    # Same ids -> the SAME sampling decisions (replay determinism).
+    records2 = []
+    tc2 = TraceCollector(emit=records2.append, sample_rate=0.5, window=1000)
+    for i in range(200):
+        tc2.observe("classify", i, _phases(), total_s=0.02)
+    second = [r["trace_id"] for r in records2
+              if r["kind"] == "serve_trace"]
+    assert [t.split("-")[1] for t in first] == \
+        [t.split("-")[1] for t in second]
+
+
+def test_always_sample_slow_rule_at_rate_zero():
+    records = []
+    tc = TraceCollector(emit=records.append, sample_rate=0.0,
+                        slo_p99_ms=50.0, window=1000)
+    tc.observe("classify", 1, _phases(), total_s=0.02)   # under SLO
+    tc.observe("classify", 2, _phases(queue=0.2), total_s=0.21)  # over
+    traces = [r for r in records if r["kind"] == "serve_trace"]
+    assert len(traces) == 1
+    assert traces[0]["sampled"] is False
+    assert traces[0]["sample_reason"] == "slow"
+    assert traces[0]["total_ms"] > 50.0
+    # No SLO configured -> rate 0 emits nothing at all.
+    silent = []
+    tc2 = TraceCollector(emit=silent.append, sample_rate=0.0, window=1000)
+    tc2.observe("classify", 2, _phases(queue=0.2), total_s=0.21)
+    assert not [r for r in silent if r["kind"] == "serve_trace"]
+
+
+def test_slow_reason_outranks_head_and_forced_exports_are_capped():
+    from bert_pytorch_tpu.serve.tracing import SLOW_TRACE_WINDOW_CAP
+
+    # A head-sampled request that was ALSO over the SLO reports "slow" —
+    # the report's serve_traces_slow tail-attribution count keys on the
+    # reason, and at rate 1.0 every over-SLO trace would otherwise hide
+    # behind "head". `sampled` still records head-sampledness.
+    records = []
+    tc = TraceCollector(emit=records.append, sample_rate=1.0,
+                        slo_p99_ms=50.0, window=1000)
+    tc.observe("classify", 1, _phases(), total_s=0.02)            # under
+    tc.observe("classify", 2, _phases(queue=0.2), total_s=0.21)   # over
+    traces = [r for r in records if r["kind"] == "serve_trace"]
+    assert [t["sample_reason"] for t in traces] == ["head", "slow"]
+    assert all(t["sampled"] is True for t in traces)
+
+    # Everything-is-slow incident at rate 0: forced exports stop at the
+    # per-window budget; the over-SLO counters are never capped.
+    slow = []
+    tc2 = TraceCollector(emit=slow.append, sample_rate=0.0,
+                         slo_p99_ms=50.0, window=1000)
+    n = SLOW_TRACE_WINDOW_CAP + 24
+    for i in range(n):
+        tc2.observe("classify", i, _phases(queue=0.2), total_s=0.21)
+    traces = [r for r in slow if r["kind"] == "serve_trace"]
+    assert len(traces) == SLOW_TRACE_WINDOW_CAP
+    snap = tc2.phase_snapshot()
+    assert snap["over_slo"] == n and snap["sampled_traces"] == len(traces)
+
+
+def test_direct_process_batch_anchors_unstamped_requests(engine):
+    """Requests handed straight to process_batch (offline scoring, the
+    docstring-invited deterministic-test path) never met Batcher.submit:
+    their life must anchor at batch entry, not at the monotonic clock's
+    origin — which would register as hours of latency and force-trace
+    every one as over-SLO."""
+    records = []
+    tracer = TraceCollector(emit=records.append, sample_rate=1.0,
+                            slo_p99_ms=30000.0, window=1000)
+    service = _serve(engine, tracer=tracer)
+    spec = engine.tasks["classify"]
+    req = Request("classify",
+                  spec.handler.prepare({"text": "paris is big"},
+                                       engine.max_len()),
+                  {"text": "paris is big"})
+    assert req.enqueued_at is None  # the unstamped sentinel
+    service.process_batch([req])
+    assert req.error is None and req.result is not None
+    (trace,) = [r for r in records if r["kind"] == "serve_trace"]
+    assert trace["sample_reason"] == "head"  # not force-sampled slow
+    assert trace["queue_wait_ms"] == 0.0
+    # Seconds of real work, not uptime: generous bound for the 2-core box.
+    assert trace["total_ms"] < 30000.0
+
+
+def test_phase_windows_and_snapshot_shape():
+    records = []
+    tc = TraceCollector(emit=records.append, sample_rate=1.0,
+                        slo_p99_ms=100.0, window=4)
+    for i in range(9):
+        tc.observe("ner", i, _phases(), total_s=0.015)
+    windows = [r for r in records if r["kind"] == "serve_phase"]
+    assert len(windows) == 2 and all(
+        w["window_requests"] == 4 for w in windows)
+    tc.finish()  # flushes the 1-request partial window
+    windows = [r for r in records if r["kind"] == "serve_phase"]
+    assert len(windows) == 3 and windows[-1]["window_requests"] == 1
+    for w in windows:
+        assert validate_record(dict(w, schema=1, ts=0.0)) == []
+        assert 0 <= w["queue_wait_share"] <= 1
+    snap = tc.phase_snapshot()
+    assert snap["requests"] == 9 and snap["over_slo"] == 0
+    assert {"queue_wait_share", "queue_p95_ms", "execute_p95_ms",
+            "slo_budget_burn"} <= set(snap)
+
+
+def test_metrics_text_prometheus_shape():
+    tc = TraceCollector(sample_rate=0.0, slo_p99_ms=100.0, window=64)
+    for i in range(7):
+        tc.observe("classify", i, _phases(), total_s=0.015)
+    tc.observe_error("classify")
+    text = tc.metrics_text()
+    assert 'bert_serve_requests_total{task="classify"} 7' in text
+    assert 'bert_serve_errors_total{task="classify"} 1' in text
+    assert "bert_serve_slo_p99_target_ms 100" in text
+    # Histogram: cumulative over le, _count equals the +Inf bucket.
+    for phase in PHASES + ("total",):
+        pat = (r'bert_serve_phase_latency_ms_bucket\{task="classify",'
+               rf'phase="{phase}",le="([^"]+)"\}} (\d+)')
+        buckets = re.findall(pat, text)
+        assert len(buckets) == len(HIST_BUCKETS_MS) + 1
+        counts = [int(c) for _, c in buckets]
+        assert counts == sorted(counts) and counts[-1] == 7
+        assert buckets[-1][0] == "+Inf"
+
+
+# ---------------------------------------------------------------------------
+# schema-lint fixtures (the check_telemetry_schema satellite)
+
+
+def test_trace_schema_fixtures_lint():
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    good = os.path.join(here, "fixtures", "telemetry",
+                        "serve_trace_good.jsonl")
+    bad = os.path.join(here, "fixtures", "telemetry",
+                       "serve_trace_bad.jsonl")
+    assert validate_file(good) == []
+    errors = validate_file(bad)
+    text = " | ".join(err for _, err in errors)
+    assert "dur_ms must be a non-negative number" in text
+    assert "queue_wait_ms (9.0) exceeds total_ms" in text
+    assert "'sampled' must be a boolean" in text
+    assert "sum of span durations" in text
+    assert "queue_wait_share must be in [0, 1]" in text
+    assert "total percentiles not ordered" in text
+    assert "over_slo (12) exceeds window_requests (8)" in text
+    # And the repo tool (jax-free, file-path bootstrap) agrees end to end.
+    proc = subprocess.run(
+        [sys.executable, "tools/check_telemetry_schema.py", good, bad],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(here))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "serve_trace_good.jsonl: ok" in proc.stdout
+    assert "serve_trace_bad" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# report: trace section + the two named gates
+
+
+def _phase_rec(task="classify", n=16, share=0.2, p99=20.0, over=0,
+               target=100.0):
+    rec = {"schema": 1, "ts": 0.0, "kind": "serve_phase", "tag": "serve",
+           "task": task, "window_requests": n, "queue_wait_share": share,
+           "total_p50_ms": p99 * 0.5, "total_p95_ms": p99 * 0.9,
+           "total_p99_ms": p99, "slo_target_ms": target,
+           "slo_budget": 0.01, "over_slo": over}
+    for phase in PHASES:
+        rec[f"{phase}_p50_ms"] = 1.0
+        rec[f"{phase}_p95_ms"] = 2.0
+    return rec
+
+
+def _trace_rec(total=20.0, dominant="execute"):
+    spans = []
+    start = 0.0
+    for name in PHASES:
+        dur = total * 0.7 if name == dominant else total * 0.05
+        spans.append({"name": name, "start_ms": start, "dur_ms": dur})
+        start += dur
+    return {"schema": 1, "ts": 0.0, "kind": "serve_trace", "tag": "serve",
+            "trace_id": f"t-{int(total)}", "task": "classify",
+            "total_ms": total, "queue_wait_ms": spans[0]["dur_ms"],
+            "sampled": True, "sample_reason": "head", "spans": spans}
+
+
+def test_report_trace_section_and_slo_verdict():
+    recs = [_phase_rec(n=16, share=0.2, p99=20.0),
+            _phase_rec(task="ner", n=16, share=0.4, p99=30.0)]
+    recs += [_trace_rec(total=5.0 + i, dominant="execute")
+             for i in range(19)]
+    recs.append(_trace_rec(total=500.0, dominant="queue"))
+    summary = report.summarize_records(recs, name="t")
+    assert summary["serve_queue_wait_share"] == pytest.approx(0.3)
+    assert summary["serve_slo_p99_ms"] == 30.0
+    assert summary["serve_slo_verdict"] == "ok"
+    assert summary["serve_traces"] == 20
+    # slowest decile = 2 traces; the 500ms queue-dominated one leads.
+    assert summary["serve_critical_path"]["queue"] == 1
+    text = report.format_summary(summary)
+    assert "serve_queue_wait_share" in text
+    assert "serve_critical_path" in text
+    # Budget burn past 1.0 (or p99 over target) flips the verdict.
+    breach = report.summarize_records(
+        [_phase_rec(n=16, share=0.2, p99=150.0, over=8)])
+    assert breach["serve_slo_verdict"] == "breach"
+    assert breach["serve_slo_budget_burn"] > 1.0
+
+
+def test_slo_gates_trip_by_name():
+    base = report.summarize_records([_phase_rec(share=0.2, p99=20.0)])
+    slow = report.summarize_records([_phase_rec(share=0.5, p99=80.0)])
+    regressions, _ = report.compare(base, slow)
+    labels = [r["label"] for r in regressions]
+    assert "serve queue-wait share" in labels
+    assert "serve SLO p99" in labels
+    # Within tolerance: neither gate fires.
+    near = report.summarize_records([_phase_rec(share=0.21, p99=21.0)])
+    regressions, checks = report.compare(base, near)
+    assert not regressions
+    assert {"serve_queue_wait_share", "serve_slo_p99_ms"} <= {
+        c["metric"] for c in checks}
+
+
+# ---------------------------------------------------------------------------
+# ISSUE-9 acceptance: concurrent HTTP with sampling on, /metricsz vs
+# /statsz consistency, counter monotonicity, and the named SLO gate on an
+# injected queue-delay regression
+
+
+def _http_get(port, path):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8")
+    finally:
+        conn.close()
+
+
+def _fire_concurrent(port, payloads):
+    import http.client
+
+    responses = [None] * len(payloads)
+
+    def fire(i, task, payload):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request("POST", f"/v1/{task}", json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            responses[i] = (resp.status, json.loads(resp.read()))
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=fire, args=(i, task, payload))
+               for i, (task, payload) in enumerate(payloads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return responses
+
+
+def _parse_prom_counters(text, name):
+    out = {}
+    for task, value in re.findall(
+            rf'{name}\{{task="([a-z_]+)"\}} (\d+)', text):
+        out[task] = int(value)
+    return out
+
+
+def _replay_to_artifact(engine, tmp_path, name, payloads, max_wait_ms,
+                        batcher_batch, slo_p99_ms):
+    """One traced replay -> (jsonl path, statsz snapshot)."""
+    from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+    jsonl = str(tmp_path / name)
+    sink = JSONLHandler(jsonl, overwrite=True)
+    tracer = TraceCollector(emit=sink.write_record, sample_rate=1.0,
+                            slo_p99_ms=slo_p99_ms, window=8)
+    service = _serve(engine, sink=sink, tracer=tracer,
+                     max_wait_ms=max_wait_ms, batcher_batch=batcher_batch)
+    from bert_pytorch_tpu.serve import make_server
+
+    service.start()
+    server = make_server(service, port=0, request_timeout_s=60.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        responses = _fire_concurrent(port, payloads)
+        assert all(r is not None and r[0] == 200 for r in responses), [
+            r for r in responses if r is None or r[0] != 200][:3]
+        _, stats_body = _http_get(port, "/statsz")
+        stats = json.loads(stats_body)
+    finally:
+        server.shutdown()
+        service.stop()
+        sink.close()
+    return jsonl, stats
+
+
+def test_http_tracing_acceptance(engine, tmp_path, capsys):
+    from bert_pytorch_tpu.serve import make_server
+    from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+    payloads = _payloads(32)
+    jsonl = str(tmp_path / "serve_traced.jsonl")
+    sink = JSONLHandler(jsonl, overwrite=True)
+    tracer = TraceCollector(emit=sink.write_record, sample_rate=1.0,
+                            slo_p99_ms=30000.0, window=8)
+    service = _serve(engine, sink=sink, tracer=tracer)
+    service.start()
+    server = make_server(service, port=0, request_timeout_s=60.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        responses = _fire_concurrent(port, payloads)
+        assert all(r is not None and r[0] == 200 for r in responses), [
+            r for r in responses if r is None or r[0] != 200][:3]
+
+        # -- /statsz carries the phase rollup; /metricsz is consistent
+        _, stats_body = _http_get(port, "/statsz")
+        stats = json.loads(stats_body)
+        assert stats["requests"] == 32 and stats["errors"] == 0
+        phases = stats["phases"]
+        assert phases["requests"] == 32
+        assert 0 <= phases["queue_wait_share"] <= 1
+
+        status, metrics1 = _http_get(port, "/metricsz")
+        assert status == 200
+        counts1 = _parse_prom_counters(metrics1,
+                                       "bert_serve_requests_total")
+        assert sum(counts1.values()) == stats["requests"] == 32
+        assert set(counts1) == {"classify", "ner"}
+        # Per-task phase histograms: every phase's +Inf count equals the
+        # task's request counter (each request contributes one sample).
+        for task, n in counts1.items():
+            for phase in PHASES + ("total",):
+                pat = (r'bert_serve_phase_latency_ms_bucket\{'
+                       rf'task="{task}",phase="{phase}",le="\+Inf"\}} '
+                       r"(\d+)")
+                (inf_count,) = re.findall(pat, metrics1)
+                assert int(inf_count) == n, (task, phase)
+        assert "bert_serve_queue_depth" in metrics1
+        assert "bert_serve_dispatch_alive 1" in metrics1
+
+        # -- counter monotonicity across scrapes under more traffic
+        more = _fire_concurrent(port, _payloads(4))
+        assert all(r is not None and r[0] == 200 for r in more)
+        _, metrics2 = _http_get(port, "/metricsz")
+        counts2 = _parse_prom_counters(metrics2,
+                                       "bert_serve_requests_total")
+        assert sum(counts2.values()) == 36
+        for task in counts1:
+            assert counts2[task] >= counts1[task]
+    finally:
+        server.shutdown()
+        service.stop()
+        sink.close()
+
+    # -- every sampled trace's span tree is complete and schema-clean
+    assert validate_file(jsonl) == []
+    records = [json.loads(line) for line in open(jsonl)]
+    traces = [r for r in records if r.get("kind") == "serve_trace"]
+    assert len(traces) == 36  # rate 1.0: every request traced
+    for t in traces:
+        assert [s["name"] for s in t["spans"]] == list(PHASES)
+        dur_sum = sum(s["dur_ms"] for s in t["spans"])
+        assert dur_sum <= t["total_ms"] + 0.01
+        assert t["queue_wait_ms"] <= t["total_ms"] + 0.01
+        assert t["sampled"] is True and t["sample_reason"] == "head"
+        assert t["bucket"] in BUCKETS and t["batch_requests"] >= 1
+        # host-cost context rides the record (pre-queue prepare; the
+        # engine's array-fill share of assembly)
+        assert t["prepare_ms"] >= 0 and t["pack_ms"] >= 0
+        # span offsets chain: each span starts where the previous ended
+        for prev, cur in zip(t["spans"], t["spans"][1:]):
+            assert cur["start_ms"] == pytest.approx(
+                prev["start_ms"] + prev["dur_ms"], abs=0.01)
+    phase_windows = [r for r in records if r.get("kind") == "serve_phase"]
+    assert {w["task"] for w in phase_windows} == {"classify", "ner"}
+
+    # -- the named SLO gate: replay the SAME payloads with an injected
+    # queue-delay regression (a 64-wide flush that only ever fires on
+    # the 1.5s oldest-request deadline parks every request in the
+    # queue), then report run-vs-baseline: nonzero exit naming
+    # "serve SLO p99".
+    slow_jsonl, slow_stats = _replay_to_artifact(
+        engine, tmp_path, "serve_slow.jsonl", payloads,
+        max_wait_ms=1500.0, batcher_batch=64, slo_p99_ms=30000.0)
+    assert slow_stats["phases"]["queue_p95_ms"] >= 1000.0
+    rc = report.main([slow_jsonl, jsonl])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "serve SLO p99" in out
+    assert "REGRESSION" in out
+    # The queue-delay regression is attributed to the queue phase: the
+    # slow run's critical path is queue-dominated.
+    slow_summary = report.summarize_file(slow_jsonl)
+    assert set(slow_summary["serve_critical_path"]) == {"queue"}
+
+
+# ---------------------------------------------------------------------------
+# slow-rule end to end + overhead guard + heartbeat
+
+
+def test_slow_requests_traced_at_rate_zero_end_to_end(engine, tmp_path):
+    """An over-SLO request is exported even with head sampling OFF —
+    the always-sample-slow rule on the real dispatch path (SLO set below
+    the deadline-flush latency so every request counts as slow)."""
+    from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+    jsonl = str(tmp_path / "slow_only.jsonl")
+    sink = JSONLHandler(jsonl, overwrite=True)
+    tracer = TraceCollector(emit=sink.write_record, sample_rate=0.0,
+                            slo_p99_ms=0.1, window=8)
+    service = _serve(engine, sink=sink, tracer=tracer, max_wait_ms=50.0)
+    service.start()
+    try:
+        for task, payload in _payloads(3):
+            service.submit(task, payload, timeout=30.0)
+    finally:
+        service.stop()
+        sink.close()
+    assert validate_file(jsonl) == []
+    traces = [json.loads(line) for line in open(jsonl)
+              if '"serve_trace"' in line]
+    assert len(traces) == 3
+    assert all(t["sampled"] is False and t["sample_reason"] == "slow"
+               for t in traces)
+    snap = tracer.phase_snapshot()
+    assert snap["over_slo"] == 3 and snap["slo_budget_burn"] > 1.0
+
+
+def test_tracing_overhead_guard(engine):
+    """Tracing off (tracer=None) must serve at the same p50 as the fully
+    traced path — the per-request bookkeeping is a few clock reads and
+    one locked dict update. Generous bound: this box is 2 throttled
+    cores and the absolute latencies are milliseconds."""
+    def median_latency(tracer):
+        service = _serve(engine, tracer=tracer, max_wait_ms=1.0)
+        service.start()
+        try:
+            for task, payload in _payloads(6):  # warm the path
+                service.submit(task, payload, timeout=30.0)
+            t_samples = []
+            for task, payload in _payloads(18):
+                t0 = time.perf_counter()
+                service.submit(task, payload, timeout=30.0)
+                t_samples.append(time.perf_counter() - t0)
+        finally:
+            service.stop()
+        return sorted(t_samples)[len(t_samples) // 2]
+
+    untraced = median_latency(None)
+    traced = median_latency(
+        TraceCollector(sample_rate=1.0, slo_p99_ms=1000.0, window=8))
+    assert traced <= untraced * 2.5 + 0.02, (traced, untraced)
+
+
+def test_serve_heartbeat_is_written_and_resumable(engine, tmp_path):
+    from bert_pytorch_tpu.telemetry.sentinels import Heartbeat
+
+    path = str(tmp_path / "heartbeat.json")
+    service = _serve(engine, heartbeat=Heartbeat(path))
+    service.start()
+    try:
+        for task, payload in _payloads(2):
+            service.submit(task, payload, timeout=30.0)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            beat = Heartbeat.read(path)
+            if beat and beat["step"] >= 2:
+                break
+            time.sleep(0.05)
+    finally:
+        service.stop()
+    beat = Heartbeat.read(path)
+    assert beat is not None
+    assert beat["step"] == 2          # step = requests served
+    assert beat["counter"] >= 2       # start beat + loop/stop beats
+    # Resumable: a restarted server continues the counter monotonically
+    # (the liveness check is "did counter advance", across restarts too).
+    resumed = Heartbeat(path)
+    assert resumed.counter == beat["counter"]
+    resumed.beat(5)
+    assert Heartbeat.read(path)["counter"] == beat["counter"] + 1

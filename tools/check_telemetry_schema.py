@@ -12,7 +12,13 @@ existed carry no ``schema`` key and are held to the universal rules only
 (bert_pytorch_tpu/telemetry/schema.py). The ``serve`` record family
 (``serve_window``/``serve_summary``, serve/stats.py) is linted with its
 consistency rules — latency percentiles ordered p50 <= p95 <= p99,
-``batch_occupancy`` in (0, 1] — and the fault-tolerance family
+``batch_occupancy`` in (0, 1]. The request-tracing kinds
+(``serve_trace``/``serve_phase``, serve/tracing.py) are held to their
+decomposition invariants: span durations non-negative and summing to no
+more than ``total_ms``, ``queue_wait_ms <= total_ms``, a boolean
+``sampled`` flag, ``queue_wait_share`` in [0, 1], ordered total
+percentiles, and ``over_slo`` bounded by the window with a positive
+``slo_target_ms`` — and the fault-tolerance family
 (``fault``/``resume``, docs/fault_tolerance.md) with its own: a real
 boolean ``injected`` marker, and every ``resume.skipped`` entry naming
 step/path/reason. The async-hot-path step_window fields are held to
