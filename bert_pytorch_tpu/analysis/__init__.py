@@ -27,11 +27,27 @@ Design constraints:
 Check families (one module each):
 
 * ``host_sync``       HS101 — blocking host transfers in step-loop hot paths
+  (cross-module via the program graph: an imported helper called from a
+  timed loop is a hot region too)
 * ``recompile``       RC201/RC202/RC203 — jit recompile / retrace hazards
 * ``rng``             RN301/RN302 — PRNG key reuse and wall-clock seeds
 * ``tracer_leak``     TL401 — traced values assigned to self/globals in jit
 * ``lock_discipline`` LK501/LK502/LK503 — accesses of registered shared
   state outside its declared guard (``analysis/concurrency.py``)
+
+The **shardlint** tier (whole-program: ``graph.Program`` parses every
+target plus the canonical context set and the checks walk the
+cross-module symbol/call graph):
+
+* ``sharding``  SD601/SD602/SD603 — collective-axis discipline, logical
+  rule coverage, and raw mesh-axis literals, all against the axes
+  registry (``analysis/axes.py``, the jax-free mirror of
+  ``parallel/mesh.py``) — the safety net under the "one mesh" refactor
+* ``donation``  DN701 — a buffer donated to a jitted call
+  (``donate_argnums``) and read after it
+* ``contracts`` CT801/CT802 — telemetry kinds emitted off the
+  ``telemetry/schema.py`` registry; argparse flags declared-but-never-
+  read / read-but-never-declared
 """
 
 from bert_pytorch_tpu.analysis.core import (  # noqa: F401

@@ -1,0 +1,156 @@
+"""The axes registry: mesh-axis names and per-strategy logical rules,
+the declarative authority the sharding checks (SD601/SD602/SD603)
+enforce against.
+
+This mirrors ``parallel/mesh.py`` (``MESH_AXES``, the ``AXIS_*``
+constants, ``_BASE_RULES``/``_STRATEGY_RULES``) the same way
+``analysis/concurrency.py`` mirrors the lock discipline: the analysis
+package must stay stdlib-only and jax-free (files are parsed, never
+imported), so it cannot import the real tables — instead this module
+restates them and ``tests/test_jaxlint.py`` pins the two copies
+together by PARSING mesh.py's AST. Drift fails tier-1, not a refactor
+three PRs later.
+
+Why a registry at all: the "one mesh" refactor (ROADMAP) rewrites every
+collective/PartitionSpec/axis-rule site in the codebase. A collective
+over a typo'd axis name traces fine and crashes (or silently
+mis-reduces) only under the mesh shape that exercises it; a logical
+name with no rule under some strategy silently REPLICATES the parameter
+— the exact fsdp bug class the ZeRO lineage warns about. With the
+registry, both become lint findings at commit time, and the refactor
+updates ONE table (mesh.py) plus its mirror here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Optional, Tuple
+
+# -- mesh axes (mirror of parallel/mesh.py MESH_AXES + AXIS_*) -----------
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_PIPE = "pipe"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+
+MESH_AXES: Tuple[str, ...] = (
+    AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL)
+
+# The constant spellings model/runner code must import instead of raw
+# literals (the SD603 contract). Name -> axis value, for messages and
+# the mesh.py mirror test.
+AXIS_CONSTANTS: Dict[str, str] = {
+    "AXIS_DATA": AXIS_DATA,
+    "AXIS_FSDP": AXIS_FSDP,
+    "AXIS_PIPE": AXIS_PIPE,
+    "AXIS_SEQ": AXIS_SEQ,
+    "AXIS_MODEL": AXIS_MODEL,
+}
+
+# -- logical-axis rules (mirror of mesh.py _BASE_RULES/_STRATEGY_RULES) --
+# Values are mesh axes (or None = replicated); only the KEY COVERAGE is
+# what SD602 enforces — an unmatched logical name silently replicates —
+# but the mirror keeps the values too so the consistency test can pin
+# the whole table.
+
+BASE_RULES: Tuple[Tuple[str, object], ...] = (
+    ("batch", (AXIS_DATA, AXIS_FSDP)),
+    ("seq_act", AXIS_SEQ),
+    ("pos", None),
+    ("types", None),
+    ("classes", None),
+    ("layers", None),
+)
+
+STRATEGY_RULES: Dict[str, Tuple[Tuple[str, object], ...]] = {
+    "pp": (
+        ("layers", AXIS_PIPE),
+        ("embed", None),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ),
+    "sp": (
+        ("embed", None),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ),
+    "dp": (
+        ("embed", None),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ),
+    "fsdp": (
+        ("embed", AXIS_FSDP),
+        ("embed_out", None),
+        ("vocab", None),
+        ("heads", None),
+        ("kv", None),
+        ("mlp", None),
+    ),
+    "tp": (
+        ("embed", None),
+        ("embed_out", AXIS_MODEL),
+        ("vocab", AXIS_MODEL),
+        ("heads", AXIS_MODEL),
+        ("kv", None),
+        ("mlp", AXIS_MODEL),
+    ),
+    "tp_fsdp": (
+        ("embed", AXIS_FSDP),
+        ("embed_out", AXIS_MODEL),
+        ("vocab", AXIS_MODEL),
+        ("heads", AXIS_MODEL),
+        ("kv", None),
+        ("mlp", AXIS_MODEL),
+    ),
+    "pp_tp": (
+        ("layers", AXIS_PIPE),
+        ("embed", None),
+        ("embed_out", AXIS_MODEL),
+        ("vocab", AXIS_MODEL),
+        ("heads", AXIS_MODEL),
+        ("kv", None),
+        ("mlp", AXIS_MODEL),
+    ),
+}
+
+
+def strategies() -> Tuple[str, ...]:
+    return tuple(sorted(STRATEGY_RULES))
+
+
+def logical_coverage(strategy: str) -> FrozenSet[str]:
+    """Logical names that RESOLVE (to a mesh axis or an explicit None =
+    replicated) under ``strategy``: its own rules plus the shared base
+    rules — the first-wins matching of mesh.logical_axis_rules means key
+    membership in the union is exactly 'has a rule'."""
+    return frozenset(
+        name for name, _ in STRATEGY_RULES[strategy] + BASE_RULES)
+
+
+def uncovered_strategies(logical_name: str) -> Tuple[str, ...]:
+    """Declared strategies under which ``logical_name`` has NO rule (and
+    would silently replicate). Empty = fully covered."""
+    return tuple(s for s in strategies()
+                 if logical_name not in logical_coverage(s))
+
+
+def is_mesh_axis(name: str) -> bool:
+    return name in MESH_AXES
+
+
+def constant_for(axis: str) -> Optional[str]:
+    """The AXIS_* constant name for a mesh axis value (for messages)."""
+    for const, value in AXIS_CONSTANTS.items():
+        if value == axis:
+            return const
+    return None

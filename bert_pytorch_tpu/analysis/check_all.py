@@ -28,23 +28,20 @@ from __future__ import annotations
 import argparse
 import glob
 import importlib.util
+import json
 import os
 import sys
 from typing import List, Optional
 
 from bert_pytorch_tpu.analysis import cli as jaxlint_cli
+# The canonical jaxlint target set — what the tier-1 gate, the
+# acceptance command, and commit hooks all mean by "lint the repo".
+from bert_pytorch_tpu.analysis.core import JAXLINT_TARGETS  # noqa: F401
 
 
 def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-
-
-# The canonical jaxlint target set — what the tier-1 gate, the
-# acceptance command, and commit hooks all mean by "lint the repo".
-JAXLINT_TARGETS = ("bert_pytorch_tpu", "run_glue.py", "run_ner.py",
-                   "run_pretraining.py", "run_server.py", "run_squad.py",
-                   "run_swag.py", "tools")
 
 
 def _load_schema_module():
@@ -57,6 +54,26 @@ def _load_schema_module():
     return module
 
 
+def _schema_results(paths: List[str]) -> List[dict]:
+    """[{path, ok, errors: [{line, error}]}] per artifact — the shared
+    engine behind both output formats."""
+    schema = _load_schema_module()
+    root = _repo_root()
+    results = []
+    for path in paths:
+        rel = os.path.relpath(path, root) if os.path.exists(path) else path
+        if not os.path.exists(path):
+            results.append({"path": rel, "ok": False,
+                            "errors": [{"line": 0, "error": "no such file"}]})
+            continue
+        errors = schema.validate_file(path)
+        results.append({
+            "path": rel, "ok": not errors,
+            "errors": [{"line": lineno, "error": err}
+                       for lineno, err in errors]})
+    return results
+
+
 def _lint_jsonls(paths: List[str]) -> int:
     # Deliberately NOT delegating to tools/check_telemetry_schema.py:
     # that script is repo-root tooling (sys.path tricks, rc-2-on-missing
@@ -66,22 +83,18 @@ def _lint_jsonls(paths: List[str]) -> int:
     # schema.validate_file; everything here is presentation. A missing
     # file counts as a plain failure (rc 1): one gate, one exit
     # contract.
-    schema = _load_schema_module()
-    root = _repo_root()
     failed = 0
-    for path in paths:
-        if not os.path.exists(path):
-            print(f"bert-lint: {path}: no such file", file=sys.stderr)
-            failed += 1
+    for result in _schema_results(paths):
+        if result["ok"]:
+            print(f"{result['path']}: ok")
             continue
-        errors = schema.validate_file(path)
-        rel = os.path.relpath(path, root)
-        if errors:
-            failed += 1
-            for lineno, err in errors:
-                print(f"{rel}:{lineno}: {err}")
-        else:
-            print(f"{rel}: ok")
+        failed += 1
+        for err in result["errors"]:
+            if err["line"] == 0 and err["error"] == "no such file":
+                print(f"bert-lint: {result['path']}: no such file",
+                      file=sys.stderr)
+            else:
+                print(f"{result['path']}:{err['line']}: {err['error']}")
     return failed
 
 
@@ -98,21 +111,51 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="only schema-lint the JSONL artifacts")
     parser.add_argument("--skip-schema", action="store_true",
                         help="only run jaxlint over the code targets")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text",
+                        help="json emits one machine-readable object "
+                             "(jaxlint findings incl. suppression state "
+                             "+ per-artifact schema results) so CI can "
+                             "diff findings across commits")
     args = parser.parse_args(argv)
+
+    targets = [os.path.join(_repo_root(), t) for t in JAXLINT_TARGETS]
+    jsonls = list(args.jsonls) or sorted(
+        glob.glob(os.path.join(_repo_root(), "*.jsonl")))
+
+    if args.format == "json":
+        rc = 0
+        combined: dict = {"version": 1}
+        if not args.skip_jaxlint:
+            try:
+                payload, jaxlint_rc = jaxlint_cli.gather(targets)
+            except (ValueError, FileNotFoundError) as e:
+                # Same rc-2 usage-error contract as the text mode (which
+                # goes through jaxlint_cli.main): a corrupt baseline must
+                # yield an error line, not a traceback and no JSON.
+                print(f"bert-lint: {e}", file=sys.stderr)
+                return 2
+            combined["jaxlint"] = payload
+            rc = rc or jaxlint_rc
+        if not args.skip_schema:
+            results = _schema_results(jsonls)
+            combined["schema"] = results
+            if any(not r["ok"] for r in results):
+                rc = 1
+        combined["rc"] = rc
+        print(json.dumps(combined, indent=2, sort_keys=False))
+        return rc
 
     rc = 0
     if not args.skip_jaxlint:
         print("== jaxlint ==")
-        targets = [os.path.join(_repo_root(), t) for t in JAXLINT_TARGETS]
         if jaxlint_cli.main(targets) != 0:
             rc = 1
     if not args.skip_schema:
-        paths = list(args.jsonls) or sorted(
-            glob.glob(os.path.join(_repo_root(), "*.jsonl")))
         print("== telemetry schema ==")
-        if not paths:
+        if not jsonls:
             print("bert-lint: no *.jsonl artifacts to lint")
-        elif _lint_jsonls(paths):
+        elif _lint_jsonls(jsonls):
             rc = 1
     print("bert-lint: " + ("OK" if rc == 0 else "FAILED"))
     return rc

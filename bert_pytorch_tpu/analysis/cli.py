@@ -3,6 +3,20 @@
 Exit codes: 0 = clean (baseline-covered findings allowed), 1 = findings,
 2 = usage / missing path / malformed baseline.
 
+Since the shardlint tier (SD6xx/DN701/CT8xx, docs/static_analysis.md)
+is whole-program, a subset run (``jaxlint serve``) still parses the
+CANONICAL target set as graph context — otherwise a flag declared in
+``serve/cli.py`` and read in ``run_server.py`` would be falsely flagged
+as dead. Findings are only ever REPORTED for the requested paths;
+``--no-context`` restricts the graph to them too (fixture tests and
+out-of-repo runs).
+
+``--format json`` emits one machine-readable object (stable check id,
+path, line, source text, suppression state for every finding incl.
+baselined ones) so CI can diff findings across commits;
+``tools/check_all.py --format json`` threads it through the unified
+gate.
+
 This module — like the whole analysis package — must never import jax:
 the tier-1 gate asserts it, and the pre-commit wrapper runs on boxes
 without the accelerator stack.
@@ -11,9 +25,10 @@ without the accelerator stack.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from bert_pytorch_tpu.analysis import baseline as baseline_mod
 from bert_pytorch_tpu.analysis import core
@@ -30,7 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="jaxlint",
         description="Pure-AST TPU-hazard linter (docs/static_analysis.md): "
                     "host-sync, recompile, RNG, tracer-leak, and "
-                    "lock-discipline checks with stable IDs.")
+                    "lock-discipline checks per file, plus the "
+                    "whole-program shardlint tier (sharding/collective "
+                    "discipline, donation hazards, contract drift), all "
+                    "with stable IDs.")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories; a bare name that does not exist is "
@@ -50,9 +68,69 @@ def build_parser() -> argparse.ArgumentParser:
         "--list-checks", action="store_true",
         help="print every check ID with its description and exit")
     parser.add_argument(
+        "--no-context", action="store_true",
+        help="do not parse the canonical target set as whole-program "
+             "context for subset runs (the program checks then see only "
+             "the given paths)")
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format; json emits one object with every finding "
+             "(incl. baselined, with suppression state) for CI diffing")
+    parser.add_argument(
         "-q", "--quiet", action="store_true",
         help="suppress the summary line (findings still print)")
     return parser
+
+
+def gather(paths: List[str], *, baseline: Optional[str] = None,
+           no_baseline: bool = False, no_context: bool = False
+           ) -> Tuple[dict, int]:
+    """Run the lint and return (payload, rc) without printing — the
+    shared engine behind ``main`` and ``check_all --format json``.
+    Payload: {files, findings: [{check, path, line, col, message,
+    source, status}], stale_baseline: [...]}; rc as the CLI exit code.
+    Raises FileNotFoundError / ValueError for usage errors (rc 2 paths)
+    so callers can present them."""
+    repo_root = _repo_root()
+    files = core.expand_paths(paths, repo_root=repo_root)
+    context = None
+    if not no_context:
+        context = []
+        for target in core.JAXLINT_TARGETS:
+            candidate = os.path.join(repo_root, target)
+            if os.path.exists(candidate):
+                context.append(candidate)
+        context = core.expand_paths(context, repo_root=repo_root) \
+            if context else None
+    findings = core.run_files(files, repo_root=repo_root,
+                              context_paths=context)
+
+    baseline_path = baseline or os.path.join(
+        repo_root, baseline_mod.DEFAULT_BASENAME)
+    entries: List[dict] = []
+    if not no_baseline:
+        entries = baseline_mod.load_baseline(baseline_path)
+    new, matched, stale = baseline_mod.apply_baseline(findings, entries)
+    linted = {os.path.relpath(p, repo_root).replace(os.sep, "/")
+              for p in files}
+    # Only entries for files this run actually linted can be judged
+    # stale — a subset run must not advertise other files' entries as
+    # prunable.
+    stale = [e for e in stale if e["path"] in linted]
+
+    def record(f, status):
+        return {"check": f.check, "path": f.path, "line": f.line,
+                "col": f.col, "message": f.message, "source": f.source,
+                "status": status}
+
+    payload = {
+        "version": 1,
+        "files": len(files),
+        "findings": ([record(f, "new") for f in new]
+                     + [record(f, "baselined") for f in matched]),
+        "stale_baseline": stale,
+    }
+    return payload, (1 if new else 0)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -66,27 +144,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         parser.error("the following arguments are required: paths")
 
     repo_root = _repo_root()
-    try:
+    if args.write_baseline:
+        payload, _ = _gather_or_usage_error(args, allow_corrupt=True)
+        if payload is None:
+            return 2
+        findings = _findings_from(payload)
+        # Safe now: gather() already expanded these same paths, so a
+        # missing one was reported as the usage error above.
         files = core.expand_paths(args.paths, repo_root=repo_root)
-    except FileNotFoundError as e:
-        print(str(e), file=sys.stderr)
-        return 2
-    findings = core.run_files(files, repo_root=repo_root)
-
-    baseline_path = args.baseline or os.path.join(
-        repo_root, baseline_mod.DEFAULT_BASENAME)
-    entries: List[dict] = []
-    if not args.no_baseline:
+        baseline_path = args.baseline or os.path.join(
+            repo_root, baseline_mod.DEFAULT_BASENAME)
         try:
             entries = baseline_mod.load_baseline(baseline_path)
-        except ValueError as e:
-            if not args.write_baseline:
-                print(str(e), file=sys.stderr)
-                return 2
-            # Rewriting is the recovery path for a corrupt baseline.
-            entries = []
-
-    if args.write_baseline:
+        except ValueError:
+            entries = []  # rewriting is the recovery path
         # MERGE, not overwrite: a subset run (jaxlint run_glue.py
         # --write-baseline) must keep other files' entries and every
         # still-matching entry's hand-written justification; only
@@ -99,18 +170,24 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{'y' if n == 1 else 'ies'} to {baseline_path}")
         return 0
 
-    new, matched, stale = baseline_mod.apply_baseline(findings, entries)
-    # Only entries for files this run actually linted can be judged
-    # stale — a subset run must not advertise other files' entries as
-    # prunable.
-    linted = {os.path.relpath(p, repo_root).replace(os.sep, "/")
-              for p in files}
-    stale = [e for e in stale if e["path"] in linted]
+    payload, rc = _gather_or_usage_error(args)
+    if payload is None:
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(payload, indent=2, sort_keys=False))
+        return rc
+
+    new = [f for f in payload["findings"] if f["status"] == "new"]
+    matched = [f for f in payload["findings"] if f["status"] == "baselined"]
+    stale = payload["stale_baseline"]
     for f in new:
-        print(f.format())
+        print(f"{f['path']}:{f['line']}:{f['col']}: "
+              f"{f['check']} {f['message']}")
     if not args.quiet:
         parts = [f"jaxlint: {len(new)} finding"
-                 f"{'' if len(new) == 1 else 's'} in {len(files)} files"]
+                 f"{'' if len(new) == 1 else 's'} in "
+                 f"{payload['files']} files"]
         if matched:
             parts.append(f"{len(matched)} baselined")
         if stale:
@@ -118,7 +195,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                          f"{'y' if len(stale) == 1 else 'ies'} "
                          "(run --write-baseline to prune)")
         print("; ".join(parts))
-    return 1 if new else 0
+    return rc
+
+
+def _gather_or_usage_error(args, allow_corrupt: bool = False):
+    try:
+        return gather(list(args.paths), baseline=args.baseline,
+                      no_baseline=args.no_baseline or allow_corrupt,
+                      no_context=args.no_context)
+    except FileNotFoundError as e:
+        print(str(e), file=sys.stderr)
+        return None, 2
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return None, 2
+
+
+def _findings_from(payload: dict) -> List[core.Finding]:
+    return [core.Finding(check=f["check"], path=f["path"], line=f["line"],
+                         col=f["col"], message=f["message"],
+                         source=f["source"])
+            for f in payload["findings"]]
 
 
 if __name__ == "__main__":

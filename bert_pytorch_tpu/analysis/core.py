@@ -52,8 +52,11 @@ class Module:
         self.text = text
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=path)
+        # One BFS walk, shared by every check: ~25 separate ast.walk
+        # passes per module dominated the gate's runtime otherwise.
+        self.nodes = list(ast.walk(self.tree))
         self.parents: dict = {}
-        for parent in ast.walk(self.tree):
+        for parent in self.nodes:
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
         self.aliases = self._import_aliases()
@@ -145,12 +148,27 @@ class Module:
 
 # -- check registry ------------------------------------------------------
 
+# The canonical lint target set — what the tier-1 gate, the acceptance
+# command, and commit hooks all mean by "lint the repo". Also the
+# context the CLI parses for the whole-program tier on subset runs.
+JAXLINT_TARGETS = ("bert_pytorch_tpu", "run_glue.py", "run_ner.py",
+                   "run_pretraining.py", "run_server.py", "run_squad.py",
+                   "run_swag.py", "tools")
+
+
 def _checks():
     # Local imports keep core importable before the check modules exist
     # in partial environments, and break the package import cycle.
     from bert_pytorch_tpu.analysis import (host_sync, lock_discipline,
                                            recompile, rng, tracer_leak)
     return (host_sync, recompile, rng, tracer_leak, lock_discipline)
+
+
+def _program_checks():
+    """The shardlint tier: whole-program checks over the cross-module
+    symbol/call graph (graph.Program) instead of one file at a time."""
+    from bert_pytorch_tpu.analysis import contracts, donation, sharding
+    return (sharding, donation, contracts)
 
 
 def all_check_ids() -> dict:
@@ -160,7 +178,7 @@ def all_check_ids() -> dict:
         JL_BAD_ID: "unknown check ID in a jaxlint disable comment",
         JL_PARSE: "file failed to parse",
     }
-    for mod in _checks():
+    for mod in _checks() + _program_checks():
         ids.update(mod.CHECKS)
     return ids
 
@@ -170,7 +188,11 @@ def all_check_ids() -> dict:
 ALL_CHECK_IDS = all_check_ids()
 
 
-def run_module(module: Module, registry=None) -> List[Finding]:
+def run_module(module: Module, registry=None, program=None) -> List[Finding]:
+    """The per-file check tier. With ``program``, checks that can use
+    the cross-module graph (HS101's hot-region propagation) may emit
+    findings in OTHER modules; suppression is then looked up in the
+    module that owns the flagged line, not the one being scanned."""
     findings: List[Finding] = []
     for line, bad_id in module.bad_ids:
         findings.append(Finding(
@@ -179,36 +201,96 @@ def run_module(module: Module, registry=None) -> List[Finding]:
                     f"(known: {', '.join(sorted(ALL_CHECK_IDS))})",
             source=module.source_line(line)))
     for mod in _checks():
-        for f in mod.check(module, registry=registry):
+        for f in mod.check(module, registry=registry, program=program):
             # JL000 is deliberately unsuppressable; everything else
-            # honors the inline disable comment.
-            if f.check == JL_BAD_ID or not module.suppressed(f.line, f.check):
+            # honors the inline disable comment in its OWN module.
+            owner = module
+            if program is not None and f.path != module.rel:
+                owner = program.by_rel.get(f.path, module)
+            if f.check == JL_BAD_ID or not owner.suppressed(f.line, f.check):
                 findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
     return findings
 
 
+def _parse_module(path: str, repo_root: Optional[str]):
+    """(Module, None) or (None, JL001 Finding)."""
+    rel = os.path.relpath(path, repo_root) if repo_root else path
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        return Module(path, text, rel), None
+    except (SyntaxError, ValueError) as e:
+        return None, Finding(
+            check=JL_PARSE, path=rel.replace(os.sep, "/"),
+            line=getattr(e, "lineno", 0) or 0, col=0,
+            message=f"parse error: {e}", source="")
+
+
 def run_files(paths: Iterable[str], repo_root: Optional[str] = None,
-              registry=None) -> List[Finding]:
+              registry=None,
+              context_paths: Optional[Iterable[str]] = None
+              ) -> List[Finding]:
     """Analyze the given FILES (no directory expansion — see run_paths).
     Unparseable files produce a JL001 finding instead of crashing the
-    run: a syntax error in lint-scope code must fail the gate loudly."""
+    run: a syntax error in lint-scope code must fail the gate loudly.
+
+    ``context_paths`` (the CLI passes the canonical target set) are
+    parsed INTO the whole-program graph but produce no findings of
+    their own — the shardlint tier (SD6xx/DN701/CT8xx) and HS101's
+    cross-module propagation need the full program to judge a subset
+    run correctly; a context file that fails to parse is silently
+    skipped (it fails loudly when it is itself a target)."""
     findings: List[Finding] = []
+    modules = []
+    target_rels = set()
+    seen = set()
     for path in paths:
-        rel = os.path.relpath(path, repo_root) if repo_root else path
-        try:
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            module = Module(path, text, rel)
-        except (SyntaxError, ValueError) as e:
-            findings.append(Finding(
-                check=JL_PARSE, path=rel.replace(os.sep, "/"),
-                line=getattr(e, "lineno", 0) or 0, col=0,
-                message=f"parse error: {e}", source=""))
+        seen.add(os.path.abspath(path))
+        module, err = _parse_module(path, repo_root)
+        if err is not None:
+            findings.append(err)
             continue
-        findings.extend(run_module(module, registry=registry))
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.check))
-    return findings
+        modules.append(module)
+        target_rels.add(module.rel)
+    for path in context_paths or ():
+        if os.path.abspath(path) in seen:
+            continue
+        seen.add(os.path.abspath(path))
+        module, err = _parse_module(path, repo_root)
+        if module is not None:
+            modules.append(module)
+
+    from bert_pytorch_tpu.analysis.graph import Program
+    program = Program(modules, target_rels=target_rels)
+
+    for module in modules:
+        if module.rel not in target_rels:
+            continue
+        for f in run_module(module, registry=registry, program=program):
+            # Cross-module propagation (HS101) can land a finding in a
+            # context-only file; like the program tier below, subset runs
+            # report only requested paths (the canonical gate targets
+            # every file, so nothing is lost there).
+            if f.path in target_rels:
+                findings.append(f)
+    for mod in _program_checks():
+        for f in mod.check_program(program, registry=registry):
+            if f.path not in target_rels:
+                continue
+            owner = program.by_rel.get(f.path)
+            if owner is None or not owner.suppressed(f.line, f.check):
+                findings.append(f)
+
+    # Cross-module propagation can surface the same finding from two
+    # scanning modules: dedupe, then order.
+    unique, emitted = [], set()
+    for f in findings:
+        if f not in emitted:
+            emitted.add(f)
+            unique.append(f)
+    unique.sort(key=lambda f: (f.path, f.line, f.col, f.check))
+    return unique
 
 
 def expand_paths(args: Iterable[str], repo_root: Optional[str] = None
@@ -251,6 +333,13 @@ def expand_paths(args: Iterable[str], repo_root: Optional[str] = None
 
 
 def run_paths(args: Iterable[str], repo_root: Optional[str] = None,
-              registry=None) -> List[Finding]:
+              registry=None,
+              context: Optional[Iterable[str]] = None) -> List[Finding]:
+    context_files = None
+    if context:
+        try:
+            context_files = expand_paths(context, repo_root)
+        except FileNotFoundError:
+            context_files = None  # partial checkouts: lint what exists
     return run_files(expand_paths(args, repo_root), repo_root=repo_root,
-                     registry=registry)
+                     registry=registry, context_paths=context_files)

@@ -1,0 +1,217 @@
+"""DN701 — donated buffers read after the jitted call.
+
+``jax.jit(..., donate_argnums=...)`` lets XLA reuse an input buffer for
+an output — the reason every train step donates its state. The contract
+is one-way: after the call dispatches, the donated argument's buffer is
+GONE. Reading it afterwards raises on TPU ("Invalid buffer passed") but
+— worse — can silently read stale bytes under some backends/transfer
+paths, and the error only fires for the shapes/donation layout that
+actually alias. The safe idiom rebinds the name from the call's own
+result (``state, metrics = step(state, batch)``); anything else that
+touches the name afterwards is flagged.
+
+Lexical approximation, deliberately: for each call of a name bound to a
+``jax.jit``/``pjit`` result with literal ``donate_argnums``/
+``donate_argnames`` (resolved through wrapper calls the way RC201 does
+— ``monitor.wrap(jax.jit(f, donate_argnums=(0, 1)), "train_step")``
+records the OUTER assignment's name), every donated bare-Name argument
+must either be rebound by the call's own assignment targets, or never
+be loaded again later in the enclosing function (by line order; a
+re-assignment to the name before the load clears the hazard). Loops are
+line-ordered too, so the next-iteration re-donation of an un-rebound
+name is out of reach — the rebind-or-never-touch idiom this check
+enforces prevents it anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from bert_pytorch_tpu.analysis.core import Finding, Module
+from bert_pytorch_tpu.analysis.graph import Program
+
+CHECKS = {
+    "DN701": "argument donated to a jitted call (donate_argnums) and "
+             "read after the call",
+}
+
+_JIT_CALLS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit", "pjit",
+              "jit"}
+
+
+@dataclass
+class _DonateSig:
+    nums: Tuple[int, ...] = ()
+    names: Tuple[str, ...] = ()
+    params: Tuple[str, ...] = field(default=())  # wrapped fn's params
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.nums or self.names)
+
+
+def _literal_ints(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    return ()
+
+
+def _literal_strs(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    return ()
+
+
+def _donate_sig(module: Module, call: ast.Call) -> Optional[_DonateSig]:
+    dotted = module.dotted(call.func)
+    if dotted not in _JIT_CALLS:
+        return None
+    sig = _DonateSig()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            sig.nums = _literal_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            sig.names = _literal_strs(kw.value)
+    if not sig.donates:
+        return None
+    # Map donate_argnames -> positions via the wrapped def's signature.
+    if call.args and isinstance(call.args[0], ast.Name):
+        for node in module.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == call.args[0].id:
+                sig.params = tuple(
+                    a.arg for a in node.args.posonlyargs + node.args.args)
+    return sig
+
+
+def _donated_bindings(module: Module) -> Dict[str, _DonateSig]:
+    """name -> donation signature, for names whose assigned value
+    contains a donating jit call anywhere (wrapper calls included)."""
+    out: Dict[str, _DonateSig] = {}
+    for node in module.nodes:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        for sub in ast.walk(node.value):
+            if isinstance(sub, ast.Call):
+                sig = _donate_sig(module, sub)
+                if sig is not None:
+                    out[node.targets[0].id] = sig
+                    break
+    return out
+
+
+def _enclosing_scope(module: Module, node: ast.AST) -> ast.AST:
+    cur = module.parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = module.parents.get(cur)
+    return module.tree
+
+
+def _enclosing_statement(module: Module, node: ast.AST) -> ast.AST:
+    cur = node
+    parent = module.parents.get(cur)
+    while parent is not None and not isinstance(parent, (
+            ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+        cur = parent
+        parent = module.parents.get(cur)
+    return cur
+
+
+def _assigned_names(stmt: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    for t in targets:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+    return names
+
+
+def _check_call(module: Module, call: ast.Call, fn_name: str,
+                sig: _DonateSig) -> List[Finding]:
+    donated_positions = set(sig.nums)
+    for name in sig.names:
+        if name in sig.params:
+            donated_positions.add(sig.params.index(name))
+    donated_vars: List[Tuple[str, int]] = []
+    for i in sorted(donated_positions):
+        if i < len(call.args) and isinstance(call.args[i], ast.Name):
+            donated_vars.append((call.args[i].id, i))
+    if not donated_vars:
+        return []
+
+    stmt = _enclosing_statement(module, call)
+    # The rebinding assignment may be the enclosing statement itself OR
+    # an ancestor between the call and it (``state, m = step(state, b)``
+    # inside a for loop: the statement is the For, the Assign sits on
+    # the path up to it).
+    rebound = _assigned_names(stmt)
+    cur = call
+    while cur is not stmt and cur is not None:
+        if isinstance(cur, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            rebound |= _assigned_names(cur)
+        cur = module.parents.get(cur)
+    scope = _enclosing_scope(module, call)
+    call_line = max(getattr(stmt, "end_lineno", stmt.lineno), stmt.lineno)
+
+    findings: List[Finding] = []
+    for var, pos in donated_vars:
+        if var in rebound:
+            continue  # state, m = step(state, batch): the safe idiom
+        # First later access wins, by line: a Store clears the hazard, a
+        # Load is the bug.
+        accesses: List[Tuple[int, int, bool, ast.AST]] = []
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Name) and sub.id == var \
+                    and sub.lineno > call_line:
+                accesses.append((sub.lineno, sub.col_offset,
+                                 isinstance(sub.ctx, (ast.Store, ast.Del)),
+                                 sub))
+        accesses.sort(key=lambda a: (a[0], a[1]))
+        if accesses and not accesses[0][2]:
+            _, _, _, load = accesses[0]
+            findings.append(module.finding(
+                "DN701", load,
+                f"'{var}' was donated to jitted '{fn_name}' "
+                f"(argument {pos}) on line {call.lineno}; its buffer is "
+                "invalid after the call — rebind it from the call's "
+                "result or stop reading it"))
+    return findings
+
+
+def _check_module(module: Module) -> List[Finding]:
+    bindings = _donated_bindings(module)
+    if not bindings:
+        return []
+    findings: List[Finding] = []
+    for node in module.nodes:
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in bindings:
+            findings.extend(_check_call(
+                module, node, node.func.id, bindings[node.func.id]))
+    return findings
+
+
+def check_program(program: Program, registry=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in program.modules:
+        if module.rel in program.target_rels:
+            findings.extend(_check_module(module))
+    return findings

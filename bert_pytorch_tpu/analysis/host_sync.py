@@ -14,8 +14,14 @@ and StepTimer can only observe after the fact.
   canonical step loop every runner and bench leg uses;
 * any function whose ``def`` line (or the line above) carries a
   ``# jaxlint: hot`` marker;
-* transitively: any same-module function called BY NAME from a
-  non-exempt hot statement (``dispatch_step(...)`` in run_pretraining).
+* transitively: any function called from a non-exempt hot statement —
+  same-module bare-name calls (``dispatch_step(...)`` in
+  run_pretraining), and, when the whole-program graph is available
+  (core.run_files builds one), functions IMPORTED from another module
+  (``from helpers import fetch; ... fetch(m)`` inside a timed loop
+  makes ``helpers.fetch`` a hot region too — the finding lands in the
+  helper's file, honoring ITS suppression comments). Same-module
+  behavior is the fallback whenever the graph cannot resolve a call.
 
 **Declared sync-cadence sites** (exempt — the body only, the test still
 runs per step and is scanned):
@@ -120,7 +126,7 @@ def _host_safe(module: Module, node: ast.AST) -> bool:
 
 def _function_defs(module: Module) -> dict:
     defs: dict = {}
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             # Last definition wins on name collisions — matches runtime
             # rebinding closely enough for a lint.
@@ -139,70 +145,110 @@ def _is_timed_loop(node: ast.AST) -> bool:
 
 
 class _HotScanner:
-    def __init__(self, module: Module):
-        self.module = module
-        self.defs = _function_defs(module)
+    """Scans one module's hot regions; with ``program``, hotness
+    propagates through imports — the scan queue carries (module, fn)
+    pairs and findings land in the defining module."""
+
+    def __init__(self, module: Module, program=None):
+        self.root = module
+        self.program = program
+        self._defs_cache: dict = {}
         self.findings: List[Finding] = []
-        self._scanned_fns: Set[str] = set()
-        self._pending_fns: List[str] = []
+        self._scanned: Set[tuple] = set()
+        self._pending: List[tuple] = []  # (Module, FunctionDef)
+
+    def _defs(self, module: Module) -> dict:
+        if self.program is not None:
+            # Same last-def-wins table, cached program-wide instead of
+            # per scanner (one _HotScanner is built per target module).
+            return self.program.defs_of(module)
+        defs = self._defs_cache.get(module.rel)
+        if defs is None:
+            defs = _function_defs(module)
+            self._defs_cache[module.rel] = defs
+        return defs
 
     def run(self) -> List[Finding]:
-        for node in ast.walk(self.module.tree):
+        module = self.root
+        for node in module.nodes:
             if _is_timed_loop(node):
-                self._scan_stmts(node.body)
-        for name, fn in self.defs.items():
+                self._scan_stmts(module, node.body)
+        for name, fn in self._defs(module).items():
             marker_lines = {fn.lineno, fn.lineno - 1}
             if fn.decorator_list:
                 marker_lines.add(fn.decorator_list[0].lineno - 1)
-            if marker_lines & self.module.hot_lines:
-                self._queue_fn(name)
-        while self._pending_fns:
-            fn = self.defs[self._pending_fns.pop()]
-            self._scan_stmts(fn.body)
+            if marker_lines & module.hot_lines:
+                self._queue_local(module, name)
+        while self._pending:
+            mod, fn = self._pending.pop()
+            self._scan_stmts(mod, fn.body)
         return self.findings
 
-    def _queue_fn(self, name: str) -> None:
-        if name in self.defs and name not in self._scanned_fns:
-            self._scanned_fns.add(name)
-            self._pending_fns.append(name)
+    def _queue_local(self, module: Module, name: str) -> None:
+        fn = self._defs(module).get(name)
+        if fn is not None:
+            self._queue(module, name, fn)
+            return
+        # Not defined here: resolve through the program graph (imported
+        # helpers called from a hot loop are hot regions too).
+        if self.program is not None:
+            hit = self.program.resolve_function(module, name)
+            if hit is not None:
+                target, target_fn = hit
+                self._queue(target, getattr(target_fn, "name", name),
+                            target_fn)
 
-    def _scan_stmts(self, stmts: List[ast.stmt]) -> None:
+    def _queue(self, module: Module, name: str, fn) -> None:
+        key = (module.rel, name)
+        if key not in self._scanned:
+            self._scanned.add(key)
+            self._pending.append((module, fn))
+
+    def _scan_stmts(self, module: Module, stmts: List[ast.stmt]) -> None:
         for stmt in stmts:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
                 continue  # a def is not execution; calls propagate hotness
             if isinstance(stmt, ast.If):
-                self._scan_expr(stmt.test)
+                self._scan_expr(module, stmt.test)
                 if _is_exempt_test(stmt.test):
                     # The body is a declared sync-cadence site; the else
                     # branch is the common per-step path and stays hot.
-                    self._scan_stmts(stmt.orelse)
+                    self._scan_stmts(module, stmt.orelse)
                 else:
-                    self._scan_stmts(stmt.body)
-                    self._scan_stmts(stmt.orelse)
+                    self._scan_stmts(module, stmt.body)
+                    self._scan_stmts(module, stmt.orelse)
                 continue
             for expr in ast.iter_child_nodes(stmt):
                 if isinstance(expr, ast.stmt):
                     continue
-                self._scan_expr(expr)
+                self._scan_expr(module, expr)
             for attr in ("body", "orelse", "finalbody"):
                 sub = getattr(stmt, attr, None)
                 if isinstance(sub, list) and sub \
                         and isinstance(sub[0], ast.stmt):
-                    self._scan_stmts(sub)
+                    self._scan_stmts(module, sub)
             for handler in getattr(stmt, "handlers", []) or []:
-                self._scan_stmts(handler.body)
+                self._scan_stmts(module, handler.body)
 
-    def _scan_expr(self, expr: ast.AST) -> None:
+    def _scan_expr(self, module: Module, expr: ast.AST) -> None:
         for node in ast.walk(expr):
             if not isinstance(node, ast.Call):
                 continue
-            self._check_call(node)
+            self._check_call(module, node)
             if isinstance(node.func, ast.Name):
-                self._queue_fn(node.func.id)
+                self._queue_local(module, node.func.id)
+            elif self.program is not None \
+                    and isinstance(node.func, ast.Attribute):
+                # helpers.fetch(...) through an imported module object.
+                dotted = module.dotted(node.func)
+                if dotted and dotted not in _SYNC_CALLS:
+                    hit = self.program.resolve_function(module, dotted)
+                    if hit is not None:
+                        target, fn = hit
+                        self._queue(target, getattr(fn, "name", dotted), fn)
 
-    def _check_call(self, node: ast.Call) -> None:
-        module = self.module
+    def _check_call(self, module: Module, node: ast.Call) -> None:
         func = node.func
         if isinstance(func, ast.Attribute) and func.attr in _SYNC_METHODS:
             dotted = module.dotted(func)
@@ -231,12 +277,12 @@ class _HotScanner:
                 "is host-resident"))
 
 
-def check(module: Module, registry=None) -> List[Finding]:
+def check(module: Module, registry=None, program=None) -> List[Finding]:
     # A timed loop inside a hot-marked function is scanned by both entry
     # points; report each flagged node once.
     seen: Set[Finding] = set()
     out: List[Finding] = []
-    for f in _HotScanner(module).run():
+    for f in _HotScanner(module, program=program).run():
         if f not in seen:
             seen.add(f)
             out.append(f)

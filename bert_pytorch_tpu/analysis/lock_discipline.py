@@ -82,7 +82,7 @@ def _held_locks(chain: List[ast.AST]) -> List[str]:
 def _accesses(module: Module, entry) -> List[Tuple[ast.AST, bool]]:
     """(node, is_store) for every access of the registered attribute."""
     out: List[Tuple[ast.AST, bool]] = []
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if entry.cls:
             if isinstance(node, ast.Attribute) and node.attr == entry.attr \
                     and isinstance(node.value, ast.Name) \
@@ -96,7 +96,7 @@ def _accesses(module: Module, entry) -> List[Tuple[ast.AST, bool]]:
     return out
 
 
-def check(module: Module, registry=None) -> List[Finding]:
+def check(module: Module, registry=None, program=None) -> List[Finding]:
     if registry is None:
         from bert_pytorch_tpu.analysis import concurrency
         registry = concurrency.REGISTRY

@@ -137,7 +137,7 @@ class _State:
 
 def _collect(module: Module) -> _State:
     state = _State(module)
-    fn_defs = {n.name: n for n in ast.walk(module.tree)
+    fn_defs = {n.name: n for n in module.nodes
                if isinstance(n, ast.FunctionDef)}
 
     for stmt in module.tree.body:
@@ -155,7 +155,7 @@ def _collect(module: Module) -> _State:
                     if isinstance(t, ast.Name) and not t.id.isupper():
                         state.mutable_globals.add(t.id)
 
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, ast.Call):
             sig = _jit_sig_from_call(module, node)
             if sig is None:
@@ -193,7 +193,7 @@ def _collect(module: Module) -> _State:
 def _check_call_sites(state: _State) -> List[Finding]:
     module = state.module
     findings: List[Finding] = []
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
                 and node.func.id in state.jitted_names):
             continue
@@ -255,6 +255,6 @@ def _check_closures(state: _State) -> List[Finding]:
     return findings
 
 
-def check(module: Module, registry=None) -> List[Finding]:
+def check(module: Module, registry=None, program=None) -> List[Finding]:
     state = _collect(module)
     return _check_call_sites(state) + _check_closures(state)

@@ -49,7 +49,7 @@ _CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
 
 def _wallclock_seed_findings(module: Module) -> List[Finding]:
     findings = []
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if not isinstance(node, ast.Call):
             continue
         if module.dotted(node.func) not in _SEED_SINKS:
@@ -191,9 +191,9 @@ class _KeyTracker:
                     state[name] = True
 
 
-def check(module: Module, registry=None) -> List[Finding]:
+def check(module: Module, registry=None, program=None) -> List[Finding]:
     findings = _wallclock_seed_findings(module)
-    for node in ast.walk(module.tree):
+    for node in module.nodes:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             tracker = _KeyTracker(module)
             tracker.scan_function(node.body)

@@ -1,0 +1,366 @@
+"""SD601/SD602/SD603 — sharding discipline against the axes registry
+(``analysis/axes.py``), the safety net the "one mesh" refactor lands on.
+
+* **SD601** collective-axis discipline: every statically-resolvable axis
+  name handed to a ``lax`` collective (``psum``/``pmean``/``pmax``/
+  ``pmin``/``all_gather``/``ppermute``/``all_to_all``/``psum_scatter``/
+  ``axis_index``) must be a registered mesh axis, or be declared by an
+  enclosing ``shard_map``/``pmap``/``xmap`` scope (``axis_names=`` /
+  ``axis_name=`` — including a wrapper function the collective's
+  enclosing function is passed to, and ``@partial(shard_map, ...)``
+  decorators). A typo'd axis traces fine and fails only under the mesh
+  shape that exercises it; the registry catches it at commit time.
+  Dynamic axis arguments (a parameter, a computed name) are skipped —
+  this tier proves what is statically knowable.
+
+* **SD602** logical-rule coverage: every logical name in an
+  ``nn.with_logical_partitioning`` annotation (or a ``kernel_axes=`` /
+  ``bias_axes=`` / ``embedding_axes=`` keyword, the factory spelling
+  ``ops/quant.py make_dense`` uses) must have a rule under EVERY
+  declared strategy (``axes.logical_coverage``); an unmatched name
+  silently REPLICATES the parameter — the ZeRO-lineage fsdp bug class.
+  ``PartitionSpec`` literals are checked against the mesh axes the same
+  way (an unknown axis raises only when a mesh is finally attached).
+
+* **SD603** raw mesh-axis literals outside ``parallel/``: axis-position
+  string literals equal to a mesh axis (collective args, PartitionSpec
+  entries, ``axis``/``axis_name``/``axis_names``/``seq_axis`` keywords
+  and parameter defaults, ``mesh.shape[...]`` lookups, axis-named
+  assignment targets and comparisons) must import the ``AXIS_*``
+  constants from ``parallel/mesh.py`` instead. The constants are the
+  first concrete step of the one-mesh consolidation: when the refactor
+  renames or splits an axis, it edits ONE module and the type of every
+  consumer, instead of a repo-wide string hunt. ``parallel/`` itself
+  (the defining layer) and this analysis package (the mirror) are
+  exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from bert_pytorch_tpu.analysis import axes as axes_registry
+from bert_pytorch_tpu.analysis.core import Finding, Module
+from bert_pytorch_tpu.analysis.graph import Program, resolve_strings
+
+CHECKS = {
+    "SD601": "collective axis name neither registered in the axes "
+             "registry nor declared by an enclosing shard_map/pmap scope",
+    "SD602": "logical/PartitionSpec axis name without a rule under every "
+             "declared strategy (silently replicates)",
+    "SD603": "raw mesh-axis string literal outside parallel/ (import the "
+             "AXIS_* constants from parallel/mesh.py)",
+}
+
+# lax collectives -> index of the axis-name positional argument.
+_COLLECTIVES: Dict[str, int] = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.axis_index": 0,
+}
+# Keywords that carry axis names on collective calls.
+_AXIS_KEYWORDS = ("axis_name", "axis_names")
+# Callables that DECLARE manual axes for a wrapped function. Matched on
+# the last dotted component: shard_map arrives as jax.shard_map,
+# jax.experimental.shard_map.shard_map, or the pipeline shim
+# bert_pytorch_tpu.parallel.pipeline.shard_map.
+_DECLARING_TAILS = ("shard_map", "pmap", "xmap")
+# Keywords/defaults/targets whose string payload is axis-positioned for
+# SD603 (the spellings this codebase actually uses).
+_AXIS_NAMED = ("axis", "axis_name", "axis_names", "seq_axis")
+# Modules allowed to spell mesh axes as raw literals: the defining
+# parallel layer and the analysis mirror itself.
+_SD603_EXEMPT_PREFIXES = ("bert_pytorch_tpu/parallel/",
+                          "bert_pytorch_tpu/analysis/")
+
+_PARTITION_SPEC_TAILS = ("PartitionSpec",)
+_LOGICAL_CALL_TAILS = ("with_logical_partitioning",)
+_LOGICAL_KEYWORDS = ("kernel_axes", "bias_axes", "embedding_axes")
+
+
+def _tail(dotted: Optional[str]) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+def _is_declaring_call(module: Module, call: ast.Call) -> bool:
+    dotted = module.dotted(call.func)
+    if _tail(dotted) in _DECLARING_TAILS:
+        return True
+    # partial(shard_map, ...) — the decorator idiom.
+    if _tail(dotted) == "partial" and call.args:
+        return _tail(module.dotted(call.args[0])) in _DECLARING_TAILS
+    return False
+
+
+def _declared_axes_of_call(program: Optional[Program], module: Module,
+                           call: ast.Call) -> Set[str]:
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KEYWORDS:
+            resolved = resolve_strings(program, module, kw.value, at=call)
+            if resolved:
+                names |= resolved
+    return names
+
+
+def _wrapped_function_names(module: Module, call: ast.Call) -> List[str]:
+    """Bare names of functions a declaring call wraps: shard_map(f, ...),
+    shard_map(partial(f, ...), ...)."""
+    if not call.args:
+        return []
+    arg = call.args[0]
+    # partial(shard_map, ...) declares for its later callee, which we
+    # cannot see here; the decorator path handles the common case.
+    if _tail(module.dotted(call.func)) == "partial":
+        return []
+    out: List[str] = []
+    if isinstance(arg, ast.Name):
+        out.append(arg.id)
+    elif isinstance(arg, ast.Call) and _tail(
+            module.dotted(arg.func)) == "partial" and arg.args \
+            and isinstance(arg.args[0], ast.Name):
+        out.append(arg.args[0].id)
+    return out
+
+
+class _ScopeAxes:
+    """Axis names each function/lambda in a module may legally use in
+    collectives, gathered from every declaring call that (a) lexically
+    encloses it, (b) wraps it by name, or (c) decorates it."""
+
+    def __init__(self, program: Optional[Program], module: Module):
+        self.module = module
+        self.program = program
+        self.by_fn: Dict[ast.AST, Set[str]] = {}
+        self._collect()
+
+    def _collect(self) -> None:
+        module = self.module
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in module.nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) \
+                            and _is_declaring_call(module, dec):
+                        self._add(node, _declared_axes_of_call(
+                            self.program, module, dec))
+        for node in module.nodes:
+            if not (isinstance(node, ast.Call)
+                    and _is_declaring_call(module, node)):
+                continue
+            declared = _declared_axes_of_call(self.program, module, node)
+            if not declared:
+                continue
+            # The wrapped function, by name...
+            for name in _wrapped_function_names(module, node):
+                for fn in defs.get(name, []):
+                    self._add(fn, declared)
+            # ...or inline (a lambda / def lexically inside the call).
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Lambda):
+                    self._add(sub, declared)
+
+    def _add(self, fn: ast.AST, names: Set[str]) -> None:
+        self.by_fn.setdefault(fn, set()).update(names)
+
+    def allowed_at(self, node: ast.AST) -> Set[str]:
+        """Declared axes visible at ``node``: every enclosing function's
+        declarations plus any enclosing declaring call's own."""
+        out: Set[str] = set()
+        cur = self.module.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                out |= self.by_fn.get(cur, set())
+            if isinstance(cur, ast.Call) \
+                    and _is_declaring_call(self.module, cur):
+                out |= _declared_axes_of_call(self.program, self.module, cur)
+            cur = self.module.parents.get(cur)
+        return out
+
+
+def _check_collectives(program: Optional[Program], module: Module,
+                       scopes: _ScopeAxes) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in module.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = module.dotted(node.func)
+        if dotted not in _COLLECTIVES:
+            continue
+        pos = _COLLECTIVES[dotted]
+        axis_nodes: List[ast.AST] = []
+        if len(node.args) > pos:
+            axis_nodes.append(node.args[pos])
+        axis_nodes.extend(kw.value for kw in node.keywords
+                          if kw.arg in _AXIS_KEYWORDS)
+        for axis_node in axis_nodes:
+            resolved = resolve_strings(program, module, axis_node, at=node)
+            if resolved is None:
+                continue  # dynamic: out of this tier's reach
+            allowed = None
+            for name in sorted(resolved):
+                if axes_registry.is_mesh_axis(name):
+                    continue
+                if allowed is None:
+                    allowed = scopes.allowed_at(node)
+                if name in allowed:
+                    continue
+                findings.append(module.finding(
+                    "SD601", axis_node,
+                    f"'{_tail(dotted)}' over axis '{name}': not a "
+                    "registered mesh axis (analysis/axes.py) and no "
+                    "enclosing shard_map/pmap scope declares it — a "
+                    "typo'd axis only fails under the mesh shape that "
+                    "exercises it"))
+    return findings
+
+
+def _check_logical(program: Optional[Program], module: Module
+                   ) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def check_logical_names(node: ast.AST, where: str) -> None:
+        resolved = resolve_strings(program, module, node, at=node)
+        if resolved is None:
+            return
+        for name in sorted(resolved):
+            missing = axes_registry.uncovered_strategies(name)
+            if missing:
+                findings.append(module.finding(
+                    "SD602", node,
+                    f"logical axis '{name}' ({where}) has no rule under "
+                    f"strateg{'y' if len(missing) == 1 else 'ies'} "
+                    f"{', '.join(missing)} — an unmatched logical name "
+                    "silently replicates; add the rule to "
+                    "parallel/mesh.py AND its mirror analysis/axes.py"))
+
+    for node in module.nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _tail(module.dotted(node.func))
+        if tail in _LOGICAL_CALL_TAILS and len(node.args) >= 2:
+            check_logical_names(node.args[1], "with_logical_partitioning")
+        for kw in node.keywords:
+            if kw.arg in _LOGICAL_KEYWORDS:
+                check_logical_names(kw.value, f"{kw.arg}=")
+        if tail in _PARTITION_SPEC_TAILS:
+            for arg in node.args:
+                if isinstance(arg, ast.Starred):
+                    continue
+                resolved = resolve_strings(program, module, arg, at=node)
+                if resolved is None:
+                    continue
+                for name in sorted(resolved):
+                    if not axes_registry.is_mesh_axis(name):
+                        findings.append(module.finding(
+                            "SD602", arg,
+                            f"PartitionSpec axis '{name}' is not a "
+                            "registered mesh axis (analysis/axes.py); "
+                            "it only fails once a mesh is attached"))
+    return findings
+
+
+def _axis_literals(node: ast.AST) -> List[ast.Constant]:
+    """Constant mesh-axis strings anywhere in an axis-positioned
+    expression subtree."""
+    return [sub for sub in ast.walk(node)
+            if isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)
+            and axes_registry.is_mesh_axis(sub.value)]
+
+
+def _is_axis_named(name: Optional[str]) -> bool:
+    return bool(name) and (name in _AXIS_NAMED or name.endswith("_axis")
+                           or name.endswith("_axes"))
+
+
+def _sd603_sites(module: Module) -> List[ast.Constant]:
+    sites: List[ast.Constant] = []
+    for node in module.nodes:
+        if isinstance(node, ast.Call):
+            dotted = module.dotted(node.func)
+            pos = _COLLECTIVES.get(dotted)
+            if pos is not None and len(node.args) > pos:
+                sites.extend(_axis_literals(node.args[pos]))
+            if _tail(dotted) in _PARTITION_SPEC_TAILS:
+                for arg in node.args:
+                    sites.extend(_axis_literals(arg))
+            # mesh.shape.get("seq", ...) lookups.
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and node.func.value.attr == "shape" and node.args:
+                sites.extend(_axis_literals(node.args[0]))
+            for kw in node.keywords:
+                if _is_axis_named(kw.arg):
+                    sites.extend(_axis_literals(kw.value))
+        elif isinstance(node, ast.Subscript):
+            # mesh.shape["pipe"] lookups.
+            if isinstance(node.value, ast.Attribute) \
+                    and node.value.attr == "shape":
+                sites.extend(_axis_literals(node.slice))
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and _is_axis_named(t.id)
+                   for t in node.targets):
+                sites.extend(_axis_literals(node.value))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sig = node.args
+            # Trailing positional defaults pair with the last params.
+            pos_args = list(sig.posonlyargs) + list(sig.args)
+            paired = zip(pos_args[-len(sig.defaults):], sig.defaults) \
+                if sig.defaults else []
+            for arg, default in list(paired) + list(
+                    zip(sig.kwonlyargs, sig.kw_defaults)):
+                if default is not None and _is_axis_named(arg.arg):
+                    sites.extend(_axis_literals(default))
+        elif isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+            if any(isinstance(op, ast.Name) and _is_axis_named(op.id)
+                   or isinstance(op, ast.Attribute)
+                   and _is_axis_named(op.attr) for op in operands):
+                for op in operands:
+                    if isinstance(op, ast.Constant):
+                        sites.extend(_axis_literals(op))
+    return sites
+
+
+def _check_literals(module: Module) -> List[Finding]:
+    if any(module.rel.startswith(p) or ("/" + p) in module.rel
+           for p in _SD603_EXEMPT_PREFIXES):
+        return []
+    findings: List[Finding] = []
+    seen: Set[int] = set()
+    for lit in _sd603_sites(module):
+        key = id(lit)
+        if key in seen:
+            continue
+        seen.add(key)
+        const = axes_registry.constant_for(lit.value)
+        findings.append(module.finding(
+            "SD603", lit,
+            f"raw mesh-axis literal '{lit.value}' outside parallel/ — "
+            f"import {const} from bert_pytorch_tpu.parallel.mesh so the "
+            "one-mesh refactor renames axes in one place"))
+    return findings
+
+
+def check_program(program: Program, registry=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for module in program.modules:
+        if module.rel not in program.target_rels:
+            continue
+        scopes = _ScopeAxes(program, module)
+        findings.extend(_check_collectives(program, module, scopes))
+        findings.extend(_check_logical(program, module))
+        findings.extend(_check_literals(module))
+    return findings

@@ -75,7 +75,7 @@ def _scan_jitted(module: Module, fn: ast.AST, label: str) -> List[Finding]:
     return findings
 
 
-def check(module: Module, registry=None) -> List[Finding]:
+def check(module: Module, registry=None, program=None) -> List[Finding]:
     state = _collect(module)
     findings: List[Finding] = []
     for fn in state.jitted_fns:

@@ -133,6 +133,7 @@ def dot_product_attention(
         # ppermute over the ambient manual axis, with no nested shard_map
         # (Shardy rejects the nested-manual backward — parallel/pipeline.py).
         from bert_pytorch_tpu.ops.ring import _ring_shard
+        from bert_pytorch_tpu.parallel.mesh import AXIS_SEQ
 
         batch, s_local = q.shape[0], q.shape[1]
         if bias is None:
@@ -143,7 +144,7 @@ def dot_product_attention(
         return _ring_shard(
             q, k, v, kbias,
             dropout_rng if active else None,
-            axis_name="seq",
+            axis_name=AXIS_SEQ,
             dropout_rate=dropout_rate if active else 0.0,
         )
     if backend == "ring":
@@ -151,16 +152,17 @@ def dot_product_attention(
         # with K/V ring rotation (ops/ring.py). Falls back to dense when no
         # seq sharding is active (e.g. single-device tests of an sp model).
         from bert_pytorch_tpu.ops.ring import ring_attention
-        from bert_pytorch_tpu.parallel.mesh import current_mesh
+        from bert_pytorch_tpu.parallel.mesh import AXIS_SEQ, current_mesh
 
         mesh = current_mesh()
-        if mesh is not None and mesh.shape.get("seq", 1) > 1:
-            if q.shape[1] % mesh.shape["seq"] != 0:
+        if mesh is not None and mesh.shape.get(AXIS_SEQ, 1) > 1:
+            if q.shape[1] % mesh.shape[AXIS_SEQ] != 0:
                 # Silently densifying here would materialize the O(S²)
                 # scores exactly in the long-context regime ring exists for.
                 raise ValueError(
                     f"backend='ring': sequence length {q.shape[1]} is not "
-                    f"divisible by the mesh 'seq' axis ({mesh.shape['seq']}); "
+                    f"divisible by the mesh 'seq' axis "
+                    f"({mesh.shape[AXIS_SEQ]}); "
                     "pad the sequence or resize the mesh")
             return ring_attention(
                 q, k, v, bias=bias,

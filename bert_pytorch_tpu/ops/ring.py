@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from bert_pytorch_tpu.parallel.mesh import AXIS_SEQ
+
 
 def _ring_shard(
     q: jnp.ndarray,
@@ -109,7 +111,7 @@ def ring_attention(
     dropout_rng=None,
     dropout_rate: float = 0.0,
     mesh=None,
-    seq_axis: str = "seq",
+    seq_axis: str = AXIS_SEQ,
 ) -> jnp.ndarray:
     """Sequence-sharded attention over global [B, S, H, D] tensors.
 

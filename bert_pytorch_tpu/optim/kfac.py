@@ -67,6 +67,8 @@ import jax.numpy as jnp
 from flax import traverse_util
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bert_pytorch_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP
+
 
 @flax.struct.dataclass
 class KFACState:
@@ -504,11 +506,11 @@ def kfac_state_shardings(mesh: Mesh, state: KFACState) -> KFACState:
     divides evenly — each data shard then eigendecomposes its slice of
     layers (the distributed-inverse placement of kfac_pytorch's
     HYBRID_OPT, expressed as a sharding instead of rank bookkeeping)."""
-    shards = mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1)
+    shards = mesh.shape.get(AXIS_DATA, 1) * mesh.shape.get(AXIS_FSDP, 1)
 
     def rule(x):
         if x.ndim >= 3 and shards > 1 and x.shape[0] % shards == 0:
-            return NamedSharding(mesh, P(("data", "fsdp")))
+            return NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP)))
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map(rule, state)

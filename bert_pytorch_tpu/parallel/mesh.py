@@ -16,7 +16,17 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("data", "fsdp", "pipe", "seq", "model")
+# The canonical mesh-axis names. Everything outside parallel/ must spell
+# axes through these constants (enforced by jaxlint SD603, mirrored in
+# analysis/axes.py): the one-mesh refactor then renames or splits an axis
+# by editing this block, not by a repo-wide string hunt.
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_PIPE = "pipe"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+
+MESH_AXES = (AXIS_DATA, AXIS_FSDP, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL)
 
 
 @dataclasses.dataclass

@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from bert_pytorch_tpu.parallel.mesh import AXIS_PIPE, AXIS_SEQ
+
 try:  # jax >= 0.5: top-level shard_map with axis_names + lax.pcast typing
     from jax import shard_map as _shard_map
 
@@ -84,7 +86,7 @@ def gpipe(
     consts: Any,
     mesh: Mesh,
     replicated: Any = None,
-    axis: str = "pipe",
+    axis: str = AXIS_PIPE,
     seq_axis: str = None,
     x_seq_dim: int = 2,
     consts_seq_dims: Any = None,
@@ -133,7 +135,7 @@ def gpipe(
             f"need at least as many microbatches as pipeline stages: "
             f"{n_mb} < {n_stages} (the bubble would dominate anyway)"
         )
-    if seq_axis is not None and seq_axis != "seq":
+    if seq_axis is not None and seq_axis != AXIS_SEQ:
         # The ring_manual attention body (ops/attention.py) and the stage
         # dropout folding (pretrain.make_pp_train_step) hardcode the axis
         # name 'seq'; a differently-named axis would shard the activations
@@ -141,7 +143,7 @@ def gpipe(
         raise ValueError(
             f"gpipe seq_axis must be the mesh axis named 'seq' "
             f"(got {seq_axis!r})")
-    if seq_axis is None and mesh.shape.get("seq", 1) > 1:
+    if seq_axis is None and mesh.shape.get(AXIS_SEQ, 1) > 1:
         # Without the manual-ring composition, a seq>1 mesh would need ring
         # attention's own 'seq'-manual shard_map NESTED inside this region;
         # that type-checks, but Shardy's lowering verifier rejects the

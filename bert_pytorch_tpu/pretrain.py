@@ -30,6 +30,8 @@ from bert_pytorch_tpu.models.losses import mlm_accuracy, pretraining_loss
 from bert_pytorch_tpu.ops.grad_utils import global_norm
 from bert_pytorch_tpu.optim.transforms import (LossScaleState, OptState,
                                                opt_step_count)
+from bert_pytorch_tpu.parallel.mesh import (AXIS_DATA, AXIS_FSDP, AXIS_PIPE,
+                                            AXIS_SEQ)
 from bert_pytorch_tpu.parallel.sharding import params_shardings
 
 
@@ -249,8 +251,8 @@ def _make_overlap_step_fn(model, tx, mesh, schedule, next_sentence,
         # over ('data','fsdp') even under dp rules (params replicated),
         # so folding in only 'data' would hand every fsdp shard sharing a
         # data index identical masks for different examples.
-        shard = (jax.lax.axis_index("data") * mesh.shape["fsdp"]
-                 + jax.lax.axis_index("fsdp"))
+        shard = (jax.lax.axis_index(AXIS_DATA) * mesh.shape[AXIS_FSDP]
+                 + jax.lax.axis_index(AXIS_FSDP))
         rng0 = jax.random.fold_in(step_rng, shard)
         zero_grads = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
@@ -311,7 +313,7 @@ def _make_overlap_step_fn(model, tx, mesh, schedule, next_sentence,
             k: P(*([None, axes] + [None] * (v.ndim - 2)))
             for k, v in batch.items()}
         grads, losses, accs = shard_map(
-            local_grads, mesh=mesh, axis_names={"data", "fsdp"},
+            local_grads, mesh=mesh, axis_names={AXIS_DATA, AXIS_FSDP},
             in_specs=(P(), batch_specs, P()),
             out_specs=(P(), P(), P()))(state.params, batch, step_rng)
         grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
@@ -712,7 +714,7 @@ def make_pp_train_step(
     from bert_pytorch_tpu.parallel.pipeline import gpipe, stage_layer_count
 
     cfg = model.config
-    n_stages = mesh.shape["pipe"]
+    n_stages = mesh.shape[AXIS_PIPE]
     stage_layer_count(cfg.num_hidden_layers, n_stages)  # validate divisibility
 
     # pp x sp: with a 'seq' mesh axis the pipeline's shard_map goes manual
@@ -720,7 +722,7 @@ def make_pp_train_step(
     # (ops/attention.py backend='ring_manual') — K/V rotate over 'seq'
     # inside the SAME manual region, sidestepping the nested-manual
     # backward Shardy rejects (parallel/pipeline.py docstring).
-    seq_manual = mesh.shape.get("seq", 1) > 1
+    seq_manual = mesh.shape.get(AXIS_SEQ, 1) > 1
     layer_backend = "ring_manual" if seq_manual else model.attention_backend
 
     emb_mod = BertEmbeddings(cfg, dtype=model.dtype)
@@ -748,10 +750,10 @@ def make_pp_train_step(
 
     def loss_fn(params, batch, rng):
         n_mb, b, seq = batch["input_ids"].shape
-        if seq_manual and seq % mesh.shape["seq"] != 0:
+        if seq_manual and seq % mesh.shape[AXIS_SEQ] != 0:
             raise ValueError(
                 f"pp x sp: sequence length {seq} is not divisible by the "
-                f"mesh 'seq' axis ({mesh.shape['seq']})")
+                f"mesh 'seq' axis ({mesh.shape[AXIS_SEQ]})")
         # Two streams: embeddings dropout + the per-(layer, microbatch)
         # folding inside the pipeline. The heads are dropout-free.
         emb_rng, pipe_rng = jax.random.split(rng)
@@ -788,7 +790,7 @@ def make_pp_train_step(
                 # attention-probability dropout decorrelates itself —
                 # _ring_shard folds in the seq index too.)
                 rng_rep = jax.random.fold_in(
-                    rng_rep, jax.lax.axis_index("seq"))
+                    rng_rep, jax.lax.axis_index(AXIS_SEQ))
 
             def body(carry, xs):
                 lp, j = xs
@@ -809,7 +811,7 @@ def make_pp_train_step(
             bias,
             mesh,
             replicated=pipe_rng,
-            seq_axis="seq" if seq_manual else None,
+            seq_axis=AXIS_SEQ if seq_manual else None,
             x_seq_dim=2,
             consts_seq_dims=4 if seq_manual else None,
         )

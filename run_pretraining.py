@@ -34,6 +34,8 @@ from bert_pytorch_tpu.data import DataLoader, DistributedSampler, ShardedPretrai
 from bert_pytorch_tpu.models import BertForPreTraining
 from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
 from bert_pytorch_tpu.parallel import launcher
+from bert_pytorch_tpu.parallel.mesh import (AXIS_DATA, AXIS_FSDP, AXIS_MODEL,
+                                            AXIS_PIPE, AXIS_SEQ)
 from bert_pytorch_tpu.testing import faults
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
@@ -384,7 +386,7 @@ def setup_training(args):
     # Accumulation math (reference :213-228), in global terms: one optimizer
     # step consumes global_batch_size sequences as accumulation_steps
     # microbatches of local_batch_size per data shard.
-    n_data = mesh.shape["data"] * mesh.shape["fsdp"]
+    n_data = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
     global_microbatch = args.local_batch_size * n_data
     if args.global_batch_size % global_microbatch != 0:
         raise ValueError(
@@ -415,7 +417,7 @@ def setup_training(args):
         raise ValueError(
             "--overlap_grad_reduce requires --parallel_strategy dp with a "
             "first-order optimizer (no --kfac) and bf16/fp32")
-    if (args.parallel_strategy == "sp" and mesh.shape["seq"] > 1
+    if (args.parallel_strategy == "sp" and mesh.shape[AXIS_SEQ] > 1
             and args.attention_backend != "ring"):
         # sp exists to avoid O(S^2) dense attention; never silently densify
         # (same stance as ops/attention.py's non-divisible check).
@@ -649,7 +651,7 @@ def main(args) -> dict:
                                              loss_scaled=fp16)
         b_shardings = pretrain.batch_shardings(
             mesh, batch_spec,
-            seq_sharded=(mesh.shape["seq"] > 1 and
+            seq_sharded=(mesh.shape[AXIS_SEQ] > 1 and
                          args.parallel_strategy in ("sp", "pp", "pp_tp")))
         init_fn = pretrain.make_init_fn(model, tx, sample, shardings)
         state = init_fn(jax.random.PRNGKey(args.seed))
@@ -747,26 +749,28 @@ def main(args) -> dict:
             optim.opt_step_count(state.opt_state)))
 
         if args.parallel_strategy in ("pp", "pp_tp"):
-            if mesh.shape["pipe"] < 2:
+            if mesh.shape[AXIS_PIPE] < 2:
                 raise ValueError(
                     "--parallel_strategy pp/pp_tp needs --mesh_pipe >= 2 (a "
                     "1-stage pipeline is just dp with schedule overhead)")
-            if args.parallel_strategy == "pp_tp" and mesh.shape["model"] < 2:
+            if args.parallel_strategy == "pp_tp" \
+                    and mesh.shape[AXIS_MODEL] < 2:
                 raise ValueError(
                     "--parallel_strategy pp_tp needs --mesh_model >= 2 "
                     "(with one model shard use plain pp)")
-            if args.parallel_strategy == "pp" and mesh.shape["model"] > 1:
+            if args.parallel_strategy == "pp" and mesh.shape[AXIS_MODEL] > 1:
                 # The engine would run, but the 'pp' rules replicate every
                 # weight over the model axis: identical work on every model
                 # shard at 1/model throughput — never what anyone wants.
                 raise ValueError(
-                    f"--mesh_model {mesh.shape['model']} with "
+                    f"--mesh_model {mesh.shape[AXIS_MODEL]} with "
                     "--parallel_strategy pp replicates all stage weights "
                     "over the model axis; use --parallel_strategy pp_tp")
-            if args.accumulation_steps < mesh.shape["pipe"]:
+            if args.accumulation_steps < mesh.shape[AXIS_PIPE]:
                 raise ValueError(
                     f"pp needs accumulation_steps >= pipeline stages "
-                    f"({args.accumulation_steps} < {mesh.shape['pipe']}); "
+                    f"({args.accumulation_steps} < "
+                    f"{mesh.shape[AXIS_PIPE]}); "
                     "raise global_batch_size or lower local_batch_size")
             train_step = pretrain.make_pp_train_step(
                 model, tx, mesh, schedule=schedule,

@@ -255,7 +255,9 @@ def main(args):
                    "start_positions": 1, "end_positions": 1})
         # [B,...] (no accumulation axis): batch axis 0 over data mesh axes
         from jax.sharding import NamedSharding, PartitionSpec as P
-        batch_sh = {k: NamedSharding(mesh, P(("data", "fsdp")))
+
+        from bert_pytorch_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP
+        batch_sh = {k: NamedSharding(mesh, P((AXIS_DATA, AXIS_FSDP)))
                     for k in batch_sh}
 
         # Telemetry facade (docs/telemetry.md): step-time windows + MFU,

@@ -1,0 +1,29 @@
+"""DN701 negative: the rebind idiom (the call's own assignment replaces
+the donated name), a Store before any later read, and donated arguments
+that are not bare names."""
+import jax
+
+
+def train_step(state, batch):
+    return state, {"loss": 0.0}
+
+
+step = jax.jit(train_step, donate_argnums=(0,))
+
+
+def run(state, batches):
+    metrics = None
+    for batch in batches:
+        state, metrics = step(state, batch)
+    return state, metrics
+
+
+def run_reset(state, batch, fresh):
+    out, metrics = step(state, batch)
+    state = fresh  # re-assigned before any read: hazard cleared
+    return out, metrics, state
+
+
+def run_attr(holder, batch):
+    out, metrics = step(holder.state, batch)
+    return out, metrics

@@ -1,0 +1,22 @@
+"""DN701 positive: buffers donated to a jitted call (donate_argnums and
+donate_argnames) and read after the call."""
+import jax
+
+
+def train_step(state, batch):
+    return state, {"loss": 0.0}
+
+
+step = jax.jit(train_step, donate_argnums=(0,))
+named = jax.jit(train_step, donate_argnames=("state",))
+
+
+def run(state, batch):
+    out, metrics = step(state, batch)
+    grad_src = state  # the donated buffer is gone after the call
+    return out, metrics, grad_src
+
+
+def run_named(state, batch):
+    out, metrics = named(state, batch)
+    return out, metrics, state

@@ -1,0 +1,48 @@
+"""SD601 negative: registered mesh axes (through a local constant),
+shard_map-declared manual axes (wrapped by name, decorator spelling,
+inline lambda), and dynamic axis names are all allowed."""
+from functools import partial
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+AXIS_DATA = "data"
+
+
+def global_mean(x):
+    return jax.lax.pmean(x, AXIS_DATA)
+
+
+def stage_body(x):
+    # Declared by the shard_map in build() below, which wraps this
+    # function by name.
+    return jax.lax.psum(x, "stage")
+
+
+def build(mesh, specs):
+    return shard_map(stage_body, mesh=mesh, axis_names={"stage"},
+                     in_specs=specs, out_specs=specs)
+
+
+@partial(shard_map, mesh=None, axis_names={"ring"}, in_specs=None,
+         out_specs=None)
+def rotate(x):
+    return jax.lax.ppermute(x, "ring", perm=[(0, 1)])
+
+
+def build_inline(mesh, specs):
+    return shard_map(lambda x: jax.lax.psum(x, "stage"), mesh=mesh,
+                     axis_names={"stage"}, in_specs=specs, out_specs=specs)
+
+
+def dynamic(x, axis):
+    # A computed axis name is out of this tier's reach: proven statically
+    # knowable or skipped, never guessed.
+    return jax.lax.psum(x, axis)
+
+
+# A lambda PARAMETER is dynamic too — it must shadow the module-level
+# constant of the same name, not resolve through it (regression: the
+# parameter check used to skip lambdas).
+axis = "typo"
+dynamic_lambda = lambda x, axis: jax.lax.psum(x, axis)

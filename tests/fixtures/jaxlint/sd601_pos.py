@@ -1,0 +1,15 @@
+"""SD601 positive: collectives over axis names that are neither
+registered mesh axes (analysis/axes.py) nor declared by any enclosing
+shard_map/pmap scope."""
+import jax
+
+
+def logical_mean(x):
+    # 'batch' is a LOGICAL axis name, not a mesh axis: pmean over it
+    # traces fine and fails only under a mesh that exercises the path.
+    return jax.lax.pmean(x, "batch")
+
+
+def typo_sum(x):
+    total = jax.lax.psum(x, axis_name="dta")
+    return total

@@ -1,0 +1,23 @@
+"""SD602 negative: fully covered logical names (direct, via the axes
+keywords, via a module constant) and mesh-axis PartitionSpecs; dynamic
+specs are skipped."""
+import flax.linen as nn
+from jax.sharding import PartitionSpec
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+EMBED_AXES = ("embed",)
+
+
+def make_param(dense, kernel_init):
+    init = nn.with_logical_partitioning(kernel_init, ("batch", "heads"))
+    layer = dense(kernel_axes=EMBED_AXES, bias_axes=("mlp",))
+    return init, layer
+
+
+def make_spec():
+    return PartitionSpec((AXIS_DATA, AXIS_FSDP), None)
+
+
+def dynamic_spec(names):
+    return PartitionSpec(*names)

@@ -1,0 +1,17 @@
+"""SD602 positive: a logical name with no rule under every declared
+strategy (it would silently replicate), and a PartitionSpec axis that is
+no mesh axis."""
+import flax.linen as nn
+from jax.sharding import PartitionSpec
+
+
+def make_param(kernel_init):
+    # 'hidden_bad' has no rule in any strategy's table: under fsdp it
+    # silently replicates instead of sharding — the ZeRO bug class.
+    init = nn.with_logical_partitioning(kernel_init, ("hidden_bad", "mlp"))
+    return init
+
+
+def make_spec():
+    # 'dta' (typo'd 'data') only raises once a mesh is attached.
+    return PartitionSpec("dta", None)

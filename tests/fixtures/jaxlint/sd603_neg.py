@@ -1,0 +1,24 @@
+"""SD603 negative: the same sites spelled through the parallel/mesh
+AXIS_* constants, plus a non-axis string in an ordinary position."""
+import jax
+from jax.sharding import PartitionSpec
+
+from bert_pytorch_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE
+
+
+def global_sum(x):
+    return jax.lax.psum(x, AXIS_DATA)
+
+
+def batch_spec():
+    return PartitionSpec((AXIS_DATA, AXIS_FSDP))
+
+
+def stage_count(mesh):
+    return mesh.shape[AXIS_PIPE]
+
+
+def tag(kind="data_loader"):
+    # An arbitrary string that merely CONTAINS an axis spelling in a
+    # non-axis position is not a site.
+    return kind

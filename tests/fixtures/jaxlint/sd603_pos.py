@@ -1,0 +1,21 @@
+"""SD603 positive: raw mesh-axis string literals outside parallel/ —
+collective axis args, PartitionSpec entries, mesh.shape lookups, and
+axis-named parameter defaults (5 sites)."""
+import jax
+from jax.sharding import PartitionSpec
+
+
+def global_sum(x):
+    return jax.lax.psum(x, "data")
+
+
+def batch_spec():
+    return PartitionSpec(("data", "fsdp"))
+
+
+def stage_count(mesh):
+    return mesh.shape["pipe"]
+
+
+def rotate(x, seq_axis="seq"):
+    return x, seq_axis

@@ -16,6 +16,7 @@ stability + fixed-line staleness).
 
 from __future__ import annotations
 
+import ast
 import os
 import subprocess
 import sys
@@ -47,9 +48,16 @@ FIXTURE_REGISTRY = (
 )
 
 
+# The CT801 fixtures judge their emitted kinds against this mini schema
+# module (parsed as program CONTEXT, so it produces no findings of its
+# own); every other fixture simply ignores it.
+FIXTURE_SCHEMA = os.path.join(FIXTURES, "telemetry", "schema.py")
+
+
 def run_fixture(name):
     return core.run_files([os.path.join(FIXTURES, name)],
-                          repo_root=REPO_ROOT, registry=FIXTURE_REGISTRY)
+                          repo_root=REPO_ROOT, registry=FIXTURE_REGISTRY,
+                          context_paths=[FIXTURE_SCHEMA])
 
 
 # -- the tier-1 gate -----------------------------------------------------
@@ -72,32 +80,46 @@ def test_repo_gate_no_unsuppressed_findings():
 
 
 def test_cli_repo_gate_runs_without_jax():
-    """The exact acceptance command, with jax imports POISONED: the
-    analyzer (and the bert_pytorch_tpu __init__ chain it rides in on)
-    must be stdlib-only, and the repo must lint clean (exit 0)."""
-    script = os.path.join(REPO_ROOT, "tools", "jaxlint.py")
+    """The exact acceptance command (ISSUE 10: the UNIFIED gate —
+    jaxlint incl. the whole-program shardlint tier, plus the telemetry
+    schema leg) with jax imports POISONED: the analyzer, the
+    bert_pytorch_tpu __init__ chain it rides in on, AND the file-path-
+    loaded schema engine must all be stdlib-only, and the repo must lint
+    clean (exit 0) against the EMPTY committed baseline."""
+    script = os.path.join(REPO_ROOT, "tools", "check_all.py")
     code = (
         "import sys, runpy\n"
         "sys.modules['jax'] = None\n"  # any 'import jax' now raises
-        "sys.argv = ['jaxlint', 'bert_pytorch_tpu', 'run_glue.py',"
-        " 'run_ner.py', 'run_pretraining.py', 'run_server.py',"
-        " 'run_squad.py', 'run_swag.py', 'serve', 'tools']\n"
+        "sys.argv = ['check_all']\n"
         f"runpy.run_path({script!r}, run_name='__main__')\n"
     )
     proc = subprocess.run([sys.executable, "-c", code], cwd=REPO_ROOT,
                           capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, (
-        f"jaxlint CLI gate failed (rc {proc.returncode}):\n"
+        f"check_all gate failed (rc {proc.returncode}):\n"
         f"{proc.stdout}\n{proc.stderr}")
 
 
-def test_cli_seeded_violation_exits_nonzero_naming_the_id():
+# One seeded violation per check FAMILY (ISSUE 10 acceptance: the CLI
+# must exit 1 naming the check ID). hs101 keeps the legacy per-file
+# tier covered; the rest are the shardlint tier.
+SEEDED = ["hs101_pos.py", "sd601_pos.py", "sd602_pos.py", "dn701_pos.py",
+          "ct801_pos.py", "ct802_pos.py"]
+
+
+@pytest.mark.parametrize("fixture", SEEDED,
+                         ids=[f.split("_")[0].upper() for f in SEEDED])
+def test_cli_seeded_violation_exits_nonzero_naming_the_id(fixture):
+    check_id = fixture.split("_")[0].upper()
+    # No --no-context: the fixture is judged against the REAL program
+    # (ct801's kinds against the real telemetry/schema.py registry,
+    # ct802's flags against the real runners' parsers).
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO_ROOT, "tools", "jaxlint.py"),
-         os.path.join(FIXTURES, "hs101_pos.py"), "--no-baseline"],
+         os.path.join(FIXTURES, fixture), "--no-baseline"],
         capture_output=True, text=True, timeout=120)
-    assert proc.returncode == 1
-    assert "HS101" in proc.stdout
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert check_id in proc.stdout
 
 
 # -- per-ID fixtures -----------------------------------------------------
@@ -113,6 +135,13 @@ POSITIVE = [
     ("lk501_pos.py", "LK501", 1),
     ("lk502_pos.py", "LK502", 1),
     ("lk503_pos.py", "LK503", 1),
+    # The shardlint (whole-program) tier.
+    ("sd601_pos.py", "SD601", 2),
+    ("sd602_pos.py", "SD602", 2),
+    ("sd603_pos.py", "SD603", 5),
+    ("dn701_pos.py", "DN701", 2),
+    ("ct801_pos.py", "CT801", 2),
+    ("ct802_pos.py", "CT802", 2),
 ]
 
 
@@ -276,6 +305,53 @@ def test_committed_baseline_loads_and_is_near_empty():
     for entry in entries:
         assert entry.get("justification"), (
             "every baseline entry needs a justification: " + repr(entry))
+
+
+# -- the axes-registry mirror --------------------------------------------
+
+def test_axes_registry_mirrors_mesh_py():
+    """analysis/axes.py restates parallel/mesh.py's axis tables because
+    the analysis package must stay stdlib-only (it cannot import the
+    real ones). This pins the two copies together by PARSING mesh.py —
+    a one-mesh-refactor edit to MESH_AXES / _BASE_RULES /
+    _STRATEGY_RULES that forgets the mirror fails tier-1 here, not a
+    sharding bug three PRs later."""
+    from bert_pytorch_tpu.analysis import axes as axes_registry
+
+    mesh_py = os.path.join(REPO_ROOT, "bert_pytorch_tpu", "parallel",
+                           "mesh.py")
+    with open(mesh_py) as fh:
+        tree = ast.parse(fh.read())
+
+    env = {}
+
+    def ev(node):
+        # The axis tables are literals plus references to the AXIS_*
+        # constants; anything richer (function calls, imports) aborts
+        # the evaluation of that assignment, which is then skipped.
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Name):
+            return env[node.id]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(ev(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return {ev(k): ev(v) for k, v in zip(node.keys, node.values)}
+        raise KeyError(ast.dump(node))
+
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            try:
+                env[stmt.targets[0].id] = ev(stmt.value)
+            except KeyError:
+                pass
+
+    assert {k: env[k] for k in axes_registry.AXIS_CONSTANTS} \
+        == axes_registry.AXIS_CONSTANTS
+    assert env["MESH_AXES"] == axes_registry.MESH_AXES
+    assert env["_BASE_RULES"] == axes_registry.BASE_RULES
+    assert env["_STRATEGY_RULES"] == axes_registry.STRATEGY_RULES
 
 
 # -- the unified gate ----------------------------------------------------
