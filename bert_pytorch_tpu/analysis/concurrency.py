@@ -138,6 +138,13 @@ REGISTRY: Tuple[Entry, ...] = (
           why="HTTP workers append, the dispatch thread drains; "
               "_take_head_task_locked is called with _cond held (the "
               "_locked suffix is the contract its name states)"),
+    Entry("bert_pytorch_tpu/serve/batcher.py", "_inflight",
+          cls="Batcher", kind="lock", locks=("_cond", "_lock"),
+          allow=("_take_head_task_locked",),
+          why="popped-but-unfinished accounting: the dispatch thread "
+              "pops/requeues/finishes while stop()'s drain loop reads "
+              "unfinished() from another thread (the requeue-during-"
+              "drain fix, PR 11)"),
     Entry("bert_pytorch_tpu/serve/batcher.py", "depth_max",
           cls="Batcher", kind="lock", locks=("_cond", "_lock"),
           why="gauge updated under submit/requeue, read by telemetry"),
@@ -190,6 +197,43 @@ REGISTRY: Tuple[Entry, ...] = (
           cls="ServeTelemetry", kind="lock", locks=("_lock",),
           why="attached once by the service before dispatch starts, read "
               "by snapshot()/finish() on scrape and shutdown threads"),
+
+    # -- serve/router.py: scrape thread vs router worker threads -----------
+    # One lock guards the whole router: the background scrape rewrites
+    # replica health while every concurrent request thread balances on
+    # it (_admit/_pick_hedge), feeds the latency history, and bumps the
+    # window/run counters; dispatch/hedge worker threads release
+    # in-flight slots through the same table.
+    Entry("bert_pytorch_tpu/serve/router.py", "_replicas",
+          cls="Router", kind="lock", locks=("_lock",),
+          allow=("_window_record_locked",),
+          why="scrape thread rewrites health/queue gauges while request "
+              "threads pick replicas and mutate inflight counts; "
+              "_window_record_locked runs with _lock held (the _locked "
+              "suffix is its contract)"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_latencies",
+          cls="Router", kind="lock", locks=("_lock",),
+          why="dispatch worker threads append successful-request "
+              "latencies while request threads read the hedge-threshold "
+              "percentile from them"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_win",
+          cls="Router", kind="lock", locks=("_lock",),
+          why="window accumulator: every request thread folds its "
+              "outcome in; flush_window (any thread) swaps it out"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_run",
+          cls="Router", kind="lock", locks=("_lock",),
+          why="run-level accumulator shared by request threads and "
+              "/statsz snapshot readers"),
+
+    # -- serve/supervisor.py: monitor thread vs control-plane callers ------
+    # The replica table (and every _Replica field reached through it) is
+    # written by the monitor thread's poll pass while start/stop/status
+    # run on the caller's thread.
+    Entry("bert_pytorch_tpu/serve/supervisor.py", "_replicas",
+          cls="Supervisor", kind="lock", locks=("_lock",),
+          why="monitor thread reaps/restarts/kills replicas while "
+              "start()/stop()/status() read and mutate the same table "
+              "from control-plane threads"),
 
     # -- utils/logging.py: the JSONL sink background emitters write --------
     Entry("bert_pytorch_tpu/utils/logging.py", "_f",

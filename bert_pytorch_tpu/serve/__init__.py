@@ -15,8 +15,11 @@ plane (:class:`TraceCollector`, :mod:`~bert_pytorch_tpu.serve.tracing`).
 from bert_pytorch_tpu.serve.batcher import Batcher, BatcherFull, Request
 from bert_pytorch_tpu.serve.engine import BatchPlan, InferenceEngine, TaskSpec
 from bert_pytorch_tpu.serve.http import make_server
+from bert_pytorch_tpu.serve.router import (Router, RouterShed,
+                                           make_router_server)
 from bert_pytorch_tpu.serve.service import ServiceDraining, ServingService
 from bert_pytorch_tpu.serve.stats import ServeTelemetry
+from bert_pytorch_tpu.serve.supervisor import ReplicaSpec, Supervisor
 from bert_pytorch_tpu.serve.tasks import TASK_NAMES, build_handlers
 from bert_pytorch_tpu.serve.tracing import TraceCollector
 
@@ -25,13 +28,18 @@ __all__ = [
     "BatcherFull",
     "BatchPlan",
     "InferenceEngine",
+    "ReplicaSpec",
     "Request",
+    "Router",
+    "RouterShed",
     "ServeTelemetry",
     "ServiceDraining",
     "ServingService",
+    "Supervisor",
     "TaskSpec",
     "TraceCollector",
     "TASK_NAMES",
     "build_handlers",
+    "make_router_server",
     "make_server",
 ]

@@ -121,6 +121,15 @@ class Batcher:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closed = False
+        # Requests popped by the dispatch thread but not yet finished
+        # (fulfilled, errored, or requeued as plan leftovers). Drain
+        # (service.stop) must wait on pending + in-flight, not pending
+        # alone: depth() reads 0 the instant a batch is popped, and a
+        # stop() racing that window used to close the batcher while the
+        # dispatch thread still held requests whose plan leftovers it
+        # was about to requeue — stranding them with blocked waiters
+        # (the requeue-during-drain ordering bug, PR 11).
+        self._inflight = 0
         # Gauges for the serve telemetry window (serve/stats.py).
         self.depth_max = 0
         self.submitted = 0
@@ -143,13 +152,29 @@ class Batcher:
 
     def requeue_front(self, requests: List[Request]) -> None:
         """Return requests a partial dispatch could not fit to the FRONT of
-        the queue (they are the oldest; FIFO order is preserved)."""
+        the queue (they are the oldest; FIFO order is preserved). They
+        move from in-flight back to pending, so :meth:`unfinished` never
+        dips while a leftover is in transit — the drain loop's evidence.
+        """
         if not requests:
             return
         with self._cond:
             self._pending[:0] = requests
+            # max(0, ...): tests/offline callers may requeue requests
+            # they never popped; the counter must not go negative.
+            self._inflight = max(0, self._inflight - len(requests))
             self.depth_max = max(self.depth_max, len(self._pending))
             self._cond.notify()
+
+    def done(self, n: int) -> None:
+        """The dispatch thread finished ``n`` popped requests (result,
+        error, or abandoned-and-skipped — anything except a requeue,
+        which re-counts itself)."""
+        if n <= 0:
+            return
+        with self._cond:
+            self._inflight = max(0, self._inflight - n)
+            self._cond.notify_all()
 
     def close(self) -> None:
         with self._cond:
@@ -174,6 +199,7 @@ class Batcher:
             else:
                 keep.append(req)
         self._pending = keep
+        self._inflight += len(take)
         now = self._clock()
         for req in take:
             # Trace queue span: enqueued_at -> this pop (serve/tracing.py).
@@ -225,3 +251,21 @@ class Batcher:
     def depth(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def unfinished(self) -> int:
+        """Pending + in-flight: the requests the service still OWES an
+        answer. This — not :meth:`depth` — is what a graceful drain
+        waits on (depth alone reads 0 while a popped batch is being
+        processed, and its plan leftovers may be about to requeue)."""
+        with self._lock:
+            return len(self._pending) + self._inflight
+
+    def drain_remaining(self) -> List[Request]:
+        """Pop and return every still-pending request (drain-deadline
+        path, serve/service.py stop): the caller fails them
+        deterministically instead of leaving their submitters blocked
+        until the client-side timeout."""
+        with self._cond:
+            remaining, self._pending = self._pending, []
+            self._cond.notify_all()
+        return remaining

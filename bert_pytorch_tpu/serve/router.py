@@ -1,0 +1,734 @@
+"""Fleet router: health-gated balancing, bounded failover, hedging,
+brownout (docs/serving.md "Fleet tier", The Tail at Scale §"Within
+Request Short-Term Adaptations").
+
+The front tier in front of N serving replicas (serve/supervisor.py).
+One :class:`Router` owns a replica table fed by a background scrape of
+each replica's ``/metricsz`` gauges (queue depth, draining,
+dispatch-thread liveness — the signal surface PR 9 built) and serves
+the SAME JSON API the replicas do (``POST /v1/<task>``), so a client
+cannot tell one engine from a fleet. Per request it applies, in order:
+
+* **admission** — only replicas that are healthy (scrape fresh, dispatch
+  alive, not draining) are candidates; if every candidate's queue depth
+  is at the brownout threshold (or none are healthy at all) the request
+  is SHED with 503 + ``Retry-After`` rather than queued into a latency
+  cliff;
+* **least-queue-depth balancing** — among candidates, route to the
+  smallest (scraped queue depth + router-local in-flight);
+* **per-request deadline** — every dispatch, backoff, and hedge wait is
+  bounded by one deadline; when it passes the client gets a definite
+  answer, never a hang;
+* **bounded retry on a DIFFERENT replica** — a transport failure or
+  5xx puts the replica on the request's exclude list and the request on
+  the next-best candidate after a full-jitter backoff
+  (``utils/retry.py``), bounded by both an attempt count and the
+  deadline. 4xx answers are returned as-is (a bad payload is bad on
+  every replica; retrying it would triple the error load);
+* **hedged requests** — once enough latency history exists, a dispatch
+  that has outlived the configured percentile of recent latencies fires
+  ONE duplicate on the next-best replica and takes whichever answers
+  first — the tail-at-scale hedge, budgeted (one hedge per request,
+  only past the percentile) so added load stays a few percent.
+
+Every ``window`` completed requests emit one schema-v1 ``router_window``
+record (ok/shed/error decomposition, retry/hedge/failover counters,
+latency and failover percentiles) — telemetry-report's "router
+failover" gate reads them.
+
+Stdlib-only and dual-loadable by file path (tools/chaos_serve.py) like
+the supervisor: the router process must never need an accelerator
+runtime.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import http.server
+import json
+import os
+import queue
+import sys
+import threading
+import time
+import urllib.parse
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def _load_util(modname: str):
+    """See serve/supervisor.py — package import normally, file-path
+    import when this module itself was loaded by path (jax-free)."""
+    if __package__:
+        import importlib
+
+        return importlib.import_module(f"bert_pytorch_tpu.utils.{modname}")
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "utils", f"{modname}.py")
+    spec = importlib.util.spec_from_file_location(
+        f"_router_{modname}", path)
+    module = sys.modules.get(f"_router_{modname}")
+    if module is not None:
+        return module
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[f"_router_{modname}"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+RetryPolicy = _load_util("retry").RetryPolicy
+
+# Statuses worth trying on another replica: server-side trouble that is
+# plausibly replica-local (a draining or saturated or crashed replica).
+# Everything else — 2xx, 4xx — is final: the answer would be the same
+# fleet-wide, and retrying a client error only multiplies it.
+RETRYABLE_STATUSES = frozenset((500, 502, 503, 504))
+
+_SAMPLE_CAP = 512  # recent-latency history for the hedge threshold
+
+
+def _pctl(sorted_vals: List[float], frac: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              int(frac * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[idx]
+
+
+class ReplicaState:
+    """Router-side view of one replica (every field is read/written
+    under ``Router._lock``)."""
+
+    def __init__(self, url: str, index: int):
+        self.url = url.rstrip("/")
+        self.index = index
+        self.healthy = False        # never routed to until a good scrape
+        self.draining = False
+        self.dispatch_alive = False
+        self.queue_depth = 0
+        self.inflight = 0           # router-local outstanding dispatches
+        self.scrape_failures = 0
+        self.requests = 0           # routed to this replica (run total)
+
+    def eligible(self) -> bool:
+        return self.healthy and self.dispatch_alive and not self.draining
+
+
+class RouterShed(RuntimeError):
+    """Request shed by admission control (brownout / no healthy
+    replica); carries the Retry-After hint."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+def default_transport(url: str, task: str, payload: dict,
+                      timeout_s: float) -> Tuple[int, dict]:
+    """POST ``payload`` to ``url``/v1/``task``; returns (status, body).
+    Raises OSError-family errors on transport failure (connection
+    refused/reset, timeout) — the retry-on-another-replica signal."""
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(
+        parsed.hostname, parsed.port, timeout=max(0.05, timeout_s))
+    try:
+        body = json.dumps(payload).encode("utf-8")
+        conn.request("POST", f"/v1/{task}", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = resp.read()
+        try:
+            decoded = json.loads(data.decode("utf-8")) if data else {}
+        except ValueError:
+            decoded = {"error": data.decode("utf-8", "replace")[:200]}
+        return resp.status, decoded
+    finally:
+        conn.close()
+
+
+def default_scrape(url: str, timeout_s: float = 2.0) -> Optional[dict]:
+    """One replica health sample: the ``/metricsz`` gauges (queue depth,
+    draining, dispatch liveness) when the replica exports them, else the
+    ``/healthz`` JSON. None = unreachable."""
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(
+        parsed.hostname, parsed.port, timeout=timeout_s)
+    try:
+        try:
+            conn.request("GET", "/metricsz")
+            resp = conn.getresponse()
+            text = resp.read().decode("utf-8", "replace")
+        except OSError:
+            return None
+        if resp.status == 200:
+            gauges: Dict[str, float] = {}
+            for line in text.splitlines():
+                if line.startswith("bert_serve_") and " " in line:
+                    name, _, value = line.partition(" ")
+                    try:
+                        gauges[name] = float(value)
+                    except ValueError:
+                        continue
+            if "bert_serve_dispatch_alive" in gauges:
+                return {
+                    "dispatch_alive":
+                        gauges["bert_serve_dispatch_alive"] >= 1.0,
+                    "draining": gauges.get("bert_serve_draining", 0) >= 1.0,
+                    "queue_depth":
+                        int(gauges.get("bert_serve_queue_depth", 0)),
+                }
+        # No tracer on the replica (404) or gauges missing: /healthz
+        # carries the same liveness/drain/queue facts as JSON.
+        try:
+            conn.close()
+            conn.connect()
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            health = json.loads(resp.read().decode("utf-8", "replace"))
+        except (OSError, ValueError):
+            return None
+        return {
+            "dispatch_alive": bool(health.get("dispatch_alive")),
+            "draining": bool(health.get("draining")),
+            "queue_depth": int(health.get("queue_depth", 0)),
+        }
+    finally:
+        conn.close()
+
+
+class Router:
+    def __init__(
+        self,
+        replica_urls: Sequence[str],
+        emit: Optional[Callable[[dict], None]] = None,
+        window: int = 64,
+        transport: Callable[[str, str, dict, float],
+                            Tuple[int, dict]] = default_transport,
+        scrape: Callable[[str], Optional[dict]] = default_scrape,
+        scrape_interval_s: float = 0.5,
+        scrape_failures_unhealthy: int = 2,
+        deadline_s: float = 15.0,
+        retry_policy: Optional[RetryPolicy] = None,
+        hedge_pctl: float = 0.95,
+        hedge_min_ms: float = 20.0,
+        hedge_min_samples: int = 32,
+        brownout_queue_depth: int = 128,
+        shed_retry_after_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if not replica_urls:
+            raise ValueError("need at least one replica URL")
+        self._emit_fn = emit
+        self.window = max(1, int(window))
+        self._transport = transport
+        self._scrape = scrape
+        self.scrape_interval_s = float(scrape_interval_s)
+        self.scrape_failures_unhealthy = int(scrape_failures_unhealthy)
+        self.deadline_s = float(deadline_s)
+        # Full jitter + short base: a dead replica fails dozens of
+        # requests at the same instant, and their retries must not land
+        # on the survivor in one synchronized wave.
+        self.retry_policy = retry_policy or RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=1.0,
+            full_jitter=True)
+        self.hedge_pctl = float(hedge_pctl)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.hedge_min_samples = int(hedge_min_samples)
+        self.brownout_queue_depth = int(brownout_queue_depth)
+        self.shed_retry_after_s = float(shed_retry_after_s)
+        self._clock = clock
+        self._sleep = sleep
+        # One lock guards the replica table, the latency history, and
+        # the window/run counters: scrape thread + every router worker
+        # thread mutate them (concurrency registry,
+        # analysis/concurrency.py).
+        self._lock = threading.Lock()
+        self._replicas = [ReplicaState(url, i)
+                          for i, url in enumerate(replica_urls)]
+        self._latencies = collections.deque(maxlen=_SAMPLE_CAP)
+        self._win = self._zero_window()
+        self._run = self._zero_window()
+        self._stop_event = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _zero_window() -> dict:
+        return {"requests": 0, "ok": 0, "sheds": 0, "errors": 0,
+                "retries": 0, "hedges": 0, "hedge_wins": 0,
+                "failovers": 0, "latency_ms": [], "failover_ms": []}
+
+    # -- health scraping --------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background health scrape; do one synchronous pass
+        first so the router is immediately routable when replicas are
+        already up."""
+        self.scrape_once()
+        self._stop_event.clear()
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, name="router-scrape", daemon=True)
+        self._scrape_thread.start()
+
+    def _scrape_loop(self) -> None:
+        while not self._stop_event.is_set():
+            self.scrape_once()
+            self._sleep(self.scrape_interval_s)
+
+    def scrape_once(self) -> None:
+        """One health pass over every replica (public so tests and the
+        chaos harness can drive deterministic scrapes)."""
+        with self._lock:
+            targets = list(self._replicas)
+        # One thread per replica: each probe is bounded by the scrape
+        # transport's own timeout, and probing CONCURRENTLY makes the
+        # pass cost max(per-replica) instead of sum — one black-holed
+        # replica must not stale every other replica's gauges for its
+        # full timeout (the balancing and brownout decisions read them).
+        results: list = [None] * len(targets)
+
+        def probe(i: int, rep: ReplicaState) -> None:
+            try:
+                results[i] = (rep, self._scrape(rep.url))
+            except Exception:
+                results[i] = (rep, None)
+
+        threads = [threading.Thread(target=probe, args=(i, rep),
+                                    name="router-scrape-probe", daemon=True)
+                   for i, rep in enumerate(targets)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with self._lock:
+            for rep, health in results:
+                if health is None:
+                    rep.scrape_failures += 1
+                    if rep.scrape_failures >= \
+                            self.scrape_failures_unhealthy:
+                        rep.healthy = False
+                    continue
+                rep.scrape_failures = 0
+                rep.healthy = True
+                rep.dispatch_alive = bool(health.get("dispatch_alive"))
+                rep.draining = bool(health.get("draining"))
+                rep.queue_depth = int(health.get("queue_depth", 0))
+
+    # -- balancing / admission -------------------------------------------
+
+    def _admit(self, exclude: frozenset) -> ReplicaState:
+        """Least-loaded eligible replica, or raise :class:`RouterShed`
+        (brownout: every eligible replica saturated; outage: none
+        eligible at all)."""
+        with self._lock:
+            candidates = [rep for rep in self._replicas
+                          if rep.eligible() and rep.url not in exclude]
+            if not candidates:
+                raise RouterShed(
+                    "no healthy replica available", self.shed_retry_after_s)
+            if all(rep.queue_depth >= self.brownout_queue_depth
+                   for rep in candidates):
+                raise RouterShed(
+                    "every healthy replica is saturated "
+                    f"(queue depth >= {self.brownout_queue_depth}); "
+                    "brownout shed", self.shed_retry_after_s)
+            chosen = min(candidates,
+                         key=lambda r: (r.queue_depth + r.inflight,
+                                        r.inflight, r.index))
+            chosen.inflight += 1
+            chosen.requests += 1
+            return chosen
+
+    def _release(self, rep: ReplicaState, failed: bool) -> None:
+        with self._lock:
+            rep.inflight = max(0, rep.inflight - 1)
+            if failed:
+                # Fast feedback: don't route more requests here until a
+                # scrape proves it back; the scrape thread re-heals it.
+                rep.healthy = False
+
+    def _hedge_delay_s(self) -> Optional[float]:
+        """Seconds a dispatch may run before its hedge fires: the
+        configured percentile of recent latencies, floored at
+        ``hedge_min_ms``. None = hedging disabled (pctl <= 0) or not
+        enough history to know what 'slow' means yet."""
+        if self.hedge_pctl <= 0:
+            return None
+        with self._lock:
+            if len(self._latencies) < self.hedge_min_samples:
+                return None
+            samples = sorted(self._latencies)
+        return max(self.hedge_min_ms / 1000.0,
+                   _pctl(samples, self.hedge_pctl))
+
+    def note_latency(self, seconds: float) -> None:
+        """Feed one successful-dispatch latency into the hedge-threshold
+        history (also called internally on every success)."""
+        with self._lock:
+            self._latencies.append(float(seconds))
+
+    # -- the request path -------------------------------------------------
+
+    def handle(self, task: str, payload: dict
+               ) -> Tuple[int, dict, Dict[str, str]]:
+        """Route one request end to end: (status, body, extra headers).
+        Never raises — every outcome is an HTTP answer, including the
+        deadline (503), brownout (503 + Retry-After), and exhausted
+        retries (502)."""
+        t0 = self._clock()
+        deadline = t0 + self.deadline_s
+        exclude: set = set()
+        rounds = 0
+        failed_rounds = 0
+        hedges_fired = 0
+        while True:
+            try:
+                replica = self._admit(frozenset(exclude))
+            except RouterShed as shed:
+                self._observe(ok=False, shed=True, t0=t0,
+                              retries=failed_rounds, hedges=hedges_fired)
+                return 503, {"error": str(shed)}, {
+                    "Retry-After": f"{shed.retry_after_s:g}"}
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                self._release(replica, failed=False)
+                self._observe(ok=False, shed=False, t0=t0,
+                              retries=failed_rounds, hedges=hedges_fired)
+                return 503, {"error": "router deadline exceeded "
+                                      f"({self.deadline_s:g}s)"}, {}
+            rounds += 1
+            status, body, hedged, hedge_won, failed_urls = \
+                self._dispatch_hedged(
+                    replica, task, payload, remaining, exclude)
+            hedges_fired += 1 if hedged else 0
+            final = (status is not None
+                     and status not in RETRYABLE_STATUSES)
+            if final:
+                # A final 4xx is the router WORKING: a definitive answer
+                # relayed once (the same answer every replica would
+                # give). Only 5xx-class outcomes — and the deadline/
+                # exhausted-retry paths below — count into ``errors``,
+                # the zero-tolerance "client saw a server failure" gate
+                # (telemetry/report.py).
+                self._observe(ok=status < 500, shed=False, t0=t0,
+                              retries=failed_rounds,
+                              hedges=hedges_fired, hedge_won=hedge_won,
+                              failover=(failed_rounds > 0))
+                return status, body, {}
+            # Retryable failure: this replica (and any hedge target that
+            # also failed) is out of the running for THIS request.
+            failed_rounds += 1
+            exclude.add(replica.url)
+            exclude.update(failed_urls)
+            policy = self.retry_policy
+            if rounds >= policy.attempts:
+                self._observe(ok=False, shed=False, t0=t0,
+                              retries=failed_rounds, hedges=hedges_fired)
+                return 502, {
+                    "error": f"request failed on {rounds} replica(s) "
+                             f"(last status {status})"}, {}
+            backoff = policy.backoff_s(rounds - 1)
+            if self._clock() + backoff >= deadline:
+                self._observe(ok=False, shed=False, t0=t0,
+                              retries=failed_rounds, hedges=hedges_fired)
+                return 503, {"error": "router deadline exceeded during "
+                                      "failover backoff"}, {}
+            self._sleep(backoff)
+
+    def _dispatch_hedged(self, primary: ReplicaState, task: str,
+                         payload: dict, timeout_s: float, exclude: set
+                         ) -> Tuple[Optional[int], dict, bool, bool, set]:
+        """One dispatch round, possibly hedged: (status, body, hedged,
+        hedge_won, failed_urls). ``status`` None = transport-level
+        failure; ``failed_urls`` is every replica that failed in this
+        round (the caller's exclude list for the retry)."""
+        results: "queue.Queue" = queue.Queue()
+        launched_urls = {primary.url}
+        n_launched = 1
+        failed_urls: set = set()
+
+        def worker(rep: ReplicaState, is_hedge: bool) -> None:
+            start = self._clock()
+            try:
+                status, body = self._transport(
+                    rep.url, task, payload, timeout_s)
+            except Exception as exc:
+                self._release(rep, failed=True)
+                results.put((None, {"error": f"{type(exc).__name__}: "
+                                             f"{exc}"}, rep, is_hedge))
+                return
+            retryable = status in RETRYABLE_STATUSES
+            # A 503 is the replica ALIVE and telling us it is draining
+            # or saturated — exclude it for this request, but only the
+            # health scrape decides whether it stays routable.
+            self._release(rep, failed=(retryable and status != 503))
+            if not retryable:
+                self.note_latency(self._clock() - start)
+            results.put((status, body, rep, is_hedge))
+
+        threading.Thread(target=worker, args=(primary, False),
+                         name="router-dispatch", daemon=True).start()
+        start = self._clock()
+        deadline = start + timeout_s
+        hedge_delay = self._hedge_delay_s()
+        hedged = False
+        hedge_tried = False
+        failures = 0
+        first_failure: Optional[Tuple[Optional[int], dict]] = None
+        while True:
+            now = self._clock()
+            if now >= deadline:
+                break
+            wait = deadline - now
+            if not hedge_tried and hedge_delay is not None:
+                hedge_in = start + hedge_delay - now
+                if hedge_in <= 0:
+                    # The dispatch has outlived the configured
+                    # percentile: fire ONE hedge on the next-best
+                    # replica (if any remains) and race them. One
+                    # attempt per round whether or not a target exists
+                    # (hedge_tried) — ``hedged`` reports only a hedge
+                    # actually LAUNCHED, and is counted by the caller at
+                    # request completion (_observe), in the same lock
+                    # acquisition as a potential hedge_win: counting the
+                    # launch here let a window flush land between the
+                    # two and emit hedge_wins > hedges, a
+                    # schema-invalid record on a healthy run.
+                    hedge_tried = True
+                    hedge_rep = self._pick_hedge(
+                        exclude | launched_urls)
+                    if hedge_rep is not None:
+                        hedged = True
+                        launched_urls.add(hedge_rep.url)
+                        n_launched += 1
+                        threading.Thread(
+                            target=worker, args=(hedge_rep, True),
+                            name="router-hedge", daemon=True).start()
+                    continue
+                wait = min(wait, hedge_in)
+            try:
+                status, body, rep, is_hedge = results.get(
+                    timeout=max(0.001, wait))
+            except queue.Empty:
+                continue
+            if status is not None and status not in RETRYABLE_STATUSES:
+                return status, body, hedged, is_hedge, failed_urls
+            failures += 1
+            failed_urls.add(rep.url)
+            if first_failure is None:
+                first_failure = (status, body)
+            if failures >= n_launched:
+                # Everything launched has failed; a not-yet-fired hedge
+                # would only duplicate a request the retry path is
+                # about to place better.
+                break
+        if first_failure is not None:
+            status, body = first_failure
+        else:
+            status, body = None, {
+                "error": f"dispatch timed out after {timeout_s:.3f}s"}
+            failed_urls.add(primary.url)
+        return status, body, hedged, False, failed_urls
+
+    def _pick_hedge(self, exclude: set) -> Optional[ReplicaState]:
+        with self._lock:
+            candidates = [rep for rep in self._replicas
+                          if rep.eligible() and rep.url not in exclude]
+            if not candidates:
+                return None
+            chosen = min(candidates,
+                         key=lambda r: (r.queue_depth + r.inflight,
+                                        r.inflight, r.index))
+            chosen.inflight += 1
+            chosen.requests += 1
+            return chosen
+
+    # -- telemetry --------------------------------------------------------
+
+    def _observe(self, ok: bool, shed: bool, t0: float, retries: int = 0,
+                 hedges: int = 0, hedge_won: bool = False,
+                 failover: bool = False) -> None:
+        latency_ms = (self._clock() - t0) * 1000.0
+        with self._lock:
+            for acc in (self._win, self._run):
+                acc["requests"] += 1
+                acc["retries"] += retries
+                # Hedges launched by this request, folded in at the same
+                # instant as its potential hedge_win so hedge_wins <=
+                # hedges holds within EVERY window (schema invariant).
+                acc["hedges"] += hedges
+                if shed:
+                    acc["sheds"] += 1
+                elif ok:
+                    acc["ok"] += 1
+                    acc["latency_ms"].append(latency_ms)
+                    if failover:
+                        acc["failovers"] += 1
+                        acc["failover_ms"].append(latency_ms)
+                else:
+                    acc["errors"] += 1
+                if hedge_won:
+                    acc["hedge_wins"] += 1
+            due = self._win["requests"] >= self.window
+        if due:
+            self.flush_window()
+
+    def _window_record_locked(self, acc: dict) -> dict:
+        healthy = sum(1 for rep in self._replicas if rep.eligible())
+        record = {
+            "kind": "router_window", "tag": "router",
+            "window_requests": acc["requests"],
+            "ok": acc["ok"], "sheds": acc["sheds"],
+            "errors": acc["errors"], "retries": acc["retries"],
+            "hedges": acc["hedges"], "hedge_wins": acc["hedge_wins"],
+            "failovers": acc["failovers"],
+            "healthy_replicas": healthy,
+            "replicas": len(self._replicas),
+        }
+        lat = sorted(acc["latency_ms"])
+        if lat:
+            record.update(
+                latency_p50_ms=round(_pctl(lat, 0.50), 3),
+                latency_p95_ms=round(_pctl(lat, 0.95), 3),
+                latency_p99_ms=round(_pctl(lat, 0.99), 3))
+        fo = sorted(acc["failover_ms"])
+        if fo:
+            record.update(
+                failover_p50_ms=round(_pctl(fo, 0.50), 3),
+                failover_p95_ms=round(_pctl(fo, 0.95), 3))
+        return record
+
+    def flush_window(self) -> Optional[dict]:
+        """Emit (and return) the current router_window record; None when
+        the window is empty."""
+        with self._lock:
+            if not self._win["requests"]:
+                return None
+            record = self._window_record_locked(self._win)
+            self._win = self._zero_window()
+        if self._emit_fn is not None:
+            try:
+                self._emit_fn(record)
+            except Exception:
+                pass
+        return record
+
+    def snapshot(self) -> dict:
+        """Run-level rollup for the router's /statsz."""
+        with self._lock:
+            record = self._window_record_locked(self._run)
+            record["kind"] = "router_summary"
+            record.pop("window_requests")
+            record["requests"] = self._run["requests"]
+            record["replica_states"] = [{
+                "url": rep.url, "healthy": rep.healthy,
+                "draining": rep.draining, "queue_depth": rep.queue_depth,
+                "inflight": rep.inflight, "requests": rep.requests,
+            } for rep in self._replicas]
+        return record
+
+    def healthy_count(self) -> int:
+        with self._lock:
+            return sum(1 for rep in self._replicas if rep.eligible())
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def stop(self) -> None:
+        """Stop the scrape thread, flush the partial window, and emit
+        the run-level ``router_summary`` rollup (the exact-percentile
+        record telemetry-report prefers over re-aggregating windows)."""
+        self._stop_event.set()
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5.0)
+            self._scrape_thread = None
+        self.flush_window()
+        with self._lock:
+            routed_any = self._run["requests"] > 0
+        if self._emit_fn is not None and routed_any:
+            try:
+                self._emit_fn(self.snapshot())
+            except Exception:
+                pass
+
+
+# -- HTTP front end ---------------------------------------------------------
+# Deliberately self-contained (not serve/http.py, which imports the
+# engine stack): the router process never needs jax.
+
+MAX_BODY_BYTES = 1 << 20
+
+
+class RouterHTTPServer(http.server.ThreadingHTTPServer):
+    daemon_threads = True
+    router: Router = None
+
+
+def _make_router_handler():
+    class Handler(http.server.BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # telemetry is the log
+            pass
+
+        def _reply(self, code: int, payload: dict,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+            body = json.dumps(payload).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            router = self.server.router
+            if self.path == "/healthz":
+                healthy = router.healthy_count()
+                total = router.replica_count()
+                ok = healthy > 0
+                self._reply(200 if ok else 503, {
+                    "status": "ok" if ok else "no_healthy_replica",
+                    "healthy_replicas": healthy,
+                    "replicas": total,
+                })
+            elif self.path == "/statsz":
+                self._reply(200, router.snapshot())
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            router = self.server.router
+            if not self.path.startswith("/v1/"):
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            task = self.path[len("/v1/"):].strip("/")
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if length > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "payload too large"})
+                    return
+                payload = json.loads(
+                    self.rfile.read(length).decode("utf-8") or "{}")
+                if not isinstance(payload, dict):
+                    raise ValueError("payload must be a JSON object")
+            except ValueError as exc:
+                self._reply(400, {"error": f"bad JSON payload: {exc}"})
+                return
+            status, body, headers = router.handle(task, payload)
+            self._reply(status, body, headers)
+
+    return Handler
+
+
+def make_router_server(router: Router, host: str = "127.0.0.1",
+                       port: int = 8100) -> RouterHTTPServer:
+    """Build (but do not start) the router's HTTP server; ``port=0``
+    binds an ephemeral port (tests read ``server.server_address``)."""
+    server = RouterHTTPServer((host, port), _make_router_handler())
+    server.router = router
+    return server

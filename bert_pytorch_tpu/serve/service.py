@@ -32,6 +32,7 @@ from bert_pytorch_tpu.serve.batcher import Batcher, Request
 from bert_pytorch_tpu.serve.engine import InferenceEngine
 from bert_pytorch_tpu.serve.stats import ServeTelemetry
 from bert_pytorch_tpu.serve.tracing import TraceCollector
+from bert_pytorch_tpu.testing import faults
 
 
 class ServiceDraining(RuntimeError):
@@ -136,9 +137,23 @@ class ServingService:
         ``execute`` (the batch's jitted forward incl. device sync,
         shared), and ``postprocess`` (the request's own handler decode).
         """
+        popped = len(batch)
+        requeued = 0
+        try:
+            requeued = self._process_batch(batch)
+        finally:
+            # Everything popped that was not requeued is finished
+            # (result, error, or abandoned-and-skipped) — the batcher's
+            # in-flight accounting is what a graceful drain waits on
+            # (Batcher.unfinished; the requeue-during-drain fix).
+            self.batcher.done(popped - requeued)
+
+    def _process_batch(self, batch: List[Request]) -> int:
+        """The dispatch body; returns how many requests were requeued as
+        plan leftovers (the in-flight bookkeeping in the wrapper)."""
         batch = [r for r in batch if not r.abandoned]
         if not batch:
-            return
+            return 0
         entry = self._clock()
         for req in batch:
             if req.enqueued_at is None:
@@ -151,6 +166,7 @@ class ServingService:
         task = batch[0].task
         spec = self.engine.tasks[task]
         plan = self.engine.plan_batch(batch)
+        requeued = len(plan.leftover)
         if plan.leftover:
             self.batcher.requeue_front(plan.leftover)
         try:
@@ -162,7 +178,7 @@ class ServingService:
                 self.telemetry.observe_error()
                 if self.tracer is not None:
                     self.tracer.observe_error(task)
-            return
+            return requeued
         exec_done = self._clock()
         device_s = info["device_s"]
         budget = info["rows"] * info["bucket"]
@@ -228,6 +244,7 @@ class ServingService:
                 queue_depth=self.batcher.depth(),
                 compiles=info["compiles"],
             )
+        return requeued
 
     def _loop(self) -> None:
         # last_beat stays a local: heartbeat cadence state is owned by
@@ -238,6 +255,15 @@ class ServingService:
             batch = self.batcher.next_batch(timeout=0.1)
             if batch:
                 self.process_batch(batch)
+                # Chaos hook (testing/faults.py `wedge@N`): after N
+                # served requests this call never returns — the
+                # dispatch thread hangs with /healthz still answering
+                # 200, which is exactly the failure only the
+                # supervisor's heartbeat watchdog can catch. Inert
+                # (one dict lookup) unless a fault spec is armed.
+                faults.get_plan().serve_wedge_check(
+                    self.telemetry.request_count(),
+                    emit=self.telemetry.emit)
             if self._heartbeat is not None:
                 now = self._clock()
                 if now - last_beat >= self._heartbeat_interval_s:
@@ -321,10 +347,21 @@ class ServingService:
     def stop(self, drain_s: float = 2.0) -> None:
         """Graceful drain: stop accepting, flush already-queued requests
         for up to ``drain_s`` seconds, stop the dispatch thread, flush the
-        serve telemetry summary."""
+        serve telemetry summary.
+
+        The drain waits on :meth:`Batcher.unfinished` (pending PLUS
+        in-flight), not queue depth: depth reads 0 the moment a batch is
+        popped, and stopping in that window used to close the batcher
+        under a dispatch thread about to requeue plan leftovers —
+        stranding accepted requests with blocked waiters until their
+        client-side timeout. Any request still unserved when the drain
+        deadline passes (or when dispatch is dead) is now failed
+        DETERMINISTICALLY instead."""
         self.begin_drain()
         deadline = self._clock() + drain_s
-        while self.batcher.depth() and self._clock() < deadline:
+        while self.batcher.unfinished() and self._clock() < deadline:
+            if not self.dispatch_alive:
+                break  # nobody is left to finish them; fail them below
             time.sleep(0.01)
         self._stop.set()
         self.batcher.close()
@@ -334,6 +371,20 @@ class ServingService:
             thread, self._thread = self._thread, None
         if thread is not None:
             thread.join(timeout=5.0)
+        # Deterministic drain flush: whatever the dispatch thread never
+        # got to (drain deadline passed, or dispatch died) gets an
+        # explicit error NOW — a blocked submitter wakes immediately
+        # with a 500-class answer instead of timing out.
+        stranded = self.batcher.drain_remaining()
+        if stranded:
+            now = self._clock()
+            for req in stranded:
+                req.set_error(
+                    "service stopped before this request was dispatched "
+                    "(drain deadline)", now)
+                self.telemetry.observe_error()
+                if self.tracer is not None:
+                    self.tracer.observe_error(req.task)
         self.telemetry.finish()  # also flushes the attached tracer
         if self._heartbeat is not None and (
                 thread is None or not thread.is_alive()):

@@ -1,0 +1,471 @@
+"""Replica supervisor: own N serving subprocesses, keep them alive
+(docs/serving.md "Fleet tier", docs/fault_tolerance.md "Serve failover").
+
+A single serving engine process is a single point of failure — the fleet
+tier's first primitive (The Tail at Scale, Dean & Barroso 2013) is
+simply N replicas that something RESTARTS. The :class:`Supervisor` owns
+one subprocess per :class:`ReplicaSpec` (a ``run_server.py`` on its own
+port, warmed from the shared persistent AOT compile cache so a restart
+costs seconds, not a recompile — PR 8's zero-cold-compile property is
+what makes supervision worth having) and runs one monitor thread that:
+
+* **reaps exits** — a replica that died is classified by exit code:
+  ``EXIT_PREEMPTED`` (75) means a SIGTERM-initiated drain finished
+  cleanly (run_server.py holds the training runners' preemption
+  contract); anything else is a crash and schedules a restart;
+* **applies restart-storm backoff** — consecutive crash restarts walk a
+  full-jitter exponential schedule (``utils/retry.py RetryPolicy``) so a
+  crash-looping replica cannot hot-spin the host; a replica that stays
+  up ``stable_reset_s`` earns its backoff index back. After
+  ``policy.attempts`` consecutive crashes the supervisor GIVES UP on
+  that replica (emits the event; the router's health gate has long since
+  stopped routing to it);
+* **catches wedges the health check cannot** — a dispatch thread stuck
+  in a hung device call keeps ``/healthz`` answering 200 (the thread is
+  alive, just never finishing a batch). The supervisor instead watches
+  the replica's HEARTBEAT FILE (the same resumable liveness file the
+  training runners write; the serve dispatch loop beats it once per
+  second with its request count): a counter that stops advancing past
+  ``heartbeat_timeout_s`` gets the replica SIGKILLed and restarted —
+  the watchdog path ``tools/chaos_serve.py`` proves;
+* **optionally probes /healthz** — ``probe_failures_to_kill``
+  consecutive failed probes of a process that still looks alive also
+  force a restart (listener wedged while dispatch runs).
+
+Every decision emits a schema-v1 ``fleet_event`` record, so the chaos
+harness (and an operator reading the artifact) can reconstruct exactly
+what the supervisor saw and did.
+
+This module is **stdlib-only and dual-loadable**: imported normally it
+is part of the serve package; loaded by FILE PATH (tools/_bootstrap.py)
+it pulls its two utility dependencies the same way, so the jax-free
+chaos/fleet parents never execute the package ``__init__`` chain — a
+hung accelerator runtime can hang a REPLICA (which the watchdog kills),
+never the supervisor itself.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
+
+
+def _load_util(modname: str):
+    """Import a stdlib-only ``utils/`` sibling both ways: through the
+    package when this module was imported normally, by file path when
+    this module was itself loaded by path (the package ``__init__``
+    chain imports jax — the property tools/chaos_serve.py needs)."""
+    if __package__:
+        import importlib
+
+        return importlib.import_module(f"bert_pytorch_tpu.utils.{modname}")
+    import importlib.util
+
+    module = sys.modules.get(f"_fleet_{modname}")
+    if module is not None:
+        return module
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "utils", f"{modname}.py")
+    spec = importlib.util.spec_from_file_location(f"_fleet_{modname}", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[f"_fleet_{modname}"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+RetryPolicy = _load_util("retry").RetryPolicy
+EXIT_PREEMPTED = _load_util("preemption").EXIT_PREEMPTED
+
+# Replica lifecycle states (status()/fleet_event records).
+STARTING = "starting"    # spawned; no heartbeat observed yet
+RUNNING = "running"      # heartbeat advancing / probe ok
+BACKOFF = "backoff"      # crashed; restart scheduled
+FAILED = "failed"        # gave up (restart storm exhausted the policy)
+STOPPED = "stopped"      # drained/stopped by the supervisor
+
+
+class ReplicaSpec:
+    """One replica's immutable launch description."""
+
+    def __init__(self, index: int, port: int, cmd: Sequence[str],
+                 heartbeat_file: Optional[str] = None,
+                 env: Optional[dict] = None,
+                 host: str = "127.0.0.1"):
+        self.index = int(index)
+        self.port = int(port)
+        self.cmd = list(cmd)
+        self.heartbeat_file = heartbeat_file
+        self.env = dict(env) if env is not None else None
+        self.host = host
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+def run_server_command(port: int, output_dir: str,
+                       extra_args: Sequence[str],
+                       python: Optional[str] = None,
+                       script: Optional[str] = None) -> List[str]:
+    """The ``run_server.py`` argv for one replica: shared engine/model
+    flags (``extra_args``) plus the per-replica port and output dir (the
+    telemetry JSONL and the heartbeat file the supervisor watches both
+    default under it)."""
+    if script is None:
+        script = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), "run_server.py")
+    return ([python or sys.executable, script, *extra_args,
+             "--port", str(port), "--output_dir", output_dir])
+
+
+class _Replica:
+    """Mutable runtime state for one supervised subprocess (internal;
+    every field is read/written under ``Supervisor._lock``)."""
+
+    def __init__(self, spec: ReplicaSpec):
+        self.spec = spec
+        self.proc = None
+        self.state = STOPPED
+        self.restarts = 0            # total spawns beyond the first
+        self.consecutive = 0         # crash restarts since last stable run
+        self.rapid_graceful = 0      # consecutive graceful exits that
+                                     # never reached stable_reset_s
+        self.started_at = 0.0
+        self.restart_at: Optional[float] = None
+        self.last_rc: Optional[int] = None
+        self.hb_counter: Optional[int] = None
+        self.hb_advance_at = 0.0     # clock time the counter last moved
+        self.probe_failures = 0
+
+
+class Supervisor:
+    """Keep ``specs``'s replica subprocesses alive until :meth:`stop`.
+
+    Every collaborator is injectable for deterministic tests: ``spawn``
+    (a ``subprocess.Popen``-alike factory), ``probe`` (url -> health
+    dict or None), ``read_heartbeat`` (spec -> counter int or None),
+    ``clock``/``sleep``. Production uses the defaults.
+    """
+
+    def __init__(
+        self,
+        specs: Sequence[ReplicaSpec],
+        emit: Optional[Callable[[dict], None]] = None,
+        spawn: Optional[Callable[[ReplicaSpec], object]] = None,
+        policy: Optional[RetryPolicy] = None,
+        heartbeat_timeout_s: float = 15.0,
+        startup_grace_s: float = 120.0,
+        stable_reset_s: float = 30.0,
+        probe: Optional[Callable[[str], Optional[dict]]] = None,
+        probe_failures_to_kill: int = 3,
+        poll_interval_s: float = 0.5,
+        drain_grace_s: float = 15.0,
+        read_heartbeat: Optional[Callable[[ReplicaSpec],
+                                          Optional[int]]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if not specs:
+            raise ValueError("need at least one ReplicaSpec")
+        self._emit_fn = emit
+        self._spawn = spawn or self._default_spawn
+        # Full jitter: when a shared cause (OOM, bad rollout) crashes
+        # several replicas at once, their restart storms must not march
+        # in lockstep against the same compile cache / port range.
+        self.policy = policy or RetryPolicy(
+            attempts=6, base_delay_s=0.5, max_delay_s=30.0,
+            full_jitter=True)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.startup_grace_s = float(startup_grace_s)
+        self.stable_reset_s = float(stable_reset_s)
+        self._probe = probe
+        self.probe_failures_to_kill = int(probe_failures_to_kill)
+        self.poll_interval_s = float(poll_interval_s)
+        self.drain_grace_s = float(drain_grace_s)
+        self._read_heartbeat = read_heartbeat or self._heartbeat_counter
+        self._clock = clock
+        self._sleep = sleep
+        # Guards _replicas (and every _Replica field): the monitor
+        # thread mutates replica state while start()/stop()/status()
+        # callers read it (concurrency registry, analysis/concurrency.py).
+        self._lock = threading.Lock()
+        self._replicas = [_Replica(spec) for spec in specs]
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- telemetry --------------------------------------------------------
+
+    def _emit(self, event: str, replica: _Replica, **extra) -> None:
+        if self._emit_fn is None:
+            return
+        record = {"kind": "fleet_event", "tag": "fleet", "event": event,
+                  "replica": replica.spec.index,
+                  "port": replica.spec.port}
+        record.update(extra)
+        try:
+            self._emit_fn(record)
+        except Exception:
+            pass  # observability must never take the fleet down
+
+    # -- default collaborators -------------------------------------------
+
+    @staticmethod
+    def _default_spawn(spec: ReplicaSpec):
+        env = dict(os.environ)
+        if spec.env:
+            env.update(spec.env)
+        return subprocess.Popen(spec.cmd, env=env)
+
+    @staticmethod
+    def _heartbeat_counter(spec: ReplicaSpec) -> Optional[int]:
+        """The replica's heartbeat counter (telemetry/sentinels.py
+        Heartbeat writes it atomically); None = no/torn file, treated
+        as "no evidence of liveness"."""
+        if not spec.heartbeat_file:
+            return None
+        try:
+            with open(spec.heartbeat_file) as f:
+                return int(json.load(f).get("counter", 0))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self, monitor: bool = True) -> None:
+        """Spawn every replica and the monitor thread. ``monitor=False``
+        skips the thread — fake-clock tests drive :meth:`poll_once`
+        themselves."""
+        now = self._clock()
+        with self._lock:
+            for rep in self._replicas:
+                if rep.proc is None:
+                    self._spawn_locked(rep, now)
+        self._stop_event.clear()
+        if monitor:
+            self._thread = threading.Thread(
+                target=self._loop, name="fleet-supervisor", daemon=True)
+            self._thread.start()
+
+    def _spawn_locked(self, rep: _Replica, now: float) -> None:
+        rep.proc = self._spawn(rep.spec)
+        rep.state = STARTING
+        rep.started_at = now
+        rep.restart_at = None
+        # Baseline the heartbeat BEFORE the new process beats: the file
+        # survives restarts (the counter resumes from it), so the dead
+        # predecessor's last value must not read as an "advance" — that
+        # would flip a still-warming replica to RUNNING and arm the
+        # short wedge timeout against its startup time.
+        rep.hb_counter = self._read_heartbeat(rep.spec)
+        rep.hb_advance_at = now
+        rep.probe_failures = 0
+        self._emit("spawn", rep, restarts=rep.restarts,
+                   pid=getattr(rep.proc, "pid", None))
+
+    def _loop(self) -> None:
+        while not self._stop_event.is_set():
+            self.poll_once()
+            self._sleep(self.poll_interval_s)
+
+    # -- the monitoring pass (public for fake-clock tests) ---------------
+
+    def poll_once(self) -> None:
+        """One monitoring pass over every replica: reap exits, schedule
+        and execute backoff restarts, kill wedged processes."""
+        now = self._clock()
+        with self._lock:
+            for rep in self._replicas:
+                self._poll_replica_locked(rep, now)
+
+    def _poll_replica_locked(self, rep: _Replica, now: float) -> None:
+        if rep.state == FAILED or (rep.state == STOPPED
+                                   and rep.proc is None):
+            return
+        if rep.state == BACKOFF:
+            if rep.restart_at is not None and now >= rep.restart_at:
+                rep.restarts += 1
+                self._spawn_locked(rep, now)
+            return
+        proc = rep.proc
+        if proc is None:
+            return
+        rc = proc.poll()
+        if rc is not None:
+            self._handle_exit_locked(rep, rc, now)
+            return
+        # Alive: fold in heartbeat progress, then the wedge/probe checks.
+        counter = self._read_heartbeat(rep.spec)
+        if counter is not None and counter != rep.hb_counter:
+            rep.hb_counter = counter
+            rep.hb_advance_at = now
+            if rep.state == STARTING:
+                rep.state = RUNNING
+            # A stable stretch pays the restart-storm debt back (and
+            # re-earns the free graceful respawn).
+            if ((rep.consecutive or rep.rapid_graceful)
+                    and now - rep.started_at >= self.stable_reset_s):
+                rep.consecutive = 0
+                rep.rapid_graceful = 0
+        if rep.spec.heartbeat_file:
+            limit = (self.heartbeat_timeout_s if rep.state == RUNNING
+                     else self.startup_grace_s)
+            age = now - max(rep.hb_advance_at, rep.started_at)
+            if age > limit:
+                self._emit("wedged_kill", rep,
+                           heartbeat_age_s=round(age, 3),
+                           requests=rep.hb_counter)
+                self._kill_locked(rep)
+                self._schedule_restart_locked(rep, now, crash=True,
+                                              reason="wedged")
+                return
+        if self._probe is not None and rep.state == RUNNING:
+            health = None
+            try:
+                health = self._probe(rep.spec.url)
+            except Exception:
+                health = None
+            ok = bool(health) and health.get("status") in ("ok", "draining")
+            rep.probe_failures = 0 if ok else rep.probe_failures + 1
+            if rep.probe_failures >= self.probe_failures_to_kill:
+                self._emit("probe_kill", rep,
+                           failures=rep.probe_failures)
+                self._kill_locked(rep)
+                self._schedule_restart_locked(rep, now, crash=True,
+                                              reason="probe")
+
+    def _handle_exit_locked(self, rep: _Replica, rc: int,
+                            now: float) -> None:
+        rep.last_rc = rc
+        rep.proc = None
+        graceful = rc in (0, EXIT_PREEMPTED)
+        self._emit("exit", rep, rc=rc, graceful=graceful,
+                   uptime_s=round(now - rep.started_at, 3))
+        if self._stop_event.is_set():
+            rep.state = STOPPED
+            return
+        # A replica that drained on an external SIGTERM still leaves the
+        # fleet a replica short — the supervisor's contract is N alive,
+        # so graceful exits respawn too, just WITHOUT burning the
+        # restart-storm budget (the exit was asked for, not a crash).
+        # ONE free graceful respawn per stable stretch, though: a
+        # replica that keeps exiting 0/75 within stable_reset_s of each
+        # spawn is a crash loop wearing a polite exit code (a config
+        # that drains instantly, an external agent SIGTERMing every
+        # startup), and a zero-backoff respawn every poll tick is
+        # exactly the storm the backoff schedule exists to prevent.
+        if graceful:
+            rapid = (now - rep.started_at) < self.stable_reset_s
+            churn = rapid and rep.rapid_graceful > 0
+            rep.rapid_graceful = rep.rapid_graceful + 1 if rapid else 0
+            self._schedule_restart_locked(
+                rep, now, crash=churn,
+                reason="graceful_churn" if churn else "exit")
+        else:
+            self._schedule_restart_locked(rep, now, crash=True,
+                                          reason="exit")
+
+    def _schedule_restart_locked(self, rep: _Replica, now: float,
+                                 crash: bool, reason: str) -> None:
+        if crash:
+            if rep.consecutive + 1 >= self.policy.attempts:
+                rep.state = FAILED
+                self._emit("gave_up", rep, restarts=rep.restarts,
+                           consecutive=rep.consecutive + 1)
+                return
+            backoff = self.policy.backoff_s(rep.consecutive)
+            rep.consecutive += 1
+        else:
+            backoff = 0.0
+        rep.state = BACKOFF
+        rep.restart_at = now + backoff
+        self._emit("restart_scheduled", rep, backoff_s=round(backoff, 3),
+                   restarts=rep.restarts, crash=crash, reason=reason)
+
+    def _kill_locked(self, rep: _Replica) -> None:
+        proc = rep.proc
+        rep.proc = None
+        if proc is None:
+            return
+        try:
+            proc.kill()
+            proc.wait(timeout=10.0)
+        except Exception:
+            pass
+
+    # -- drain / stop -----------------------------------------------------
+
+    def stop(self) -> dict:
+        """Drain the fleet: SIGTERM every replica, wait up to
+        ``drain_grace_s`` for the preemption-contract exits (rc 75 /
+        0), SIGKILL stragglers, join the monitor thread. Returns a
+        summary the chaos harness asserts on: per-replica final rc and
+        whether every live replica drained gracefully."""
+        self._stop_event.set()
+        with self._lock:
+            live = [rep for rep in self._replicas if rep.proc is not None]
+            for rep in live:
+                self._emit("drain", rep)
+                try:
+                    rep.proc.send_signal(signal.SIGTERM)
+                except Exception:
+                    pass
+        deadline = self._clock() + self.drain_grace_s
+        while self._clock() < deadline:
+            with self._lock:
+                waiting = False
+                for rep in self._replicas:
+                    if rep.proc is None:
+                        continue
+                    rc = rep.proc.poll()
+                    if rc is None:
+                        waiting = True
+                    else:
+                        self._handle_exit_locked(rep, rc, self._clock())
+            if not waiting:
+                break
+            self._sleep(min(0.05, self.poll_interval_s))
+        killed = 0
+        with self._lock:
+            for rep in self._replicas:
+                if rep.proc is not None:
+                    killed += 1
+                    self._emit("drain_kill", rep)
+                    self._kill_locked(rep)
+                    rep.state = STOPPED
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        with self._lock:
+            rcs = {rep.spec.index: rep.last_rc for rep in self._replicas}
+        graceful = all(rc in (0, EXIT_PREEMPTED)
+                       for rc in rcs.values() if rc is not None)
+        return {"rcs": rcs, "drain_killed": killed,
+                "all_graceful": graceful and killed == 0}
+
+    # -- introspection ----------------------------------------------------
+
+    def status(self) -> List[dict]:
+        """Per-replica snapshot (state, restarts, pid, port) under the
+        lock — what the chaos harness and tests assert on."""
+        with self._lock:
+            return [{
+                "replica": rep.spec.index,
+                "port": rep.spec.port,
+                "url": rep.spec.url,
+                "state": rep.state,
+                "restarts": rep.restarts,
+                "consecutive_crashes": rep.consecutive,
+                "pid": getattr(rep.proc, "pid", None),
+                "last_rc": rep.last_rc,
+                "heartbeat_counter": rep.hb_counter,
+            } for rep in self._replicas]
+
+    def replica_urls(self) -> List[str]:
+        with self._lock:
+            return [rep.spec.url for rep in self._replicas]

@@ -111,7 +111,10 @@ def summarize_records(records, name: str = "") -> dict:
     serve_traces = []
     faults = []
     resumes = []
+    router_windows = []
+    fleet_events = []
     serve_summary: Optional[dict] = None
+    router_summary: Optional[dict] = None
     run_summary: Optional[dict] = None
     n_records = 0
     for rec in records:
@@ -143,6 +146,12 @@ def summarize_records(records, name: str = "") -> dict:
             faults.append(rec)
         elif kind == "resume":
             resumes.append(rec)
+        elif kind == "router_window":
+            router_windows.append(rec)
+        elif kind == "router_summary":
+            router_summary = rec
+        elif kind == "fleet_event":
+            fleet_events.append(rec)
         elif kind == "run_summary":
             run_summary = rec
 
@@ -407,6 +416,65 @@ def summarize_records(records, name: str = "") -> dict:
         if modes:
             out["serve_quantize"] = ",".join(modes)
 
+    # -- fleet record family (serve/router.py, serve/supervisor.py) -----
+    # Router traffic follows the serve conventions: the run-level
+    # router_summary is exact when the router stopped cleanly; otherwise
+    # aggregate the windows (sums for counters, weighted-median p50,
+    # max for tails — a failover spike anywhere in the run must not
+    # average away). ``router_failover_p95_ms`` is the metric behind the
+    # "router failover" gate: the client-visible latency of requests
+    # that needed a different replica than first chosen.
+    if router_summary is not None:
+        for src, dst in (("requests", "router_requests"),
+                         ("ok", "router_ok"),
+                         ("sheds", "router_sheds"),
+                         ("errors", "router_errors"),
+                         ("retries", "router_retries"),
+                         ("hedges", "router_hedges"),
+                         ("hedge_wins", "router_hedge_wins"),
+                         ("failovers", "router_failovers"),
+                         ("latency_p50_ms", "router_latency_p50_ms"),
+                         ("latency_p95_ms", "router_latency_p95_ms"),
+                         ("failover_p95_ms", "router_failover_p95_ms")):
+            if router_summary.get(src) is not None:
+                out[dst] = router_summary[src]
+    elif router_windows:
+        for src, dst in (("window_requests", "router_requests"),
+                         ("ok", "router_ok"),
+                         ("sheds", "router_sheds"),
+                         ("errors", "router_errors"),
+                         ("retries", "router_retries"),
+                         ("hedges", "router_hedges"),
+                         ("hedge_wins", "router_hedge_wins"),
+                         ("failovers", "router_failovers")):
+            out[dst] = sum(int(w.get(src, 0)) for w in router_windows)
+        p50 = _weighted_median(
+            [(float(w["latency_p50_ms"]), int(w.get("window_requests", 1)))
+             for w in router_windows if "latency_p50_ms" in w])
+        if p50 is not None:
+            out["router_latency_p50_ms"] = round(p50, 3)
+        for key, dst in (("latency_p95_ms", "router_latency_p95_ms"),
+                         ("failover_p95_ms", "router_failover_p95_ms")):
+            vals = [float(w[key]) for w in router_windows if key in w]
+            if vals:
+                out[dst] = round(max(vals), 3)
+    # Supervisor history: operational counts by decision type — "how
+    # often did something need restarting, and did anything get given up
+    # on" is answerable offline from the artifact alone.
+    if fleet_events:
+        out["fleet_events"] = len(fleet_events)
+        by_event: dict = {}
+        for rec in fleet_events:
+            name = str(rec.get("event", "?"))
+            by_event[name] = by_event.get(name, 0) + 1
+        out["fleet_event_kinds"] = dict(sorted(by_event.items()))
+        out["fleet_spawns"] = by_event.get("spawn", 0)
+        out["fleet_crash_restarts"] = sum(
+            1 for rec in fleet_events
+            if rec.get("event") == "restart_scheduled" and rec.get("crash"))
+        out["fleet_wedged_kills"] = by_event.get("wedged_kill", 0)
+        out["fleet_gave_up"] = by_event.get("gave_up", 0)
+
     if run_summary:
         for key, value in run_summary.items():
             if key in ("schema", "ts", "kind", "tag"):
@@ -454,6 +522,14 @@ _CHECKS = (
     # restarted replica is recompiling (cache key drift — e.g. a renamed
     # forward — or the persistence bar filtering serve executables).
     ("serve_cold_start_s", "serve cold start", "up", "p95"),
+    # Fleet-tier gates (serve/router.py, docs/serving.md "Fleet tier"):
+    # the "router failover" gate is the resilience acceptance — the
+    # client-visible latency of requests that had to fail over to a
+    # different replica. It growing past tolerance means recovery is
+    # slipping (retry backoff too slow, health gate too stale, hedge not
+    # firing) even while the healthy-path latency stays flat.
+    ("router_failover_p95_ms", "router failover p95", "up", "p95"),
+    ("router_latency_p95_ms", "router p95 latency", "up", "p95"),
 )
 
 
@@ -491,9 +567,14 @@ def compare(base: dict, new: dict, tolerances: Optional[dict] = None):
     # here too: a warm-cache baseline (0 cold compiles) against a run
     # that recompiled is the cold-start acceptance breaking, no matter
     # how fast the recompiles happened to be.
+    # router_errors (exhausted failover: a client saw a 5xx) and
+    # fleet_gave_up (a replica crash-looped past the restart budget) are
+    # zero in any healthy run, so any new occurrence is a regression.
     for key, label in (("nonfinite_steps", "non-finite steps"),
                        ("divergence_warnings", "divergence warnings"),
-                       ("serve_compiles_cold", "serve cold compiles")):
+                       ("serve_compiles_cold", "serve cold compiles"),
+                       ("router_errors", "router client-visible errors"),
+                       ("fleet_gave_up", "fleet replicas given up")):
         b, n = int(base.get(key, 0)), int(new.get(key, 0))
         if n > b:
             entry = {"metric": key, "label": label, "base": b, "new": n,
@@ -535,6 +616,13 @@ def format_summary(summary: dict) -> str:
              "serve_postprocess_p95_ms", "serve_traces",
              "serve_traces_slow", "serve_slo_target_ms", "serve_slo_p99_ms",
              "serve_slo_over", "serve_slo_budget_burn", "serve_slo_verdict",
+             "router_requests", "router_ok", "router_sheds",
+             "router_errors", "router_retries", "router_hedges",
+             "router_hedge_wins", "router_failovers",
+             "router_latency_p50_ms", "router_latency_p95_ms",
+             "router_failover_p95_ms",
+             "fleet_events", "fleet_spawns", "fleet_crash_restarts",
+             "fleet_wedged_kills", "fleet_gave_up",
              "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",
@@ -549,6 +637,10 @@ def format_summary(summary: dict) -> str:
                      + ", ".join(f"{k}={v}" for k, v
                                  in summary["serve_critical_path"].items())
                      + " (dominant phase, slowest decile)")
+    if summary.get("fleet_event_kinds"):
+        lines.append(f"  {'fleet_event_kinds':>22}: "
+                     + ", ".join(f"{k}={v}" for k, v
+                                 in summary["fleet_event_kinds"].items()))
     if summary.get("fault_kinds"):
         lines.append(f"  {'fault_kinds':>22}: "
                      + ", ".join(summary["fault_kinds"]))

@@ -113,6 +113,27 @@ KIND_REQUIRED_KEYS = {
         "postprocess_p50_ms", "postprocess_p95_ms",
         "total_p50_ms", "total_p95_ms", "total_p99_ms",
     ),
+    # -- fleet record family (serve/supervisor.py, serve/router.py,
+    # docs/serving.md "Fleet tier") ------------------------------------
+    # one supervisor decision about one replica: spawn, exit (with rc
+    # and graceful classification), restart_scheduled (with backoff),
+    # wedged_kill/probe_kill (watchdog), gave_up, drain/drain_kill
+    "fleet_event": ("event", "replica", "port"),
+    # one window of routed traffic: the ok/shed/error decomposition plus
+    # the tail-at-scale counters (retries, hedges, failovers) and the
+    # failover-latency percentiles the "router failover" report gate
+    # reads (serve/router.py)
+    "router_window": (
+        "window_requests", "ok", "sheds", "errors",
+        "retries", "hedges", "hedge_wins", "failovers",
+        "healthy_replicas", "replicas",
+    ),
+    # run-level router rollup (the router's /statsz shape)
+    "router_summary": (
+        "requests", "ok", "sheds", "errors",
+        "retries", "hedges", "hedge_wins", "failovers",
+        "healthy_replicas", "replicas",
+    ),
 }
 
 # serve_trace span names (serve/tracing.py PHASES, mirrored here so the
@@ -187,6 +208,10 @@ def validate_record(rec) -> list:
                     _check_fault_fields(rec, errors)
                 if kind == "resume":
                     _check_resume_fields(rec, errors)
+                if kind == "fleet_event":
+                    _check_fleet_fields(rec, errors)
+                if kind in ("router_window", "router_summary"):
+                    _check_router_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -411,6 +436,78 @@ def _check_fault_fields(rec, errors) -> None:
         errors.append(
             f"fault record 'injected' must be a boolean, got "
             f"{rec.get('injected')!r}")
+
+
+def _check_fleet_fields(rec, errors) -> None:
+    """fleet_event consistency (serve/supervisor.py): the event is a
+    non-empty string and the replica identity is a real non-negative
+    index — the chaos harness reconstructs the supervisor's decision
+    sequence from these and must be able to trust the join keys."""
+    event = rec.get("event")
+    if not isinstance(event, str) or not event:
+        errors.append(f"event must be a non-empty string, got {event!r}")
+    for key in ("replica", "port"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{key} must be a non-negative integer, got {v!r}")
+    backoff = rec.get("backoff_s")
+    if backoff is not None and (not _is_number(backoff) or backoff < 0):
+        errors.append(
+            f"backoff_s must be a non-negative number, got {backoff!r}")
+
+
+# Router counter keys whose values must be non-negative integers; the
+# outcome triple additionally decomposes the window exactly (every
+# routed request is ok, shed, or errored — a router that loses requests
+# between the counters is the bug this invariant exists to catch).
+_ROUTER_COUNTERS = ("ok", "sheds", "errors", "retries", "hedges",
+                    "hedge_wins", "failovers")
+
+
+def _check_router_fields(rec, errors) -> None:
+    """router_window/router_summary consistency (serve/router.py)."""
+    total_key = ("window_requests" if rec.get("kind") == "router_window"
+                 else "requests")
+    ints = {}
+    for key in (total_key,) + _ROUTER_COUNTERS + (
+            "healthy_replicas", "replicas"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{key} must be a non-negative integer, got {v!r}")
+        else:
+            ints[key] = v
+    if {total_key, "ok", "sheds", "errors"} <= set(ints) and \
+            ints["ok"] + ints["sheds"] + ints["errors"] != ints[total_key]:
+        errors.append(
+            f"ok + sheds + errors must equal {total_key} "
+            f"({ints['ok']} + {ints['sheds']} + {ints['errors']} != "
+            f"{ints[total_key]}): every routed request is exactly one "
+            "of the three")
+    if {"hedges", "hedge_wins"} <= set(ints) and \
+            ints["hedge_wins"] > ints["hedges"]:
+        errors.append(
+            f"hedge_wins ({ints['hedge_wins']}) exceeds hedges "
+            f"({ints['hedges']})")
+    if {"healthy_replicas", "replicas"} <= set(ints) and \
+            ints["healthy_replicas"] > ints["replicas"]:
+        errors.append(
+            f"healthy_replicas ({ints['healthy_replicas']}) exceeds "
+            f"replicas ({ints['replicas']})")
+    for prefix, pcts in (("latency", ("p50", "p95", "p99")),
+                         ("failover", ("p50", "p95"))):
+        vals = [rec.get(f"{prefix}_{p}_ms") for p in pcts]
+        for p, v in zip(pcts, vals):
+            if v is not None and (not _is_number(v) or v < 0):
+                errors.append(
+                    f"{prefix}_{p}_ms must be a non-negative number, "
+                    f"got {v!r}")
+        present = [v for v in vals if _is_number(v)]
+        if len(present) == len(pcts) and present != sorted(present):
+            errors.append(
+                f"{prefix} percentiles not ordered "
+                f"({' <= '.join(pcts)}): {present}")
 
 
 def _check_resume_fields(rec, errors) -> None:

@@ -25,6 +25,13 @@ The spec is a comma-separated list of points::
     shard_error      first K (default 1) HDF5 shard loads raise OSError,
     shard_errorxK    then reads are healthy — exercises the data-path
                      retry/backoff (transient-then-healthy)
+    wedge@N          wedge the SERVING dispatch thread once N requests
+    wedge@NxS        have been served: the dispatch loop's fault check
+                     sleeps S seconds (default 3600) while /healthz keeps
+                     answering 200 (the thread is alive, just stuck) —
+                     the failure mode only the supervisor's heartbeat
+                     watchdog can catch (serve/supervisor.py,
+                     tools/chaos_serve.py)
 
 Everything is keyed on explicit step numbers / call counts — rerunning
 the same spec on the same data reproduces the same failure, which is
@@ -83,7 +90,7 @@ class FaultPlan:
             point = m.group("point")
             step = m.group("step")
             count = int(m.group("count") or 0)
-            if point in _STEP_POINTS:
+            if point in _STEP_POINTS or point == "wedge":
                 if step is None:
                     raise FaultSpecError(
                         f"fault point {point!r} needs @step (e.g. "
@@ -95,7 +102,7 @@ class FaultPlan:
             else:
                 raise FaultSpecError(
                     f"unknown fault point {point!r} (known: "
-                    f"{', '.join(_STEP_POINTS)}, shard_error)")
+                    f"{', '.join(_STEP_POINTS)}, shard_error, wedge)")
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -162,6 +169,25 @@ class FaultPlan:
         # hard-preemption model. Telemetry written so far survives
         # because the JSONL sink flushes per record.
         os.kill(os.getpid(), signal.SIGKILL)
+
+    def serve_wedge_check(self, requests_served: int,
+                          emit: Optional[Callable] = None) -> None:
+        """Wedge the calling (dispatch) thread once ``requests_served``
+        reaches the armed ``wedge@N`` threshold: emit the injection
+        record, then sleep S seconds (default 3600). Called by the
+        serving dispatch loop after each processed batch
+        (serve/service.py); fires at most once per plan."""
+        cfg = self._points.get("wedge")
+        if (cfg is None or requests_served < cfg["step"]
+                or "wedge" in self._fired):
+            return
+        self._fired.add("wedge")
+        hang_s = cfg["count"] or 3600
+        if emit is not None:
+            emit(self._record("injected_wedge", None,
+                              requests_served=int(requests_served),
+                              hang_s=hang_s))
+        time.sleep(hang_s)
 
     def shard_read_check(self, path: str,
                          emit: Optional[Callable] = None) -> None:

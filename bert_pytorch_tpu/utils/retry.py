@@ -25,6 +25,22 @@ Jitter is "full jitter" scaled: ``delay = backoff * (1 - jitter + jitter
 in ``[0.5, 1.0) * backoff``, decorrelating retry herds (every host of a
 multi-host job hitting the same flaky filer) while keeping the expected
 wait predictable.
+
+Two opt-in extensions (PR 11, the serving router's requirements — both
+OFF by default so every existing call site keeps byte-identical delay
+sequences, pinned by ``tests/test_fleet.py``):
+
+* ``full_jitter=True`` — the AWS "full jitter" scheme: ``delay = backoff
+  * u`` with ``u ~ U[0, 1)``. A router retrying a failed replica wants
+  maximal decorrelation (many concurrent requests fail over at the same
+  instant when a replica dies) and a LOW expected wait, not a
+  predictable one — half the raw backoff on average, spread over the
+  whole interval;
+* ``max_elapsed_s`` — a wall-clock budget over the WHOLE retry loop
+  (measured by the injectable ``clock``): once the next sleep would
+  land past the budget, :func:`retry_call` stops retrying and raises.
+  Per-request deadlines make "attempts" the wrong unit alone — a
+  deadline-bound caller needs the loop bounded in seconds too.
 """
 
 from __future__ import annotations
@@ -46,6 +62,11 @@ class RetryPolicy:
     per retry up to ``max_delay_s``. ``jitter`` in [0, 1] is the fraction
     of each delay that is randomized (0 = deterministic, for tests and
     for callers that already decorrelate externally).
+
+    ``full_jitter=True`` switches to ``delay = raw * u`` (``jitter`` is
+    then ignored); ``max_elapsed_s`` bounds the whole retry loop in
+    wall-clock seconds (:func:`retry_call` checks it against ``clock``
+    before every sleep). Both default off — see the module docstring.
     """
 
     def __init__(
@@ -57,6 +78,9 @@ class RetryPolicy:
         jitter: float = 0.5,
         sleep: Callable[[float], None] = time.sleep,
         rng: Optional[random.Random] = None,
+        full_jitter: bool = False,
+        max_elapsed_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
     ):
         if attempts < 1:
             raise ValueError(f"attempts must be >= 1, got {attempts}")
@@ -66,6 +90,9 @@ class RetryPolicy:
             raise ValueError(f"multiplier must be >= 1, got {multiplier}")
         if not 0 <= jitter <= 1:
             raise ValueError(f"jitter must be in [0, 1], got {jitter}")
+        if max_elapsed_s is not None and max_elapsed_s < 0:
+            raise ValueError(
+                f"max_elapsed_s must be >= 0, got {max_elapsed_s}")
         self.attempts = int(attempts)
         self.base_delay_s = float(base_delay_s)
         self.max_delay_s = float(max_delay_s)
@@ -73,12 +100,18 @@ class RetryPolicy:
         self.jitter = float(jitter)
         self.sleep = sleep
         self.rng = rng if rng is not None else random.Random()
+        self.full_jitter = bool(full_jitter)
+        self.max_elapsed_s = (None if max_elapsed_s is None
+                              else float(max_elapsed_s))
+        self.clock = clock
 
     def backoff_s(self, retry_index: int) -> float:
         """Jittered delay before retry ``retry_index`` (0-based: the delay
         after the first failed attempt is ``backoff_s(0)``)."""
         raw = min(self.max_delay_s,
                   self.base_delay_s * self.multiplier ** retry_index)
+        if self.full_jitter:
+            return raw * self.rng.random()
         if self.jitter == 0:
             return raw
         return raw * (1.0 - self.jitter + self.jitter * self.rng.random())
@@ -106,22 +139,35 @@ def retry_call(
     telemetry records / warnings without this module knowing about either.
     Exhausted attempts raise :class:`RetryError` from the last error;
     non-``retry_on`` errors propagate immediately (a genuine bug must not
-    burn the retry budget looking transient).
+    burn the retry budget looking transient). With ``policy.max_elapsed_s``
+    set, a retry whose backoff sleep would end past the budget (measured
+    by ``policy.clock`` from this call's entry) is abandoned the same way
+    an exhausted attempt count is.
     """
     policy = policy or RetryPolicy()
+    t0 = policy.clock() if policy.max_elapsed_s is not None else 0.0
     last: Optional[BaseException] = None
+    exhausted_by = ""
     for attempt in range(1, policy.attempts + 1):
         try:
             return fn(*args, **kwargs)
         except retry_on as exc:
             last = exc
             if attempt >= policy.attempts:
+                exhausted_by = f"after {policy.attempts} attempt(s)"
                 break
             delay = policy.backoff_s(attempt - 1)
+            if policy.max_elapsed_s is not None and (
+                    policy.clock() - t0 + delay > policy.max_elapsed_s):
+                exhausted_by = (
+                    f"after {attempt} attempt(s): next {delay:.3f}s "
+                    f"backoff exceeds the {policy.max_elapsed_s:g}s "
+                    "elapsed budget")
+                break
             if on_retry is not None:
                 on_retry(attempt, exc, delay)
             policy.sleep(delay)
     what = description or getattr(fn, "__name__", "call")
     raise RetryError(
-        f"{what} failed after {policy.attempts} attempt(s): "
+        f"{what} failed {exhausted_by}: "
         f"{type(last).__name__}: {last}") from last

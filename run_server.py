@@ -225,7 +225,17 @@ def build_service(args):
     return service, sink
 
 
-def main(args):
+def main(args) -> int:
+    """Serve until interrupted; returns the process exit code.
+
+    A SIGTERM-initiated drain exits with ``preemption.EXIT_PREEMPTED``
+    (75) — the SAME contract the five training runners hold
+    (utils/preemption.py): the supervisor (serve/supervisor.py) and any
+    scheduler can distinguish "drained cleanly, every accepted request
+    answered" from success (0, an operator Ctrl-C) and from crashes
+    (anything else). A crashed replica is restarted with backoff; a
+    drained one was ASKED to stop.
+    """
     from bert_pytorch_tpu.serve import make_server
 
     logger.init(handlers=[logger.StreamHandler()])
@@ -254,11 +264,16 @@ def main(args):
                 f"{args.trace_sample_rate:.0%} head-sampled, "
                 f"SLO p99 {args.slo_p99_ms:g}ms (over-SLO always traced)")
 
+    preempted = {"signaled": False}
+
     def shutdown(signum, frame):
         # Graceful drain (docs/fault_tolerance.md): flip /healthz to 503
         # FIRST — load balancers stop routing on their next probe while
         # the listener is still up — then unwind through the finally
-        # below, which flushes in-flight requests before stopping.
+        # below, which flushes in-flight requests before stopping. The
+        # flag is what turns the exit code into EXIT_PREEMPTED: only a
+        # SIGTERM-initiated drain is a preemption (Ctrl-C stays 0).
+        preempted["signaled"] = True
         service.begin_drain()
         raise KeyboardInterrupt
 
@@ -270,12 +285,26 @@ def main(args):
     finally:
         logger.info("draining: rejecting new requests (healthz 503), "
                     "flushing in-flight batches, then shutting down")
+        if preempted["signaled"] and sink is not None:
+            # The training runners' preemption fault record, serve
+            # flavor: the artifact says WHY this run ended (schema v1
+            # `fault` kind; step = requests served at the signal).
+            sink.write_record({
+                "kind": "fault", "tag": "serve", "fault": "preemption",
+                "signal": "SIGTERM", "injected": False,
+                "step": service.telemetry.request_count(),
+            })
         server.shutdown()
         service.stop()  # drain + dispatch-thread join + telemetry summary
         if sink is not None:
             sink.close()
         logger.close()
+    from bert_pytorch_tpu.utils import preemption
+
+    return preemption.EXIT_PREEMPTED if preempted["signaled"] else 0
 
 
 if __name__ == "__main__":
-    main(parse_arguments())
+    import sys
+
+    sys.exit(main(parse_arguments()))

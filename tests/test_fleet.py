@@ -1,0 +1,639 @@
+"""Fleet-tier resilience unit tests (PR 11, docs/serving.md "Fleet
+tier"): the supervisor's restart-storm backoff under a fake clock, the
+router's failover/hedge/brownout behaviors with fake transports, the
+retry-policy extensions the router rides on (with the byte-identical
+pin for every pre-existing call site), the requeue-during-drain batcher
+regression, and the fleet_event/router_window schema + report gates.
+
+The end-to-end proof — real replica subprocesses SIGKILLed/wedged under
+a live burst — is ``tools/chaos_serve.py --smoke``
+(tests/test_fleet_chaos.py)."""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from bert_pytorch_tpu.serve.batcher import Batcher, Request
+from bert_pytorch_tpu.serve.router import Router
+from bert_pytorch_tpu.serve.supervisor import (BACKOFF, FAILED, RUNNING,
+                                               STARTING, ReplicaSpec,
+                                               Supervisor)
+from bert_pytorch_tpu.telemetry import report, schema
+from bert_pytorch_tpu.utils.preemption import EXIT_PREEMPTED
+from bert_pytorch_tpu.utils.retry import RetryError, RetryPolicy, retry_call
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# utils/retry.py: the PR-11 extensions + the byte-identical default pin
+
+
+def test_retry_defaults_byte_identical_to_pre_fleet_formula():
+    """The router's new modes are opt-in: under the DEFAULT flags every
+    existing call site (dataset shard reads, bench loops) must draw the
+    exact scaled-jitter sequence the pre-fleet formula produced."""
+    seed = 20250803
+    p = RetryPolicy(attempts=6, base_delay_s=0.8, max_delay_s=7.0,
+                    jitter=0.5, rng=random.Random(seed))
+    assert p.full_jitter is False and p.max_elapsed_s is None
+    rng = random.Random(seed)
+    for i in range(5):
+        raw = min(7.0, 0.8 * 2 ** i)
+        assert p.backoff_s(i) == raw * (1.0 - 0.5 + 0.5 * rng.random())
+
+
+def test_retry_defaults_never_touch_the_clock():
+    """max_elapsed_s=None must not even READ the clock — the cheapest
+    possible proof that default-path behavior is unchanged."""
+    def explode() -> float:
+        raise AssertionError("clock read on the default path")
+
+    p = RetryPolicy(attempts=3, base_delay_s=0.0, jitter=0.0,
+                    sleep=lambda s: None, clock=explode)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, policy=p) == "ok"
+
+
+def test_retry_full_jitter_band():
+    p = RetryPolicy(base_delay_s=10.0, max_delay_s=10.0, full_jitter=True,
+                    rng=random.Random(0))
+    draws = [p.backoff_s(0) for _ in range(200)]
+    assert all(0.0 <= d < 10.0 for d in draws)
+    assert min(draws) < 2.0  # genuinely reaches the low band
+    assert len(set(draws)) > 100
+
+
+def test_retry_max_elapsed_budget_stops_the_loop():
+    clock = FakeClock()
+    p = RetryPolicy(attempts=10, base_delay_s=1.0, jitter=0.0,
+                    max_elapsed_s=2.5, clock=clock,
+                    sleep=lambda s: clock.advance(s))
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise OSError("replica down")
+
+    with pytest.raises(RetryError, match="elapsed budget"):
+        retry_call(always_down, policy=p)
+    # attempt 1 fails -> 1s backoff fits the 2.5s budget; attempt 2
+    # fails -> the next 2s backoff would land at 3s > 2.5 -> abandon.
+    assert calls["n"] == 2
+
+
+# ---------------------------------------------------------------------------
+# serve/batcher.py: the requeue-during-drain regression
+
+
+def _req(task="classify", n=6):
+    return Request(task, {"input_ids": list(range(2, 2 + n)),
+                          "segment_ids": [0] * n}, {})
+
+
+def test_batcher_unfinished_covers_popped_requests():
+    """depth() reads 0 the instant a batch is popped; unfinished() —
+    what stop()'s drain loop now waits on — must not, or a drain racing
+    the dispatch window closes the batcher under requests whose plan
+    leftovers are about to requeue (the PR-11 bug)."""
+    b = Batcher(max_batch_size=4, max_wait_ms=0.0)
+    reqs = [_req() for _ in range(4)]
+    for r in reqs:
+        b.submit(r)
+    batch = b.next_batch(timeout=0.01)
+    assert len(batch) == 4
+    assert b.depth() == 0              # the lying gauge the bug raced
+    assert b.unfinished() == 4         # the honest one
+
+    # A partial dispatch requeues 2 as plan leftovers: they move from
+    # in-flight back to pending with no dip in between.
+    b.requeue_front(batch[2:])
+    assert b.depth() == 2
+    assert b.unfinished() == 4
+    b.done(2)                          # the dispatched pair finished
+    assert b.unfinished() == 2
+
+    # Drain flush: whatever dispatch never got to is handed back for a
+    # deterministic error instead of stranding blocked submitters.
+    stranded = b.drain_remaining()
+    assert [r.id for r in stranded] == [r.id for r in batch[2:]]
+    assert b.depth() == 0
+
+
+def test_batcher_done_and_requeue_never_go_negative():
+    b = Batcher(max_batch_size=4, max_wait_ms=0.0)
+    b.done(3)                          # nothing popped: clamps at 0
+    assert b.unfinished() == 0
+    b.requeue_front([_req()])          # never-popped requeue (tests do)
+    assert b.depth() == 1
+    assert b.unfinished() == 1         # pending only, not negative
+
+
+def test_batcher_requeue_during_drain_ordering():
+    """The full race, single-threaded: stop() must observe unfinished()
+    > 0 across the pop -> requeue window, so leftovers re-enter the
+    queue BEFORE the close, in FIFO order."""
+    b = Batcher(max_batch_size=8, max_wait_ms=0.0)
+    reqs = [_req() for _ in range(6)]
+    for r in reqs:
+        b.submit(r)
+    batch = b.next_batch(timeout=0.01)
+    assert len(batch) == 6 and b.unfinished() == 6
+    # drain begins here; depth()==0 would have let stop() close now
+    b.requeue_front(batch[4:])
+    b.done(4)
+    b.close()
+    leftovers = b.drain_remaining()
+    assert [r.id for r in leftovers] == [batch[4].id, batch[5].id]
+    assert b.unfinished() == 0
+
+
+# ---------------------------------------------------------------------------
+# serve/supervisor.py: restart-storm backoff with a fake clock
+
+
+class FakeProc:
+    _pids = iter(range(4000, 5000))
+
+    def __init__(self):
+        self.pid = next(FakeProc._pids)
+        self.rc = None
+        self.signals = []
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        self.rc = EXIT_PREEMPTED   # a well-behaved replica drains
+
+
+def _supervisor(clock, *, attempts=4, heartbeat=None, events=None,
+                **kwargs):
+    procs = []
+
+    def spawn(spec):
+        procs.append(FakeProc())
+        return procs[-1]
+
+    sup = Supervisor(
+        [ReplicaSpec(0, 9001, ["run_server"],
+                     heartbeat_file="hb.json" if heartbeat else None)],
+        emit=events.append if events is not None else None,
+        spawn=spawn,
+        policy=RetryPolicy(attempts=attempts, base_delay_s=1.0,
+                           max_delay_s=8.0, jitter=0.0),
+        read_heartbeat=heartbeat, clock=clock, sleep=lambda s: None,
+        **kwargs)
+    return sup, procs
+
+
+def test_supervisor_backoff_schedule_and_give_up():
+    clock = FakeClock()
+    events: list = []
+    sup, procs = _supervisor(clock, attempts=4, events=events)
+    sup.start(monitor=False)
+    assert len(procs) == 1
+
+    backoffs = []
+    for expected in (1.0, 2.0, 4.0):   # base 1.0 x2, capped at 8, jitter 0
+        procs[-1].rc = 1               # crash
+        sup.poll_once()
+        st = sup.status()[0]
+        assert st["state"] == BACKOFF
+        sched = [e for e in events if e["event"] == "restart_scheduled"]
+        backoffs.append(sched[-1]["backoff_s"])
+        # Not a second early: just before the deadline nothing respawns.
+        clock.advance(expected - 0.01)
+        sup.poll_once()
+        assert len(procs) == len(backoffs)
+        clock.advance(0.02)
+        sup.poll_once()
+        assert len(procs) == len(backoffs) + 1   # respawned on schedule
+    assert backoffs == [1.0, 2.0, 4.0]
+
+    procs[-1].rc = 1                   # 4th consecutive crash: give up
+    sup.poll_once()
+    st = sup.status()[0]
+    assert st["state"] == FAILED
+    assert [e["event"] for e in events].count("gave_up") == 1
+    clock.advance(60.0)
+    sup.poll_once()
+    assert len(procs) == 4             # FAILED stays down
+
+
+def test_supervisor_graceful_exit_respawns_without_burning_budget():
+    clock = FakeClock()
+    events: list = []
+    sup, procs = _supervisor(clock, events=events)
+    sup.start(monitor=False)
+    procs[-1].rc = EXIT_PREEMPTED      # asked to drain, not a crash
+    sup.poll_once()
+    sched = [e for e in events if e["event"] == "restart_scheduled"][-1]
+    assert sched["crash"] is False and sched["backoff_s"] == 0.0
+    sup.poll_once()                    # immediate respawn
+    assert len(procs) == 2
+    assert sup.status()[0]["consecutive_crashes"] == 0
+
+
+def test_supervisor_graceful_churn_escalates_to_backoff():
+    """ONE free graceful respawn per stable stretch: a replica that
+    keeps exiting 0/75 within stable_reset_s of each spawn is a crash
+    loop wearing a polite exit code (a config that drains instantly, an
+    agent SIGTERMing every startup) and must walk the restart-storm
+    schedule — a zero-backoff respawn every poll tick is exactly the
+    storm the backoff exists to prevent."""
+    clock = FakeClock()
+    events: list = []
+    sup, procs = _supervisor(clock, events=events)
+    sup.start(monitor=False)
+    procs[-1].rc = EXIT_PREEMPTED
+    sup.poll_once()                    # first graceful exit: free
+    sched = [e for e in events if e["event"] == "restart_scheduled"][-1]
+    assert sched["crash"] is False and sched["backoff_s"] == 0.0
+    sup.poll_once()                    # immediate respawn
+    assert len(procs) == 2
+    procs[-1].rc = EXIT_PREEMPTED      # "drains" again, instantly
+    sup.poll_once()
+    sched = [e for e in events if e["event"] == "restart_scheduled"][-1]
+    assert sched["crash"] is True
+    assert sched["reason"] == "graceful_churn"
+    assert sched["backoff_s"] > 0.0
+    assert sup.status()[0]["consecutive_crashes"] == 1
+
+
+def test_supervisor_stable_run_pays_backoff_debt_back():
+    clock = FakeClock()
+    hb = {"counter": 0}
+    sup, procs = _supervisor(clock, heartbeat=lambda spec: hb["counter"],
+                             stable_reset_s=30.0)
+    sup.start(monitor=False)
+    procs[-1].rc = 1
+    sup.poll_once()                    # crash -> consecutive = 1
+    clock.advance(1.5)
+    sup.poll_once()                    # respawn
+    assert sup.status()[0]["consecutive_crashes"] == 1
+    hb["counter"] += 1
+    sup.poll_once()                    # heartbeat advance -> RUNNING
+    assert sup.status()[0]["state"] == RUNNING
+    clock.advance(31.0)
+    hb["counter"] += 1
+    sup.poll_once()                    # stable past stable_reset_s
+    assert sup.status()[0]["consecutive_crashes"] == 0
+
+
+def test_supervisor_watchdog_kills_wedged_replica():
+    """A wedged dispatch thread keeps /healthz 200 — only the heartbeat
+    counter going stale can catch it. The stale-counter age must be
+    measured against the startup grace while STARTING (a warming
+    replica is not wedged) and the tight timeout once RUNNING."""
+    clock = FakeClock()
+    events: list = []
+    hb = {"counter": 0}
+    sup, procs = _supervisor(
+        clock, events=events, heartbeat=lambda spec: hb["counter"],
+        heartbeat_timeout_s=5.0, startup_grace_s=60.0)
+    sup.start(monitor=False)
+    # Warming: counter stale at its pre-spawn baseline, 20s in — still
+    # inside the startup grace, must NOT be killed.
+    clock.advance(20.0)
+    sup.poll_once()
+    assert sup.status()[0]["state"] == STARTING
+    assert procs[-1].rc is None
+    hb["counter"] += 1
+    sup.poll_once()                    # first beat -> RUNNING
+    assert sup.status()[0]["state"] == RUNNING
+    hb["counter"] += 1
+    clock.advance(1.0)
+    sup.poll_once()                    # advancing: healthy
+    assert procs[-1].rc is None
+    clock.advance(5.5)                 # counter frozen past the timeout
+    sup.poll_once()
+    assert [e["event"] for e in events].count("wedged_kill") == 1
+    assert procs[-1].rc == -9          # SIGKILLed
+    assert sup.status()[0]["state"] == BACKOFF
+
+
+def test_supervisor_restart_baselines_stale_heartbeat():
+    """The heartbeat file SURVIVES a replica crash (the counter resumes
+    from it). The predecessor's last value must not read as an advance
+    for the fresh process — that would flip a warming replica straight
+    to RUNNING and arm the tight wedge timeout against its startup."""
+    clock = FakeClock()
+    hb = {"counter": 57}               # the dead replica's last beat
+    sup, procs = _supervisor(
+        clock, heartbeat=lambda spec: hb["counter"],
+        heartbeat_timeout_s=5.0, startup_grace_s=60.0)
+    sup.start(monitor=False)
+    clock.advance(10.0)                # warming, stale counter visible
+    sup.poll_once()
+    assert sup.status()[0]["state"] == STARTING
+    assert procs[-1].rc is None        # grace applies — no false kill
+    hb["counter"] = 58                 # the NEW process's first beat
+    sup.poll_once()
+    assert sup.status()[0]["state"] == RUNNING
+
+
+def test_supervisor_stop_reports_preemption_contract_exits():
+    clock = FakeClock()
+    sup, procs = _supervisor(clock)
+    sup.start(monitor=False)
+    summary = sup.stop()
+    assert procs[-1].signals == [15]   # SIGTERM drain
+    assert summary["rcs"] == {0: EXIT_PREEMPTED}
+    assert summary["all_graceful"] is True and summary["drain_killed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serve/router.py: failover, hedging, brownout
+
+
+def _healthy_scrape(url):
+    return {"dispatch_alive": True, "draining": False, "queue_depth": 0}
+
+
+def _router(transport, scrape=_healthy_scrape, urls=("http://a:1",
+                                                     "http://b:2"),
+            events=None, **kwargs):
+    kwargs.setdefault("retry_policy", RetryPolicy(
+        attempts=3, base_delay_s=0.0, jitter=0.0))
+    kwargs.setdefault("hedge_pctl", 0.0)   # hedging off unless the test
+    r = Router(list(urls), emit=events.append if events is not None
+               else None, transport=transport, scrape=scrape,
+               sleep=lambda s: None, **kwargs)
+    r.scrape_once()
+    return r
+
+
+def test_router_retry_excludes_failed_replica():
+    calls = []
+
+    def transport(url, task, payload, timeout_s):
+        calls.append(url)
+        if url == "http://a:1":
+            raise ConnectionRefusedError("replica a is dead")
+        return 200, {"answer": 42}
+
+    r = _router(transport)
+    status, body, headers = r.handle("classify", {"text": "hi"})
+    assert status == 200 and body == {"answer": 42}
+    # index tie-break routed to a first; the retry went ELSEWHERE.
+    assert calls == ["http://a:1", "http://b:2"]
+    snap = r.snapshot()
+    assert snap["failovers"] == 1 and snap["retries"] == 1
+    assert snap["errors"] == 0
+    # Fast feedback: the failed replica is out of rotation until a
+    # scrape proves it back.
+    assert [s for s in snap["replica_states"]
+            if s["url"] == "http://a:1"][0]["healthy"] is False
+    r.scrape_once()
+    assert r.healthy_count() == 2      # ...and the scrape re-heals it
+
+
+def test_router_retryable_5xx_fails_over_but_4xx_is_final():
+    calls = []
+
+    def transport(url, task, payload, timeout_s):
+        calls.append(url)
+        if url == "http://a:1":
+            return 500, {"error": "execute blew up"}
+        return 200, {"ok": True}
+
+    r = _router(transport)
+    status, _, _ = r.handle("classify", {"text": "hi"})
+    assert status == 200 and calls == ["http://a:1", "http://b:2"]
+
+    calls.clear()
+
+    def bad_payload(url, task, payload, timeout_s):
+        calls.append(url)
+        return 400, {"error": "bad JSON"}
+
+    r2 = _router(bad_payload)
+    status, _, _ = r2.handle("classify", {"text": None})
+    # A client error is the same on every replica: answered as-is, once.
+    assert status == 400 and len(calls) == 1
+    snap = r2.snapshot()
+    assert snap["retries"] == 0
+    # A relayed 4xx is the router WORKING (counted ok, not error): the
+    # zero-tolerance "router client-visible errors" report gate must
+    # not trip because one client mistyped a task name.
+    assert snap["ok"] == 1 and snap["errors"] == 0
+
+
+def test_router_exhausted_retries_yield_502():
+    def transport(url, task, payload, timeout_s):
+        raise ConnectionRefusedError("everything is down")
+
+    r = _router(transport)
+    status, body, _ = r.handle("classify", {"text": "hi"})
+    # Both replicas burned -> no candidates -> the outage shed answer.
+    assert status == 503
+    assert r.snapshot()["sheds"] == 1
+
+
+def test_router_hedge_fires_only_past_percentile():
+    slow_started = threading.Event()
+    release_slow = threading.Event()
+    calls = []
+    lock = threading.Lock()
+
+    def transport(url, task, payload, timeout_s):
+        with lock:
+            calls.append(url)
+        if url == "http://a:1":
+            slow_started.set()
+            release_slow.wait(timeout=10.0)   # the slow tail
+            return 200, {"from": "a"}
+        return 200, {"from": "b"}
+
+    r = _router(transport, hedge_pctl=0.95, hedge_min_ms=10.0,
+                hedge_min_samples=8)
+    # Below min_samples: no hedge threshold exists yet.
+    assert r._hedge_delay_s() is None
+    for _ in range(16):
+        r.note_latency(0.005)
+    delay = r._hedge_delay_s()
+    assert delay == pytest.approx(0.010)   # floored at hedge_min_ms
+
+    status, body, _ = r.handle("classify", {"text": "hi"})
+    release_slow.set()
+    assert status == 200 and body == {"from": "b"}   # the hedge won
+    snap = r.snapshot()
+    assert snap["hedges"] == 1 and snap["hedge_wins"] == 1
+    assert snap["errors"] == 0
+
+    # A fast primary never hedges: budgeted tail-cutting, not 2x load.
+    calls.clear()
+    fast = _router(lambda u, t, p, s: (200, {"from": u}),
+                   hedge_pctl=0.95, hedge_min_ms=10.0, hedge_min_samples=8)
+    for _ in range(16):
+        fast.note_latency(0.005)
+    fast.handle("classify", {"text": "hi"})
+    assert fast.snapshot()["hedges"] == 0
+
+
+def test_router_brownout_503_carries_retry_after():
+    def saturated(url):
+        return {"dispatch_alive": True, "draining": False,
+                "queue_depth": 128}
+
+    r = _router(lambda *a: (200, {}), scrape=saturated,
+                brownout_queue_depth=64, shed_retry_after_s=1.5)
+    status, body, headers = r.handle("classify", {"text": "hi"})
+    assert status == 503
+    assert headers["Retry-After"] == "1.5"
+    assert "brownout" in body["error"]
+    assert r.snapshot()["sheds"] == 1
+
+
+def test_router_skips_draining_and_dead_dispatch_replicas():
+    calls = []
+
+    def transport(url, task, payload, timeout_s):
+        calls.append(url)
+        return 200, {}
+
+    def scrape(url):
+        if url == "http://a:1":
+            return {"dispatch_alive": True, "draining": True,
+                    "queue_depth": 0}
+        return {"dispatch_alive": True, "draining": False,
+                "queue_depth": 5}
+
+    r = _router(transport, scrape=scrape)
+    status, _, _ = r.handle("classify", {"text": "hi"})
+    # a is draining: even with the deeper queue, b takes the request.
+    assert status == 200 and calls == ["http://b:2"]
+
+
+def test_router_window_and_summary_records_are_schema_clean():
+    events: list = []
+
+    def transport(url, task, payload, timeout_s):
+        if url == "http://a:1":
+            raise ConnectionRefusedError("down")
+        return 200, {}
+
+    r = _router(transport, events=events, window=4)
+    for _ in range(5):
+        r.handle("classify", {"text": "hi"})
+    r.stop()
+    kinds = [e.get("kind") for e in events]
+    assert "router_window" in kinds and "router_summary" in kinds
+    for rec in events:
+        rec = dict(rec, schema=schema.SCHEMA_VERSION, ts=0.0)
+        assert schema.validate_record(rec) == [], rec
+    summary = [e for e in events if e["kind"] == "router_summary"][-1]
+    assert summary["requests"] == 5
+    assert summary["failovers"] >= 1
+    assert summary["failover_p95_ms"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# schema lint fixtures + the telemetry-report "router failover" gate
+
+
+def test_fleet_schema_fixtures_lint():
+    good = os.path.join(HERE, "fixtures", "telemetry", "fleet_good.jsonl")
+    bad = os.path.join(HERE, "fixtures", "telemetry", "fleet_bad.jsonl")
+    assert schema.validate_file(good) == []
+    errors = schema.validate_file(bad)
+    text = " | ".join(err for _, err in errors)
+    assert "event must be a non-empty string" in text
+    assert "ok + sheds + errors must equal window_requests" in text
+    assert "hedge_wins (3) exceeds hedges (1)" in text
+    assert "healthy_replicas (4) exceeds replicas (2)" in text
+    assert "failover percentiles not ordered" in text
+    assert "backoff_s must be a non-negative number" in text
+    # And the repo tool (jax-free, file-path bootstrap) agrees.
+    proc = subprocess.run(
+        [sys.executable, "tools/check_telemetry_schema.py", good, bad],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "fleet_good.jsonl: ok" in proc.stdout
+    assert "fleet_bad" in proc.stdout
+
+
+def _fleet_records(failover_p95_ms=120.0, errors=0, gave_up=0):
+    records = [
+        {"kind": "fleet_event", "event": "spawn", "replica": i, "port": p}
+        for i, p in ((0, 8001), (1, 8002))]
+    records += [{"kind": "fleet_event", "event": "restart_scheduled",
+                 "replica": 0, "port": 8001, "crash": True,
+                 "backoff_s": 0.4, "reason": "exit"}]
+    records += [{"kind": "fleet_event", "event": "gave_up", "replica": 1,
+                 "port": 8002}] * gave_up
+    records.append({
+        "kind": "router_window", "window_requests": 64, "ok": 62 - errors,
+        "sheds": 2, "errors": errors, "retries": 3, "hedges": 2,
+        "hedge_wins": 1, "failovers": 3, "healthy_replicas": 2,
+        "replicas": 2, "latency_p50_ms": 8.0, "latency_p95_ms": 40.0,
+        "latency_p99_ms": 80.0, "failover_p50_ms": 60.0,
+        "failover_p95_ms": failover_p95_ms})
+    return [dict(r, schema=schema.SCHEMA_VERSION, ts=0.0) for r in records]
+
+
+def test_report_summarizes_fleet_records():
+    summary = report.summarize_records(_fleet_records())
+    assert summary["router_requests"] == 64
+    assert summary["router_failovers"] == 3
+    assert summary["router_failover_p95_ms"] == 120.0
+    assert summary["fleet_spawns"] == 2
+    assert summary["fleet_crash_restarts"] == 1
+    assert summary["fleet_gave_up"] == 0
+    text = report.format_summary(summary)
+    assert "router_failover_p95_ms" in text and "fleet_event_kinds" in text
+
+
+def test_report_router_failover_gate_trips():
+    """The named resilience gate: injected failover latency drifting
+    past tolerance must be CALLED OUT, not averaged away."""
+    base = report.summarize_records(_fleet_records(failover_p95_ms=120.0))
+    ok_run = report.summarize_records(_fleet_records(failover_p95_ms=130.0))
+    slow = report.summarize_records(_fleet_records(failover_p95_ms=400.0))
+    regressions, _ = report.compare(base, ok_run)
+    assert regressions == []
+    regressions, _ = report.compare(base, slow)
+    assert "router failover p95" in [r["label"] for r in regressions]
+
+
+def test_report_router_errors_and_gave_up_are_zero_tolerance():
+    base = report.summarize_records(_fleet_records())
+    bad = report.summarize_records(_fleet_records(errors=1, gave_up=1))
+    regressions, _ = report.compare(base, bad)
+    labels = [r["label"] for r in regressions]
+    assert "router client-visible errors" in labels
+    assert "fleet replicas given up" in labels

@@ -199,7 +199,13 @@ class TestTrainStepFp16:
 
 
 class TestRunnerFp16:
+    @pytest.mark.slow
     def test_runner_fp16_smoke_checkpoint_roundtrip(self, workdir):
+        """Slow-gated (~46s: two full runner invocations): the fp16 step
+        math is tier-1-covered by TestTrainStepFp16 and checkpoint
+        resume by tests/test_checkpoint.py; this E2E proves the runner
+        WIRING (scaler state riding in 'optimizer' across a resume) and
+        runs under ``-m slow``."""
         import run_pretraining
 
         result = run_pretraining.main(

@@ -64,7 +64,12 @@ def run_fixture(name):
 
 def test_repo_gate_no_unsuppressed_findings():
     """The acceptance invariant: package + runners + tools lint clean
-    against the committed (near-empty) baseline, in well under 10 s."""
+    against the committed (near-empty) baseline, fast enough to live in
+    tier-1 (the bound started at 10s; each PR grows the parsed corpus —
+    PR 10 added the whole-program tier, PR 11 ~120KB of fleet code —
+    and the throttled 2-core box's clock varies, so the bound tracks
+    "an order of magnitude under the tier-1 budget", not the original
+    measurement)."""
     t0 = time.perf_counter()
     findings = core.run_paths(list(check_all.JAXLINT_TARGETS),
                               repo_root=REPO_ROOT)
@@ -76,7 +81,7 @@ def test_repo_gate_no_unsuppressed_findings():
     assert not stale, (
         "stale baseline entries (the flagged lines no longer exist — "
         "prune with --write-baseline): " + repr(stale))
-    assert elapsed < 10.0, f"jaxlint took {elapsed:.1f}s (budget 10s)"
+    assert elapsed < 25.0, f"jaxlint took {elapsed:.1f}s (budget 25s)"
 
 
 def test_cli_repo_gate_runs_without_jax():

@@ -650,11 +650,18 @@ def test_pretraining_smoke_emits_telemetry(pretrain_workdir):
     assert any(r.get("tag") == "train" for r in kinds["metric"])
 
 
+@pytest.mark.slow
 def test_pretraining_resume_keeps_grad_health_cadence(pretrain_workdir):
     """A checkpoint-resumed run whose resume step is NOT a multiple of
     the sampled sync cadence must still emit grad_health records: the
     in-jit due gate is rebased on the run-start optimizer count
-    (stats_phase), matching the host's run-local sync index."""
+    (stats_phase), matching the host's run-local sync index.
+
+    Slow-gated (~36s: two full pretraining runs): the rebasing invariant
+    itself is tier-1-covered at the step level by
+    tests/test_model_stats.py (phase-offset due-gate cases); this E2E
+    proves the runner plumbs the run-start count through and runs under
+    ``-m slow``."""
     import run_pretraining
 
     def run(steps):
@@ -683,8 +690,14 @@ def test_pretraining_resume_keeps_grad_health_cadence(pretrain_workdir):
                      "drifted off the run-local sync cadence")
 
 
+@pytest.mark.slow
 def test_pretraining_sentinel_abort_flag(pretrain_workdir):
-    """--sentinel_policy abort is accepted and a healthy run completes."""
+    """--sentinel_policy abort is accepted and a healthy run completes.
+
+    Slow-gated (~24s for a full compile+run that asserts only flag
+    acceptance): the sentinel abort BEHAVIOR is tier-1-covered by the
+    FailureSentinel unit tests above and the fault-tolerance in-process
+    injection tests; runs under ``-m slow``."""
     import run_pretraining
 
     args = run_pretraining.parse_arguments([

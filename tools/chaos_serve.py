@@ -1,0 +1,573 @@
+#!/usr/bin/env python
+"""Fleet chaos harness: kill, wedge, and drain-kill serving replicas
+under live traffic — and PROVE no client ever saw it
+(docs/serving.md "Fleet tier", docs/fault_tolerance.md "Serve failover").
+
+The serving resilience layer's acceptance gate, the serve analog of
+``tools/chaos_run.py``. One invocation stands up the real fleet — a
+:class:`Supervisor` owning N ``run_server.py`` replica subprocesses
+(each warmed from one shared persistent AOT compile cache) behind a
+:class:`Router` front tier — then drives a closed-loop client burst
+through the router while injecting, in sequence:
+
+1. **SIGKILL mid-flight** — one replica is killed with requests in its
+   queue. The router's transport failures fail over to a different
+   replica inside the retry budget; the supervisor reaps the exit and
+   respawns with crash backoff; the restarted replica must report
+   ``compiles_cold == 0`` (PR 8's warm-restart property is what makes
+   seconds-scale recovery real);
+2. **wedged dispatch** — a replica armed with ``BERT_FAULTS=wedge@N``
+   hangs its dispatch thread while ``/healthz`` keeps answering 200.
+   Only the supervisor's heartbeat watchdog can catch this; meanwhile
+   the router's hedged requests keep the stuck replica's traffic inside
+   the latency budget until the watchdog kills it;
+3. **kill during drain** — SIGTERM (graceful drain) followed by SIGKILL
+   mid-drain. Requests the dying replica never answered are retried
+   elsewhere; the supervisor classifies the exit as a crash.
+
+Acceptance, asserted per phase and overall: ZERO client-visible
+failures (every request answers 2xx, except explicit brownout sheds —
+503 carrying ``Retry-After``); failover latency p95 within
+``--failover_tolerance_ms`` (the same number telemetry-report's
+"router failover" gate regresses on); the supervisor's restart within
+the backoff budget; and every artifact (router/fleet events + each
+replica's serve telemetry) schema-clean.
+
+Verdict is one JSON line on stdout; exit 0 = every assertion held.
+
+``--smoke`` is the documented one-command local gate (2 replicas, small
+bursts, sized for a throttled tier-1 CPU box)::
+
+    python tools/chaos_serve.py --smoke
+
+The parent is deliberately jax-free: supervisor/router/schema load by
+FILE PATH (tools/_bootstrap.py), so a hung accelerator runtime can hang
+a REPLICA — which the watchdog kills — never the harness itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.parse
+
+from _bootstrap import REPO_ROOT, load_by_path
+
+schema = load_by_path(
+    "_fleet_schema", "bert_pytorch_tpu", "telemetry", "schema.py")
+supervisor_mod = load_by_path(
+    "_fleet_supervisor", "bert_pytorch_tpu", "serve", "supervisor.py")
+router_mod = load_by_path(
+    "_fleet_router", "bert_pytorch_tpu", "serve", "router.py")
+faults = load_by_path(
+    "_fleet_faults", "bert_pytorch_tpu", "testing", "faults.py")
+synth = load_by_path(
+    "_fleet_synth", "bert_pytorch_tpu", "tools", "make_synthetic_data.py")
+
+# Tiny fp32 model over the trace vocabulary: the gate's evidence is
+# request outcomes and fleet/router records, not model quality — sized
+# at the floor that still exercises the full serve path (tokenize ->
+# batch -> jitted forward -> postprocess) so replica warmup stays
+# seconds, not minutes, on a throttled CPU.
+def model_config() -> dict:
+    vocab = 5 + len(synth.TRACE_WORDS)
+    vocab += (8 - vocab % 8) % 8
+    return {
+        "vocab_size": vocab, "hidden_size": 16, "num_hidden_layers": 1,
+        "num_attention_heads": 2, "intermediate_size": 32,
+        "max_position_embeddings": 32, "type_vocab_size": 2,
+        "next_sentence": True, "mask_token_id": 4,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+    }
+
+
+PHRASES = (
+    "paris is big", "the river runs through london",
+    "william shakespeare wrote hamlet", "england is old",
+    "the capital of france is paris", "hamlet was wrote in london",
+)
+
+
+class ChaosFailure(AssertionError):
+    pass
+
+
+def check(cond, what):
+    if not cond:
+        raise ChaosFailure(what)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class Sink:
+    """Thread-safe schema-v1 JSONL sink + in-memory event index.
+
+    The supervisor's monitor thread and every router request thread emit
+    through ``write``; the harness polls ``count`` to sequence phases
+    (e.g. "burst until the watchdog's wedged_kill lands"). Deliberately
+    local: the package JSONLHandler imports the package chain on first
+    write, which would drag jax into this jax-free parent.
+    """
+
+    def __init__(self, path: str):
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+        self.records = []
+
+    def write(self, record: dict) -> None:
+        rec = {"schema": schema.SCHEMA_VERSION, "ts": round(time.time(), 3)}
+        rec.update(record)
+        with self._lock:
+            self.records.append(rec)
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+
+    def count(self, event: str) -> int:
+        with self._lock:
+            return sum(1 for r in self.records if r.get("event") == event)
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def make_spawn(log_dir: str):
+    """A Popen factory that pins replicas to CPU jax, strips the test
+    harness's virtual-device flag and any leaked fault spec from the
+    inherited environment (spec.env re-arms faults deliberately), and
+    tees replica output to a per-replica log for post-mortems."""
+
+    def spawn(spec):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop(faults.FAULTS_ENV, None)
+        xla = " ".join(
+            flag for flag in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in flag)
+        if xla:
+            env["XLA_FLAGS"] = xla
+        else:
+            env.pop("XLA_FLAGS", None)
+        if spec.env:
+            env.update(spec.env)
+        log = open(os.path.join(log_dir, f"replica_{spec.index}.log"), "ab")
+        return subprocess.Popen(spec.cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+
+    return spawn
+
+
+# -- the closed-loop client --------------------------------------------------
+
+def post(url: str, task: str, payload: dict, timeout_s: float):
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("POST", f"/v1/{task}",
+                     body=json.dumps(payload).encode("utf-8"),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        return resp.status, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def run_burst(url: str, total: int, workers: int, timeout_s: float,
+              outcomes: list, should_stop=None, mid=None) -> None:
+    """Closed-loop burst: ``workers`` threads issue requests until
+    ``total`` have been sent (or ``should_stop()`` says enough — the
+    wedge phase stops on the watchdog's event, not a count). Each
+    outcome is appended to the shared ``outcomes`` list.
+
+    ``mid=(count, callback)`` fires ``callback`` exactly once, from
+    whichever worker completes outcome number ``count`` — the fault
+    injection is sequenced INSIDE the burst, so it lands mid-flight no
+    matter how fast the box drains the request quota."""
+    lock = threading.Lock()
+    issued = [0]
+    mid_fired = [False]
+
+    def worker() -> None:
+        while True:
+            if should_stop is not None and should_stop():
+                return
+            with lock:
+                if issued[0] >= total:
+                    return
+                issued[0] += 1
+                seq = issued[0]
+            payload = {"text": PHRASES[seq % len(PHRASES)]}
+            t0 = time.monotonic()
+            try:
+                status, headers = post(url, "classify", payload, timeout_s)
+            except Exception as exc:
+                status, headers = None, {
+                    "error": f"{type(exc).__name__}: {exc}"}
+            fire = False
+            with lock:
+                outcomes.append({
+                    "status": status,
+                    "retry_after": headers.get("Retry-After"),
+                    "latency_s": round(time.monotonic() - t0, 4),
+                })
+                if (mid is not None and not mid_fired[0]
+                        and len(outcomes) >= mid[0]):
+                    mid_fired[0] = True
+                    fire = True
+            if fire:
+                mid[1]()
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def classify_outcomes(outcomes: list) -> dict:
+    """ok / shed / failure decomposition of one burst. A shed is an
+    EXPLICIT admission-control answer — 503 carrying Retry-After;
+    everything else non-2xx (including the router's own deadline 503,
+    which has no Retry-After) is a client-visible failure."""
+    ok = shed = 0
+    failures = []
+    for o in outcomes:
+        if o["status"] is not None and 200 <= o["status"] < 300:
+            ok += 1
+        elif o["status"] == 503 and o.get("retry_after"):
+            shed += 1
+        else:
+            failures.append(o)
+    return {"requests": len(outcomes), "ok": ok, "sheds": shed,
+            "failures": len(failures), "failure_samples": failures[:5]}
+
+
+def wait_until(pred, timeout_s: float, what: str, poll_s: float = 0.25):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll_s)
+    raise ChaosFailure(f"timed out after {timeout_s:g}s waiting for {what}")
+
+
+def cold_start_records(out_dir: str) -> list:
+    path = os.path.join(out_dir, "serve_telemetry.jsonl")
+    records = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    if rec.get("kind") == "serve_cold_start":
+                        records.append(rec)
+    return records
+
+
+def lint(path: str) -> None:
+    errors = schema.validate_file(path)
+    check(errors == [], f"schema lint failed for {path}: {errors[:3]}")
+
+
+# -- the scenario ------------------------------------------------------------
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="replica kill/wedge/drain-kill chaos harness for the "
+                    "serving fleet tier")
+    parser.add_argument("--smoke", action="store_true",
+                        help="the one-command local gate: 2 replicas, "
+                             "small bursts, tier-1-budget-sized")
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--burst_workers", type=int, default=4)
+    parser.add_argument("--phase_a_requests", type=int, default=None,
+                        help="burst size for the SIGKILL phase "
+                             "(default 60; 50 under --smoke)")
+    parser.add_argument("--phase_c_requests", type=int, default=30)
+    parser.add_argument("--wedge_at", type=int, default=100,
+                        help="requests the wedge replica serves before "
+                             "its dispatch thread hangs (BERT_FAULTS "
+                             "wedge@N; must exceed its phase-A share)")
+    parser.add_argument("--wedge_cap_requests", type=int, default=600,
+                        help="phase-B safety cap: the wedge MUST fire "
+                             "before this many burst requests")
+    parser.add_argument("--router_deadline_s", type=float, default=8.0)
+    parser.add_argument("--failover_tolerance_ms", type=float, default=8000.0,
+                        help="failover-latency p95 budget — the same "
+                             "tolerance telemetry-report's 'router "
+                             "failover' gate regresses on")
+    parser.add_argument("--warmup_timeout_s", type=float, default=240.0)
+    parser.add_argument("--recover_timeout_s", type=float, default=120.0,
+                        help="budget for a killed replica to be respawned "
+                             "AND healthy again (backoff + warm start)")
+    parser.add_argument("--client_timeout_s", type=float, default=15.0)
+    parser.add_argument("--workdir", type=str, default="",
+                        help="keep artifacts here (default: a fresh temp "
+                             "dir, removed on success)")
+    args = parser.parse_args(argv)
+    args.phase_a_requests = args.phase_a_requests or (
+        50 if args.smoke else 60)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_serve_")
+    os.makedirs(workdir, exist_ok=True)
+    cache_dir = os.path.join(workdir, "compile_cache")
+    vocab_path = synth.write_trace_vocab(os.path.join(workdir, "vocab.txt"))
+    config_path = os.path.join(workdir, "model.json")
+    with open(config_path, "w") as f:
+        json.dump(model_config(), f)
+
+    # One ReplicaSpec per replica: shared model/cache flags, its own
+    # port + output dir (telemetry JSONL and the heartbeat file the
+    # supervisor watches live under it). The LAST replica is armed with
+    # the wedge fault — it hangs only after serving --wedge_at requests,
+    # so phases A (SIGKILL) and B (wedge) stay sequenced.
+    shared_args = [
+        "--model_config_file", config_path, "--vocab_file", vocab_path,
+        "--tasks", "classify", "--classify_labels", "neg,pos",
+        "--buckets", "16", "--max_batch_size", "4", "--max_wait_ms", "5",
+        "--dtype", "float32", "--compile_cache_dir", cache_dir,
+        "--trace_sample_rate", "0", "--telemetry_window", "16",
+        "--request_timeout_s", "10",
+    ]
+    specs = []
+    for i in range(args.replicas):
+        out_dir = os.path.join(workdir, f"replica_{i}")
+        os.makedirs(out_dir, exist_ok=True)
+        env = {}
+        if i == args.replicas - 1:
+            env[faults.FAULTS_ENV] = f"wedge@{args.wedge_at}"
+        port = free_port()
+        specs.append(supervisor_mod.ReplicaSpec(
+            index=i, port=port,
+            cmd=supervisor_mod.run_server_command(port, out_dir,
+                                                  shared_args),
+            heartbeat_file=os.path.join(out_dir, "heartbeat.json"),
+            env=env))
+
+    sink = Sink(os.path.join(workdir, "fleet_telemetry.jsonl"))
+    sup = supervisor_mod.Supervisor(
+        specs, emit=sink.write, spawn=make_spawn(workdir),
+        policy=supervisor_mod.RetryPolicy(
+            attempts=5, base_delay_s=0.4, max_delay_s=3.0,
+            full_jitter=True),
+        heartbeat_timeout_s=5.0,
+        startup_grace_s=args.warmup_timeout_s,
+        stable_reset_s=15.0, poll_interval_s=0.25, drain_grace_s=15.0)
+    router = router_mod.Router(
+        [s.url for s in specs], emit=sink.write, window=32,
+        scrape_interval_s=0.25,
+        deadline_s=args.router_deadline_s,
+        retry_policy=router_mod.RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            full_jitter=True),
+        hedge_pctl=0.95, hedge_min_ms=30.0, hedge_min_samples=24,
+        brownout_queue_depth=64, shed_retry_after_s=0.5)
+    router_server = router_mod.make_router_server(router, port=0)
+    router_url = "http://%s:%d" % router_server.server_address[:2]
+
+    t_start = time.monotonic()
+    verdict = {"metric": "chaos_serve_fleet_failover", "workdir": workdir,
+               "replicas": args.replicas, "router_url": router_url}
+    wedge_idx = args.replicas - 1
+
+    def state_of(idx):
+        return sup.status()[idx]
+
+    def healthy(idx):
+        st = state_of(idx)
+        return (st["state"] == supervisor_mod.RUNNING
+                and router.healthy_count() >= 1
+                and any(r["healthy"] and r["url"].endswith(
+                    f":{specs[idx].port}")
+                        for r in router.snapshot()["replica_states"]))
+
+    try:
+        sup.start()
+        router.start()
+        threading.Thread(target=router_server.serve_forever,
+                         daemon=True).start()
+        wait_until(lambda: router.healthy_count() == args.replicas,
+                   args.warmup_timeout_s,
+                   f"all {args.replicas} replicas healthy")
+
+        # -- phase A: SIGKILL one replica under load --------------------
+        outcomes_a: list = []
+        kill_at = {"t": None}
+
+        def kill_replica_0() -> None:
+            pid = state_of(0)["pid"]
+            kill_at["t"] = time.monotonic()
+            if pid:
+                os.kill(pid, signal.SIGKILL)
+
+        run_burst(router_url, args.phase_a_requests, args.burst_workers,
+                  args.client_timeout_s, outcomes_a,
+                  mid=(args.phase_a_requests // 4, kill_replica_0))
+        t_kill = kill_at["t"]
+        check(t_kill is not None, "phase-A kill never fired")
+        phase_a = classify_outcomes(outcomes_a)
+        verdict["phase_a"] = phase_a
+        check(phase_a["failures"] == 0,
+              f"phase A (SIGKILL): client-visible failures: {phase_a}")
+        wait_until(lambda: healthy(0), args.recover_timeout_s,
+                   "killed replica respawned and healthy")
+        verdict["phase_a"]["recovery_s"] = round(
+            time.monotonic() - t_kill, 2)
+        check(sink.count("spawn") >= args.replicas + 1,
+              "no respawn fleet_event after the SIGKILL")
+        crash_restarts = [
+            r for r in sink.records
+            if r.get("event") == "restart_scheduled" and r.get("crash")]
+        check(crash_restarts, "SIGKILL was not classified as a crash")
+        check(crash_restarts[0]["backoff_s"] <= sup.policy.max_delay_s,
+              f"restart backoff {crash_restarts[0]['backoff_s']} exceeds "
+              "the policy ceiling")
+
+        # The warm-restart acceptance: the respawned replica warmed from
+        # the shared AOT cache — zero cold compiles, by the cache
+        # counter events (the authority, per PR 8).
+        colds = cold_start_records(os.path.join(workdir, "replica_0"))
+        check(len(colds) >= 2,
+              f"expected >=2 serve_cold_start records (initial + "
+              f"restart), found {len(colds)}")
+        verdict["restart_compiles_cold"] = colds[-1]["compiles_cold"]
+        check(colds[-1]["compiles_cold"] == 0,
+              f"restarted replica recompiled: {colds[-1]}")
+
+        # -- phase B: wedged dispatch, caught only by the watchdog ------
+        outcomes_b: list = []
+        run_burst(router_url, args.wedge_cap_requests, args.burst_workers,
+                  args.client_timeout_s, outcomes_b,
+                  should_stop=lambda: sink.count("wedged_kill") > 0)
+        # The burst's only job is to push the wedge replica past
+        # --wedge_at served requests; the watchdog then needs its OWN
+        # detection window — heartbeat_timeout_s of staleness plus a
+        # poll tick — measured from the instant the dispatch thread
+        # hung. A fast burst drains its remaining requests through the
+        # surviving replica in less than that, so the kill is awaited
+        # here rather than required to land mid-burst.
+        wait_until(lambda: sink.count("wedged_kill") > 0,
+                   args.recover_timeout_s,
+                   "watchdog kill of the wedged replica (if the wedge "
+                   f"never armed, raise --wedge_cap_requests "
+                   f"[{args.wedge_cap_requests}] or lower --wedge_at "
+                   f"[{args.wedge_at}])")
+        phase_b = classify_outcomes(outcomes_b)
+        verdict["phase_b"] = phase_b
+        check(phase_b["failures"] == 0,
+              f"phase B (wedge): client-visible failures: {phase_b}")
+        wait_until(lambda: healthy(wedge_idx), args.recover_timeout_s,
+                   "wedged replica respawned and healthy")
+
+        # -- phase C: SIGKILL mid-drain ---------------------------------
+        outcomes_c: list = []
+
+        def kill_during_drain() -> None:
+            pid = state_of(wedge_idx)["pid"]
+            if not pid:
+                verdict["phase_c_kill"] = "no_pid"
+                return
+            os.kill(pid, signal.SIGTERM)   # graceful drain begins
+            time.sleep(0.3)
+            try:
+                os.kill(pid, signal.SIGKILL)   # ... and is cut short
+                verdict["phase_c_kill"] = "mid_drain"
+            except ProcessLookupError:
+                verdict["phase_c_kill"] = "drained_first"
+
+        run_burst(router_url, args.phase_c_requests, args.burst_workers,
+                  args.client_timeout_s, outcomes_c,
+                  mid=(args.phase_c_requests // 4, kill_during_drain))
+        check(verdict.get("phase_c_kill") in ("mid_drain",
+                                              "drained_first"),
+              f"phase-C kill did not fire: {verdict.get('phase_c_kill')}")
+        phase_c = classify_outcomes(outcomes_c)
+        verdict["phase_c"] = phase_c
+        check(phase_c["failures"] == 0,
+              f"phase C (kill-during-drain): client-visible failures: "
+              f"{phase_c}")
+        wait_until(
+            lambda: any(r.get("event") == "exit"
+                        and r.get("replica") == wedge_idx
+                        for r in sink.records[-20:]),
+            30.0, "supervisor to reap the drain-killed replica")
+
+        # -- teardown + fleet-level assertions --------------------------
+        drain = sup.stop()
+        router_server.shutdown()
+        router.stop()
+        snapshot = router.snapshot()
+        verdict["drain"] = {"rcs": {str(k): v for k, v
+                                    in drain["rcs"].items()},
+                            "drain_killed": drain["drain_killed"]}
+        check(drain["drain_killed"] == 0,
+              "a live replica ignored the drain SIGTERM and needed "
+              f"SIGKILL at stop: {drain}")
+        check(drain["rcs"][0] == supervisor_mod.EXIT_PREEMPTED,
+              f"replica 0 should exit EXIT_PREEMPTED on drain, got "
+              f"{drain['rcs'][0]} (the run_server preemption contract)")
+        verdict["router"] = {
+            k: snapshot.get(k) for k in
+            ("requests", "ok", "sheds", "errors", "retries", "hedges",
+             "hedge_wins", "failovers", "latency_p95_ms",
+             "failover_p95_ms")}
+        check(snapshot["errors"] == 0,
+              f"router recorded client-visible errors: {snapshot}")
+        check(snapshot["failovers"] >= 1,
+              "no failover was recorded — the kill phases did not "
+              "exercise the retry path")
+        failover_p95 = snapshot.get("failover_p95_ms")
+        check(failover_p95 is not None,
+              "router snapshot carries no failover percentile")
+        check(failover_p95 <= args.failover_tolerance_ms,
+              f"failover p95 {failover_p95}ms exceeds the "
+              f"{args.failover_tolerance_ms:g}ms tolerance — the "
+              "telemetry-report 'router failover' gate would trip")
+
+        # -- every artifact schema-clean --------------------------------
+        sink.close()
+        lint(os.path.join(workdir, "fleet_telemetry.jsonl"))
+        for i in range(args.replicas):
+            lint(os.path.join(workdir, f"replica_{i}",
+                              "serve_telemetry.jsonl"))
+
+        verdict.update(ok=True, wall_s=round(time.monotonic() - t_start, 1))
+        print(json.dumps(verdict))
+        if not args.workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return 0
+    except (ChaosFailure, OSError, ValueError, KeyError) as exc:
+        verdict.update(ok=False, error=f"{type(exc).__name__}: {exc}")
+        try:
+            sup.stop()
+            router_server.shutdown()
+            router.stop()
+        except Exception:
+            pass
+        print(json.dumps(verdict))
+        print(f"chaos_serve: FAILED — artifacts kept in {workdir}",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

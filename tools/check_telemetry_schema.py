@@ -25,8 +25,13 @@ step/path/reason. The async-hot-path step_window fields are held to
 their invariants too: ``h2d_wait_*`` must be numeric and never exceed
 the ``data_wait_*`` it is a sub-phase of, and ``ckpt_step_*``
 percentiles require a positive ``ckpt_steps`` checkpoint-step flag
-(docs/telemetry.md "Checkpoint-step p95"). The chaos harness
-(tools/chaos_run.py) lints its kill->corrupt->resume artifacts through
+(docs/telemetry.md "Checkpoint-step p95"). The fleet-tier kinds
+(``fleet_event``/``router_window``/``router_summary``,
+serve/supervisor.py + serve/router.py) carry their own rules: the
+ok/shed/error triple must decompose the window exactly, hedge wins are
+bounded by hedges fired, healthy replicas by the fleet size, and the
+latency/failover percentiles must be ordered. The chaos harnesses
+(tools/chaos_run.py, tools/chaos_serve.py) lint their artifacts through
 this same module.
 
 Usage::
