@@ -106,6 +106,108 @@ REGISTRY: Tuple[Entry, ...] = (
           cls="TrainTelemetry", kind="frozen",
           why="step_done notes liveness on it from the train loop while "
               "its own thread polls; the binding must be stable"),
+    Entry("bert_pytorch_tpu/telemetry/runner.py", "introspect",
+          cls="TrainTelemetry", kind="frozen",
+          why="emit() tees records into the hub from the train loop AND "
+              "background emitters (watchdog) while debug-server HTTP "
+              "threads read snapshots; the hub locks itself, the binding "
+              "must not move"),
+    Entry("bert_pytorch_tpu/telemetry/runner.py", "flight_recorder",
+          cls="TrainTelemetry", kind="frozen",
+          why="emit() notes records into the ring from every emitting "
+              "thread; the recorder locks itself, the binding must not "
+              "move"),
+
+    # -- telemetry/introspect.py: train loop vs debug-plane HTTP threads ---
+    # The hub's single state dict is the debug plane's ONLY shared
+    # mutable state: note_step (train loop) and observe_record (any
+    # emitting thread, incl. the watchdog) write while /healthz /statsz
+    # /metricsz handlers snapshot it from HTTP worker threads.
+    Entry("bert_pytorch_tpu/telemetry/introspect.py", "_state",
+          cls="IntrospectionHub", kind="lock", locks=("_lock",),
+          why="train loop + background emitters write the live snapshot "
+              "while debug-server HTTP threads render it"),
+
+    # -- telemetry/flightrec.py: every emitting thread vs flush paths ------
+    # The ring (and its accounting) is written by the train loop /
+    # dispatch thread and every background emitter via note_record,
+    # while incident/periodic/atexit/excepthook flushes read it — one
+    # lock; *_locked helpers run with it held (the suffix contract).
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_ring",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          allow=("_append_locked", "_payload_locked"),
+          why="noted by every emitting thread, drained by flush paths "
+              "(incident, periodic, atexit, excepthook)"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_bytes",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          allow=("_append_locked", "_payload_locked"),
+          why="byte-bound accounting updated with the ring"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_dropped",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          allow=("_append_locked", "_payload_locked"),
+          why="eviction counter updated with the ring"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_noted",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          allow=("_append_locked", "_payload_locked"),
+          why="note counter updated with the ring"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_unflushed",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          allow=("_append_locked",),
+          why="atexit-overwrite guard: reset by flushes, bumped by notes"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_incident",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          why="clean-close file-removal decision shared by note/flush/"
+              "close paths"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_closed",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          why="close() (teardown thread) flips it while emitters note"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_last_flush",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          why="periodic-flush cadence shared by every noting thread"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_last_reason",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          why="flush bookkeeping read by the atexit guard"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_flush_seq",
+          cls="FlightRecorder", kind="lock", locks=("_lock",),
+          why="payload build order, stamped with the ring state it "
+              "captured"),
+    Entry("bert_pytorch_tpu/telemetry/flightrec.py", "_written_seq",
+          cls="FlightRecorder", kind="lock", locks=("_write_lock",),
+          why="newest payload on disk: a descheduled periodic flush "
+              "must never clobber a newer crash payload"),
+
+    # -- telemetry/collector.py: background loop vs manual passes ----------
+    # collect_once may be driven by a test/harness thread while the
+    # background loop runs — the lock serializes whole passes, so the
+    # target table, tailers, pass counter, and output handle are only
+    # ever touched by the pass that holds it. The *_locked helpers run
+    # with it held (the suffix contract).
+    Entry("bert_pytorch_tpu/telemetry/collector.py", "_targets",
+          cls="FleetCollector", kind="lock", locks=("_lock",),
+          why="per-target sample state is rewritten every pass; a "
+              "manual pass and the loop thread must never interleave"),
+    Entry("bert_pytorch_tpu/telemetry/collector.py", "_tails",
+          cls="FleetCollector", kind="lock", locks=("_lock",),
+          why="tailer offsets advance per pass; interleaved passes "
+              "would double-read or skip sink records"),
+    Entry("bert_pytorch_tpu/telemetry/collector.py", "_passes",
+          cls="FleetCollector", kind="lock", locks=("_lock",),
+          why="pass counter bumped by whichever thread runs the pass"),
+    Entry("bert_pytorch_tpu/telemetry/collector.py", "_out_f",
+          cls="FleetCollector", kind="lock", locks=("_lock",),
+          allow=("_write_locked",),
+          why="timeline writes are serialized per pass; stop() closes "
+              "the handle while a pass could otherwise be writing"),
+
+    # -- serve/supervisor.py: the supervisor's own heartbeat ---------------
+    # Beaten only from poll_once (the monitor thread, or the fake-clock
+    # test driving passes); safety rests on the binding being stable —
+    # the same contract as the serve dispatch loop's heartbeat.
+    Entry("bert_pytorch_tpu/serve/supervisor.py", "_heartbeat",
+          cls="Supervisor", kind="frozen",
+          why="beaten by the monitor thread's poll pass while start()/"
+              "stop() run on control-plane threads; the binding must "
+              "never change after __init__"),
 
     # -- telemetry/sentinels.py: the watchdog's own shared state -----------
     Entry("bert_pytorch_tpu/telemetry/sentinels.py", "_last",

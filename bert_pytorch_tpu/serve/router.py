@@ -629,6 +629,56 @@ class Router:
             } for rep in self._replicas]
         return record
 
+    def metrics_text(self, prefix: str = "bert_router") -> str:
+        """Prometheus text-exposition rendering of the run-level
+        counters and per-replica health gauges — the same numbers
+        /statsz serves as JSON, in the one format the fleet collector
+        (telemetry/collector.py) scrapes everywhere. Rendered from
+        :meth:`snapshot`, so the two surfaces cannot drift."""
+        snap = self.snapshot()
+        lines: List[str] = []
+
+        def render(value) -> str:
+            # Full precision, not %g: a run-level counter past ~1e6
+            # would otherwise export rounded and drift from /statsz —
+            # delta-computing scrapers would see it advance in jumps.
+            v = float(value)
+            return str(int(v)) if v.is_integer() else repr(v)
+
+        def metric(name, value, kind, help_text=""):
+            if value is None:
+                return
+            if help_text:
+                lines.append(f"# HELP {prefix}_{name} {help_text}")
+            lines.append(f"# TYPE {prefix}_{name} {kind}")
+            lines.append(f"{prefix}_{name} {render(value)}")
+
+        metric("requests_total", snap.get("requests"), "counter",
+               "Requests routed (ok + sheds + errors).")
+        for key in ("ok", "sheds", "errors", "retries", "hedges",
+                    "hedge_wins", "failovers"):
+            metric(f"{key}_total", snap.get(key), "counter")
+        metric("healthy_replicas", snap.get("healthy_replicas"), "gauge",
+               "Replicas currently eligible for routing.")
+        metric("replicas", snap.get("replicas"), "gauge")
+        for key in ("latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+                    "failover_p50_ms", "failover_p95_ms"):
+            metric(key, snap.get(key), "gauge")
+        name = f"{prefix}_replica_state"
+        lines.append(f"# HELP {name} Per-replica router view "
+                     "(healthy/draining/queue_depth/inflight).")
+        lines.append(f"# TYPE {name} gauge")
+        for i, rep in enumerate(snap.get("replica_states", ())):
+            for field in ("healthy", "draining"):
+                lines.append(
+                    f'{name}{{replica="{i}",field="{field}"}} '
+                    f"{1 if rep.get(field) else 0}")
+            for field in ("queue_depth", "inflight"):
+                lines.append(
+                    f'{name}{{replica="{i}",field="{field}"}} '
+                    f"{render(rep.get(field, 0))}")
+        return "\n".join(lines) + "\n"
+
     def healthy_count(self) -> int:
         with self._lock:
             return sum(1 for rep in self._replicas if rep.eligible())
@@ -698,6 +748,19 @@ def _make_router_handler():
                 })
             elif self.path == "/statsz":
                 self._reply(200, router.snapshot())
+            elif self.path == "/metricsz":
+                # Prometheus text format (version 0.0.4), so the fleet
+                # collector and standard scrapers consume ONE format
+                # across replicas, trainers, and the router; /statsz
+                # stays the human JSON surface.
+                body = router.metrics_text().encode("utf-8")
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
 

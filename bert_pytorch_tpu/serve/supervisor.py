@@ -56,31 +56,46 @@ import time
 from typing import Callable, List, Optional, Sequence
 
 
-def _load_util(modname: str):
-    """Import a stdlib-only ``utils/`` sibling both ways: through the
+def _load_pkg_module(subpkg: str, modname: str):
+    """Import a stdlib-only package sibling both ways: through the
     package when this module was imported normally, by file path when
     this module was itself loaded by path (the package ``__init__``
     chain imports jax — the property tools/chaos_serve.py needs)."""
     if __package__:
         import importlib
 
-        return importlib.import_module(f"bert_pytorch_tpu.utils.{modname}")
+        return importlib.import_module(
+            f"bert_pytorch_tpu.{subpkg}.{modname}")
     import importlib.util
 
-    module = sys.modules.get(f"_fleet_{modname}")
+    alias = f"_fleet_{subpkg}_{modname}"
+    module = sys.modules.get(alias)
     if module is not None:
         return module
     path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "utils", f"{modname}.py")
-    spec = importlib.util.spec_from_file_location(f"_fleet_{modname}", path)
+        os.path.abspath(__file__))), subpkg, f"{modname}.py")
+    spec = importlib.util.spec_from_file_location(alias, path)
     module = importlib.util.module_from_spec(spec)
-    sys.modules[f"_fleet_{modname}"] = module
+    sys.modules[alias] = module
     spec.loader.exec_module(module)
     return module
 
 
+def _load_util(modname: str):
+    return _load_pkg_module("utils", modname)
+
+
 RetryPolicy = _load_util("retry").RetryPolicy
 EXIT_PREEMPTED = _load_util("preemption").EXIT_PREEMPTED
+# The same resumable liveness file the training runners and run_server
+# write — the supervisor is the fleet's last liveness blind spot
+# (telemetry/sentinels.py is stdlib-only, like utils/retry.py).
+Heartbeat = _load_pkg_module("telemetry", "sentinels").Heartbeat
+
+# How many of a harvested postmortem's newest records/lines ride the
+# fleet_event (the full file stays on disk for the operator; the event
+# names WHY the replica died without bloating the fleet artifact).
+_HARVEST_TAIL = 5
 
 # Replica lifecycle states (status()/fleet_event records).
 STARTING = "starting"    # spawned; no heartbeat observed yet
@@ -95,12 +110,17 @@ class ReplicaSpec:
 
     def __init__(self, index: int, port: int, cmd: Sequence[str],
                  heartbeat_file: Optional[str] = None,
+                 postmortem_file: Optional[str] = None,
                  env: Optional[dict] = None,
                  host: str = "127.0.0.1"):
         self.index = int(index)
         self.port = int(port)
         self.cmd = list(cmd)
         self.heartbeat_file = heartbeat_file
+        # The replica's flight-recorder flush target (telemetry/
+        # flightrec.py): harvested into a fleet_event when the replica
+        # dies, so the failover story names WHY.
+        self.postmortem_file = postmortem_file
         self.env = dict(env) if env is not None else None
         self.host = host
 
@@ -168,6 +188,7 @@ class Supervisor:
         drain_grace_s: float = 15.0,
         read_heartbeat: Optional[Callable[[ReplicaSpec],
                                           Optional[int]]] = None,
+        heartbeat_file: Optional[str] = None,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
@@ -198,6 +219,15 @@ class Supervisor:
         self._replicas = [_Replica(spec) for spec in specs]
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # The supervisor's OWN liveness file (step = supervision ticks):
+        # the same resumable heartbeat the runners and run_server write,
+        # closing the chaos harness's last liveness blind spot. Beaten
+        # only from poll_once (the monitor thread, or the fake-clock
+        # test driving passes itself) — Heartbeat relies on that
+        # single-caller lifecycle, like the serve dispatch loop's.
+        self._heartbeat = Heartbeat(heartbeat_file) if heartbeat_file \
+            else None
+        self._ticks = 0
 
     # -- telemetry --------------------------------------------------------
 
@@ -253,6 +283,15 @@ class Supervisor:
             self._thread.start()
 
     def _spawn_locked(self, rep: _Replica, now: float) -> None:
+        if rep.spec.postmortem_file:
+            # Fresh forensics per incarnation: the dead predecessor's
+            # postmortem was harvested at reap time (the fleet_event);
+            # leaving the file would let a NEXT crash-before-first-flush
+            # harvest the wrong incarnation's last seconds.
+            try:
+                os.remove(rep.spec.postmortem_file)
+            except OSError:
+                pass
         rep.proc = self._spawn(rep.spec)
         rep.state = STARTING
         rep.started_at = now
@@ -277,11 +316,17 @@ class Supervisor:
 
     def poll_once(self) -> None:
         """One monitoring pass over every replica: reap exits, schedule
-        and execute backoff restarts, kill wedged processes."""
+        and execute backoff restarts, kill wedged processes. Each pass
+        beats the supervisor's own heartbeat (step = tick count), so
+        "is the supervisor itself alive" is readable the same way
+        replica liveness is."""
         now = self._clock()
         with self._lock:
             for rep in self._replicas:
                 self._poll_replica_locked(rep, now)
+        self._ticks += 1
+        if self._heartbeat is not None:
+            self._heartbeat.beat(self._ticks)
 
     def _poll_replica_locked(self, rep: _Replica, now: float) -> None:
         if rep.state == FAILED or (rep.state == STOPPED
@@ -321,6 +366,7 @@ class Supervisor:
                            heartbeat_age_s=round(age, 3),
                            requests=rep.hb_counter)
                 self._kill_locked(rep)
+                self._harvest_postmortem_locked(rep, context="wedged")
                 self._schedule_restart_locked(rep, now, crash=True,
                                               reason="wedged")
                 return
@@ -336,6 +382,7 @@ class Supervisor:
                 self._emit("probe_kill", rep,
                            failures=rep.probe_failures)
                 self._kill_locked(rep)
+                self._harvest_postmortem_locked(rep, context="probe")
                 self._schedule_restart_locked(rep, now, crash=True,
                                               reason="probe")
 
@@ -346,6 +393,12 @@ class Supervisor:
         graceful = rc in (0, EXIT_PREEMPTED)
         self._emit("exit", rep, rc=rc, graceful=graceful,
                    uptime_s=round(now - rep.started_at, 3))
+        if not graceful:
+            # The failover story should name WHY the replica died, not
+            # just that it did: harvest the dead process's flight-
+            # recorder flush (its last telemetry records and log lines)
+            # into the fleet artifact before the slot is respawned.
+            self._harvest_postmortem_locked(rep, context="exit")
         if self._stop_event.is_set():
             rep.state = STOPPED
             return
@@ -386,6 +439,42 @@ class Supervisor:
         rep.restart_at = now + backoff
         self._emit("restart_scheduled", rep, backoff_s=round(backoff, 3),
                    restarts=rep.restarts, crash=crash, reason=reason)
+
+    def _harvest_postmortem_locked(self, rep: _Replica,
+                                   context: str) -> None:
+        """Emit the dead replica's postmortem (telemetry/flightrec.py
+        flush) as a ``fleet_event``: the ring's newest records/lines
+        (bounded to ``_HARVEST_TAIL`` each — the file keeps the full
+        ring for the operator), the flush reason, and whether a
+        postmortem existed at all (a crash before the first flush is
+        itself diagnostic)."""
+        spec = rep.spec
+        if not spec.postmortem_file:
+            return
+        pm = None
+        try:
+            with open(spec.postmortem_file, "r", encoding="utf-8") as f:
+                pm = json.load(f)
+        except (OSError, ValueError):
+            pm = None
+        if not isinstance(pm, dict):
+            self._emit("postmortem", rep, context=context, found=False,
+                       path=spec.postmortem_file)
+            return
+        records = pm.get("records") or []
+        lines = pm.get("lines") or []
+        self._emit(
+            "postmortem", rep, context=context, found=True,
+            path=spec.postmortem_file,
+            reason=pm.get("reason"), process=pm.get("process"),
+            flushed_at=pm.get("flushed_at"),
+            ring_entries=pm.get("ring_entries"),
+            ring_bytes=pm.get("ring_bytes"),
+            dropped=pm.get("dropped"),
+            records=records[-_HARVEST_TAIL:]
+            if isinstance(records, list) else [],
+            lines=lines[-_HARVEST_TAIL:]
+            if isinstance(lines, list) else [])
 
     def _kill_locked(self, rep: _Replica) -> None:
         proc = rep.proc

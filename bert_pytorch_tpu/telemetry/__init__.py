@@ -11,6 +11,14 @@ from bert_pytorch_tpu.telemetry.cli import (add_cli_args,
                                             default_jsonl_path,
                                             from_args,
                                             stats_every)
+from bert_pytorch_tpu.telemetry.collector import (FleetCollector,
+                                                  JsonlTailer,
+                                                  Target)
+from bert_pytorch_tpu.telemetry.flightrec import (FlightRecorder,
+                                                  read_postmortem)
+from bert_pytorch_tpu.telemetry.introspect import (IntrospectionHub,
+                                                   make_debug_server,
+                                                   start_debug_server)
 from bert_pytorch_tpu.telemetry.compile_events import (CompileMonitor,
                                                        shapes_digest)
 from bert_pytorch_tpu.telemetry.memory import (MemorySampler,
@@ -34,7 +42,15 @@ __all__ = [
     "CompileMonitor",
     "DivergenceError",
     "DivergenceMonitor",
+    "FleetCollector",
+    "FlightRecorder",
+    "IntrospectionHub",
+    "JsonlTailer",
     "MemorySampler",
+    "Target",
+    "make_debug_server",
+    "read_postmortem",
+    "start_debug_server",
     "add_cli_args",
     "analyze_executable",
     "default_jsonl_path",

@@ -68,6 +68,31 @@ def add_cli_args(parser, window_default: int = 50,
                              "default <output_dir>/heartbeat.json. The "
                              "capture harness reads it instead of guessing "
                              "liveness from checkpoint mtimes")
+    parser.add_argument("--debug_port", type=int, default=0,
+                        help="live training introspection plane "
+                             "(telemetry/introspect.py, docs/"
+                             "observability.md): serve /healthz "
+                             "(heartbeat-backed step liveness), /statsz "
+                             "(live window/grad-health/compile snapshot) "
+                             "and /metricsz (Prometheus text, consistent "
+                             "with the JSONL windows per metric name) on "
+                             "127.0.0.1:<port>. 0 (default) disables")
+    parser.add_argument("--debug_stale_after_s", type=float, default=0.0,
+                        help="debug-plane /healthz staleness bound: 503 "
+                             "once no step completed for this many "
+                             "seconds. 0 (default) follows "
+                             "--watchdog_timeout_s when set, else 60 — "
+                             "size it above the worst healthy step time")
+    parser.add_argument("--postmortem_file", type=str, default="",
+                        help="crash flight recorder (telemetry/"
+                             "flightrec.py): bounded ring of the last "
+                             "telemetry records + log lines, flushed "
+                             "atomically here on fault/divergence/crash "
+                             "(and periodically, so even a SIGKILLed "
+                             "process leaves forensics); default "
+                             "<output_dir>/postmortem.json, disabled "
+                             "without an output dir. A clean run removes "
+                             "the file")
     parser.add_argument("--grad_stats_every", type=int, default=-1,
                         help="in-jit grad-health cadence (per-layer-group "
                              "grad/param norms + update:weight ratios, "
@@ -132,12 +157,21 @@ def from_args(args, sink=None, is_primary: bool = True,
               seq_per_step: Optional[int] = None,
               flops_per_seq: Optional[float] = None,
               tokens_per_step: Optional[int] = None,
-              output_dir: Optional[str] = None):
+              output_dir: Optional[str] = None,
+              process: str = "train"):
     """Build a TrainTelemetry from the :func:`add_cli_args` namespace.
 
-    ``output_dir`` anchors the profile-dir / heartbeat fallbacks; without
-    one, traces go to ``./profile`` and the heartbeat is disabled unless
-    the flags name paths explicitly.
+    ``output_dir`` anchors the profile-dir / heartbeat / postmortem
+    fallbacks; without one, traces go to ``./profile`` and the heartbeat
+    and flight recorder are disabled unless the flags name paths
+    explicitly. ``process`` labels the runner in the debug plane's
+    exports and the postmortem payload ("pretrain", "glue", ...), so a
+    fleet timeline can attribute trainer samples by name.
+
+    Rank-0 only for the observability plane: non-primary ranks get
+    neither a debug server (one port per JOB, like the artifacts) nor a
+    flight recorder (their sink is disabled; an empty ring would flush
+    empty postmortems over rank 0's).
     """
     import jax
 
@@ -147,7 +181,30 @@ def from_args(args, sink=None, is_primary: bool = True,
         os.path.join(output_dir, "profile") if output_dir else "profile")
     heartbeat = args.heartbeat_file or (
         os.path.join(output_dir, "heartbeat.json") if output_dir else None)
-    return TrainTelemetry(
+    introspect = None
+    recorder = None
+    if is_primary:
+        postmortem = getattr(args, "postmortem_file", "") or (
+            os.path.join(output_dir, "postmortem.json")
+            if output_dir else None)
+        if postmortem:
+            from bert_pytorch_tpu.telemetry.flightrec import FlightRecorder
+            from bert_pytorch_tpu.utils import logging as logging_util
+
+            recorder = FlightRecorder(
+                postmortem, process=process).install_exit_hooks()
+            # Log lines tee into the ring too (the runners initialized
+            # their handlers before building telemetry, so append).
+            logging_util.add_handler(recorder.log_handler())
+        if getattr(args, "debug_port", 0):
+            from bert_pytorch_tpu.telemetry.introspect import \
+                IntrospectionHub
+
+            stale_after = getattr(args, "debug_stale_after_s", 0.0) or \
+                getattr(args, "watchdog_timeout_s", 0.0) or 60.0
+            introspect = IntrospectionHub(
+                process=process, stale_after_s=stale_after)
+    tele = TrainTelemetry(
         sink=sink,
         is_primary=is_primary,
         window=args.telemetry_window,
@@ -165,4 +222,26 @@ def from_args(args, sink=None, is_primary: bool = True,
         watchdog_timeout_s=getattr(args, "watchdog_timeout_s", 0.0),
         grad_spike_factor=args.grad_spike_factor,
         update_ratio_max=args.update_ratio_max,
-        cost_analysis=args.telemetry_cost_analysis)
+        cost_analysis=args.telemetry_cost_analysis,
+        introspect=introspect,
+        flight_recorder=recorder)
+    if introspect is not None:
+        from bert_pytorch_tpu.telemetry.introspect import start_debug_server
+        from bert_pytorch_tpu.utils import logging as logging_util
+
+        try:
+            tele.debug_server = start_debug_server(
+                introspect, port=int(args.debug_port))
+        except OSError as exc:
+            # Observability must never take the run down: a port
+            # already held (a second runner on the host, a stale
+            # process) costs the debug plane, not the training job.
+            logging_util.info(
+                f"telemetry: debug plane DISABLED — could not bind "
+                f"port {args.debug_port}: {exc}")
+        else:
+            host, port = tele.debug_server.server_address[:2]
+            logging_util.info(
+                f"telemetry: debug plane on http://{host}:{port} "
+                "(/healthz /statsz /metricsz)")
+    return tele

@@ -1,0 +1,589 @@
+"""The fleet collector: one ordered timeline for the whole fleet
+(docs/observability.md "Fleet collector").
+
+PR 11's fleet is many processes, each with its own signal surface: every
+trainer now has a live debug plane (telemetry/introspect.py), every
+replica exports ``/metricsz`` (serve/tracing.py), the router serves
+``/statsz`` and ``/metricsz``, and each process writes its own JSONL
+sink. Nobody merges them — answering "what did the fleet look like when
+replica 1 died" means hand-joining five files and three scrape formats.
+The :class:`FleetCollector` owns that join:
+
+* **concurrent scrape** — every registered :class:`Target` is probed
+  once per pass, one thread per target bounded by the transport
+  timeout, so one black-holed target costs max(per-target) and can
+  never stale the others' samples (the ``Router.scrape_once``
+  discipline). Each probe yields one schema-v1 ``obs_scrape`` record:
+  the target's headline gauges plus ``staleness_s`` — seconds since the
+  last GOOD sample, the number the "fleet scrape staleness" report gate
+  regresses on;
+* **JSONL tailing** — every registered sink file is tailed
+  incrementally (offset + partial-line buffer, rotation-safe); new
+  records merge into the timeline stamped with their source name;
+* **one ordered timeline** — each pass's harvest (tailed records +
+  scrape samples + the pass's ``obs_fleet_window`` aggregate) is sorted
+  by ``(ts, source, sequence)`` and appended to the output JSONL. The
+  sort is deterministic: replaying the same sources yields the same
+  timeline byte for byte (out-of-order source timestamps land in
+  timestamp order within the pass);
+* **fleet aggregates** — one ``obs_fleet_window`` per pass: healthy /
+  total target counts (the dip-and-recovery signal when a replica
+  dies), fleet request rate (delta of replica request counters between
+  passes), worst-replica p99 (histogram-quantile over each replica's
+  exported phase-latency histogram — the "fleet worst-replica p99"
+  gate), trainer step rate, max staleness, and the fleet error-budget
+  burn (over-SLO counts against the configured budget).
+
+Stdlib-only and dual-loadable like the supervisor/router: imported
+normally it is part of the telemetry package; loaded by FILE PATH
+(``tools/obs_collect.py`` via tools/_bootstrap.py) it pulls the schema
+module the same way, so the collector process never needs an
+accelerator runtime — it must keep collecting while the processes it
+watches hang.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+import urllib.parse
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def _load_schema():
+    """Schema module both ways: package import when this module was
+    imported normally, sibling file-path import when it was itself
+    loaded by path (the jax-free parent property)."""
+    if __package__:
+        import importlib
+
+        return importlib.import_module(
+            "bert_pytorch_tpu.telemetry.schema")
+    import importlib.util
+
+    module = sys.modules.get("_collector_schema")
+    if module is not None:
+        return module
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "schema.py")
+    spec = importlib.util.spec_from_file_location("_collector_schema", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["_collector_schema"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+_schema = _load_schema()
+SCHEMA_VERSION = _schema.SCHEMA_VERSION
+TARGET_KINDS = _schema.OBS_TARGET_KINDS
+
+
+# -- scrape transports -------------------------------------------------------
+
+def _http_get(url: str, path: str, timeout_s: float) -> Tuple[int, str]:
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=max(0.05, timeout_s))
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def parse_prometheus(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """(name, labels, value) per sample line of a text exposition."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_part, _, value_part = line.rpartition(" ")
+        if not name_part:
+            continue
+        try:
+            value = float(value_part)
+        except ValueError:
+            continue
+        labels: Dict[str, str] = {}
+        name = name_part
+        if "{" in name_part and name_part.endswith("}"):
+            name, _, raw = name_part.partition("{")
+            for item in raw[:-1].split(","):
+                if "=" in item:
+                    k, _, v = item.partition("=")
+                    labels[k.strip()] = v.strip().strip('"')
+        out.append((name, labels, value))
+    return out
+
+
+def _histogram_quantile(buckets: Dict[float, float], frac: float,
+                        total: Optional[float] = None) -> Optional[float]:
+    """Upper-bound quantile estimate from cumulative Prometheus buckets
+    (le -> cumulative count, finite bounds only). ``total`` is the TRUE
+    observation count (the ``_count`` series / +Inf bucket) — without
+    it the overflow observations above the largest finite bound would
+    be invisible and a tail blowup would UNDER-report the quantile.
+    Returns the smallest finite bound covering ``frac`` of the total,
+    or the largest finite bound when the quantile sits in the +Inf
+    bucket (a floor, not an average-away)."""
+    if not buckets:
+        return None
+    bounds = sorted(buckets)
+    if total is None or total < max(buckets.values()):
+        total = max(buckets.values())
+    if total <= 0:
+        return None
+    want = frac * total
+    for bound in bounds:
+        if buckets[bound] >= want:
+            return bound
+    return bounds[-1]
+
+
+def scrape_trainer(url: str, timeout_s: float = 2.0) -> Optional[dict]:
+    """One trainer debug-plane sample: the headline ``bert_train_*``
+    gauges off /metricsz. None = unreachable."""
+    try:
+        status, text = _http_get(url, "/metricsz", timeout_s)
+    except OSError:
+        return None
+    if status != 200:
+        return None
+    gauges: Dict[str, float] = {}
+    for name, _labels, value in parse_prometheus(text):
+        if name.startswith("bert_train_"):
+            gauges[name[len("bert_train_"):]] = value
+    if not gauges:
+        return None
+    # Healthy = answering AND stepping: a trainer wedged in a hung
+    # collective keeps serving /metricsz (the HTTP threads are fine) —
+    # only the step age vs the exported staleness bound says whether
+    # training is actually advancing (the /healthz verdict, readable
+    # from the same scrape). No step age yet = still warming = healthy.
+    age = gauges.get("step_age_seconds")
+    bound = gauges.get("stale_after_seconds")
+    stepping = age is None or bound is None or age <= bound
+    out = {"healthy": gauges.get("up", 0.0) >= 1.0 and stepping}
+    for src, dst in (("step", "step"),
+                     ("step_age_seconds", "step_age_s"),
+                     ("window_steps_per_sec", "steps_per_sec"),
+                     ("window_mfu", "mfu"),
+                     ("nonfinite_steps_total", "nonfinite_steps"),
+                     ("faults_total", "faults")):
+        if src in gauges:
+            out[dst] = gauges[src]
+    return out
+
+
+def scrape_replica(url: str, timeout_s: float = 2.0) -> Optional[dict]:
+    """One serving-replica sample off /metricsz: liveness/queue gauges,
+    request/error/over-SLO counters summed over task heads, and a p99
+    estimate from the total-phase latency histogram."""
+    try:
+        status, text = _http_get(url, "/metricsz", timeout_s)
+    except OSError:
+        return None
+    if status != 200:
+        return None
+    series = parse_prometheus(text)
+    sums = {"requests": 0.0, "errors": 0.0, "over_slo": 0.0}
+    buckets: Dict[float, float] = {}
+    hist_total = 0.0
+    gauges: Dict[str, float] = {}
+    for name, labels, value in series:
+        if name == "bert_serve_requests_total":
+            sums["requests"] += value
+        elif name == "bert_serve_errors_total":
+            sums["errors"] += value
+        elif name == "bert_serve_over_slo_total":
+            sums["over_slo"] += value
+        elif name == "bert_serve_phase_latency_ms_bucket" and \
+                labels.get("phase") == "total":
+            le = labels.get("le", "")
+            if le == "+Inf":
+                # The TRUE total: observations past the largest finite
+                # bound live only here, and a quantile computed without
+                # them under-reports exactly during a tail blowup.
+                hist_total += value
+            elif le:
+                try:
+                    bound = float(le)
+                except ValueError:
+                    continue
+                buckets[bound] = buckets.get(bound, 0.0) + value
+        elif not labels and name.startswith("bert_serve_"):
+            gauges[name[len("bert_serve_"):]] = value
+    if not series:
+        return None
+    out = {
+        "healthy": gauges.get("dispatch_alive", 0.0) >= 1.0
+        and gauges.get("draining", 0.0) < 1.0,
+        "dispatch_alive": gauges.get("dispatch_alive", 0.0) >= 1.0,
+        "draining": gauges.get("draining", 0.0) >= 1.0,
+        "queue_depth": gauges.get("queue_depth", 0.0),
+        "requests": sums["requests"],
+        "errors": sums["errors"],
+        "over_slo": sums["over_slo"],
+    }
+    p99 = _histogram_quantile(buckets, 0.99, total=hist_total or None)
+    if p99 is not None:
+        out["latency_p99_ms"] = p99
+    return out
+
+
+def scrape_router(url: str, timeout_s: float = 2.0) -> Optional[dict]:
+    """One router sample off its JSON /statsz (the human surface; the
+    router's /metricsz carries the same counters for standard
+    scrapers)."""
+    try:
+        status, text = _http_get(url, "/statsz", timeout_s)
+        stats = json.loads(text)
+    except (OSError, ValueError):
+        return None
+    if status != 200 or not isinstance(stats, dict):
+        return None
+    out = {"healthy": stats.get("healthy_replicas", 0) > 0}
+    for key in ("requests", "sheds", "errors", "retries",
+                "failovers", "healthy_replicas", "replicas",
+                "latency_p99_ms"):
+        if stats.get(key) is not None:
+            out[key] = stats[key]
+    if stats.get("ok") is not None:
+        # Renamed: the obs_scrape record's own boolean ``ok`` (did the
+        # scrape succeed) must never be clobbered by the router's
+        # ok-request counter.
+        out["requests_ok"] = stats["ok"]
+    return out
+
+
+_SCRAPERS = {
+    "trainer": scrape_trainer,
+    "replica": scrape_replica,
+    "router": scrape_router,
+}
+
+
+class Target:
+    """One scrape target. ``scrape`` is injectable for deterministic
+    tests (a callable ``url -> Optional[dict]``); production resolves it
+    from ``kind``. Mutable sample state (last good sample + its clock
+    time) is only touched by :meth:`FleetCollector.collect_once` under
+    the collector's lock."""
+
+    def __init__(self, name: str, kind: str, url: str,
+                 scrape: Optional[Callable[[str], Optional[dict]]] = None,
+                 timeout_s: float = 2.0):
+        if kind not in TARGET_KINDS:
+            raise ValueError(
+                f"target kind must be one of {TARGET_KINDS}, got {kind!r}")
+        self.name = str(name)
+        self.kind = kind
+        self.url = url.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self._scrape = scrape or (
+            lambda u: _SCRAPERS[kind](u, timeout_s=self.timeout_s))
+        # Sample state (collector-thread-owned, under the collector lock)
+        self.last_ok_at: Optional[float] = None
+        self.last_sample: Optional[dict] = None
+        self.prev_sample: Optional[dict] = None
+        self.prev_ok_at: Optional[float] = None
+        self.failures = 0
+
+
+class JsonlTailer:
+    """Incremental reader of one JSONL sink: returns only the records
+    appended since the last poll. A partial trailing line stays buffered
+    until its newline lands (a writer mid-line never yields a torn
+    record); a truncated/rotated file restarts from the top."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = str(source)
+        self._offset = 0
+        self._buf = ""
+
+    def poll(self) -> List[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self._offset:
+            self._offset = 0  # rotated/truncated: start over
+            self._buf = ""
+        records: List[dict] = []
+        try:
+            with open(self.path, "r", encoding="utf-8") as f:
+                f.seek(self._offset)
+                chunk = f.read()
+                self._offset = f.tell()
+        except OSError:
+            return []
+        data = self._buf + chunk
+        lines = data.split("\n")
+        self._buf = lines.pop()  # "" after a complete final line
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # the schema lint owns strictness
+            if isinstance(rec, dict):
+                records.append(rec)
+        return records
+
+
+class FleetCollector:
+    """Merge scrapes + tailed sinks into one ordered timeline JSONL.
+
+    Drive it either with a background thread (:meth:`start` /
+    :meth:`stop`) or by calling :meth:`collect_once` per pass
+    (deterministic tests, the chaos harness) — one lock serializes the
+    two, so a manual pass and the thread never interleave a pass.
+    ``emit`` optionally receives every timeline record as it is written
+    (the in-memory index the E2E asserts on)."""
+
+    def __init__(
+        self,
+        targets: Sequence[Target],
+        tails: Sequence[JsonlTailer] = (),
+        out_path: Optional[str] = None,
+        emit: Optional[Callable[[dict], None]] = None,
+        interval_s: float = 1.0,
+        slo_error_budget: float = 0.01,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        self._emit_fn = emit
+        self.interval_s = float(interval_s)
+        self.slo_error_budget = float(slo_error_budget)
+        self._clock = clock
+        self._wall = wall
+        self._sleep = sleep
+        # One lock guards the target table, the tailers, the output
+        # file, and the pass counter: collect_once may be driven by a
+        # test/harness thread while the background loop runs (registry,
+        # analysis/concurrency.py).
+        self._lock = threading.Lock()
+        self._targets = list(targets)
+        self._tails = list(tails)
+        self._passes = 0
+        self._started_at = clock()
+        self._out_f = open(out_path, "a", encoding="utf-8") \
+            if out_path else None
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one pass ---------------------------------------------------------
+
+    def collect_once(self) -> Optional[dict]:
+        """Scrape every target concurrently, drain every tailer, write
+        the pass's records in deterministic order. Returns the pass's
+        ``obs_fleet_window`` record (None only when the collector has no
+        targets at all)."""
+        with self._lock:
+            targets = list(self._targets)
+            # Concurrent probes: one bounded thread per target, results
+            # by slot — the scrape_once discipline (a black-holed target
+            # costs max(per-target), and its staleness is RECORDED, not
+            # propagated to the others).
+            results: list = [None] * len(targets)
+            costs: list = [0.0] * len(targets)
+
+            def probe(i: int, target: Target) -> None:
+                t0 = self._clock()
+                try:
+                    results[i] = target._scrape(target.url)
+                except Exception:
+                    results[i] = None
+                finally:
+                    # Per-target cost, stamped inside the probe: the
+                    # pass-level join time is the SLOWEST target's cost
+                    # and must not be misattributed to the healthy ones.
+                    costs[i] = self._clock() - t0
+
+            threads = [threading.Thread(target=probe, args=(i, t),
+                                        name="obs-collect-probe",
+                                        daemon=True)
+                       for i, t in enumerate(targets)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            now = self._clock()
+            wall_ts = self._wall()
+            self._passes += 1
+            harvest: List[Tuple[float, int, int, dict]] = []
+            scrapes = []
+            for idx, (target, sample) in enumerate(zip(targets, results)):
+                target.prev_sample, target.prev_ok_at = (
+                    (target.last_sample, target.last_ok_at)
+                    if sample is not None else
+                    (target.prev_sample, target.prev_ok_at))
+                if sample is not None:
+                    target.failures = 0
+                    staleness = 0.0
+                    target.last_sample = sample
+                    target.last_ok_at = now
+                else:
+                    target.failures += 1
+                    # Never-scraped targets age from collector start:
+                    # a target that was never up is maximally stale,
+                    # not zero-stale.
+                    anchor = (target.last_ok_at
+                              if target.last_ok_at is not None
+                              else self._started_at)
+                    staleness = now - anchor
+                rec = {
+                    "kind": "obs_scrape", "tag": "obs",
+                    "target": target.name, "target_kind": target.kind,
+                    "url": target.url,
+                    "ok": sample is not None,
+                    "staleness_s": round(max(0.0, staleness), 3),
+                    "scrape_ms": round(costs[idx] * 1000.0, 3),
+                }
+                if sample is not None:
+                    # The scrape envelope's own fields win: a sample key
+                    # colliding with ok/target/staleness_s/... must not
+                    # rewrite the record's identity.
+                    rec.update({k: v for k, v in sample.items()
+                                if k not in rec})
+                scrapes.append((target, sample, rec))
+            window = self._fleet_window_locked(targets, scrapes, now)
+            for tail_idx, tailer in enumerate(self._tails):
+                for line_no, rec in enumerate(tailer.poll()):
+                    rec = dict(rec)
+                    rec.setdefault("obs_source", tailer.source)
+                    ts = rec.get("ts")
+                    ts = float(ts) if isinstance(ts, (int, float)) \
+                        and not isinstance(ts, bool) else wall_ts
+                    harvest.append((ts, 1 + tail_idx, line_no, rec))
+            for scrape_idx, (_, _, rec) in enumerate(scrapes):
+                harvest.append((wall_ts, 0, scrape_idx, rec))
+            if window is not None:
+                harvest.append((wall_ts, 0, len(scrapes), window))
+            # Deterministic merge: timestamp order, ties broken by
+            # (source index, per-source sequence) — replaying the same
+            # sources reproduces the same timeline byte for byte.
+            harvest.sort(key=lambda item: (item[0], item[1], item[2]))
+            for ts, _, _, rec in harvest:
+                self._write_locked(rec, ts)
+        return window
+
+    def _fleet_window_locked(self, targets: List[Target],
+                             scrapes, now: float) -> Optional[dict]:
+        if not targets:
+            return None
+        healthy = 0
+        replicas = replicas_healthy = 0
+        trainers_rate: List[float] = []
+        worst_p99: Optional[float] = None
+        fleet_rps = 0.0
+        rps_seen = False
+        over_slo = requests = 0.0
+        max_staleness = 0.0
+        for target, sample, rec in scrapes:
+            max_staleness = max(max_staleness, rec["staleness_s"])
+            ok = sample is not None and bool(sample.get("healthy"))
+            healthy += 1 if ok else 0
+            if target.kind == "replica":
+                replicas += 1
+                replicas_healthy += 1 if ok else 0
+                if sample is not None:
+                    p99 = sample.get("latency_p99_ms")
+                    if p99 is not None:
+                        worst_p99 = p99 if worst_p99 is None \
+                            else max(worst_p99, p99)
+                    requests += float(sample.get("requests", 0.0))
+                    over_slo += float(sample.get("over_slo", 0.0))
+                    prev = target.prev_sample
+                    if prev is not None and target.prev_ok_at is not None \
+                            and now > target.prev_ok_at:
+                        delta = (float(sample.get("requests", 0.0))
+                                 - float(prev.get("requests", 0.0)))
+                        if delta >= 0:
+                            fleet_rps += delta / (now - target.prev_ok_at)
+                            rps_seen = True
+            elif target.kind == "trainer" and sample is not None:
+                rate = sample.get("steps_per_sec")
+                if rate is not None:
+                    trainers_rate.append(float(rate))
+        record = {
+            "kind": "obs_fleet_window", "tag": "obs",
+            "targets_total": len(targets),
+            "targets_healthy": healthy,
+            "max_staleness_s": round(max_staleness, 3),
+        }
+        if replicas:
+            record["replicas_total"] = replicas
+            record["replicas_healthy"] = replicas_healthy
+        if worst_p99 is not None:
+            record["worst_replica_p99_ms"] = round(worst_p99, 3)
+        if rps_seen:
+            record["fleet_rps"] = round(fleet_rps, 3)
+        if trainers_rate:
+            record["trainer_steps_per_sec"] = round(
+                sum(trainers_rate) / len(trainers_rate), 4)
+        if requests > 0:
+            budget = self.slo_error_budget * requests
+            if budget > 0:
+                record["error_budget_burn"] = round(over_slo / budget, 4)
+        return record
+
+    def _write_locked(self, rec: dict, ts: float) -> None:
+        out = dict(rec)
+        out.setdefault("schema", SCHEMA_VERSION)
+        out.setdefault("ts", round(ts, 3))
+        if self._out_f is not None:
+            self._out_f.write(json.dumps(out) + "\n")
+            self._out_f.flush()
+        if self._emit_fn is not None:
+            try:
+                self._emit_fn(out)
+            except Exception:
+                pass  # observability must never take the collector down
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="obs-collector", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop_event.is_set():
+            self.collect_once()
+            self._sleep(self.interval_s)
+
+    def stop(self) -> None:
+        """Stop the background loop, run one final pass (drain anything
+        the sinks appended since the last tick), close the output.
+        Manual drivers (the CLI's own pass loop) that already ran their
+        last pass use :meth:`close` instead — stop()'s drain pass would
+        be an extra, uncounted round."""
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.collect_once()
+        self.close()
+
+    def close(self) -> None:
+        """Close the timeline output without another pass."""
+        with self._lock:
+            if self._out_f is not None:
+                self._out_f.close()
+                self._out_f = None
+
+    def passes(self) -> int:
+        with self._lock:
+            return self._passes

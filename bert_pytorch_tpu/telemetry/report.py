@@ -113,6 +113,8 @@ def summarize_records(records, name: str = "") -> dict:
     resumes = []
     router_windows = []
     fleet_events = []
+    obs_scrapes = []
+    obs_windows = []
     serve_summary: Optional[dict] = None
     router_summary: Optional[dict] = None
     run_summary: Optional[dict] = None
@@ -152,6 +154,10 @@ def summarize_records(records, name: str = "") -> dict:
             router_summary = rec
         elif kind == "fleet_event":
             fleet_events.append(rec)
+        elif kind == "obs_scrape":
+            obs_scrapes.append(rec)
+        elif kind == "obs_fleet_window":
+            obs_windows.append(rec)
         elif kind == "run_summary":
             run_summary = rec
 
@@ -475,6 +481,50 @@ def summarize_records(records, name: str = "") -> dict:
         out["fleet_wedged_kills"] = by_event.get("wedged_kill", 0)
         out["fleet_gave_up"] = by_event.get("gave_up", 0)
 
+    # -- fleet observatory section (telemetry/collector.py, docs/
+    # observability.md) --------------------------------------------------
+    # The collector's timeline carries per-target scrape samples and
+    # per-pass fleet aggregates. Aggregation follows the house
+    # conventions: max over samples for staleness and worst-replica p99
+    # (a dead scrape or a latency cliff anywhere in the run must not
+    # average away), min over windows for the healthy count (the dip is
+    # the signal), weighted medians for rates.
+    if obs_scrapes:
+        out["obs_scrapes"] = len(obs_scrapes)
+        out["obs_targets"] = len({str(r.get("target")) for r in obs_scrapes})
+        out["obs_scrape_failures"] = sum(
+            1 for r in obs_scrapes if not r.get("ok"))
+        stale = [float(r["staleness_s"]) for r in obs_scrapes
+                 if r.get("staleness_s") is not None]
+        if stale:
+            # The metric behind the "fleet scrape staleness" gate.
+            out["fleet_scrape_staleness_s"] = round(max(stale), 3)
+    if obs_windows:
+        out["fleet_windows"] = len(obs_windows)
+        out["fleet_targets"] = max(
+            int(w.get("targets_total", 0)) for w in obs_windows)
+        out["fleet_healthy_min"] = min(
+            int(w.get("targets_healthy", 0)) for w in obs_windows)
+        p99s = [float(w["worst_replica_p99_ms"]) for w in obs_windows
+                if w.get("worst_replica_p99_ms") is not None]
+        if p99s:
+            # The metric behind the "fleet worst-replica p99" gate.
+            out["fleet_worst_replica_p99_ms"] = round(max(p99s), 3)
+        rps = _weighted_median(
+            [(float(w["fleet_rps"]), 1) for w in obs_windows
+             if w.get("fleet_rps") is not None])
+        if rps is not None:
+            out["fleet_rps"] = round(rps, 3)
+        rates = _weighted_median(
+            [(float(w["trainer_steps_per_sec"]), 1) for w in obs_windows
+             if w.get("trainer_steps_per_sec") is not None])
+        if rates is not None:
+            out["fleet_trainer_steps_per_sec"] = round(rates, 4)
+        burns = [float(w["error_budget_burn"]) for w in obs_windows
+                 if w.get("error_budget_burn") is not None]
+        if burns:
+            out["fleet_error_budget_burn"] = round(max(burns), 4)
+
     if run_summary:
         for key, value in run_summary.items():
             if key in ("schema", "ts", "kind", "tag"):
@@ -530,6 +580,14 @@ _CHECKS = (
     # firing) even while the healthy-path latency stays flat.
     ("router_failover_p95_ms", "router failover p95", "up", "p95"),
     ("router_latency_p95_ms", "router p95 latency", "up", "p95"),
+    # Fleet observatory gates (telemetry/collector.py): staleness is
+    # the collector's own health — a growing max means some endpoint
+    # stopped answering (or the collector stopped keeping up), exactly
+    # the blind spot the observatory exists to close; worst-replica p99
+    # is the fleet-level tail the router's balancing is supposed to
+    # hold down even while a replica dies and recovers.
+    ("fleet_scrape_staleness_s", "fleet scrape staleness", "up", "p95"),
+    ("fleet_worst_replica_p99_ms", "fleet worst-replica p99", "up", "p95"),
 )
 
 
@@ -623,6 +681,11 @@ def format_summary(summary: dict) -> str:
              "router_failover_p95_ms",
              "fleet_events", "fleet_spawns", "fleet_crash_restarts",
              "fleet_wedged_kills", "fleet_gave_up",
+             "obs_scrapes", "obs_targets", "obs_scrape_failures",
+             "fleet_windows", "fleet_targets", "fleet_healthy_min",
+             "fleet_scrape_staleness_s", "fleet_worst_replica_p99_ms",
+             "fleet_rps", "fleet_trainer_steps_per_sec",
+             "fleet_error_budget_burn",
              "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",

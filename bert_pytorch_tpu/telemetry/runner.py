@@ -79,6 +79,8 @@ class TrainTelemetry:
         update_ratio_max: float = 1.0,
         grad_warmup: int = 10,
         cost_analysis: str = "auto",
+        introspect=None,
+        flight_recorder=None,
         clock: Callable[[], float] = time.perf_counter,
     ):
         self.is_primary = is_primary
@@ -126,6 +128,17 @@ class TrainTelemetry:
         # setup (data/featurization, sometimes minutes) doesn't count.
         self.watchdog = (HeartbeatWatchdog(watchdog_timeout_s, emit=self.emit)
                         if watchdog_timeout_s and is_primary else None)
+        # Live introspection hub (telemetry/introspect.py) and crash
+        # flight recorder (telemetry/flightrec.py): both fed from emit()
+        # — which background threads (watchdog) also call — so the
+        # bindings are frozen after __init__ (concurrency registry);
+        # each object does its own locking.
+        self.introspect = introspect
+        self.flight_recorder = flight_recorder
+        # The debug HTTP server serving the hub, attached by
+        # telemetry/cli.from_args (or tests); finish()/close() shut it
+        # down so a runner that opened --debug_port never leaks the port.
+        self.debug_server = None
         self._loader_stats: Optional[Callable[[], Optional[dict]]] = None
         self._prefetcher = None
         self._last_sync_target = None
@@ -134,9 +147,17 @@ class TrainTelemetry:
     # -- wiring ---------------------------------------------------------
 
     def emit(self, record=None, **kwargs) -> None:
-        """Write one telemetry record to the JSONL sink (only)."""
+        """Write one telemetry record to the JSONL sink — teeing it into
+        the live introspection hub and the flight-recorder ring first
+        (both no-ops when not attached; an incident record — fault /
+        divergence / sentinel — makes the recorder flush its
+        postmortem)."""
         rec = dict(record or {})
         rec.update(kwargs)
+        if self.introspect is not None:
+            self.introspect.observe_record(rec)
+        if self.flight_recorder is not None:
+            self.flight_recorder.note_record(rec)
         if self.sink is not None:
             self.sink.write_record(rec)
 
@@ -250,6 +271,15 @@ class TrainTelemetry:
             self.sentinel.observe(step, finite, loss)
             if self.timer._step_index % self.heartbeat_every == 0:
                 self.heartbeat.beat(step, last_loss=loss)
+        if self.introspect is not None:
+            # Every step, synced or not: /healthz liveness must not
+            # depend on the sync cadence (the loss rides only when this
+            # step fetched it — reading it off-cadence would BE a sync).
+            hub_loss = None
+            if metrics is not None and synced and \
+                    metrics.get("loss") is not None:
+                hub_loss = float(metrics["loss"])
+            self.introspect.note_step(step, loss=hub_loss)
         if self.watchdog is not None:
             self.watchdog.start().note(step)
         self.profiler.maybe_stop(
@@ -287,9 +317,25 @@ class TrainTelemetry:
             rec.update(summary)
             self.emit(rec)
         self.heartbeat.beat(step)
+        self._shutdown_observability()
+
+    def _shutdown_observability(self) -> None:
+        """Stop the debug server and mark the flight recorder's clean
+        exit (a fault/divergence flush earlier in the run keeps its
+        postmortem; a clean run removes it)."""
+        server, self.debug_server = self.debug_server, None
+        if server is not None:
+            try:
+                server.shutdown()
+                server.server_close()
+            except Exception:
+                pass
+        if self.flight_recorder is not None:
+            self.flight_recorder.close(clean=True)
 
     def close(self) -> None:
         if self.watchdog is not None:
             self.watchdog.stop()
+        self._shutdown_observability()
         if self.sink is not None:
             self.sink.close()

@@ -134,7 +134,23 @@ KIND_REQUIRED_KEYS = {
         "retries", "hedges", "hedge_wins", "failovers",
         "healthy_replicas", "replicas",
     ),
+    # -- fleet observatory family (telemetry/collector.py,
+    # docs/observability.md) --------------------------------------------
+    # one collector probe of one registered endpoint (trainer debug
+    # plane, replica /metricsz, router /statsz): whether the scrape
+    # succeeded, and how stale the target's last GOOD sample is — the
+    # number the "fleet scrape staleness" report gate regresses on
+    "obs_scrape": ("target", "target_kind", "ok", "staleness_s"),
+    # one collector pass's fleet aggregate: healthy/total target counts
+    # (the dip-and-recovery signal when a replica dies), worst-replica
+    # p99, fleet request rate, trainer step rate, error-budget burn
+    "obs_fleet_window": ("targets_total", "targets_healthy",
+                         "max_staleness_s"),
 }
+
+# Target kinds the collector scrapes (telemetry/collector.py; mirrored
+# here so the schema module stays stdlib-only/jax-free like TRACE_PHASES).
+OBS_TARGET_KINDS = ("trainer", "replica", "router")
 
 # serve_trace span names (serve/tracing.py PHASES, mirrored here so the
 # schema module stays stdlib-only/jax-free — tools/check_telemetry_schema
@@ -212,6 +228,10 @@ def validate_record(rec) -> list:
                     _check_fleet_fields(rec, errors)
                 if kind in ("router_window", "router_summary"):
                     _check_router_fields(rec, errors)
+                if kind == "obs_scrape":
+                    _check_obs_scrape_fields(rec, errors)
+                if kind == "obs_fleet_window":
+                    _check_obs_fleet_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -508,6 +528,65 @@ def _check_router_fields(rec, errors) -> None:
             errors.append(
                 f"{prefix} percentiles not ordered "
                 f"({' <= '.join(pcts)}): {present}")
+
+
+def _check_obs_scrape_fields(rec, errors) -> None:
+    """obs_scrape consistency (telemetry/collector.py): the target
+    identity is a non-empty string of a known kind, ``ok`` is a real
+    boolean (the collector's health aggregation and the staleness gate
+    both filter on it), and staleness/scrape cost are non-negative —
+    a negative staleness would mean the collector's clocks ran
+    backwards, which is corruption, not data."""
+    target = rec.get("target")
+    if not isinstance(target, str) or not target:
+        errors.append(f"target must be a non-empty string, got {target!r}")
+    kind = rec.get("target_kind")
+    if kind not in OBS_TARGET_KINDS:
+        errors.append(
+            f"target_kind must be one of {OBS_TARGET_KINDS}, got {kind!r}")
+    if not isinstance(rec.get("ok"), bool):
+        errors.append(
+            f"obs_scrape 'ok' must be a boolean, got {rec.get('ok')!r}")
+    for key in ("staleness_s", "scrape_ms", "queue_depth",
+                "latency_p99_ms", "requests", "errors", "over_slo"):
+        v = rec.get(key)
+        if v is not None and (not _is_number(v) or v < 0):
+            errors.append(
+                f"{key} must be a non-negative number, got {v!r}")
+
+
+def _check_obs_fleet_fields(rec, errors) -> None:
+    """obs_fleet_window consistency (telemetry/collector.py): the
+    healthy/total pairs are non-negative integers with healthy bounded
+    by total (a window claiming more healthy targets than targets is
+    the aggregation bug this invariant exists to catch), and every
+    rate/latency/burn aggregate is a non-negative number."""
+    ints = {}
+    for key in ("targets_total", "targets_healthy", "replicas_total",
+                "replicas_healthy"):
+        v = rec.get(key)
+        if v is None and key in ("replicas_total", "replicas_healthy"):
+            continue  # optional pair: a trainer-only fleet has none
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{key} must be a non-negative integer, got {v!r}")
+        else:
+            ints[key] = v
+    for healthy, total in (("targets_healthy", "targets_total"),
+                           ("replicas_healthy", "replicas_total")):
+        if {healthy, total} <= set(ints) and \
+                ints[healthy] > ints[total]:
+            errors.append(
+                f"{healthy} ({ints[healthy]}) exceeds {total} "
+                f"({ints[total]})")
+    for key in ("max_staleness_s", "worst_replica_p99_ms", "fleet_rps",
+                "trainer_steps_per_sec", "error_budget_burn"):
+        v = rec.get(key)
+        if key == "max_staleness_s" and v is None:
+            continue  # required-key check already flagged it
+        if v is not None and (not _is_number(v) or v < 0):
+            errors.append(
+                f"{key} must be a non-negative number, got {v!r}")
 
 
 def _check_resume_fields(rec, errors) -> None:

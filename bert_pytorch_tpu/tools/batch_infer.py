@@ -138,6 +138,20 @@ def main(argv=None):
             out.close()
         if sink is not None:
             sink.close()
+        if getattr(service, "flight_recorder", None) is not None:
+            exc = sys.exc_info()[1]
+            if exc is not None:
+                # Crashing out of the batch: flush the forensics WITH
+                # the traceback now (a clean close here would delete
+                # the file and disarm the excepthook — zero forensics
+                # for the exact case the recorder exists for).
+                service.flight_recorder.flush("crash", exc=exc)
+            else:
+                # Clean close, like run_server's teardown: a healthy
+                # batch run must not leave a stale postmortem.json for
+                # a later harvest to misread (the atexit hook would
+                # otherwise flush one at interpreter exit).
+                service.flight_recorder.close(clean=True)
     stats["wall_s"] = round(time.perf_counter() - t0, 3)
     startup = service.engine.startup or {}
     stats["quantize"] = startup.get("quantize", args.quantize)

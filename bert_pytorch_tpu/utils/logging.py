@@ -318,6 +318,12 @@ class Logger:
         self.close()
         self.handlers = list(handlers)
 
+    def add_handler(self, handler: Handler) -> None:
+        """Append one handler to an already-initialized logger (the
+        flight recorder's log tee attaches this way — after init, which
+        would otherwise close and replace it)."""
+        self.handlers.append(handler)
+
     def info(self, message: str) -> None:
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
         for h in self.handlers:
@@ -335,6 +341,7 @@ class Logger:
 # Module-level singleton, loggerplus-style.
 logger = Logger()
 init = logger.init
+add_handler = logger.add_handler
 info = logger.info
 log = logger.log
 close = logger.close

@@ -216,7 +216,8 @@ def main(args):
         flops_per_seq=flops_util.bert_finetune_flops_per_seq(
             config, args.max_seq_len, head_outputs=num_labels,
             per_token_head=False, pooled=True),
-        output_dir=args.output_dir or None)
+        output_dir=args.output_dir or None,
+        process="glue")
 
     train_step = tele.instrument(
         jax.jit(train_step, donate_argnums=(0, 1)), "train_step")

@@ -201,7 +201,12 @@ def main(args):
         sink=telemetry_sink,
         seq_per_step=args.batch_size,
         flops_per_seq=flops_util.bert_finetune_flops_per_seq(
-            config, args.max_seq_len, head_outputs=len(args.labels) + 1))
+            config, args.max_seq_len, head_outputs=len(args.labels) + 1),
+        # output_dir anchors the heartbeat/postmortem fallbacks the other
+        # runners already get (run_ner gained --output_dir in PR 5 but
+        # never passed it through).
+        output_dir=args.output_dir or None,
+        process="ner")
 
     train_step = tele.instrument(
         jax.jit(train_step, donate_argnums=(0, 1)), "train_step")

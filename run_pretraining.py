@@ -815,7 +815,8 @@ def main(args) -> dict:
             # step's real_tokens metric divides out the pads
             # (padding_efficiency in the window records).
             tokens_per_step=args.global_batch_size * seq_len,
-            output_dir=args.output_dir)
+            output_dir=args.output_dir,
+            process="pretrain")
         tele.attach_loader(loader)
         train_step = tele.instrument(train_step, "train_step")
 

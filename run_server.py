@@ -32,6 +32,7 @@ import argparse
 import json
 import os
 import signal
+import sys
 
 from bert_pytorch_tpu.utils import logging as logger
 
@@ -100,6 +101,16 @@ def parse_arguments(argv=None):
                              "without an output_dir")
     parser.add_argument("--telemetry_window", type=int, default=64,
                         help="requests per serve_window record")
+    parser.add_argument("--postmortem_file", type=str, default="",
+                        help="crash flight recorder flush target "
+                             "(telemetry/flightrec.py): the bounded ring "
+                             "of this replica's last telemetry records + "
+                             "log lines, written atomically on fault/"
+                             "crash and periodically (so a SIGKILLed "
+                             "replica leaves forensics for the "
+                             "supervisor's postmortem harvest); default "
+                             "<output_dir>/postmortem.json, disabled "
+                             "without an output_dir")
     parser.add_argument("--compile_cache_dir", type=str, default="",
                         help="persistent XLA compile cache; empty disables")
     args = parser.parse_args(argv)
@@ -180,17 +191,31 @@ def build_service(args):
         if args.output_dir else None)
     sink = (logger.JSONLHandler(telemetry_jsonl, overwrite=False)
             if telemetry_jsonl else None)
+    # Crash flight recorder (telemetry/flightrec.py, docs/
+    # observability.md): every telemetry record tees into a bounded
+    # ring, flushed to postmortem.json on fault/crash and periodically —
+    # the file the supervisor harvests when this replica dies.
+    from bert_pytorch_tpu.telemetry.flightrec import FlightRecorder
+
+    postmortem = getattr(args, "postmortem_file", "") or (
+        os.path.join(args.output_dir, "postmortem.json")
+        if args.output_dir else None)
+    recorder = (FlightRecorder(postmortem, process="serve")
+                .install_exit_hooks() if postmortem else None)
+    emit = sink.write_record if sink else None
+    if recorder is not None:
+        emit = recorder.tee(emit)
     serve_tele = ServeTelemetry(
-        emit=sink.write_record if sink else None,
+        emit=emit,
         window=args.telemetry_window)
     monitor = CompileMonitor(
-        emit=sink.write_record if sink else (lambda rec: None))
+        emit=emit if emit is not None else (lambda rec: None))
     # Request tracing + /metricsz (docs/serving.md "Request tracing &
     # metrics"): spans for the head-sampled fraction (and EVERY over-SLO
     # request), serve_phase decomposition windows, Prometheus export.
     from bert_pytorch_tpu.serve.cli import build_tracer
 
-    tracer = build_tracer(args, emit=sink.write_record if sink else None,
+    tracer = build_tracer(args, emit=emit,
                           window=args.telemetry_window)
     # Serve heartbeat: the same resumable liveness file the five training
     # runners maintain, so the capture harness covers serving processes.
@@ -222,6 +247,9 @@ def build_service(args):
         max_pending=args.max_pending)
     service = ServingService(engine, batcher, serve_tele, tracer=tracer,
                              heartbeat=heartbeat)
+    # Rides the service so main()/tests reach it without widening the
+    # (service, sink) signature batch_infer/bench already consume.
+    service.flight_recorder = recorder
     return service, sink
 
 
@@ -240,6 +268,10 @@ def main(args) -> int:
 
     logger.init(handlers=[logger.StreamHandler()])
     service, sink = build_service(args)
+    if service.flight_recorder is not None:
+        # Log lines tee into the flight-recorder ring too: a postmortem
+        # carries the replica's last words, not just its last records.
+        logger.add_handler(service.flight_recorder.log_handler())
     logger.info(
         f"warming {len(service.engine.tasks)} task heads over buckets "
         f"{service.engine.buckets} "
@@ -285,11 +317,13 @@ def main(args) -> int:
     finally:
         logger.info("draining: rejecting new requests (healthz 503), "
                     "flushing in-flight batches, then shutting down")
-        if preempted["signaled"] and sink is not None:
+        if preempted["signaled"] and service.telemetry.emit is not None:
             # The training runners' preemption fault record, serve
             # flavor: the artifact says WHY this run ended (schema v1
             # `fault` kind; step = requests served at the signal).
-            sink.write_record({
+            # Emitted through the teed path so the flight recorder sees
+            # the incident and flushes its postmortem alongside.
+            service.telemetry.emit({
                 "kind": "fault", "tag": "serve", "fault": "preemption",
                 "signal": "SIGTERM", "injected": False,
                 "step": service.telemetry.request_count(),
@@ -298,6 +332,18 @@ def main(args) -> int:
         service.stop()  # drain + dispatch-thread join + telemetry summary
         if sink is not None:
             sink.close()
+        if service.flight_recorder is not None:
+            exc = sys.exc_info()[1]
+            if exc is not None and not isinstance(exc, KeyboardInterrupt):
+                # An exception is escaping the serve loop: flush the
+                # forensics WITH the traceback instead of deleting them
+                # (a clean close would also disarm the excepthook).
+                service.flight_recorder.flush("crash", exc=exc)
+            else:
+                # Clean close removes the postmortem; the preemption
+                # fault above counts as an incident, so a drained
+                # replica keeps its forensics on disk.
+                service.flight_recorder.close(clean=True)
         logger.close()
     from bert_pytorch_tpu.utils import preemption
 
@@ -305,6 +351,4 @@ def main(args) -> int:
 
 
 if __name__ == "__main__":
-    import sys
-
     sys.exit(main(parse_arguments()))

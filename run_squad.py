@@ -271,7 +271,8 @@ def main(args):
             seq_per_step=args.train_batch_size if args.do_train else None,
             flops_per_seq=flops_util.bert_finetune_flops_per_seq(
                 config, args.max_seq_length, head_outputs=2),
-            output_dir=args.output_dir)
+            output_dir=args.output_dir,
+            process="squad")
 
         if args.do_train:
             train_examples = squad.read_squad_examples(

@@ -181,7 +181,8 @@ def main(args):
         * flops_util.bert_finetune_flops_per_seq(
             config, args.max_seq_len, head_outputs=1,
             per_token_head=False, pooled=True),
-        output_dir=args.output_dir or None)
+        output_dir=args.output_dir or None,
+        process="swag")
 
     train_step = tele.instrument(
         jax.jit(train_step, donate_argnums=(0, 1)), "train_step")

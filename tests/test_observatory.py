@@ -1,0 +1,802 @@
+"""Fleet observatory unit tests (ISSUE 12, docs/observability.md):
+the training introspection plane (hub state machine, /metricsz vs JSONL
+agreement), the flight recorder (byte bound, incident/periodic/crash
+flush semantics, torn-write safety), the fleet collector (deterministic
+merge under out-of-order timestamps, black-holed-target concurrency and
+staleness, fleet-window aggregation), the supervisor's heartbeat +
+postmortem harvest, the router's /metricsz, and the telemetry-report
+fleet section with its two named gates.
+
+The end-to-end proof — real replicas + router + a live trainer plane,
+SIGKILL mid-burst, harvested postmortem in the one fleet timeline — is
+tests/test_observatory_e2e.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from bert_pytorch_tpu.serve.router import Router
+from bert_pytorch_tpu.serve.supervisor import ReplicaSpec, Supervisor
+from bert_pytorch_tpu.telemetry import report, schema
+from bert_pytorch_tpu.telemetry.collector import (FleetCollector,
+                                                  JsonlTailer, Target,
+                                                  parse_prometheus)
+from bert_pytorch_tpu.telemetry.flightrec import (FlightRecorder,
+                                                  read_postmortem)
+from bert_pytorch_tpu.telemetry.introspect import (IntrospectionHub,
+                                                   start_debug_server)
+from bert_pytorch_tpu.telemetry.runner import TrainTelemetry
+from bert_pytorch_tpu.utils.retry import RetryPolicy
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _get(url: str):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+# ---------------------------------------------------------------------------
+# telemetry/introspect.py: the hub + the debug plane
+
+
+def test_hub_healthz_warming_ok_stale():
+    clock = FakeClock()
+    hub = IntrospectionHub(process="pretrain", stale_after_s=10.0,
+                           clock=clock)
+    code, body = hub.healthz()
+    assert (code, body["status"]) == (200, "warming")
+    hub.note_step(3, loss=2.0)
+    clock.advance(5.0)
+    code, body = hub.healthz()
+    assert (code, body["status"]) == (200, "ok")
+    assert body["step"] == 3 and body["last_loss"] == 2.0
+    clock.advance(10.1)
+    code, body = hub.healthz()
+    assert (code, body["status"]) == (503, "stale")
+    assert body["step_age_s"] > 10.0
+    # A new step re-arms liveness (the re-heal path).
+    hub.note_step(4)
+    assert hub.healthz()[0] == 200
+
+
+def test_hub_counters_fold_record_kinds():
+    hub = IntrospectionHub()
+    hub.observe_record({"kind": "compile", "fn": "f", "compile_s": 1.5,
+                        "cache": "miss"})
+    hub.observe_record({"kind": "compile", "fn": "f", "compile_s": 0.0,
+                        "cache": "hit"})
+    hub.observe_record({"kind": "sentinel", "step": 4})
+    hub.observe_record({"kind": "divergence", "step": 5})
+    hub.observe_record({"kind": "fault", "fault": "hung_step"})
+    stats = hub.statsz()
+    assert stats["compiles"] == 2
+    assert stats["compile_cache"] == {"miss": 1, "hit": 1}
+    assert stats["nonfinite_steps"] == 1
+    assert stats["divergence_warnings"] == 1
+    assert stats["faults"] == 1
+    assert stats["records"] == 5
+
+
+def test_debug_plane_metricsz_agrees_with_jsonl_window(tmp_path):
+    """THE tentpole consistency property: every numeric field of the
+    last step_window record in the JSONL artifact appears on /metricsz
+    as bert_train_window_<field> with the IDENTICAL value (nested
+    loader gauges as bert_train_loader_<field>) — the scrape surface
+    and the offline artifact cannot drift."""
+    jsonl = tmp_path / "train_telemetry.jsonl"
+    hub = IntrospectionHub(process="unit")
+    tele = TrainTelemetry(jsonl_path=str(jsonl), window=10, sync_every=1,
+                          introspect=hub)
+    tele.attach_loader(type("L", (), {"snapshot": staticmethod(
+        lambda: {"batches": 7, "wait_s_total": 0.25, "stalls": 1,
+                 "depth_max": 3})})())
+    server = start_debug_server(hub, port=0)
+    try:
+        for step in range(1, 24):
+            tele.timer.data_start()
+            tele.timer.data_end()
+            tele.dispatch_done()
+            tele.step_done(step, {"loss": 2.0 + 0.01 * step})
+        host, port = server.server_address[:2]
+        code, text = _get(f"http://{host}:{port}/metricsz")
+        assert code == 200
+        gauges = {name: value
+                  for name, labels, value in parse_prometheus(text)}
+        windows = [rec for rec in report.iter_records(str(jsonl))
+                   if rec.get("kind") == "step_window"]
+        assert len(windows) == 2  # 23 steps, window 10
+        last = windows[-1]
+        checked = 0
+        for key, value in last.items():
+            if key in ("kind", "tag", "schema", "ts"):
+                continue
+            if isinstance(value, (int, float)) and \
+                    not isinstance(value, bool):
+                assert gauges[f"bert_train_window_{key}"] == \
+                    pytest.approx(value, abs=0.0), key
+                checked += 1
+            elif isinstance(value, dict):
+                for sub, sv in value.items():
+                    if isinstance(sv, (int, float)):
+                        assert gauges[f"bert_train_{key}_{sub}"] == \
+                            pytest.approx(sv, abs=0.0), (key, sub)
+                        checked += 1
+        assert checked >= 10  # the window genuinely exports its fields
+        # Liveness + route sanity on the same server.
+        code, body = _get(f"http://{host}:{port}/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        code, body = _get(f"http://{host}:{port}/statsz")
+        assert json.loads(body)["last_window"]["step"] == last["step"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        tele.close()
+
+
+def test_from_args_wires_debug_plane_and_recorder(tmp_path):
+    """The runner wiring (telemetry/cli.py): --debug_port stands up the
+    live plane, output_dir anchors the flight recorder, and finish()
+    tears both down (port released, clean run leaves no postmortem)."""
+    import argparse
+    import socket
+
+    from bert_pytorch_tpu.telemetry import cli as tcli
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    parser = argparse.ArgumentParser()
+    tcli.add_cli_args(parser)
+    args = parser.parse_args(["--debug_port", str(port)])
+    tele = tcli.from_args(args, output_dir=str(tmp_path), process="unit")
+    try:
+        assert tele.debug_server is not None
+        assert tele.flight_recorder is not None
+        assert tele.flight_recorder.path == \
+            str(tmp_path / "postmortem.json")
+        code, body = _get(f"http://127.0.0.1:{port}/healthz")
+        payload = json.loads(body)
+        assert code == 200 and payload["status"] == "warming"
+        assert payload["process"] == "unit"
+    finally:
+        tele.finish(0)
+        tele.close()
+    with pytest.raises(OSError):
+        _get(f"http://127.0.0.1:{port}/healthz")
+    assert not os.path.exists(tmp_path / "postmortem.json")
+
+
+def test_from_args_debug_port_zero_disables(tmp_path):
+    import argparse
+
+    from bert_pytorch_tpu.telemetry import cli as tcli
+
+    parser = argparse.ArgumentParser()
+    tcli.add_cli_args(parser)
+    tele = tcli.from_args(parser.parse_args([]))
+    assert tele.debug_server is None
+    assert tele.introspect is None
+    assert tele.flight_recorder is None  # no output_dir, no flag
+    tele.close()
+
+
+# ---------------------------------------------------------------------------
+# telemetry/flightrec.py: the ring + flush semantics
+
+
+def test_flightrec_ring_never_exceeds_byte_bound(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "pm.json"), max_bytes=4096,
+                         flush_interval_s=1e9)
+    for i in range(500):
+        rec.note_record({"kind": "step_window", "step": i,
+                         "pad": "x" * (i % 97)})
+        assert rec.ring_bytes() <= 4096
+    rec.note_line("y" * 100000)  # oversized entries are stubbed
+    assert rec.ring_bytes() <= 4096
+    pm_path = rec.flush("unit")
+    pm = read_postmortem(pm_path)
+    assert pm["ring_bytes"] <= 4096
+    assert pm["dropped"] > 0 and pm["records"]
+    # Newest records survive eviction, oldest go first.
+    assert pm["records"][-1]["step"] == 499
+
+
+def test_flightrec_incident_flush_and_clean_close(tmp_path):
+    path = str(tmp_path / "pm.json")
+    rec = FlightRecorder(path, flush_interval_s=float("inf"))
+    rec.note_record({"kind": "step_window", "step": 1})
+    assert not os.path.exists(path)  # periodic flushing disabled
+    rec.note_record({"kind": "fault", "fault": "preemption",
+                     "injected": False})
+    pm = read_postmortem(path)
+    assert pm["reason"] == "fault:preemption"
+    assert [r["kind"] for r in pm["records"]] == ["step_window", "fault"]
+    rec.close(clean=True)
+    assert os.path.exists(path)  # incident forensics survive clean close
+
+    clean = FlightRecorder(str(tmp_path / "pm2.json"),
+                           flush_interval_s=0.0)
+    clean.note_record({"kind": "step_window", "step": 1})
+    assert os.path.exists(clean.path)  # periodic flush
+    clean.close(clean=True)
+    assert not os.path.exists(clean.path)  # clean run leaves no stale file
+
+
+def test_flightrec_periodic_flush_survives_sigkill_semantics(tmp_path):
+    """The SIGKILL story: no atexit, no excepthook — the last periodic
+    flush IS the postmortem. Fake clock drives the cadence."""
+    clock = FakeClock()
+    rec = FlightRecorder(str(tmp_path / "pm.json"), flush_interval_s=2.0,
+                         clock=clock)
+    rec.note_record({"kind": "serve_window", "window_requests": 8})
+    first = read_postmortem(rec.path)
+    assert first["reason"] == "periodic"  # first note flushes immediately
+    clock.advance(1.0)
+    rec.note_record({"kind": "serve_window", "window_requests": 9})
+    assert read_postmortem(rec.path) == first  # cadence not due: no write
+    clock.advance(1.5)
+    rec.note_record({"kind": "serve_window", "window_requests": 10})
+    assert len(read_postmortem(rec.path)["records"]) == 3
+
+
+def test_flightrec_torn_write_safe(tmp_path, monkeypatch):
+    """tmp + rename: a failed replace leaves the previous postmortem
+    intact, and the on-disk file is ALWAYS complete JSON."""
+    from bert_pytorch_tpu.telemetry import flightrec as mod
+
+    path = str(tmp_path / "pm.json")
+    rec = FlightRecorder(path, flush_interval_s=1e9)
+    rec.note_record({"kind": "step_window", "step": 1})
+    rec.flush("first")
+    before = read_postmortem(path)
+
+    real_replace = os.replace
+
+    def broken_replace(src, dst):
+        raise OSError("disk pulled mid-rename")
+
+    monkeypatch.setattr(mod.os, "replace", broken_replace)
+    rec.note_record({"kind": "step_window", "step": 2})
+    rec.flush("second")  # swallowed: forensics never crash the process
+    assert read_postmortem(path) == before  # target untouched
+    monkeypatch.setattr(mod.os, "replace", real_replace)
+    rec.flush("third")
+    assert read_postmortem(path)["reason"] == "third"
+
+
+def test_flightrec_excepthook_keeps_traceback_over_atexit(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "pm.json"), flush_interval_s=1e9)
+    rec.note_record({"kind": "step_window", "step": 7})
+    try:
+        raise RuntimeError("boom at step 7")
+    except RuntimeError as exc:
+        rec.flush("crash", exc=exc)
+    pm = read_postmortem(rec.path)
+    assert "boom at step 7" in pm["exception"]
+    # The atexit pass after an excepthook flush must NOT overwrite the
+    # traceback-carrying payload with a contextless one.
+    rec._atexit_flush()
+    assert read_postmortem(rec.path)["reason"] == "crash"
+
+
+def test_flightrec_stale_flush_never_clobbers_newer_payload(tmp_path):
+    """The build-under-lock/write-after-release window (review
+    finding): a descheduled periodic flush must not overwrite a newer
+    crash payload already on disk — _write is ordered by sequence."""
+    rec = FlightRecorder(str(tmp_path / "pm.json"),
+                         flush_interval_s=float("inf"))
+    rec.note_record({"kind": "step_window", "step": 1})
+    stale = rec._payload_locked("periodic")
+    rec.note_record({"kind": "step_window", "step": 2})
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as exc:
+        rec.flush("crash", exc=exc)  # seq 1, written
+    rec._write(stale, seq=0)  # the descheduled older writer resumes
+    pm = read_postmortem(rec.path)
+    assert pm["reason"] == "crash" and "boom" in pm["exception"]
+
+
+def test_from_args_survives_debug_port_conflict(tmp_path):
+    """A held port costs the debug plane, never the training run
+    (review finding: the bind error used to crash the runner)."""
+    import argparse
+    import socket
+
+    from bert_pytorch_tpu.telemetry import cli as tcli
+
+    holder = socket.socket()
+    holder.bind(("127.0.0.1", 0))
+    holder.listen(1)
+    port = holder.getsockname()[1]
+    try:
+        parser = argparse.ArgumentParser()
+        tcli.add_cli_args(parser)
+        tele = tcli.from_args(parser.parse_args(["--debug_port",
+                                                 str(port)]))
+        assert tele.debug_server is None  # plane disabled, run alive
+        assert tele.introspect is not None
+        tele.close()
+    finally:
+        holder.close()
+
+
+def test_flightrec_tee_and_log_handler(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "pm.json"), flush_interval_s=1e9)
+    seen = []
+    teed = rec.tee(seen.append)
+    teed({"kind": "serve_window", "window_requests": 4})
+    assert seen == [{"kind": "serve_window", "window_requests": 4}]
+    handler = rec.log_handler()
+    handler.write_message("[ts] warming 1 task heads")
+    handler.write_record({"tag": "train", "step": 3, "loss": float("nan")})
+    pm = read_postmortem(rec.flush("unit"))
+    assert pm["lines"] == ["[ts] warming 1 task heads"]
+    assert pm["records"][-1]["loss"] is None  # NaN sanitized, not raw
+
+
+# ---------------------------------------------------------------------------
+# telemetry/collector.py: merge, staleness, aggregation
+
+
+def _mk_tail(tmp_path, name, records):
+    path = tmp_path / f"{name}.jsonl"
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    return JsonlTailer(str(path), name)
+
+
+def test_collector_merge_deterministic_under_out_of_order_ts(tmp_path):
+    """Two identical runs over shuffled-timestamp sources produce the
+    SAME timeline, in timestamp order within the pass."""
+    recs_a = [{"schema": 1, "ts": 100.0 + t, "kind": "fleet_event",
+               "tag": "fleet", "event": "spawn", "replica": 0, "port": 1}
+              for t in (5, 1, 3)]
+    recs_b = [{"schema": 1, "ts": 100.0 + t, "kind": "fleet_event",
+               "tag": "fleet", "event": "exit", "replica": 1, "port": 2}
+              for t in (4, 2)]
+
+    tail_a = _mk_tail(tmp_path, "a", recs_a)
+    tail_b = _mk_tail(tmp_path, "b", recs_b)
+
+    def run(out_name):
+        out = tmp_path / out_name
+        coll = FleetCollector(
+            [], tails=[JsonlTailer(tail_a.path, "a"),
+                       JsonlTailer(tail_b.path, "b")],
+            out_path=str(out), wall=lambda: 200.0)
+        coll.collect_once()
+        coll.stop()
+        return out.read_bytes()
+
+    one, two = run("one"), run("two")
+    assert one == two
+    timeline = [json.loads(line) for line in one.decode().splitlines()]
+    tailed = [r for r in timeline if r.get("kind") == "fleet_event"]
+    assert [r["ts"] for r in tailed] == sorted(r["ts"] for r in tailed)
+    assert all(r["obs_source"] for r in tailed)
+    # Tailers are incremental: a second pass re-reads nothing.
+    errors = schema.validate_file(str(tmp_path / "one"))
+    assert errors == []
+
+
+def test_collector_tailer_incremental_and_partial_lines(tmp_path):
+    path = tmp_path / "sink.jsonl"
+    path.write_text('{"a": 1}\n{"b": 2')
+    tail = JsonlTailer(str(path), "s")
+    assert tail.poll() == [{"a": 1}]
+    assert tail.poll() == []  # the partial line stays buffered
+    with open(path, "a") as f:
+        f.write("}\n")
+    assert tail.poll() == [{"b": 2}]
+
+
+def test_collector_blackholed_target_concurrent_and_stale():
+    """One dead target cannot stall the pass (concurrent probes, the
+    scrape_once discipline) and its staleness is RECORDED per pass."""
+    clock = FakeClock()
+    stall = threading.Event()
+
+    def dead(url):
+        stall.wait(timeout=0.5)  # a black-holed transport timing out
+        return None
+
+    fast_called = []
+
+    def fast(url):
+        fast_called.append(time.monotonic())
+        return {"healthy": True, "requests": 10.0}
+
+    emitted = []
+    coll = FleetCollector(
+        [Target("dead", "replica", "http://x", scrape=dead),
+         Target("fast", "replica", "http://y", scrape=fast)],
+        emit=emitted.append, clock=clock, wall=lambda: 500.0)
+    t0 = time.monotonic()
+    clock.advance(1.0)
+    coll.collect_once()
+    wall = time.monotonic() - t0
+    assert wall < 1.5  # one stalled probe, not two serialized
+    scrapes = {r["target"]: r for r in emitted
+               if r.get("kind") == "obs_scrape"}
+    assert scrapes["fast"]["ok"] is True
+    assert scrapes["fast"]["staleness_s"] == 0.0
+    assert scrapes["dead"]["ok"] is False
+    assert scrapes["dead"]["staleness_s"] > 0
+    first_stale = scrapes["dead"]["staleness_s"]
+    clock.advance(3.0)
+    emitted.clear()
+    coll.collect_once()
+    dead_rec = [r for r in emitted if r.get("target") == "dead"][0]
+    assert dead_rec["staleness_s"] >= first_stale + 3.0  # grows per pass
+    window = [r for r in emitted
+              if r.get("kind") == "obs_fleet_window"][0]
+    assert window["targets_total"] == 2
+    assert window["targets_healthy"] == 1
+    assert window["max_staleness_s"] == dead_rec["staleness_s"]
+
+
+def test_collector_fleet_window_aggregates():
+    clock = FakeClock()
+    replica_state = {"r0": 100.0, "r1": 200.0}
+
+    def mk_scrape(name, p99):
+        def scrape(url):
+            return {"healthy": True, "requests": replica_state[name],
+                    "over_slo": 4.0, "latency_p99_ms": p99}
+        return scrape
+
+    def trainer(url):
+        return {"healthy": True, "steps_per_sec": 3.5}
+
+    emitted = []
+    coll = FleetCollector(
+        [Target("r0", "replica", "http://a", scrape=mk_scrape("r0", 40.0)),
+         Target("r1", "replica", "http://b", scrape=mk_scrape("r1", 90.0)),
+         Target("t0", "trainer", "http://c", scrape=trainer)],
+        emit=emitted.append, clock=clock, slo_error_budget=0.1)
+    coll.collect_once()
+    clock.advance(2.0)
+    replica_state["r0"] += 50.0   # 25 req/s
+    replica_state["r1"] += 10.0   # 5 req/s
+    emitted.clear()
+    coll.collect_once()
+    window = [r for r in emitted
+              if r.get("kind") == "obs_fleet_window"][0]
+    assert window["replicas_total"] == 2
+    assert window["replicas_healthy"] == 2
+    assert window["worst_replica_p99_ms"] == 90.0
+    assert window["fleet_rps"] == pytest.approx(30.0)
+    assert window["trainer_steps_per_sec"] == pytest.approx(3.5)
+    # 8 over-SLO of 360 requests at 10% budget: burn well under 1.
+    assert 0 < window["error_budget_burn"] < 1
+    for rec in emitted:
+        assert schema.validate_record(rec) == []
+
+
+def test_replica_p99_counts_overflow_bucket(monkeypatch):
+    """The worst-replica p99 must see observations past the largest
+    finite histogram bound (they live only in the +Inf bucket / _count
+    series): a 5%-of-requests tail blowup is exactly the incident the
+    'fleet worst-replica p99' gate exists to catch (review finding)."""
+    from bert_pytorch_tpu.telemetry import collector as mod
+
+    text = "\n".join([
+        "bert_serve_dispatch_alive 1",
+        "bert_serve_draining 0",
+        "bert_serve_queue_depth 0",
+        'bert_serve_requests_total{task="classify"} 100',
+        'bert_serve_phase_latency_ms_bucket{task="classify",'
+        'phase="total",le="10"} 95',
+        'bert_serve_phase_latency_ms_bucket{task="classify",'
+        'phase="total",le="2500"} 95',
+        'bert_serve_phase_latency_ms_bucket{task="classify",'
+        'phase="total",le="+Inf"} 100',
+        'bert_serve_phase_latency_ms_count{task="classify",'
+        'phase="total"} 100',
+    ]) + "\n"
+    monkeypatch.setattr(mod, "_http_get", lambda url, path, t: (200, text))
+    sample = mod.scrape_replica("http://x")
+    # 99th of 100 sits among the 5 overflow observations: the estimate
+    # floors at the largest finite bound, never the fast-path 10ms.
+    assert sample["latency_p99_ms"] == 2500.0
+
+
+def test_scrape_trainer_counts_wedged_trainer_unhealthy():
+    """A trainer wedged in a hung collective keeps answering /metricsz
+    (the HTTP threads are fine) — the scraper must read the step age
+    against the exported staleness bound, not just 'the port answered'
+    (the review finding: bert_train_up alone is always 1)."""
+    from bert_pytorch_tpu.telemetry.collector import scrape_trainer
+
+    clock = FakeClock()
+    hub = IntrospectionHub(process="t", stale_after_s=10.0, clock=clock)
+    hub.note_step(5)
+    server = start_debug_server(hub, port=0)
+    try:
+        url = "http://%s:%d" % server.server_address[:2]
+        assert scrape_trainer(url)["healthy"] is True
+        clock.advance(11.0)  # past the bound: /healthz would say 503
+        sample = scrape_trainer(url)
+        assert sample["healthy"] is False
+        assert sample["step_age_s"] > 10.0
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# serve/supervisor.py: heartbeat + postmortem harvest
+
+
+class FakeProc:
+    _pids = iter(range(6000, 7000))
+
+    def __init__(self):
+        self.pid = next(FakeProc._pids)
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.rc = 0
+
+
+def _harvest_supervisor(tmp_path, clock, events):
+    pm_path = str(tmp_path / "postmortem.json")
+    procs = []
+
+    def spawn(spec):
+        procs.append(FakeProc())
+        return procs[-1]
+
+    sup = Supervisor(
+        [ReplicaSpec(0, 9001, ["run_server"], postmortem_file=pm_path)],
+        emit=events.append, spawn=spawn,
+        policy=RetryPolicy(attempts=5, base_delay_s=1.0, jitter=0.0),
+        heartbeat_file=str(tmp_path / "sup_heartbeat.json"),
+        clock=clock, sleep=lambda s: None)
+    return sup, procs, pm_path
+
+
+def test_supervisor_writes_its_own_heartbeat(tmp_path):
+    clock = FakeClock()
+    events: list = []
+    sup, procs, _ = _harvest_supervisor(tmp_path, clock, events)
+    sup.start(monitor=False)
+    sup.poll_once()
+    hb = json.load(open(tmp_path / "sup_heartbeat.json"))
+    assert (hb["step"], hb["counter"]) == (1, 1)
+    sup.poll_once()
+    hb = json.load(open(tmp_path / "sup_heartbeat.json"))
+    assert (hb["step"], hb["counter"]) == (2, 2)
+    # Resumable like every heartbeat: a new supervisor continues the
+    # counter instead of restarting it (liveness = "did it advance").
+    sup2 = Supervisor([ReplicaSpec(0, 9001, ["x"])],
+                      spawn=lambda spec: FakeProc(),
+                      heartbeat_file=str(tmp_path / "sup_heartbeat.json"),
+                      clock=clock, sleep=lambda s: None)
+    sup2.start(monitor=False)
+    sup2.poll_once()
+    assert json.load(open(tmp_path / "sup_heartbeat.json"))["counter"] == 3
+
+
+def test_supervisor_harvests_postmortem_on_crash(tmp_path):
+    clock = FakeClock()
+    events: list = []
+    sup, procs, pm_path = _harvest_supervisor(tmp_path, clock, events)
+    sup.start(monitor=False)
+    # The replica's flight recorder flushed before it died (periodic).
+    json.dump({"process": "serve", "reason": "periodic",
+               "flushed_at": 123.0, "ring_entries": 9, "ring_bytes": 512,
+               "dropped": 0,
+               "records": [{"kind": "serve_window", "window_requests": i}
+                           for i in range(8)],
+               "lines": ["serving on :9001"]},
+              open(pm_path, "w"))
+    procs[-1].rc = -9  # SIGKILL
+    sup.poll_once()
+    harvests = [e for e in events if e["event"] == "postmortem"]
+    assert len(harvests) == 1
+    h = harvests[0]
+    assert h["found"] is True and h["context"] == "exit"
+    assert h["reason"] == "periodic" and h["ring_entries"] == 9
+    assert len(h["records"]) == 5  # bounded tail, newest kept
+    assert h["records"][-1]["window_requests"] == 7
+    assert h["lines"] == ["serving on :9001"]
+    assert schema.validate_record(
+        dict(h, schema=1, ts=1.0)) == []
+    # The respawn wipes the dead incarnation's file: fresh forensics.
+    clock.advance(1.01)
+    sup.poll_once()
+    assert len(procs) == 2
+    assert not os.path.exists(pm_path)
+
+
+def test_supervisor_graceful_exit_does_not_harvest(tmp_path):
+    clock = FakeClock()
+    events: list = []
+    sup, procs, pm_path = _harvest_supervisor(tmp_path, clock, events)
+    sup.start(monitor=False)
+    json.dump({"reason": "periodic", "records": [], "lines": []},
+              open(pm_path, "w"))
+    procs[-1].rc = 0  # operator stop, not a crash
+    sup.poll_once()
+    assert not any(e["event"] == "postmortem" for e in events)
+
+
+def test_supervisor_harvest_names_missing_postmortem(tmp_path):
+    """A crash before the first flush is itself diagnostic — the event
+    says found=false instead of silently skipping."""
+    clock = FakeClock()
+    events: list = []
+    sup, procs, pm_path = _harvest_supervisor(tmp_path, clock, events)
+    sup.start(monitor=False)
+    procs[-1].rc = 1
+    sup.poll_once()
+    harvests = [e for e in events if e["event"] == "postmortem"]
+    assert harvests and harvests[0]["found"] is False
+
+
+# ---------------------------------------------------------------------------
+# serve/router.py: the Prometheus export
+
+
+def test_router_metricsz_matches_statsz():
+    router = Router(["http://127.0.0.1:1"],
+                    scrape=lambda url: {"dispatch_alive": True,
+                                        "draining": False,
+                                        "queue_depth": 2},
+                    transport=lambda url, task, payload, t: (200, {}),
+                    sleep=lambda s: None)
+    router.scrape_once()
+    for _ in range(3):
+        status, _, _ = router.handle("classify", {"text": "x"})
+        assert status == 200
+    text = router.metrics_text()
+    series = {name: value for name, labels, value
+              in parse_prometheus(text) if not labels}
+    snap = router.snapshot()
+    assert series["bert_router_requests_total"] == snap["requests"] == 3
+    assert series["bert_router_ok_total"] == snap["ok"] == 3
+    assert series["bert_router_healthy_replicas"] == 1
+    labeled = {(name, labels.get("replica"), labels.get("field")): value
+               for name, labels, value in parse_prometheus(text) if labels}
+    assert labeled[("bert_router_replica_state", "0", "healthy")] == 1
+    assert labeled[("bert_router_replica_state", "0", "queue_depth")] == 2
+
+
+# ---------------------------------------------------------------------------
+# report: the fleet observatory section + its two named gates
+
+
+def _timeline_records(stale=0.4, p99=45.0):
+    return [
+        {"kind": "obs_scrape", "target": "r0", "target_kind": "replica",
+         "ok": True, "staleness_s": 0.0},
+        {"kind": "obs_scrape", "target": "r1", "target_kind": "replica",
+         "ok": False, "staleness_s": stale},
+        {"kind": "obs_fleet_window", "targets_total": 3,
+         "targets_healthy": 2, "max_staleness_s": stale,
+         "replicas_total": 2, "replicas_healthy": 1,
+         "worst_replica_p99_ms": p99, "fleet_rps": 40.0,
+         "trainer_steps_per_sec": 3.0, "error_budget_burn": 0.5},
+        {"kind": "obs_fleet_window", "targets_total": 3,
+         "targets_healthy": 3, "max_staleness_s": 0.0},
+    ]
+
+
+def test_report_summarizes_fleet_observatory_section():
+    summary = report.summarize_records(_timeline_records(), name="t")
+    assert summary["obs_scrapes"] == 2
+    assert summary["obs_targets"] == 2
+    assert summary["obs_scrape_failures"] == 1
+    assert summary["fleet_scrape_staleness_s"] == 0.4
+    assert summary["fleet_windows"] == 2
+    assert summary["fleet_targets"] == 3
+    assert summary["fleet_healthy_min"] == 2
+    assert summary["fleet_worst_replica_p99_ms"] == 45.0
+    assert summary["fleet_error_budget_burn"] == 0.5
+
+
+def test_report_gates_fleet_staleness_and_worst_p99_by_name(tmp_path):
+    """An injected staleness/latency regression exits nonzero NAMING
+    the fleet gate — through the real CLI shim, the ISSUE acceptance."""
+    base = report.summarize_records(_timeline_records(), name="base")
+    worse = report.summarize_records(
+        _timeline_records(stale=5.0, p99=200.0), name="new")
+    regressions, checks = report.compare(base, worse)
+    names = {r["label"] for r in regressions}
+    assert "fleet scrape staleness" in names
+    assert "fleet worst-replica p99" in names
+    # And via the CLI: rc 1, gate named in stdout.
+    base_path = tmp_path / "base.jsonl"
+    new_path = tmp_path / "new.jsonl"
+    for path, stale, p99 in ((base_path, 0.4, 45.0),
+                             (new_path, 5.0, 200.0)):
+        with open(path, "w") as f:
+            for rec in _timeline_records(stale=stale, p99=p99):
+                f.write(json.dumps(dict(rec, schema=1, ts=1.0)) + "\n")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "telemetry_report.py"),
+         str(new_path), str(base_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "fleet scrape staleness" in proc.stdout
+    assert "fleet worst-replica p99" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# fixtures + the obs_collect CLI (jax-free parent)
+
+
+def test_obs_schema_fixtures_lint_as_expected():
+    good = os.path.join(HERE, "fixtures", "telemetry", "obs_good.jsonl")
+    bad = os.path.join(HERE, "fixtures", "telemetry", "obs_bad.jsonl")
+    assert schema.validate_file(good) == []
+    errors = schema.validate_file(bad)
+    assert len(errors) >= 6
+    text = " ".join(err for _, err in errors)
+    assert "target_kind" in text
+    assert "targets_healthy" in text
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "check_telemetry_schema.py"),
+         good, bad],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+    assert "obs_good.jsonl: ok" in proc.stdout
+    assert "obs_bad" in proc.stdout
+
+
+def test_obs_collect_cli_tails_and_self_lints(tmp_path):
+    sink = tmp_path / "fleet.jsonl"
+    with open(sink, "w") as f:
+        f.write(json.dumps({"schema": 1, "ts": 1.0, "kind": "fleet_event",
+                            "tag": "fleet", "event": "spawn",
+                            "replica": 0, "port": 9001}) + "\n")
+    out = tmp_path / "timeline.jsonl"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "obs_collect.py"),
+         "--tail", f"fleet={sink}", "--out", str(out),
+         "--passes", "2", "--interval_s", "0.05"],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.join(REPO_ROOT, "tools"))
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "ok" in proc.stdout
+    timeline = [json.loads(line) for line in open(out)]
+    assert any(r.get("kind") == "fleet_event" for r in timeline)
+    assert schema.validate_file(str(out)) == []

@@ -1,0 +1,416 @@
+"""ISSUE 12 E2E acceptance (docs/observability.md): the fleet
+observatory over a REAL fleet on the chaos-tiny config.
+
+One test stands up 2 ``run_server.py`` replicas (supervised, warming
+from one shared persistent AOT cache) behind the router, plus a live
+in-process training loop exporting the ``--debug_port`` introspection
+plane, and runs the fleet collector over all of it while a client burst
+flows and replica 0 is SIGKILLed mid-burst. Asserted on the ONE merged
+timeline the collector writes:
+
+* schema-clean end to end (``obs_scrape``/``obs_fleet_window`` +
+  every tailed fleet/trainer record);
+* the trainer's /metricsz agrees with its JSONL step_window artifact
+  per metric name (the introspection plane's consistency contract);
+* the SIGKILLed replica's harvested postmortem is IN the timeline
+  (fleet_event ``postmortem`` with a non-empty ring tail — the flight
+  recorder's periodic flush survived the kill);
+* an ``obs_fleet_window`` shows the healthy-count dip AND a later
+  window shows recovery (supervised respawn, warm restart);
+* an injected staleness regression makes ``telemetry-report`` exit
+  nonzero NAMING the fleet gate.
+
+Kept in its own module (like tests/test_fleet_chaos.py) so the
+subprocess fleet never slows collection of the in-process observatory
+tests. Budgeted for the throttled 2-core tier-1 box: one fleet
+spin-up, one small burst, one kill/recover cycle.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from bert_pytorch_tpu.serve import router as router_mod
+from bert_pytorch_tpu.serve import supervisor as supervisor_mod
+from bert_pytorch_tpu.telemetry import report, schema
+from bert_pytorch_tpu.telemetry.collector import (FleetCollector,
+                                                  JsonlTailer, Target,
+                                                  parse_prometheus)
+from bert_pytorch_tpu.telemetry.introspect import (IntrospectionHub,
+                                                   start_debug_server)
+from bert_pytorch_tpu.telemetry.runner import TrainTelemetry
+from bert_pytorch_tpu.tools import make_synthetic_data as synth
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+
+PHRASES = (
+    "paris is big", "the river runs through london",
+    "william shakespeare wrote hamlet", "england is old",
+    "the capital of france is paris", "hamlet was wrote in london",
+)
+
+
+def model_config() -> dict:
+    vocab = 5 + len(synth.TRACE_WORDS)
+    vocab += (8 - vocab % 8) % 8
+    return {
+        "vocab_size": vocab, "hidden_size": 16, "num_hidden_layers": 1,
+        "num_attention_heads": 2, "intermediate_size": 32,
+        "max_position_embeddings": 32, "type_vocab_size": 2,
+        "next_sentence": True, "mask_token_id": 4,
+        "hidden_dropout_prob": 0.0, "attention_probs_dropout_prob": 0.0,
+    }
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_spawn(log_dir: str):
+    """Replica Popen factory: pin CPU jax, strip the test harness's
+    virtual-device flag (the replicas must not build an 8-device mesh),
+    tee output per replica (tools/chaos_serve.py discipline)."""
+
+    def spawn(spec):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("BERT_FAULTS", None)
+        xla = " ".join(
+            flag for flag in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in flag)
+        if xla:
+            env["XLA_FLAGS"] = xla
+        else:
+            env.pop("XLA_FLAGS", None)
+        if spec.env:
+            env.update(spec.env)
+        log = open(os.path.join(log_dir, f"replica_{spec.index}.log"), "ab")
+        return subprocess.Popen(spec.cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+
+    return spawn
+
+
+class Sink:
+    """Thread-safe schema-v1 JSONL sink + in-memory index (the chaos
+    harness's Sink, trimmed): supervisor + router emit through it, the
+    collector tails the file, the test asserts on the index."""
+
+    def __init__(self, path: str):
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+        self.records = []
+
+    def write(self, record: dict) -> None:
+        rec = {"schema": schema.SCHEMA_VERSION,
+               "ts": round(time.time(), 3)}
+        rec.update(record)
+        with self._lock:
+            self.records.append(rec)
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+
+    def count(self, event: str) -> int:
+        with self._lock:
+            return sum(1 for r in self.records
+                       if r.get("event") == event)
+
+    def close(self) -> None:
+        with self._lock:
+            self._f.close()
+
+
+def post(url: str, task: str, payload: dict, timeout_s: float):
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("POST", f"/v1/{task}",
+                     body=json.dumps(payload).encode("utf-8"),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        return resp.status, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+def wait_until(pred, timeout_s: float, what: str, poll_s: float = 0.25):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(poll_s)
+    raise AssertionError(f"timed out after {timeout_s:g}s waiting for "
+                         f"{what}")
+
+
+class TrainerPlane:
+    """A live in-process training loop on the real TrainTelemetry
+    facade with the real debug server — the 'short training run with
+    --debug_port' of the acceptance, without a third jax subprocess on
+    the throttled box (the subprocess runners wire the identical path
+    through telemetry/cli.from_args)."""
+
+    def __init__(self, workdir: str):
+        self.jsonl = os.path.join(workdir, "trainer_telemetry.jsonl")
+        self.hub = IntrospectionHub(process="pretrain",
+                                    stale_after_s=30.0)
+        self.tele = TrainTelemetry(
+            jsonl_path=self.jsonl, window=20, sync_every=1,
+            introspect=self.hub)
+        self.server = start_debug_server(self.hub, port=0)
+        self.url = "http://%s:%d" % self.server.server_address[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="e2e-trainer")
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        step = 0
+        while not self._stop.is_set():
+            step += 1
+            self.tele.timer.data_start()
+            self.tele.timer.data_end()
+            self.tele.dispatch_done()
+            self.tele.step_done(step, {"loss": 2.0 + 0.001 * step})
+            time.sleep(0.02)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10.0)
+        self.server.shutdown()
+        self.server.server_close()
+        self.tele.close()
+
+
+def test_fleet_observatory_acceptance(tmp_path):
+    workdir = str(tmp_path)
+    cache_dir = os.path.join(workdir, "compile_cache")
+    vocab_path = synth.write_trace_vocab(os.path.join(workdir, "vocab.txt"))
+    config_path = os.path.join(workdir, "model.json")
+    with open(config_path, "w") as f:
+        json.dump(model_config(), f)
+
+    shared_args = [
+        "--model_config_file", config_path, "--vocab_file", vocab_path,
+        "--tasks", "classify", "--classify_labels", "neg,pos",
+        "--buckets", "16", "--max_batch_size", "4", "--max_wait_ms", "5",
+        "--dtype", "float32", "--compile_cache_dir", cache_dir,
+        "--trace_sample_rate", "0", "--telemetry_window", "16",
+        "--slo_p99_ms", "2000", "--request_timeout_s", "10",
+    ]
+    specs = []
+    for i in range(2):
+        out_dir = os.path.join(workdir, f"replica_{i}")
+        os.makedirs(out_dir, exist_ok=True)
+        port = free_port()
+        specs.append(supervisor_mod.ReplicaSpec(
+            index=i, port=port,
+            cmd=supervisor_mod.run_server_command(port, out_dir,
+                                                  shared_args),
+            heartbeat_file=os.path.join(out_dir, "heartbeat.json"),
+            postmortem_file=os.path.join(out_dir, "postmortem.json")))
+
+    sink = Sink(os.path.join(workdir, "fleet_telemetry.jsonl"))
+    sup = supervisor_mod.Supervisor(
+        specs, emit=sink.write, spawn=make_spawn(workdir),
+        policy=supervisor_mod.RetryPolicy(
+            attempts=5, base_delay_s=0.4, max_delay_s=3.0,
+            full_jitter=True),
+        heartbeat_timeout_s=10.0, startup_grace_s=240.0,
+        stable_reset_s=15.0, poll_interval_s=0.25, drain_grace_s=15.0,
+        heartbeat_file=os.path.join(workdir, "supervisor_heartbeat.json"))
+    router = router_mod.Router(
+        [s.url for s in specs], emit=sink.write, window=16,
+        scrape_interval_s=0.25, deadline_s=8.0,
+        retry_policy=router_mod.RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            full_jitter=True),
+        hedge_pctl=0.95, hedge_min_ms=30.0, hedge_min_samples=24,
+        brownout_queue_depth=64, shed_retry_after_s=0.5)
+    router_server = router_mod.make_router_server(router, port=0)
+    router_url = "http://%s:%d" % router_server.server_address[:2]
+
+    trainer = TrainerPlane(workdir)
+    timeline_path = os.path.join(workdir, "fleet_timeline.jsonl")
+    collected = []
+    collector = FleetCollector(
+        targets=[
+            Target("pretrain", "trainer", trainer.url),
+            Target("r0", "replica", specs[0].url),
+            Target("r1", "replica", specs[1].url),
+            Target("front", "router", router_url),
+        ],
+        tails=[
+            JsonlTailer(os.path.join(workdir, "fleet_telemetry.jsonl"),
+                        "fleet"),
+            JsonlTailer(trainer.jsonl, "trainer"),
+            JsonlTailer(os.path.join(workdir, "replica_0",
+                                     "serve_telemetry.jsonl"), "r0"),
+            JsonlTailer(os.path.join(workdir, "replica_1",
+                                     "serve_telemetry.jsonl"), "r1"),
+        ],
+        out_path=timeline_path, emit=collected.append, interval_s=0.5)
+
+    try:
+        trainer.start()
+        sup.start()
+        router.start()
+        threading.Thread(target=router_server.serve_forever,
+                         daemon=True).start()
+        collector.start()
+        wait_until(lambda: router.healthy_count() == 2, 240.0,
+                   "both replicas healthy")
+
+        # -- the burst, with a SIGKILL landing mid-flight ----------------
+        outcomes = []
+        kill_at = {"t": None, "wall": None}
+
+        def kill_replica_0():
+            pid = sup.status()[0]["pid"]
+            kill_at["t"] = time.monotonic()
+            kill_at["wall"] = time.time()
+            if pid:
+                os.kill(pid, signal.SIGKILL)
+
+        for seq in range(40):
+            if seq == 10:
+                kill_replica_0()
+            status, headers = post(
+                router_url, "classify",
+                {"text": PHRASES[seq % len(PHRASES)]}, timeout_s=15.0)
+            outcomes.append((status, headers.get("Retry-After")))
+        assert kill_at["t"] is not None
+        failures = [o for o in outcomes
+                    if not (o[0] == 200 or (o[0] == 503 and o[1]))]
+        assert failures == [], failures  # the PR-11 resilience story holds
+
+        # The supervisor harvested the dead replica's postmortem...
+        wait_until(lambda: sink.count("postmortem") >= 1, 60.0,
+                   "postmortem harvest fleet_event")
+        # ...and the fleet healed (respawn + warm restart).
+        wait_until(lambda: router.healthy_count() == 2, 120.0,
+                   "killed replica respawned and healthy")
+        # Let the collector observe the healed fleet in its OWN windows
+        # (the recovery half of the dip-and-recovery assertion).
+        def dip_then_recovery() -> bool:
+            snap = [r for r in list(collected)
+                    if r.get("kind") == "obs_fleet_window"]
+            dips = [r["ts"] for r in snap
+                    if r.get("replicas_healthy", 2) < 2
+                    and r["ts"] > kill_at["wall"]]
+            return bool(dips) and any(
+                r.get("replicas_healthy") == 2 and r["ts"] > dips[0]
+                for r in snap)
+
+        wait_until(dip_then_recovery, 60.0,
+                   "an obs_fleet_window dip (post-kill) then recovery")
+
+        # -- trainer /metricsz vs its JSONL windows, per metric name -----
+        with urllib.request.urlopen(f"{trainer.url}/metricsz",
+                                    timeout=5) as resp:
+            gauges = {name: value for name, labels, value
+                      in parse_prometheus(resp.read().decode())}
+        windows = [r for r in report.iter_records(trainer.jsonl)
+                   if r.get("kind") == "step_window"]
+        assert windows, "the trainer emitted no step_window records"
+        # The scrape races the live loop: the exported window is SOME
+        # recently emitted one — find it by step, then compare every
+        # numeric field verbatim.
+        exported_step = gauges.get("bert_train_window_step")
+        match = [w for w in windows if w.get("step") == exported_step]
+        assert match, (exported_step, [w["step"] for w in windows])
+        checked = 0
+        for key, value in match[0].items():
+            if key in ("kind", "tag", "schema", "ts"):
+                continue
+            if isinstance(value, (int, float)) and \
+                    not isinstance(value, bool):
+                assert gauges[f"bert_train_window_{key}"] == \
+                    pytest.approx(value, abs=0.0), key
+                checked += 1
+        assert checked >= 10
+    finally:
+        try:
+            collector.stop()
+        except Exception:
+            pass
+        try:
+            trainer.stop()
+        except Exception:
+            pass
+        drain = sup.stop()
+        router_server.shutdown()
+        router.stop()
+        sink.close()
+
+    # -- the one timeline: schema-clean, postmortem present, dip+recover -
+    assert schema.validate_file(timeline_path) == []
+    timeline = [json.loads(line) for line in open(timeline_path)]
+    harvests = [r for r in timeline
+                if r.get("kind") == "fleet_event"
+                and r.get("event") == "postmortem"]
+    assert harvests, "harvested postmortem never reached the timeline"
+    pm = harvests[0]
+    assert pm["found"] is True
+    assert pm["records"], "harvested ring is empty"
+    # The ring's last records are the replica's final telemetry — the
+    # serve records it emitted before dying (cold start at minimum).
+    kinds = {r.get("kind") for r in pm["records"]}
+    assert kinds & {"serve_cold_start", "serve_window", "serve_trace",
+                    "serve_phase", "compile", "compile_cost"}, kinds
+    dips = [r for r in timeline if r.get("kind") == "obs_fleet_window"
+            and r.get("replicas_healthy", 99) < r.get("replicas_total", 0)
+            and r["ts"] > kill_at["wall"]]
+    assert dips, "no obs_fleet_window recorded the post-kill dip"
+    recoveries = [r for r in timeline
+                  if r.get("kind") == "obs_fleet_window"
+                  and r.get("replicas_healthy") == 2
+                  and r["ts"] > dips[0]["ts"]]
+    assert recoveries, "no obs_fleet_window recorded the recovery"
+    scraped_kinds = {r.get("target_kind") for r in timeline
+                     if r.get("kind") == "obs_scrape"}
+    assert scraped_kinds == {"trainer", "replica", "router"}
+
+    # -- the report gate: injected staleness exits nonzero, by name ------
+    doctored = os.path.join(workdir, "doctored_timeline.jsonl")
+    with open(timeline_path) as src, open(doctored, "w") as dst:
+        dst.write(src.read())
+        dst.write(json.dumps({
+            "schema": 1, "ts": time.time(), "kind": "obs_scrape",
+            "tag": "obs", "target": "r1", "target_kind": "replica",
+            "ok": False, "staleness_s": 900.0}) + "\n")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "telemetry_report.py"),
+         doctored, timeline_path],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1, proc.stdout[-2000:]
+    assert "fleet scrape staleness" in proc.stdout
+    # And the clean timeline against itself stays green.
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO_ROOT, "tools", "telemetry_report.py"),
+         timeline_path, timeline_path],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout[-2000:]

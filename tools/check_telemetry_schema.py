@@ -30,9 +30,14 @@ percentiles require a positive ``ckpt_steps`` checkpoint-step flag
 serve/supervisor.py + serve/router.py) carry their own rules: the
 ok/shed/error triple must decompose the window exactly, hedge wins are
 bounded by hedges fired, healthy replicas by the fleet size, and the
-latency/failover percentiles must be ordered. The chaos harnesses
-(tools/chaos_run.py, tools/chaos_serve.py) lint their artifacts through
-this same module.
+latency/failover percentiles must be ordered. The fleet-observatory
+kinds (``obs_scrape``/``obs_fleet_window``, telemetry/collector.py —
+the fleet-timeline JSONLs ``tools/obs_collect.py`` writes and self-
+lints by default) carry theirs: a non-empty target of a known kind
+(trainer/replica/router), a boolean ``ok``, non-negative staleness/
+latency/rate aggregates, and healthy counts bounded by totals. The
+chaos harnesses (tools/chaos_run.py, tools/chaos_serve.py) lint their
+artifacts through this same module.
 
 Usage::
 

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Fleet observatory collector CLI (docs/observability.md).
+
+Scrapes every registered endpoint — trainer debug planes
+(``--debug_port``, telemetry/introspect.py), serving replicas'
+``/metricsz``, the router's ``/statsz`` — and tails their JSONL sinks,
+merging everything into ONE ordered fleet-timeline JSONL with schema-v1
+``obs_scrape`` (per-target sample + staleness) and ``obs_fleet_window``
+(healthy/total counts, fleet req/s, worst-replica p99, trainer step
+rate, error-budget burn) records. ``telemetry-report`` summarizes the
+timeline and gates on "fleet scrape staleness" and "fleet worst-replica
+p99" by name.
+
+Usage::
+
+    python tools/obs_collect.py \
+        --target trainer:pretrain=http://127.0.0.1:9100 \
+        --target replica:r0=http://127.0.0.1:8001 \
+        --target router:front=http://127.0.0.1:8100 \
+        --tail trainer=out/pretrain_telemetry.jsonl \
+        --tail fleet=out/fleet_telemetry.jsonl \
+        --out fleet_timeline.jsonl --interval_s 1 --duration_s 60
+
+``--target`` is ``kind:name=url`` with kind in trainer/replica/router;
+``--tail`` is ``name=path``. Bounded by ``--duration_s`` or
+``--passes`` (whichever lands first; Ctrl-C stops cleanly either way).
+The output is schema-linted by default at exit (exit 1 on violations) —
+the collector's own artifact is held to the same bar as everything it
+collects; ``--no-lint`` skips that.
+
+jax-free like every tool here: the collector engine loads by FILE PATH
+(tools/_bootstrap.py), so this process keeps collecting even while the
+accelerator processes it watches are hung.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from _bootstrap import REPO_ROOT, load_by_path
+
+collector_mod = load_by_path(
+    "_obs_collector", "bert_pytorch_tpu", "telemetry", "collector.py")
+schema = load_by_path(
+    "_obs_schema", "bert_pytorch_tpu", "telemetry", "schema.py")
+
+
+def parse_target(spec: str):
+    kind, sep, rest = spec.partition(":")
+    name, sep2, url = rest.partition("=")
+    if not sep or not sep2 or not name or not url:
+        raise argparse.ArgumentTypeError(
+            f"--target wants kind:name=url, got {spec!r}")
+    if kind not in schema.OBS_TARGET_KINDS:
+        raise argparse.ArgumentTypeError(
+            f"target kind must be one of {schema.OBS_TARGET_KINDS}, "
+            f"got {kind!r}")
+    return kind, name, url
+
+
+def parse_tail(spec: str):
+    name, sep, path = spec.partition("=")
+    if not sep or not name or not path:
+        raise argparse.ArgumentTypeError(
+            f"--tail wants name=path, got {spec!r}")
+    return name, path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="obs-collect",
+        description="scrape the fleet's endpoints + tail its JSONL "
+                    "sinks into one ordered timeline "
+                    "(docs/observability.md)")
+    parser.add_argument("--target", action="append", default=[],
+                        type=parse_target, metavar="KIND:NAME=URL",
+                        help="scrape target (trainer/replica/router); "
+                             "repeatable")
+    parser.add_argument("--tail", action="append", default=[],
+                        type=parse_tail, metavar="NAME=PATH",
+                        help="JSONL sink to tail into the timeline; "
+                             "repeatable")
+    parser.add_argument("--out", type=str, default="fleet_timeline.jsonl",
+                        help="timeline output JSONL (appended)")
+    parser.add_argument("--interval_s", type=float, default=1.0,
+                        help="seconds between collector passes")
+    parser.add_argument("--duration_s", type=float, default=0.0,
+                        help="stop after this much wall time "
+                             "(0 = unbounded; Ctrl-C always stops "
+                             "cleanly)")
+    parser.add_argument("--passes", type=int, default=0,
+                        help="stop after this many passes (0 = unbounded)")
+    parser.add_argument("--scrape_timeout_s", type=float, default=2.0,
+                        help="per-target scrape transport timeout")
+    parser.add_argument("--slo_error_budget", type=float, default=0.01,
+                        help="over-SLO fraction allowed before the "
+                             "fleet error-budget burn exceeds 1")
+    parser.add_argument("--no-lint", action="store_true",
+                        help="skip schema-linting the timeline at exit")
+    args = parser.parse_args(argv)
+
+    if not args.target and not args.tail:
+        parser.error("need at least one --target or --tail")
+    targets = [collector_mod.Target(name, kind, url,
+                                    timeout_s=args.scrape_timeout_s)
+               for kind, name, url in args.target]
+    tails = [collector_mod.JsonlTailer(path, name)
+             for name, path in args.tail]
+    coll = collector_mod.FleetCollector(
+        targets, tails=tails, out_path=args.out,
+        interval_s=args.interval_s,
+        slo_error_budget=args.slo_error_budget)
+    deadline = (time.monotonic() + args.duration_s
+                if args.duration_s > 0 else None)
+    done = 0
+    try:
+        while True:
+            window = coll.collect_once()
+            done += 1
+            if window is not None:
+                print(f"pass {done}: healthy "
+                      f"{window['targets_healthy']}/"
+                      f"{window['targets_total']}, max staleness "
+                      f"{window['max_staleness_s']:.1f}s")
+            if args.passes and done >= args.passes:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(args.interval_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        # close(), not stop(): this loop already ran its final pass —
+        # stop()'s drain pass (background-thread mode) would append an
+        # uncounted extra round, blocking on any dead target again.
+        coll.close()
+    if args.no_lint:
+        return 0
+    # The collector's own artifact is held to the schema bar by default
+    # (the check_all/check_telemetry_schema contract): a timeline that
+    # fails its own lint must not exit 0.
+    errors = schema.validate_file(args.out)
+    rel = os.path.relpath(args.out, REPO_ROOT) \
+        if args.out.startswith(REPO_ROOT) else args.out
+    if errors:
+        for lineno, err in errors[:20]:
+            print(f"{rel}:{lineno}: {err}", file=sys.stderr)
+        print(f"obs-collect: timeline FAILED schema lint "
+              f"({len(errors)} errors)", file=sys.stderr)
+        return 1
+    print(f"obs-collect: {rel}: ok ({done} passes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
