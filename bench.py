@@ -158,6 +158,21 @@ SERVE_REQUESTS = int(os.environ.get("BENCH_SERVE_REQUESTS", "256"))
 SERVE_BATCH = int(os.environ.get("BENCH_SERVE_BATCH", "8"))
 SERVE_BUCKETS = os.environ.get("BENCH_SERVE_BUCKETS", "32,64,128")
 SERVE_RATE = float(os.environ.get("BENCH_SERVE_RATE", "0"))
+# The serving dispatch plane the in-process serve legs drive
+# (docs/serving.md "Continuous batching"): pipelined (default) or serial
+# — the same A/B knob run_server.py exposes as --dispatch_mode.
+SERVE_DISPATCH = os.environ.get("BENCH_SERVE_DISPATCH", "pipelined")
+# BENCH_SERVE_SATURATION=1 runs the ROADMAP saturation curve instead
+# (docs/serving.md "Continuous batching"): a closed-loop req/s vs p99
+# sweep through the REAL fleet — supervisor-owned run_server.py replica
+# subprocesses behind the router — at 1 and 2 replicas, pipelined vs
+# serial dispatch legs replaying the same trace, every
+# (replicas, mode, workers) point stamped into the result JSON. Knobs:
+# BENCH_SERVE_SAT_REPLICAS ("1,2"), BENCH_SERVE_SAT_MODES
+# ("pipelined,serial"), BENCH_SERVE_SAT_WORKERS ("2,6" — closed-loop
+# client concurrency sweep), BENCH_SERVE_SAT_REQUESTS (per point,
+# default 48), BENCH_SERVE_SAT_WARMUP_S (replica warmup budget, 240).
+SERVE_SATURATION = os.environ.get("BENCH_SERVE_SATURATION", "0") == "1"
 PACK = (os.environ.get("BENCH_PACK", "0") == "1"
         or "--pack_sequences" in sys.argv[1:])
 PACK_K = int(os.environ.get("BENCH_PACK_K", "8"))
@@ -236,6 +251,10 @@ def _config_digest(degraded=None, local_batch=None):
         # appended outside the tuple for the same marker-stability reason.
         key += (f"+serve{SERVE_BATCH}x{SERVE_BUCKETS}"
                 + ("+spack" if SERVE_PACK else ""))
+    if SERVE_SATURATION:
+        # The saturation leg compiles inside its replica subprocesses
+        # (their own shared cache); keyed so its marker never collides.
+        key += "+servesat"
     if ASYNC:
         # The async-checkpoint leg compiles nothing heavy (the snapshot
         # identity only); keyed so its marker never collides with a
@@ -701,7 +720,7 @@ def _serve_child_main():
             engine,
             Batcher(max_batch_size=SERVE_BATCH, max_wait_ms=5.0,
                     max_requests_per_pack=engine.max_requests_per_pack),
-            telemetry, tracer=tracer)
+            telemetry, tracer=tracer, dispatch_mode=SERVE_DISPATCH)
 
     def replay(service):
         t_warm = time.perf_counter()
@@ -878,6 +897,246 @@ def _serve_child_main():
             "metric": metric})
         sink.close()
     print(_json.dumps(result))
+
+
+def _serve_saturation_child_main():
+    """BENCH_SERVE_SATURATION leg: the ROADMAP saturation curve — a
+    closed-loop req/s vs p99 sweep through the REAL fleet (supervisor-
+    owned ``run_server.py`` replica subprocesses behind the router), at
+    1 and 2 supervised replicas, pipelined vs serial dispatch legs
+    replaying the same trace (docs/serving.md "Continuous batching").
+
+    The parent stays jax-free (supervisor/router/synthetic-data load by
+    file path, like tools/chaos_serve.py): all compilation happens
+    inside the replica subprocesses, which share one persistent AOT
+    cache — the first replica of the first fleet compiles, every later
+    fleet warms from the cache, so four fleets cost one warmup. A small
+    2-layer model keeps each point dispatch-bound, which is the thing
+    under test: the curve separates the dispatch planes, not the model.
+    """
+    import http.client
+    import importlib.util
+    import json as _json
+    import socket
+    import tempfile
+    import threading
+    import urllib.parse
+
+    def _load(name, *parts):
+        spec = importlib.util.spec_from_file_location(
+            name, os.path.join(REPO_ROOT, *parts))
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+    supervisor_mod = _load("_sat_supervisor",
+                           "bert_pytorch_tpu", "serve", "supervisor.py")
+    router_mod = _load("_sat_router",
+                       "bert_pytorch_tpu", "serve", "router.py")
+    synth = _load("_sat_synth",
+                  "bert_pytorch_tpu", "tools", "make_synthetic_data.py")
+
+    replicas_list = [int(n) for n in os.environ.get(
+        "BENCH_SERVE_SAT_REPLICAS", "1,2").split(",") if n.strip()]
+    modes = [m.strip() for m in os.environ.get(
+        "BENCH_SERVE_SAT_MODES", "pipelined,serial").split(",")
+        if m.strip()]
+    workers_list = [int(n) for n in os.environ.get(
+        "BENCH_SERVE_SAT_WORKERS", "2,6").split(",") if n.strip()]
+    point_requests = int(os.environ.get("BENCH_SERVE_SAT_REQUESTS", "48"))
+    warmup_s = float(os.environ.get("BENCH_SERVE_SAT_WARMUP_S", "240"))
+
+    workdir = tempfile.mkdtemp(prefix="bench_servesat_")
+    cache_dir = os.path.join(workdir, "compile_cache")
+    vocab_path = synth.write_trace_vocab(os.path.join(workdir, "vocab.txt"))
+    vocab = 5 + len(synth.TRACE_WORDS)
+    vocab += (8 - vocab % 8) % 8
+    config_path = os.path.join(workdir, "model.json")
+    with open(config_path, "w") as f:
+        _json.dump({
+            "vocab_size": vocab, "hidden_size": 64, "num_hidden_layers": 2,
+            "num_attention_heads": 4, "intermediate_size": 128,
+            "max_position_embeddings": 64, "type_vocab_size": 2,
+            "next_sentence": True, "mask_token_id": 4,
+            "hidden_dropout_prob": 0.0,
+            "attention_probs_dropout_prob": 0.0,
+        }, f)
+
+    phrases = ("paris is big", "the river runs through london",
+               "william shakespeare wrote hamlet", "england is old",
+               "the capital of france is paris")
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn(spec):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("BENCH_CHILD", None)  # the replica is run_server, not us
+        if spec.env:
+            env.update(spec.env)
+        log = open(os.path.join(
+            workdir, f"replica_{spec.index}.log"), "ab")
+        return subprocess.Popen(spec.cmd, env=env, stdout=log,
+                                stderr=subprocess.STDOUT)
+
+    def post(url, payload, timeout_s):
+        parsed = urllib.parse.urlsplit(url)
+        conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                          timeout=timeout_s)
+        try:
+            conn.request("POST", "/v1/classify",
+                         body=_json.dumps(payload).encode("utf-8"),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            return resp.status
+        finally:
+            conn.close()
+
+    def burst(url, total, workers):
+        """Closed-loop burst: ``workers`` concurrent clients, each
+        firing its next request the moment the previous answers —
+        offered load scales with the worker count, which is the sweep
+        axis of the saturation curve."""
+        lock = threading.Lock()
+        issued = [0]
+        outcomes = []
+
+        def worker():
+            while True:
+                with lock:
+                    if issued[0] >= total:
+                        return
+                    issued[0] += 1
+                    seq = issued[0]
+                payload = {"text": phrases[seq % len(phrases)]}
+                t0 = time.monotonic()
+                try:
+                    status = post(url, payload, timeout_s=30.0)
+                except Exception:
+                    status = None
+                with lock:
+                    outcomes.append(
+                        (status, time.monotonic() - t0))
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return outcomes, time.monotonic() - t0
+
+    def pctl(sorted_vals, frac):
+        if not sorted_vals:
+            return None
+        idx = min(len(sorted_vals) - 1,
+                  int(frac * (len(sorted_vals) - 1) + 0.5))
+        return sorted_vals[idx]
+
+    legs = []
+    for n_replicas in replicas_list:
+        for mode in modes:
+            shared_args = [
+                "--model_config_file", config_path,
+                "--vocab_file", vocab_path,
+                "--tasks", "classify", "--classify_labels", "neg,pos",
+                "--buckets", "16,32", "--max_batch_size", "4",
+                "--max_wait_ms", "5", "--dtype", "float32",
+                "--compile_cache_dir", cache_dir,
+                "--trace_sample_rate", "0", "--telemetry_window", "32",
+                "--request_timeout_s", "20",
+                "--dispatch_mode", mode,
+            ]
+            specs = []
+            for i in range(n_replicas):
+                out_dir = os.path.join(
+                    workdir, f"fleet_{n_replicas}{mode[0]}_replica_{i}")
+                os.makedirs(out_dir, exist_ok=True)
+                port = free_port()
+                specs.append(supervisor_mod.ReplicaSpec(
+                    index=i, port=port,
+                    cmd=supervisor_mod.run_server_command(
+                        port, out_dir, shared_args),
+                    heartbeat_file=os.path.join(out_dir, "heartbeat.json")))
+            sup = supervisor_mod.Supervisor(
+                specs, emit=lambda rec: None, spawn=spawn,
+                startup_grace_s=warmup_s, poll_interval_s=0.25,
+                drain_grace_s=15.0)
+            router = router_mod.Router(
+                [s.url for s in specs], emit=lambda rec: None,
+                scrape_interval_s=0.25, deadline_s=20.0,
+                brownout_queue_depth=4096)
+            router_server = router_mod.make_router_server(router, port=0)
+            url = "http://%s:%d" % router_server.server_address[:2]
+            leg = {"replicas": n_replicas, "dispatch_mode": mode,
+                   "points": []}
+            try:
+                sup.start()
+                router.start()
+                threading.Thread(target=router_server.serve_forever,
+                                 daemon=True).start()
+                deadline = time.monotonic() + warmup_s
+                while time.monotonic() < deadline and \
+                        router.healthy_count() < n_replicas:
+                    time.sleep(0.25)
+                if router.healthy_count() < n_replicas:
+                    leg["error"] = "fleet never became healthy"
+                    legs.append(leg)
+                    continue
+                for workers in workers_list:
+                    outcomes, wall = burst(url, point_requests, workers)
+                    ok = [lat for status, lat in outcomes
+                          if status is not None and 200 <= status < 300]
+                    lat = sorted(lat * 1000.0 for lat in ok)
+                    leg["points"].append({
+                        "workers": workers,
+                        "requests": len(outcomes),
+                        "ok": len(ok),
+                        "failures": len(outcomes) - len(ok),
+                        # Goodput, not offered load: a failure-heavy
+                        # point must not outscore an all-ok one in the
+                        # headline max (failures ride alongside).
+                        "req_per_sec": round(len(ok) / wall, 2),
+                        "p50_ms": round(pctl(lat, 0.50), 2) if lat else None,
+                        "p99_ms": round(pctl(lat, 0.99), 2) if lat else None,
+                    })
+            finally:
+                # Each teardown step gets its own guard: a replica that
+                # wedges sup.stop() must not leak the previous leg's
+                # router server + scrape thread into the later legs.
+                for teardown in (sup.stop, router_server.shutdown,
+                                 router.stop):
+                    try:
+                        teardown()
+                    except Exception:
+                        pass
+            legs.append(leg)
+
+    # The headline value: best pipelined req/s at the largest sweep
+    # point; the serial twin rides alongside so the curve carries its
+    # own A/B (pipelined should hold lower p99 at equal offered load).
+    def best(mode):
+        points = [p for leg in legs if leg["dispatch_mode"] == mode
+                  for p in leg.get("points", []) if p["ok"]]
+        return max((p["req_per_sec"] for p in points), default=None)
+
+    result = {
+        "metric": "serve_saturation_req_per_sec",
+        "value": best("pipelined"),
+        "unit": "req/s",
+        "requests_per_point": point_requests,
+        "workers_sweep": workers_list,
+        "serial_best_req_per_sec": best("serial"),
+        "legs": legs,
+    }
+    print(json.dumps(result))
 
 
 def _async_child_main():
@@ -1202,7 +1461,7 @@ def main():
     degrade_ok = (os.environ.get("BENCH_DEGRADE", "auto") != "0"
                   and not DEGRADED and PHASE == 1 and not KFAC
                   and not LONG_SEQ and not N_DEVICES and not PACK
-                  and not SERVE and not ASYNC)
+                  and not SERVE and not ASYNC and not SERVE_SATURATION)
     degraded_warm = degrade_ok and os.path.exists(
         os.path.join(CACHE_DIR, f"warm_{_degraded_digest()}"))
     if not degrade_ok:
@@ -1319,6 +1578,8 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
         if ASYNC:
             _async_child_main()
+        elif SERVE_SATURATION:
+            _serve_saturation_child_main()
         elif SERVE:
             _serve_child_main()
         else:

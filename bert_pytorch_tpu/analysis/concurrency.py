@@ -223,15 +223,52 @@ REGISTRY: Tuple[Entry, ...] = (
           why="stall counter incremented by the watchdog thread, read by "
               "runners/tests"),
 
-    # -- serve/service.py: HTTP workers vs dispatch vs signal handler ------
+    # -- serve/service.py: HTTP workers vs stage threads vs signal handler -
     Entry("bert_pytorch_tpu/serve/service.py", "_draining",
           cls="ServingService", kind="lock", locks=("_state_lock",),
           why="flipped by begin_drain (signal handler / run_server) while "
               "every HTTP worker reads it in submit/health"),
-    Entry("bert_pytorch_tpu/serve/service.py", "_thread",
+    Entry("bert_pytorch_tpu/serve/service.py", "_threads",
           cls="ServingService", kind="lock", locks=("_state_lock",),
-          why="start/stop rebind it while HTTP workers read liveness "
-              "through dispatch_alive for /healthz"),
+          why="start/stop rebind the stage-thread list while HTTP workers "
+              "read liveness through dispatch_alive for /healthz"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_forming",
+          cls="ServingService", kind="lock", locks=("_state_lock",),
+          why="forming-batch depth gauge written by the assembler stage, "
+              "read by /healthz and /metricsz scrape threads"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_stage_inflight",
+          cls="ServingService", kind="lock", locks=("_state_lock",),
+          why="per-stage in-flight batch markers written by the executor "
+              "and completion threads, swept by stop()'s fail-or-flush "
+              "drain on the caller's thread"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_handoff",
+          cls="ServingService", kind="frozen",
+          why="depth-1 staged-batch queue shared by the assembler and "
+              "executor stages (a Queue locks itself); the binding must "
+              "never change after __init__"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_completed_q",
+          cls="ServingService", kind="frozen",
+          why="executed-batch queue shared by the executor and completion "
+              "stages plus stop()'s flush; the binding must never change "
+              "after __init__"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_hungry",
+          cls="ServingService", kind="frozen",
+          why="executor-is-waiting event read by the assembler's "
+              "admission window (an Event locks itself); the binding "
+              "must never change after __init__"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_batches_assembled",
+          cls="ServingService", kind="confined",
+          forbidden_in=("_execute_loop", "_complete_loop", "_loop",
+                        "submit", "health", "metrics_text"),
+          why="admit_hold chaos-hook counter owned by the assembler "
+              "stage; no other stage or scrape path may touch it"),
+    Entry("bert_pytorch_tpu/serve/service.py", "_last_exec_end",
+          cls="ServingService", kind="confined",
+          forbidden_in=("_assemble_loop", "_complete_loop",
+                        "submit", "health", "metrics_text"),
+          why="serial-mode executor-gap timestamp owned by the single "
+              "device-calling thread (the pipelined executor keeps its "
+              "own local)"),
 
     # -- serve/batcher.py: request FIFO + gauges ---------------------------
     Entry("bert_pytorch_tpu/serve/batcher.py", "_pending",

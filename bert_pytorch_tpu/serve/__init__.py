@@ -13,7 +13,8 @@ plane (:class:`TraceCollector`, :mod:`~bert_pytorch_tpu.serve.tracing`).
 """
 
 from bert_pytorch_tpu.serve.batcher import Batcher, BatcherFull, Request
-from bert_pytorch_tpu.serve.engine import BatchPlan, InferenceEngine, TaskSpec
+from bert_pytorch_tpu.serve.engine import (BatchPlan, InferenceEngine,
+                                           StagedBatch, TaskSpec)
 from bert_pytorch_tpu.serve.http import make_server
 from bert_pytorch_tpu.serve.router import (Router, RouterShed,
                                            make_router_server)
@@ -35,6 +36,7 @@ __all__ = [
     "ServeTelemetry",
     "ServiceDraining",
     "ServingService",
+    "StagedBatch",
     "Supervisor",
     "TaskSpec",
     "TraceCollector",

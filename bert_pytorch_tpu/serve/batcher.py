@@ -15,7 +15,13 @@ The flush policy is deliberately separated from the blocking machinery:
 :meth:`poll` is a non-blocking pure function of (queue state, clock) so
 tests drive it deterministically with an injected fake clock, while
 :meth:`next_batch` adds the condition-variable wait the dispatch thread
-uses in production.
+uses in production. The pipelined dispatch plane (docs/serving.md
+"Continuous batching") grows a third path out of the same flush
+machinery: :meth:`admit_into_forming` lets the assembler stage admit
+requests that arrive WHILE a previous batch executes into the batch it
+is still forming — continuous batching's iteration-level admission (Yu
+et al., OSDI 2022) adapted to the one-shot encoder workload — instead
+of parking them for the next flush.
 
 Length-aware grouping happens downstream: the batcher keeps arrival order
 (FIFO fairness bounds worst-case wait), and the engine's batch planner
@@ -77,6 +83,11 @@ class Request:
         self.result: Optional[dict] = None
         self.error: Optional[str] = None
         self.abandoned = False
+        # True when the request joined a FORMING batch through the
+        # admission window (Batcher.admit_into_forming) instead of a
+        # normal flush — the continuous-batching win the serve_trace
+        # `admitted_late` field and the admitted-late counters report.
+        self.admitted_late = False
         # Filled by the dispatch thread for telemetry: seconds of jitted
         # forward (incl. the device sync) the request's batch cost.
         self.device_s: Optional[float] = None
@@ -155,10 +166,16 @@ class Batcher:
         the queue (they are the oldest; FIFO order is preserved). They
         move from in-flight back to pending, so :meth:`unfinished` never
         dips while a leftover is in transit — the drain loop's evidence.
+        A requeued request is no longer late-admitted, whatever path
+        popped it: it will ride a future flush like any pending request,
+        and the admitted_late marker must describe the batch that
+        actually serves it.
         """
         if not requests:
             return
         with self._cond:
+            for req in requests:
+                req.admitted_late = False
             self._pending[:0] = requests
             # max(0, ...): tests/offline callers may requeue requests
             # they never popped; the counter must not go negative.
@@ -183,8 +200,10 @@ class Batcher:
 
     # -- consumer side ---------------------------------------------------
 
-    def _flush_size(self) -> int:
-        """Requests of the head task that justify a size flush."""
+    def flush_size(self) -> int:
+        """Requests of the head task that justify a size flush — also
+        the budget an admission window may grow a forming batch to
+        (:meth:`admit_into_forming`)."""
         return self.max_batch_size * self.max_requests_per_pack
 
     def _take_head_task_locked(self) -> List[Request]:
@@ -192,7 +211,7 @@ class Batcher:
         both the taken group's and the remainder's arrival order."""
         head_task = self._pending[0].task
         take, keep = [], []
-        limit = self._flush_size()
+        limit = self.flush_size()
         for req in self._pending:
             if req.task == head_task and len(take) < limit:
                 take.append(req)
@@ -217,7 +236,7 @@ class Batcher:
             n_head = sum(1 for r in self._pending if r.task == head_task)
             oldest_wait_ms = (self._clock()
                               - self._pending[0].enqueued_at) * 1000.0
-            if (n_head >= self._flush_size()
+            if (n_head >= self.flush_size()
                     or oldest_wait_ms >= self.max_wait_ms):
                 return self._take_head_task_locked()
             return None
@@ -248,15 +267,60 @@ class Batcher:
                     waits.append(max(0.0, deadline - self._clock()))
                 self._cond.wait(timeout=min(waits) if waits else None)
 
+    def admit_into_forming(self, task: str, limit: int) -> List[Request]:
+        """Admission-window path (pipelined dispatch, docs/serving.md
+        "Continuous batching"): pop up to ``limit`` pending requests of
+        ``task`` so the assembler can fold them into the batch it is
+        still FORMING while the executor runs the previous one — they
+        ride the next device step instead of waiting for their own
+        flush. Popped requests are stamped ``dequeued_at`` (their queue
+        span ends at admission) and marked ``admitted_late``; they move
+        to in-flight like any flush, so :meth:`unfinished` never dips.
+        Non-blocking; returns [] when nothing matches (or the batcher
+        is closed — a drain must not grow forming batches)."""
+        if limit <= 0:
+            return []
+        with self._cond:
+            if self._closed or not self._pending:
+                return []
+            take, keep = [], []
+            for req in self._pending:
+                if req.task == task and len(take) < limit:
+                    take.append(req)
+                else:
+                    keep.append(req)
+            if not take:
+                return []
+            self._pending = keep
+            self._inflight += len(take)
+            now = self._clock()
+            for req in take:
+                req.dequeued_at = now
+                req.admitted_late = True
+            return take
+
     def depth(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def inflight(self) -> int:
+        """Requests popped (flushed or late-admitted) but not yet
+        finished — the in-flight half of :meth:`unfinished`, spanning
+        every pipeline stage (forming, staged, executing, completing)."""
+        with self._lock:
+            return self._inflight
 
     def unfinished(self) -> int:
         """Pending + in-flight: the requests the service still OWES an
         answer. This — not :meth:`depth` — is what a graceful drain
         waits on (depth alone reads 0 while a popped batch is being
-        processed, and its plan leftovers may be about to requeue)."""
+        processed, and its plan leftovers may be about to requeue), and
+        what the router's least-loaded score balances on
+        (``bert_serve_unfinished`` — a mid-batch replica must not
+        scrape as idle). In pipelined dispatch the in-flight half spans
+        every stage: requests in the forming batch, the staged handoff,
+        the executing batch, and the completion stage all count until
+        :meth:`done` retires them."""
         with self._lock:
             return len(self._pending) + self._inflight
 

@@ -14,6 +14,22 @@ import argparse
 
 QUANTIZE_CHOICES = ("none", "bf16", "int8")
 ATTENTION_BACKENDS = ("xla", "pallas", "pallas_infer", "auto")
+DISPATCH_MODES = ("pipelined", "serial")
+
+
+def add_dispatch_args(parser: argparse.ArgumentParser) -> None:
+    """The dispatch-plane knob (serve/service.py, docs/serving.md
+    "Continuous batching"), shared by run_server.py and the BENCH_SERVE
+    legs so the A/B comparison uses one spelling."""
+    parser.add_argument(
+        "--dispatch_mode", type=str, default="pipelined",
+        choices=DISPATCH_MODES,
+        help="pipelined (default) runs the three-stage continuous-"
+             "batching plane: an assembler admits late arrivals into "
+             "the forming batch while the executor keeps the device "
+             "hot and a completion stage decodes off the device "
+             "thread; serial is the flush-then-wait loop, kept for "
+             "A/B measurement")
 
 
 def add_fast_path_args(parser: argparse.ArgumentParser) -> None:

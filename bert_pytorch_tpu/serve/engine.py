@@ -28,10 +28,17 @@ The :class:`InferenceEngine` owns the device side of serving:
   budget fits the flushed group (and, with packing on, the first-fit-
   decreasing row assignment over ``data/packing.py``'s packer), returning
   requests that do not fit for the batcher to requeue;
-* **execution + demultiplexing** — :meth:`execute` pads/packs the group
-  into the fixed (max_batch_size, bucket) compile shape, runs the jitted
-  forward, and slices each request's own output back out (row, or
-  (row, segment-span) / (row, pack-slot) when packed).
+* **execution + demultiplexing** — split into three composable steps so
+  the pipelined dispatch plane (serve/service.py, docs/serving.md
+  "Continuous batching") can run them on different stages:
+  :meth:`stage` pads/packs the group into the fixed
+  (max_batch_size, bucket) compile shape (host-only — the assembler
+  stage), :meth:`execute_staged` runs the jitted forward (the ONLY
+  device call — the executor stage), and :meth:`demux` slices each
+  request's own output back out (row, or (row, segment-span) /
+  (row, pack-slot) when packed; host conversion — the completion
+  stage). :meth:`execute` composes the three for the serial dispatch
+  mode, offline scoring, and tests.
 
 Batch shapes are FIXED at (max_batch_size, bucket): a partially full
 group pads with all-zero rows (attention mask 0 — rows are independent
@@ -81,6 +88,28 @@ class BatchPlan:
     @property
     def requests(self) -> List[Request]:
         return [r for row in self.rows for r in row]
+
+
+class StagedBatch:
+    """A plan staged into its fixed compile-shape arrays, ready for the
+    device (output of :meth:`InferenceEngine.stage`).
+
+    ``args`` is the positional argument tuple the plan's jitted forward
+    takes (after params); ``offsets`` maps request id -> (row, token
+    offset, pack slot) for :meth:`InferenceEngine.demux`; ``pack_s`` is
+    the host seconds spent filling the arrays — the engine's share of
+    the trace's ``assembly`` span. ``staged_at`` is stamped by the
+    dispatch plane (the assembler) when staging completes, so the
+    executor's pickup delay (``staged_wait``) is attributable."""
+
+    def __init__(self, task: str, plan: BatchPlan, args: tuple,
+                 offsets: Dict[int, Tuple[int, int, int]], pack_s: float):
+        self.task = task
+        self.plan = plan
+        self.args = args
+        self.offsets = offsets
+        self.pack_s = pack_s
+        self.staged_at: Optional[float] = None
 
 
 class InferenceEngine:
@@ -352,15 +381,12 @@ class InferenceEngine:
 
     # -- execution -------------------------------------------------------
 
-    def execute(self, task: str, plan: BatchPlan
-                ) -> Tuple[List[object], dict]:
-        """Run one planned batch; returns (per-request output slices in
-        ``plan.requests`` order, info dict with bucket/rows/real_tokens/
-        device_s/compiles, plus ``pack_s`` — the host time spent packing
-        the group into the fixed compile shape, the engine's share of
-        the trace's ``assembly`` span)."""
-        import jax
-
+    def stage(self, task: str, plan: BatchPlan) -> StagedBatch:
+        """Pack/pad one planned batch into its fixed compile-shape
+        arrays. HOST-ONLY — never touches the device, so the pipelined
+        dispatch plane's assembler stage can run it concurrently with
+        the executor's jitted forward (the one-device-thread
+        invariant)."""
         spec = self.tasks[task]
         t_host0 = self._clock()
         B, S = self.max_batch_size, plan.bucket
@@ -383,6 +409,10 @@ class InferenceEngine:
                     cpos[r, k] = offset
                     offsets[req.id] = (r, offset, k)
                     offset += n
+            if spec.handler.output_kind == "pooled":
+                args = (ids, seg, mask, sids, cpos)
+            else:
+                args = (ids, seg, mask, sids)
         else:
             for r, row in enumerate(plan.rows):
                 (req,) = row
@@ -391,33 +421,56 @@ class InferenceEngine:
                 seg[r, :n] = req.features["segment_ids"]
                 mask[r, :n] = 1
                 offsets[req.id] = (r, 0, 0)
+            args = (ids, seg, mask)
+        return StagedBatch(task, plan, args, offsets,
+                           pack_s=self._clock() - t_host0)
 
+    def execute_staged(self, staged: StagedBatch
+                       ) -> Tuple[object, dict]:
+        """Run one staged batch's jitted forward (incl. the device
+        sync); returns (device output, info dict). The ONLY method on
+        the serving path that touches the device — in pipelined
+        dispatch, only the executor stage calls it."""
+        import jax
+
+        spec = self.tasks[staged.task]
+        plan = staged.plan
         compiles_before = len(self.monitor.events)
         t0 = self._clock()
         fwd = spec.forwards[(plan.bucket, plan.packed)]
-        if plan.packed:
-            if spec.handler.output_kind == "pooled":
-                out = fwd(spec.params, ids, seg, mask, sids, cpos)
-            else:
-                out = fwd(spec.params, ids, seg, mask, sids)
-        else:
-            out = fwd(spec.params, ids, seg, mask)
+        out = fwd(spec.params, *staged.args)
         out = jax.block_until_ready(out)
         device_s = self._clock() - t0
         compiles = sum(
             1 for e in self.monitor.events[compiles_before:]
             if e.get("kind") == "compile")
+        info = {
+            "bucket": plan.bucket,
+            "rows": self.max_batch_size,
+            "real_tokens": sum(r.length for r in plan.requests),
+            "device_s": device_s,
+            "pack_s": staged.pack_s,
+            "compiles": compiles,
+            "packed": plan.packed,
+        }
+        return out, info
 
+    def demux(self, staged: StagedBatch, out) -> List[object]:
+        """Slice each request's own output back out of the batch output
+        (host conversion + per-request views, in ``plan.requests``
+        order). Host-only — the completion stage runs it, so client
+        decode never blocks the next device step."""
+        spec = self.tasks[staged.task]
+        plan = staged.plan
         kind = spec.handler.output_kind
         if kind == "span":
             start = np.asarray(out[0], np.float32)
             end = np.asarray(out[1], np.float32)
         else:
             host = np.asarray(out, np.float32)
-
         results: List[object] = []
         for req in plan.requests:
-            r, off, slot = offsets[req.id]
+            r, off, slot = staged.offsets[req.id]
             n = req.length
             if kind == "pooled":
                 results.append(host[r, slot] if plan.packed else host[r])
@@ -425,16 +478,22 @@ class InferenceEngine:
                 results.append((start[r, off:off + n], end[r, off:off + n]))
             else:
                 results.append(host[r, off:off + n])
-        info = {
-            "bucket": plan.bucket,
-            "rows": B,
-            "real_tokens": sum(r.length for r in plan.requests),
-            "device_s": device_s,
-            "pack_s": t0 - t_host0,
-            "compiles": compiles,
-            "packed": plan.packed,
-        }
-        return results, info
+        return results
+
+    def execute(self, task: str, plan: BatchPlan
+                ) -> Tuple[List[object], dict]:
+        """Run one planned batch end to end (stage -> execute_staged ->
+        demux on the calling thread); returns (per-request output slices
+        in ``plan.requests`` order, info dict with bucket/rows/
+        real_tokens/device_s/compiles, plus ``pack_s`` — the host time
+        spent packing the group into the fixed compile shape, the
+        engine's share of the trace's ``assembly`` span). The serial
+        dispatch mode, offline scoring, and parity tests use this
+        composition; pipelined dispatch calls the three steps from
+        their own stages."""
+        staged = self.stage(task, plan)
+        out, info = self.execute_staged(staged)
+        return self.demux(staged, out), info
 
     def run_direct(self, task: str, payload: dict) -> dict:
         """One request end to end through the SAME batched path (a batch

@@ -108,12 +108,25 @@ class ReplicaState:
         self.draining = False
         self.dispatch_alive = False
         self.queue_depth = 0
+        # Pending + in-flight across the replica's whole dispatch plane
+        # (bert_serve_unfinished): the honest load signal — queue_depth
+        # reads 0 the instant a batch pops, so a replica mid-batch (or
+        # mid-pipeline) scraped as idle and soaked up traffic it could
+        # not absorb. None when the replica predates the gauge.
+        self.unfinished: Optional[int] = None
         self.inflight = 0           # router-local outstanding dispatches
         self.scrape_failures = 0
         self.requests = 0           # routed to this replica (run total)
 
     def eligible(self) -> bool:
         return self.healthy and self.dispatch_alive and not self.draining
+
+    def load(self) -> int:
+        """Scraped load for balancing and brownout admission: prefer
+        ``unfinished`` (pending + in-flight), fall back to the bare
+        queue depth for replicas that do not export it."""
+        return (self.unfinished if self.unfinished is not None
+                else self.queue_depth)
 
 
 class RouterShed(RuntimeError):
@@ -172,13 +185,20 @@ def default_scrape(url: str, timeout_s: float = 2.0) -> Optional[dict]:
                     except ValueError:
                         continue
             if "bert_serve_dispatch_alive" in gauges:
-                return {
+                health = {
                     "dispatch_alive":
                         gauges["bert_serve_dispatch_alive"] >= 1.0,
                     "draining": gauges.get("bert_serve_draining", 0) >= 1.0,
                     "queue_depth":
                         int(gauges.get("bert_serve_queue_depth", 0)),
                 }
+                if "bert_serve_unfinished" in gauges:
+                    # Pending + in-flight: the load signal balancing and
+                    # brownout prefer (a mid-batch replica's queue_depth
+                    # reads 0; its unfinished does not).
+                    health["unfinished"] = int(
+                        gauges["bert_serve_unfinished"])
+                return health
         # No tracer on the replica (404) or gauges missing: /healthz
         # carries the same liveness/drain/queue facts as JSON.
         try:
@@ -189,11 +209,14 @@ def default_scrape(url: str, timeout_s: float = 2.0) -> Optional[dict]:
             health = json.loads(resp.read().decode("utf-8", "replace"))
         except (OSError, ValueError):
             return None
-        return {
+        result = {
             "dispatch_alive": bool(health.get("dispatch_alive")),
             "draining": bool(health.get("draining")),
             "queue_depth": int(health.get("queue_depth", 0)),
         }
+        if health.get("unfinished") is not None:
+            result["unfinished"] = int(health["unfinished"])
+        return result
     finally:
         conn.close()
 
@@ -315,27 +338,32 @@ class Router:
                 rep.dispatch_alive = bool(health.get("dispatch_alive"))
                 rep.draining = bool(health.get("draining"))
                 rep.queue_depth = int(health.get("queue_depth", 0))
+                unfinished = health.get("unfinished")
+                rep.unfinished = (int(unfinished)
+                                  if unfinished is not None else None)
 
     # -- balancing / admission -------------------------------------------
 
     def _admit(self, exclude: frozenset) -> ReplicaState:
         """Least-loaded eligible replica, or raise :class:`RouterShed`
         (brownout: every eligible replica saturated; outage: none
-        eligible at all)."""
+        eligible at all). Load is ``ReplicaState.load()`` — unfinished
+        (pending + in-flight) when the replica exports it, else queue
+        depth — so a replica mid-batch no longer scrapes as idle."""
         with self._lock:
             candidates = [rep for rep in self._replicas
                           if rep.eligible() and rep.url not in exclude]
             if not candidates:
                 raise RouterShed(
                     "no healthy replica available", self.shed_retry_after_s)
-            if all(rep.queue_depth >= self.brownout_queue_depth
+            if all(rep.load() >= self.brownout_queue_depth
                    for rep in candidates):
                 raise RouterShed(
                     "every healthy replica is saturated "
-                    f"(queue depth >= {self.brownout_queue_depth}); "
+                    f"(unfinished >= {self.brownout_queue_depth}); "
                     "brownout shed", self.shed_retry_after_s)
             chosen = min(candidates,
-                         key=lambda r: (r.queue_depth + r.inflight,
+                         key=lambda r: (r.load() + r.inflight,
                                         r.inflight, r.index))
             chosen.inflight += 1
             chosen.requests += 1
@@ -539,7 +567,7 @@ class Router:
             if not candidates:
                 return None
             chosen = min(candidates,
-                         key=lambda r: (r.queue_depth + r.inflight,
+                         key=lambda r: (r.load() + r.inflight,
                                         r.inflight, r.index))
             chosen.inflight += 1
             chosen.requests += 1
@@ -625,6 +653,7 @@ class Router:
             record["replica_states"] = [{
                 "url": rep.url, "healthy": rep.healthy,
                 "draining": rep.draining, "queue_depth": rep.queue_depth,
+                "unfinished": rep.unfinished,
                 "inflight": rep.inflight, "requests": rep.requests,
             } for rep in self._replicas]
         return record
@@ -677,6 +706,10 @@ class Router:
                 lines.append(
                     f'{name}{{replica="{i}",field="{field}"}} '
                     f"{render(rep.get(field, 0))}")
+            if rep.get("unfinished") is not None:
+                lines.append(
+                    f'{name}{{replica="{i}",field="unfinished"}} '
+                    f"{render(rep['unfinished'])}")
         return "\n".join(lines) + "\n"
 
     def healthy_count(self) -> int:

@@ -7,9 +7,15 @@ layer's ``step_window``/``run_summary`` pair:
   request count, end-to-end and on-device latency percentiles
   (p50/p95/p99, milliseconds), batch count, mean batch occupancy
   (real tokens / dispatched slot budget — the serving analog of
-  ``padding_efficiency``), max queue depth, and the number of XLA
+  ``padding_efficiency``), max queue depth, the number of XLA
   compiles observed in the window (zero in steady state — the engine
-  AOT-compiles every (task, bucket) at startup);
+  AOT-compiles every (task, bucket) at startup), and two
+  continuous-batching gauges (docs/serving.md "Continuous batching"):
+  ``admitted_late`` (requests that joined a forming batch through the
+  admission window) and ``device_idle_share`` (executor gap between
+  consecutive forwards / (gap + busy) — the idle the pipelined
+  dispatch plane exists to squeeze out, and the metric behind the
+  "serve device idle share" report gate);
 * ``kind="serve_summary"`` — the end-of-run rollup ``finish()`` emits,
   plus the live snapshot ``/statsz`` serves.
 
@@ -78,6 +84,13 @@ class ServeTelemetry:
         self._budget_tokens = 0
         self._depth_max = 0
         self._compiles = 0
+        self._admitted_late = 0
+        # Executor-gap accounting: device idle seconds between
+        # consecutive forwards vs the busy (forward) seconds they
+        # bracket — only batches that carried a gap sample contribute
+        # to the busy basis, so the share is a true ratio.
+        self._gap_s = 0.0
+        self._gap_busy_s = 0.0
         self._window_t0 = clock()
         # run totals; latency samples bounded to the RUN_SAMPLE_CAP most
         # recent so a long-lived server's memory and /statsz cost stay flat
@@ -90,6 +103,9 @@ class ServeTelemetry:
         self._run_budget_tokens = 0
         self._run_depth_max = 0
         self._run_compiles = 0
+        self._run_admitted_late = 0
+        self._run_gap_s = 0.0
+        self._run_gap_busy_s = 0.0
         # Engine startup stats (cold_start_s, warm/cold compile split,
         # quantize mode, weight bytes): written once by observe_cold_start
         # on the thread that ran warmup, read by HTTP workers via
@@ -105,10 +121,16 @@ class ServeTelemetry:
 
     def observe_batch(self, e2e_s: List[float], device_s: float,
                       rows: int, bucket: int, real_tokens: int,
-                      queue_depth: int = 0, compiles: int = 0) -> None:
+                      queue_depth: int = 0, compiles: int = 0,
+                      admitted_late: int = 0,
+                      exec_gap_s: Optional[float] = None) -> None:
         """Record one dispatched batch: per-request end-to-end latencies,
         the batch's forward wall time (incl. device sync), its dispatched
-        slot budget (``rows * bucket``), and the real tokens it carried."""
+        slot budget (``rows * bucket``), and the real tokens it carried.
+        ``admitted_late`` counts the batch's requests that joined its
+        forming plan through the admission window; ``exec_gap_s`` is the
+        device-idle gap between the previous forward's end and this
+        one's start (None for the first batch — no gap exists yet)."""
         budget = int(rows) * int(bucket)
         with self._lock:
             self._e2e.extend(e2e_s)
@@ -118,6 +140,13 @@ class ServeTelemetry:
             self._budget_tokens += budget
             self._depth_max = max(self._depth_max, int(queue_depth))
             self._compiles += int(compiles)
+            self._admitted_late += int(admitted_late)
+            if exec_gap_s is not None:
+                gap = max(0.0, float(exec_gap_s))
+                self._gap_s += gap
+                self._gap_busy_s += float(device_s)
+                self._run_gap_s += gap
+                self._run_gap_busy_s += float(device_s)
             self.total_requests += len(e2e_s)
             self.total_batches += 1
             self._run_e2e.extend(e2e_s)
@@ -127,6 +156,7 @@ class ServeTelemetry:
             self._run_depth_max = max(self._run_depth_max,
                                       int(queue_depth))
             self._run_compiles += int(compiles)
+            self._run_admitted_late += int(admitted_late)
             due = len(self._e2e) >= self.window
         if due:
             self.flush_window()
@@ -197,6 +227,15 @@ class ServeTelemetry:
         # tokens, but guard the floor anyway.
         return round(min(1.0, max(real, 1) / budget), 4)
 
+    @staticmethod
+    def _idle_share(gap_s: float, busy_s: float) -> Optional[float]:
+        """Device-idle share over the batches that carried a gap sample
+        (None before a second forward exists — one batch has no gap)."""
+        total = gap_s + busy_s
+        if total <= 0:
+            return None
+        return round(min(1.0, max(0.0, gap_s / total)), 4)
+
     def flush_window(self) -> Optional[dict]:
         """Emit (and return) the current window record; None when empty."""
         with self._lock:
@@ -218,6 +257,10 @@ class ServeTelemetry:
             occ = self._occupancy(self._real_tokens, self._budget_tokens)
             if occ is not None:
                 record["batch_occupancy"] = occ
+            record["admitted_late"] = self._admitted_late
+            idle = self._idle_share(self._gap_s, self._gap_busy_s)
+            if idle is not None:
+                record["device_idle_share"] = idle
             self._e2e = []
             self._device = []
             self._batches = 0
@@ -225,6 +268,9 @@ class ServeTelemetry:
             self._budget_tokens = 0
             self._depth_max = 0
             self._compiles = 0
+            self._admitted_late = 0
+            self._gap_s = 0.0
+            self._gap_busy_s = 0.0
             self._window_t0 = now
         if self.emit is not None:
             self.emit(record)
@@ -255,6 +301,10 @@ class ServeTelemetry:
                                   self._run_budget_tokens)
             if occ is not None:
                 record["batch_occupancy"] = occ
+            record["admitted_late"] = self._run_admitted_late
+            idle = self._idle_share(self._run_gap_s, self._run_gap_busy_s)
+            if idle is not None:
+                record["device_idle_share"] = idle
             if self._cold_start is not None:
                 # 'compiles' here is the STEADY-STATE count (zero after
                 # warmup — the serve acceptance); the warmup compile

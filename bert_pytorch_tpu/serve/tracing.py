@@ -118,6 +118,10 @@ class _TaskStats:
         self.errors = 0
         self.sampled = 0
         self.over_slo = 0
+        # Requests that joined a forming batch through the admission
+        # window (continuous batching, docs/serving.md) — the
+        # bert_serve_admitted_late_total counter.
+        self.admitted_late = 0
         # Prometheus histogram state per phase (+ "total"): non-cumulative
         # per-bucket counts, rendered cumulative at scrape time.
         self.hist = {p: [0] * (len(HIST_BUCKETS_MS) + 1)
@@ -137,6 +141,7 @@ class _TaskStats:
         self.win_over_slo = 0
         self.win_sampled = 0
         self.win_slow_forced = 0
+        self.win_admitted_late = 0
 
     def note(self, phases_s: Dict[str, float], total_s: float) -> None:
         self.requests += 1
@@ -201,12 +206,17 @@ class TraceCollector:
                 batch_requests: Optional[int] = None,
                 occupancy: Optional[float] = None,
                 prepare_s: Optional[float] = None,
-                pack_s: Optional[float] = None) -> Optional[dict]:
+                pack_s: Optional[float] = None,
+                admitted_late: Optional[bool] = None,
+                staged_wait_s: Optional[float] = None) -> Optional[dict]:
         """Record one completed request's phase decomposition; returns
         the emitted ``serve_trace`` record when the request was sampled
         (head rate, or forced by the over-SLO slow rule), else None.
         ``phases_s`` maps each name in :data:`PHASES` to its duration in
-        seconds."""
+        seconds. ``admitted_late`` marks a request that joined a forming
+        batch through the pipelined plane's admission window;
+        ``staged_wait_s`` is its batch's staging-complete -> executor
+        pickup delay (pipeline buffering — context, not a span)."""
         phases_s = {name: max(0.0, float(phases_s.get(name, 0.0)))
                     for name in PHASES}
         total_s = max(float(total_s), sum(phases_s.values()))
@@ -219,6 +229,9 @@ class TraceCollector:
         with self._lock:
             stats = self._tasks.setdefault(task, _TaskStats())
             stats.note(phases_s, total_s)
+            if admitted_late:
+                stats.admitted_late += 1
+                stats.win_admitted_late += 1
             if over_slo:
                 stats.over_slo += 1
                 stats.win_over_slo += 1
@@ -248,7 +261,8 @@ class TraceCollector:
                 task, request_id, phases_s, total_ms, sampled=head,
                 over_slo=over_slo,
                 bucket=bucket, packed=packed, batch_requests=batch_requests,
-                occupancy=occupancy, prepare_s=prepare_s, pack_s=pack_s)
+                occupancy=occupancy, prepare_s=prepare_s, pack_s=pack_s,
+                admitted_late=admitted_late, staged_wait_s=staged_wait_s)
             self.emit(trace_record)
         if phase_record is not None:
             self.emit(phase_record)
@@ -262,7 +276,8 @@ class TraceCollector:
 
     def _trace_record(self, task, request_id, phases_s, total_ms, sampled,
                       over_slo, bucket, packed, batch_requests, occupancy,
-                      prepare_s, pack_s=None) -> dict:
+                      prepare_s, pack_s=None, admitted_late=None,
+                      staged_wait_s=None) -> dict:
         spans = []
         start = 0.0
         for name in PHASES:
@@ -305,6 +320,19 @@ class TraceCollector:
             # (serve/engine.py execute info["pack_s"]) — sub-attribution
             # context, already counted inside the assembly duration.
             record["pack_ms"] = round(float(pack_s) * 1000.0, 3)
+        if admitted_late is not None:
+            # Continuous batching: did this request join a FORMING batch
+            # through the admission window (pipelined dispatch) instead
+            # of waiting for its own flush? Schema-linted as a real
+            # boolean — the A/B acceptance counts on it.
+            record["admitted_late"] = bool(admitted_late)
+        if staged_wait_s is not None:
+            # Pipeline buffering between staging and the executor's
+            # pickup — context like pack_ms, NOT a span: it is waiting,
+            # not work, and sits in the slack between sum(spans) and
+            # total_ms.
+            record["staged_wait_ms"] = round(
+                float(staged_wait_s) * 1000.0, 3)
         return record
 
     def _window_record_locked(self, task: str, stats: _TaskStats) -> dict:
@@ -316,6 +344,7 @@ class TraceCollector:
             "task": task,
             "window_requests": len(stats.win_samples["total"]),
             "sampled_traces": stats.win_sampled,
+            "admitted_late": stats.win_admitted_late,
         }
         for name in PHASES:
             s = sorted(stats.win_samples[name])
@@ -369,6 +398,8 @@ class TraceCollector:
                 "errors": sum(s.errors for s in self._tasks.values()),
                 "sampled_traces": sum(
                     s.sampled for s in self._tasks.values()),
+                "admitted_late": sum(
+                    s.admitted_late for s in self._tasks.values()),
             }
             total_s = sum(s.run_total_s for s in self._tasks.values())
             queue_s = sum(s.run_phase_s["queue"]
@@ -409,6 +440,7 @@ class TraceCollector:
                     "errors": stats.errors,
                     "sampled": stats.sampled,
                     "over_slo": stats.over_slo,
+                    "admitted_late": stats.admitted_late,
                     "hist": {p: list(stats.hist[p])
                              for p in PHASES + ("total",)},
                     "hist_sum": dict(stats.hist_sum),
@@ -437,6 +469,13 @@ class TraceCollector:
             lines.append(
                 f'{prefix}_traces_sampled_total{{task="{task}"}} '
                 f"{stats['sampled']}")
+        header(f"{prefix}_admitted_late_total", "counter",
+               "Requests admitted into a forming batch through the "
+               "admission window (continuous batching).")
+        for task, stats in copied.items():
+            lines.append(
+                f'{prefix}_admitted_late_total{{task="{task}"}} '
+                f"{stats['admitted_late']}")
         if self.slo_p99_ms:
             header(f"{prefix}_over_slo_total", "counter",
                    "Requests over the p99 SLO target per task head.")

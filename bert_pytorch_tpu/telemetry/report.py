@@ -311,7 +311,13 @@ def summarize_records(records, name: str = "") -> dict:
                          ("device_p50_ms", "serve_device_p50_ms"),
                          ("batch_occupancy", "serve_occupancy"),
                          ("compiles", "serve_compiles"),
-                         ("errors", "serve_errors")):
+                         ("errors", "serve_errors"),
+                         # Continuous-batching gauges (docs/serving.md):
+                         # the executor-gap share behind the "serve
+                         # device idle share" gate, and the
+                         # admission-window win count.
+                         ("device_idle_share", "serve_device_idle_share"),
+                         ("admitted_late", "serve_admitted_late")):
             if serve_summary.get(src) is not None:
                 out[dst] = serve_summary[src]
     elif serve_windows:
@@ -336,6 +342,20 @@ def summarize_records(records, name: str = "") -> dict:
                 sum(v * w for v, w in occs) / total_w, 4)
         out["serve_compiles"] = sum(
             int(w.get("compiles", 0)) for w in serve_windows)
+        out["serve_admitted_late"] = sum(
+            int(w.get("admitted_late", 0)) for w in serve_windows)
+        # Window fallback for the executor-gap share: request-weighted
+        # mean (each window's share already normalizes by its own busy
+        # basis; a dead-air window anywhere must still pull the run's
+        # number up, which a min/max would over- or under-state).
+        idles = [(float(w["device_idle_share"]),
+                  int(w.get("window_requests", 1)))
+                 for w in serve_windows
+                 if w.get("device_idle_share") is not None]
+        if idles:
+            total_w = sum(w for _, w in idles)
+            out["serve_device_idle_share"] = round(
+                sum(v * w for v, w in idles) / total_w, 4)
 
     # -- request-tracing section (serve/tracing.py, docs/serving.md) ----
     # serve_phase windows carry the latency DECOMPOSITION the coarse
@@ -568,6 +588,13 @@ _CHECKS = (
     # the serving SLO is written against.
     ("serve_queue_wait_share", "serve queue-wait share", "up", "p95"),
     ("serve_slo_p99_ms", "serve SLO p99", "up", "p95"),
+    # Continuous-batching gate (docs/serving.md "Continuous batching"):
+    # the executor-gap (device idle) share between consecutive jitted
+    # forwards. The pipelined dispatch plane exists to hold this down —
+    # a regression means the device is idling through host-side
+    # assembly/decode again (e.g. the pipeline silently serialized),
+    # even when per-request latency still looks fine at low load.
+    ("serve_device_idle_share", "serve device idle share", "up", "p95"),
     # Cold start: the persisted-AOT-cache win. A regression here means a
     # restarted replica is recompiling (cache key drift — e.g. a renamed
     # forward — or the persistence bar filtering serve executables).
@@ -667,7 +694,9 @@ def format_summary(summary: dict) -> str:
              "serve_requests", "serve_rps", "serve_latency_p50_ms",
              "serve_latency_p95_ms", "serve_latency_p99_ms",
              "serve_device_p50_ms", "serve_occupancy", "serve_compiles",
-             "serve_errors", "serve_cold_start_s", "serve_compiles_cold",
+             "serve_errors", "serve_admitted_late",
+             "serve_device_idle_share",
+             "serve_cold_start_s", "serve_compiles_cold",
              "serve_compiles_warm", "serve_quantize",
              "serve_queue_wait_share", "serve_queue_p95_ms",
              "serve_assembly_p95_ms", "serve_execute_p95_ms",

@@ -293,7 +293,13 @@ def _check_async_fields(rec, errors) -> None:
 
 
 def _check_serve_fields(rec, errors) -> None:
-    """Serve-kind consistency (schema v1 addition; serve/stats.py)."""
+    """Serve-kind consistency (schema v1 addition; serve/stats.py).
+    Continuous-batching fields (docs/serving.md "Continuous batching"):
+    ``device_idle_share`` is a ratio of idle to (idle + busy) executor
+    time, so it lives in [0, 1]; ``admitted_late`` counts requests, so
+    it is a non-negative integer bounded by the record's request
+    count — a window claiming more late admissions than requests is the
+    accounting bug this invariant exists to catch."""
     for prefix in _SERVE_LATENCY_PREFIXES:
         keys = [f"{prefix}_p50_ms", f"{prefix}_p95_ms", f"{prefix}_p99_ms"]
         vals = [rec.get(k) for k in keys]
@@ -311,6 +317,24 @@ def _check_serve_fields(rec, errors) -> None:
                 or not 0 < occ <= 1:
             errors.append(
                 f"batch_occupancy must be in (0, 1], got {occ!r}")
+    if "device_idle_share" in rec:
+        share = rec["device_idle_share"]
+        if not _is_number(share) or not 0 <= share <= 1:
+            errors.append(
+                f"device_idle_share must be in [0, 1], got {share!r}")
+    late = rec.get("admitted_late")
+    if late is not None:
+        total_key = ("window_requests" if rec.get("kind") == "serve_window"
+                     else "requests")
+        total = rec.get(total_key)
+        if not isinstance(late, int) or isinstance(late, bool) or late < 0:
+            errors.append(
+                f"admitted_late must be a non-negative integer, got "
+                f"{late!r}")
+        elif isinstance(total, int) and not isinstance(total, bool) \
+                and late > total:
+            errors.append(
+                f"admitted_late ({late}) exceeds {total_key} ({total})")
 
 
 def _check_cold_start_fields(rec, errors) -> None:
@@ -366,6 +390,19 @@ def _check_trace_fields(rec, errors) -> None:
     if reason is not None and reason not in ("head", "slow"):
         errors.append(
             f"sample_reason must be 'head' or 'slow', got {reason!r}")
+    late = rec.get("admitted_late")
+    if late is not None and not isinstance(late, bool):
+        # The continuous-batching admission marker (serve/service.py
+        # pipelined dispatch): consumers count admission-window wins on
+        # it, so it must be a real boolean, like `sampled`.
+        errors.append(
+            f"serve_trace 'admitted_late' must be a boolean, got {late!r}")
+    staged_wait = rec.get("staged_wait_ms")
+    if staged_wait is not None and (
+            not _is_number(staged_wait) or staged_wait < 0):
+        errors.append(
+            f"staged_wait_ms must be a non-negative number, got "
+            f"{staged_wait!r}")
     spans = rec.get("spans")
     if not isinstance(spans, list) or not spans:
         errors.append(
@@ -429,6 +466,15 @@ def _check_phase_fields(rec, errors) -> None:
             not (totals[0] <= totals[1] <= totals[2]):
         errors.append(
             f"total percentiles not ordered (p50 <= p95 <= p99): {totals}")
+    late = rec.get("admitted_late")
+    if late is not None:
+        if not isinstance(late, int) or isinstance(late, bool) or late < 0:
+            errors.append(
+                f"admitted_late must be a non-negative integer, got "
+                f"{late!r}")
+        elif isinstance(n, int) and not isinstance(n, bool) and late > n:
+            errors.append(
+                f"admitted_late ({late}) exceeds window_requests ({n})")
     over = rec.get("over_slo")
     if over is not None:
         if not isinstance(over, int) or isinstance(over, bool) or over < 0:

@@ -32,6 +32,14 @@ The spec is a comma-separated list of points::
                      the failure mode only the supervisor's heartbeat
                      watchdog can catch (serve/supervisor.py,
                      tools/chaos_serve.py)
+    admit_hold@N     hold the serving ASSEMBLER inside the admission
+    admit_hold@NxS   window on its Nth formed batch: the fault check
+                     emits its injection record (the chaos harness's
+                     kill cue) then sleeps S seconds (default 3) with
+                     the forming batch open — so a SIGKILL lands with
+                     requests provably inside the admission window
+                     (serve/service.py pipelined dispatch,
+                     tools/chaos_serve.py)
 
 Everything is keyed on explicit step numbers / call counts — rerunning
 the same spec on the same data reproduces the same failure, which is
@@ -90,7 +98,7 @@ class FaultPlan:
             point = m.group("point")
             step = m.group("step")
             count = int(m.group("count") or 0)
-            if point in _STEP_POINTS or point == "wedge":
+            if point in _STEP_POINTS or point in ("wedge", "admit_hold"):
                 if step is None:
                     raise FaultSpecError(
                         f"fault point {point!r} needs @step (e.g. "
@@ -102,7 +110,8 @@ class FaultPlan:
             else:
                 raise FaultSpecError(
                     f"unknown fault point {point!r} (known: "
-                    f"{', '.join(_STEP_POINTS)}, shard_error, wedge)")
+                    f"{', '.join(_STEP_POINTS)}, shard_error, wedge, "
+                    f"admit_hold)")
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -188,6 +197,29 @@ class FaultPlan:
                               requests_served=int(requests_served),
                               hang_s=hang_s))
         time.sleep(hang_s)
+
+    def serve_admit_check(self, batches_assembled: int,
+                          emit: Optional[Callable] = None) -> None:
+        """Hold the calling (assembler) thread inside the admission
+        window once ``batches_assembled`` reaches the armed
+        ``admit_hold@N`` threshold: emit the injection record FIRST (it
+        is the chaos harness's cue to SIGKILL this replica with
+        requests captive in the forming batch), then sleep S seconds
+        (default 3 — a hold, not a wedge: an unkilled replica resumes
+        and serves the batch late). Called by the pipelined assembler
+        per formed batch (serve/service.py); fires at most once per
+        plan."""
+        cfg = self._points.get("admit_hold")
+        if (cfg is None or batches_assembled < cfg["step"]
+                or "admit_hold" in self._fired):
+            return
+        self._fired.add("admit_hold")
+        hold_s = cfg["count"] or 3
+        if emit is not None:
+            emit(self._record("injected_admit_hold", None,
+                              batches_assembled=int(batches_assembled),
+                              hold_s=hold_s))
+        time.sleep(hold_s)
 
     def shard_read_check(self, path: str,
                          emit: Optional[Callable] = None) -> None:

@@ -68,9 +68,13 @@ def parse_arguments(argv=None):
                              "waited this long")
     # Inference fast path (docs/serving.md): --quantize/--attention_backend,
     # shared with tools/batch_infer.py via one helper. Tracing/SLO knobs
-    # (docs/serving.md "Request tracing & metrics") ride the same way.
-    from bert_pytorch_tpu.serve.cli import add_fast_path_args, add_tracing_args
+    # (docs/serving.md "Request tracing & metrics") and the dispatch-plane
+    # mode (docs/serving.md "Continuous batching") ride the same way.
+    from bert_pytorch_tpu.serve.cli import (add_dispatch_args,
+                                            add_fast_path_args,
+                                            add_tracing_args)
 
+    add_dispatch_args(parser)
     add_fast_path_args(parser)
     add_tracing_args(parser)
     parser.add_argument("--pack_requests", action="store_true",
@@ -246,7 +250,9 @@ def build_service(args):
         max_requests_per_pack=engine.max_requests_per_pack,
         max_pending=args.max_pending)
     service = ServingService(engine, batcher, serve_tele, tracer=tracer,
-                             heartbeat=heartbeat)
+                             heartbeat=heartbeat,
+                             dispatch_mode=getattr(args, "dispatch_mode",
+                                                   "pipelined"))
     # Rides the service so main()/tests reach it without widening the
     # (service, sink) signature batch_infer/bench already consume.
     service.flight_recorder = recorder
@@ -292,7 +298,8 @@ def main(args) -> int:
     host, port = server.server_address[:2]
     logger.info(f"serving {sorted(service.engine.tasks)} on "
                 f"http://{host}:{port} (POST /v1/<task>, GET /healthz, "
-                "GET /statsz, GET /metricsz) — tracing "
+                "GET /statsz, GET /metricsz) — dispatch "
+                f"{service.dispatch_mode}, tracing "
                 f"{args.trace_sample_rate:.0%} head-sampled, "
                 f"SLO p99 {args.slo_p99_ms:g}ms (over-SLO always traced)")
 

@@ -456,6 +456,20 @@ class _FakeEngine:
                 {"device_s": 0.001, "rows": len(plan.requests), "bucket": 8,
                  "real_tokens": 3, "compiles": 0})
 
+    # The pipelined dispatch plane (docs/serving.md "Continuous
+    # batching") drives the staged split; compose it from execute.
+    def stage(self, task, plan):
+        from types import SimpleNamespace
+
+        return SimpleNamespace(task=task, plan=plan, pack_s=0.0,
+                               staged_at=None)
+
+    def execute_staged(self, staged):
+        return self.execute(staged.task, staged.plan)
+
+    def demux(self, staged, out):
+        return out
+
 
 def test_serve_drain_sheds_then_flushes_then_stops():
     from bert_pytorch_tpu.serve import Batcher, ServiceDraining

@@ -36,6 +36,10 @@ def test_chaos_serve_fleet_failover_acceptance():
     assert verdict["ok"] is True
     for phase in ("phase_a", "phase_b", "phase_c"):
         assert verdict[phase]["failures"] == 0, verdict[phase]
+    # Phase A's SIGKILL landed inside the admission window: the armed
+    # admit_hold fault reported the assembler holding a forming batch
+    # open (pipelined dispatch) before the kill fired.
+    assert verdict["phase_a"]["admit_hold_observed"] is True
     assert verdict["restart_compiles_cold"] == 0
     assert verdict["router"]["errors"] == 0
     assert verdict["router"]["failovers"] >= 1

@@ -1,0 +1,552 @@
+"""Continuous batching: the pipelined serve dispatch plane (ISSUE 13,
+serve/service.py, docs/serving.md "Continuous batching").
+
+Covers the acceptance surface with a DETERMINISTIC fake engine (pure
+host-side sleeps per stage — no jax, no device; the real-engine serving
+path is exercised end to end by tests/test_serve.py and
+tests/test_serve_tracing.py, which now run pipelined by default):
+
+* the same concurrent burst driven through ``dispatch_mode="serial"``
+  and ``"pipelined"``: pipelined shows late-admitted requests
+  (``admitted_late > 0``), a LOWER executor-gap (device idle) share,
+  and p99 no worse; span invariants (sum(spans) <= total,
+  queue_wait <= total) hold on every trace in both modes;
+* a paced (open-loop) burst through both modes: the slowest-decile
+  critical path shifts OFF ``assembly`` — the demux host conversion
+  that serial dispatch charges to the assembly span runs on the
+  completion stage in pipelined mode, off the device thread's path;
+* drain correctness across the pipeline: ``stop()`` fails-or-flushes
+  requests stranded in the forming batch, the staged handoff, a wedged
+  executor, and a wedged completion stage deterministically — every
+  blocked submitter wakes with a definite answer;
+* the admission-window API (``Batcher.admit_into_forming``) under a
+  fake clock;
+* the under-reporting load gauge fix: ``bert_serve_unfinished``
+  (pending + in-flight) exported next to ``queue_depth``, a mid-batch
+  replica no longer scraping as idle, and the router's least-loaded
+  score and brownout admission preferring it;
+* the "serve device idle share" telemetry-report gate (fixture pair,
+  wired like the PR 9 SLO gates).
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from bert_pytorch_tpu.serve.batcher import Batcher, Request
+from bert_pytorch_tpu.serve.engine import BatchPlan, StagedBatch
+from bert_pytorch_tpu.serve.service import ServingService
+from bert_pytorch_tpu.serve.stats import ServeTelemetry
+from bert_pytorch_tpu.serve.tracing import TraceCollector
+from bert_pytorch_tpu.telemetry import report
+from bert_pytorch_tpu.telemetry.schema import validate_file, validate_record
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures", "telemetry")
+
+
+# ---------------------------------------------------------------------------
+# deterministic fake engine: per-stage costs are injected sleeps
+
+
+class _Handler:
+    output_kind = "pooled"
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def prepare(self, payload, max_len):
+        n = min(max_len, int(payload.get("n", 6)))
+        return {"input_ids": list(range(2, 2 + n)), "segment_ids": [0] * n}
+
+    def postprocess(self, features, out, payload):
+        eng = self._engine
+        if payload.get("block") and eng.post_hold is not None \
+                and not eng.post_hold.is_set():
+            eng.post_entered.set()
+            eng.post_hold.wait(10.0)
+        if eng.post_s:
+            time.sleep(eng.post_s)
+        return {"ok": True, "n": len(features["input_ids"])}
+
+
+class _Spec:
+    def __init__(self, handler):
+        self.handler = handler
+
+
+class FakeEngine:
+    """Host-only engine stand-in with deterministic per-stage costs.
+
+    ``stage_s``/``execute_s``/``demux_s``/``post_s`` are sleeps, so the
+    A/B between serial and pipelined dispatch is a property of the
+    dispatch plane alone. ``exec_gate``/``post_hold`` (when set by a
+    test) block the executor / completion stage — the wedge shapes the
+    drain tests strand requests behind."""
+
+    def __init__(self, stage_s=0.0, execute_s=0.0, demux_s=0.0,
+                 post_s=0.0, max_batch_size=4):
+        self.stage_s = stage_s
+        self.execute_s = execute_s
+        self.demux_s = demux_s
+        self.post_s = post_s
+        self.max_batch_size = max_batch_size
+        self.pack = False
+        self.warmed = True
+        self.startup = None
+        self.exec_gate = None       # unset Event = executor blocks
+        self.post_hold = None       # unset Event = postprocess blocks
+        self.post_entered = threading.Event()
+        self.tasks = {"classify": _Spec(_Handler(self))}
+
+    def max_len(self):
+        return 32
+
+    def warmup(self):
+        return 0
+
+    def plan_batch(self, requests, packed=None):
+        take = requests[: self.max_batch_size]
+        leftover = requests[self.max_batch_size:]
+        return BatchPlan(16, [[r] for r in take], leftover, False)
+
+    def stage(self, task, plan):
+        if self.stage_s:
+            time.sleep(self.stage_s)
+        return StagedBatch(task, plan, (), {}, pack_s=self.stage_s)
+
+    def execute_staged(self, staged):
+        if self.exec_gate is not None:
+            self.exec_gate.wait(10.0)
+        t0 = time.monotonic()
+        if self.execute_s:
+            time.sleep(self.execute_s)
+        device_s = time.monotonic() - t0
+        n = len(staged.plan.requests)
+        info = {"bucket": staged.plan.bucket, "rows": self.max_batch_size,
+                "real_tokens": sum(r.length for r in staged.plan.requests),
+                "device_s": device_s, "pack_s": staged.pack_s,
+                "compiles": 0, "packed": False}
+        return [None] * n, info
+
+    def demux(self, staged, out):
+        if self.demux_s:
+            time.sleep(self.demux_s)
+        return list(out)
+
+    def execute(self, task, plan):
+        staged = self.stage(task, plan)
+        out, info = self.execute_staged(staged)
+        return self.demux(staged, out), info
+
+
+def _req(n=6, payload=None, task="classify"):
+    return Request(task, {"input_ids": list(range(2, 2 + n)),
+                          "segment_ids": [0] * n}, payload or {})
+
+
+def _service(engine, mode, max_batch_size=4, max_wait_ms=2.0,
+             tracer=None):
+    return ServingService(
+        engine, Batcher(max_batch_size=max_batch_size,
+                        max_wait_ms=max_wait_ms),
+        ServeTelemetry(window=64), tracer=tracer, dispatch_mode=mode)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the same concurrent burst, serial vs pipelined
+
+
+def _saturation_leg(mode, n_workers=4, per_worker=7):
+    """Closed-loop staggered burst: enough concurrency that batches
+    overlap with arrivals — the shape continuous batching exists for."""
+    records = []
+    tracer = TraceCollector(emit=records.append, sample_rate=1.0,
+                            window=64)
+    engine = FakeEngine(stage_s=0.004, execute_s=0.025, demux_s=0.008,
+                        post_s=0.001)
+    service = _service(engine, mode, tracer=tracer)
+    service.start()
+    errors = []
+
+    def worker(i):
+        time.sleep(0.003 * i)  # desynchronize the closed loops
+        for k in range(per_worker):
+            try:
+                service.submit("classify", {"n": 6}, timeout=30.0)
+            except Exception as exc:  # pragma: no cover - the assert
+                errors.append(exc)
+            time.sleep(0.002 * ((i + k) % 3))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    service.stop()
+    snap = service.telemetry.snapshot(include_phases=False)
+    traces = [r for r in records if r.get("kind") == "serve_trace"]
+    return snap, traces, errors
+
+
+def test_pipelined_vs_serial_saturation_acceptance():
+    snap_s, traces_s, err_s = _saturation_leg("serial")
+    snap_p, traces_p, err_p = _saturation_leg("pipelined")
+    assert not err_s and not err_p
+    assert snap_s["requests"] == snap_p["requests"] == 28
+    assert snap_s["errors"] == snap_p["errors"] == 0
+
+    # Late admission exists only in the pipelined plane: requests that
+    # arrived while a batch executed joined the NEXT forming batch.
+    assert snap_p["admitted_late"] > 0
+    assert snap_s["admitted_late"] == 0
+    assert any(t["admitted_late"] for t in traces_p)
+    assert not any(t["admitted_late"] for t in traces_s)
+
+    # The device idles less: back-to-back forwards from the depth-1
+    # staged handoff vs serial's assemble/demux/decode gaps.
+    assert snap_s["device_idle_share"] > 0
+    assert snap_p["device_idle_share"] <= snap_s["device_idle_share"] * 0.8
+
+    # Tail latency is no worse under the pipeline (it should be better:
+    # the same batches, minus the serialized host work between them).
+    assert snap_p["latency_p99_ms"] <= snap_s["latency_p99_ms"] * 1.25
+
+    # Span invariants hold by construction on EVERY trace, both modes —
+    # and the records lint against schema v1 (admitted_late is a real
+    # boolean, staged_wait_ms non-negative).
+    for t in traces_s + traces_p:
+        dur_sum = sum(s["dur_ms"] for s in t["spans"])
+        assert dur_sum <= t["total_ms"] + 0.01, t
+        assert t["queue_wait_ms"] <= t["total_ms"] + 0.01, t
+        assert validate_record(dict(t, schema=1, ts=0.0)) == []
+    # Pipelined traces carry the staged-handoff wait as context.
+    assert all("staged_wait_ms" in t for t in traces_p)
+    assert all("staged_wait_ms" not in t for t in traces_s)
+
+
+def _paced_leg(mode, n_requests=10, interval_s=0.11):
+    """Open-loop paced burst (arrival interval > the serial cycle): no
+    queueing in either mode, so per-trace span attribution — not
+    backlog — decides the critical path."""
+    records = []
+    tracer = TraceCollector(emit=records.append, sample_rate=1.0,
+                            window=64)
+    engine = FakeEngine(stage_s=0.004, execute_s=0.02, demux_s=0.06,
+                        post_s=0.001)
+    service = _service(engine, mode)
+    service.tracer = tracer
+    service.telemetry.attach_tracer(tracer)
+    service.start()
+    errors = []
+
+    def one():
+        try:
+            service.submit("classify", {"n": 6}, timeout=30.0)
+        except Exception as exc:  # pragma: no cover - the assert
+            errors.append(exc)
+
+    threads = []
+    for _ in range(n_requests):
+        t = threading.Thread(target=one)
+        threads.append(t)
+        t.start()
+        time.sleep(interval_s)
+    for t in threads:
+        t.join(timeout=60)
+    service.stop()
+    assert not errors
+    return [r for r in records if r.get("kind") == "serve_trace"]
+
+
+def test_critical_path_shifts_off_assembly():
+    """Serial dispatch charges the demux host conversion to the
+    ``assembly`` span (it happens on the dispatch thread between pop
+    and fulfilment); the pipelined completion stage runs it off the
+    device path, so the slowest-decile critical path
+    (telemetry-report's tail attribution) moves off ``assembly``."""
+    traces_serial = _paced_leg("serial")
+    traces_pipe = _paced_leg("pipelined")
+    cp_serial = report.summarize_records(
+        traces_serial, name="serial")["serve_critical_path"]
+    cp_pipe = report.summarize_records(
+        traces_pipe, name="pipelined")["serve_critical_path"]
+    assert max(cp_serial, key=cp_serial.get) == "assembly", cp_serial
+    assert max(cp_pipe, key=cp_pipe.get) != "assembly", cp_pipe
+
+
+# ---------------------------------------------------------------------------
+# drain correctness: fail-or-flush across every pipeline stage
+
+
+def test_stop_fails_stranded_forming_staged_and_executing():
+    """A wedged executor strands batches in every stage: the executing
+    batch, the staged handoff, the forming batch, and the pending
+    queue. stop() must give EVERY request a deterministic error — no
+    blocked submitter left waiting for its client-side timeout."""
+    engine = FakeEngine(max_batch_size=2)
+    engine.exec_gate = threading.Event()  # executor blocks until set
+    service = _service(engine, "pipelined", max_batch_size=2,
+                       max_wait_ms=1.0)
+    service.start()
+    try:
+        reqs = [_req() for _ in range(8)]
+        for r in reqs:
+            service.batcher.submit(r)
+        # Pipeline fills: b1 executing (blocked), b2 in the handoff,
+        # b3 forming, r7/r8 pending.
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            health = service.health()
+            if health["forming_depth"] == 2 and health["queue_depth"] == 2:
+                break
+            time.sleep(0.01)
+        assert service.batcher.unfinished() == 8
+        service.stop(drain_s=0.1, join_s=0.3)
+    finally:
+        engine.exec_gate.set()  # unwedge for thread cleanup
+    for r in reqs:
+        assert r.error is not None, r.id
+    messages = " | ".join(r.error for r in reqs)
+    assert "executing" in messages
+    assert "staged but unexecuted" in messages
+    assert "before this request was dispatched" in messages
+    assert service.batcher.unfinished() == 0
+    assert service.telemetry.snapshot()["errors"] == 8
+
+
+def test_stop_flushes_executed_and_fails_wedged_completion():
+    """Batches the executor already finished are FLUSHED at stop (their
+    answers exist); the batch a wedged completion stage holds is failed
+    deterministically."""
+    engine = FakeEngine(max_batch_size=2)
+    engine.post_hold = threading.Event()  # postprocess blocks until set
+    service = _service(engine, "pipelined", max_batch_size=2,
+                       max_wait_ms=1.0)
+    service.start()
+    try:
+        blocked = [_req(payload={"block": True}) for _ in range(2)]
+        for r in blocked:
+            service.batcher.submit(r)
+        # The completion stage is now wedged inside b1's postprocess.
+        assert engine.post_entered.wait(5.0)
+        flushed = [_req() for _ in range(2)]
+        for r in flushed:
+            service.batcher.submit(r)
+        # b2 executes and parks in the completion queue (nobody drains).
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                service._completed_q.qsize() < 1:
+            time.sleep(0.01)
+        assert service._completed_q.qsize() >= 1
+        service.stop(drain_s=0.1, join_s=0.3)
+    finally:
+        engine.post_hold.set()  # unwedge for thread cleanup
+    # Executed-but-undelivered b2: flushed — real results.
+    for r in flushed:
+        assert r.result is not None and r.result["ok"], r.id
+    # The wedged b1: failed deterministically.
+    for r in blocked:
+        assert r.error is not None and "completion stage" in r.error, r.id
+    assert service.batcher.unfinished() == 0
+
+
+def test_serial_mode_unchanged_by_stop():
+    """The serial plane still drains as before (no pipeline queues to
+    sweep): accepted requests are served, late pending ones failed."""
+    engine = FakeEngine(execute_s=0.005, max_batch_size=2)
+    service = _service(engine, "serial", max_batch_size=2,
+                       max_wait_ms=1.0)
+    service.start()
+    r = _req()
+    service.batcher.submit(r)
+    assert r.wait(5.0) and r.result is not None
+    service.stop()
+    assert service.batcher.unfinished() == 0
+
+
+def test_admission_window_closes_on_unplaceable_leftover():
+    """When the re-plan cannot place admitted requests (plan capacity
+    below the flush budget — the packed-rows-full shape), the overflow
+    bounces back to pending with its admitted_late marker CLEARED and
+    the window CLOSES: exactly one re-plan happens, not an
+    admit/replan/re-stage spin that burns the assembler until the
+    executor goes hungry. Driven deterministically: the handoff is
+    pre-parked (executor 'busy', never hungry) and _form_and_hand_off
+    runs on the test thread until a timed stop."""
+    engine = FakeEngine(max_batch_size=2)
+    calls = {"plan": 0}
+    orig_plan = engine.plan_batch
+
+    def counting_plan(requests, packed=None):
+        calls["plan"] += 1
+        return orig_plan(requests, packed)
+
+    engine.plan_batch = counting_plan
+    batcher = Batcher(max_batch_size=2, max_wait_ms=1.0,
+                      max_requests_per_pack=2)  # flush budget 4 > rows 2
+    service = ServingService(engine, batcher, ServeTelemetry(),
+                             dispatch_mode="pipelined")
+    service._handoff.put(object())  # park: the window can never hand off
+    stopper = threading.Timer(0.25, service._stop.set)
+    stopper.start()
+    live = [_req() for _ in range(4)]
+    try:
+        service._form_and_hand_off(live)
+    finally:
+        stopper.cancel()
+        service._stop.set()
+    # Initial plan + exactly ONE replan for the admitted pair — the
+    # unfixed loop replans every ~2ms poll for the whole window.
+    assert calls["plan"] == 2, calls
+    # The unplaceable pair bounced to pending unmarked; the stop path
+    # requeued the forming pair — nobody is stranded, nobody is "late".
+    assert batcher.depth() == 4
+    assert all(not r.admitted_late for r in live)
+    assert all(r.error is None for r in live)
+
+
+# ---------------------------------------------------------------------------
+# the admission-window API under a fake clock
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_admit_into_forming_fake_clock():
+    clk = FakeClock()
+    b = Batcher(max_batch_size=4, max_wait_ms=10.0, clock=clk)
+    classify = [_req() for _ in range(3)]
+    other = _req(task="ner")
+    for r in (classify[0], other, classify[1], classify[2]):
+        b.submit(r)
+    clk.t += 0.5
+    admitted = b.admit_into_forming("classify", 2)
+    # Task-filtered, FIFO-ordered, capped at the limit.
+    assert admitted == classify[:2]
+    for r in admitted:
+        assert r.admitted_late and r.dequeued_at == clk.t
+    # They moved pending -> in-flight: unfinished never dipped.
+    assert b.depth() == 2 and b.inflight() == 2 and b.unfinished() == 4
+    # The remainder keeps arrival order (other task untouched).
+    assert b.admit_into_forming("classify", 5) == [classify[2]]
+    assert b.depth() == 1
+    # limit <= 0 admits nothing; a closed (draining) batcher refuses.
+    assert b.admit_into_forming("ner", 0) == []
+    b.close()
+    assert b.admit_into_forming("ner", 5) == []
+    # Flush-path requests are NOT marked late.
+    assert not other.admitted_late
+
+
+# ---------------------------------------------------------------------------
+# the under-reporting load gauge fix (bert_serve_unfinished)
+
+
+def test_mid_batch_replica_no_longer_scrapes_as_idle():
+    """queue_depth reads 0 the instant a batch pops; the new
+    bert_serve_unfinished gauge (pending + in-flight) keeps reporting
+    the requests the replica still owes — on /metricsz AND /healthz."""
+    clk = FakeClock()
+    b = Batcher(max_batch_size=4, max_wait_ms=1.0, clock=clk)
+    for _ in range(3):
+        b.submit(_req())
+    clk.t += 1.0
+    batch = b.poll()  # the whole queue pops: "mid-batch" state
+    assert len(batch) == 3
+    assert b.depth() == 0 and b.unfinished() == 3
+    service = ServingService(
+        FakeEngine(), b, ServeTelemetry(),
+        tracer=TraceCollector(sample_rate=0.0))
+    text = service.metrics_text()
+    assert "bert_serve_queue_depth 0" in text
+    assert "bert_serve_unfinished 3" in text
+    assert "bert_serve_forming_depth 0" in text
+    health = service.health()
+    assert health["queue_depth"] == 0 and health["unfinished"] == 3
+
+
+def test_router_prefers_unfinished_and_brownouts_on_it():
+    from bert_pytorch_tpu.serve.router import Router
+
+    calls = []
+
+    def transport(url, task, payload, timeout_s):
+        calls.append(url)
+        return 200, {"ok": True}
+
+    scrapes = {
+        # Mid-batch replica: empty queue but 9 unfinished requests.
+        "http://a": {"dispatch_alive": True, "draining": False,
+                     "queue_depth": 0, "unfinished": 9},
+        # Deeper queue but nearly drained pipeline: the honest choice.
+        "http://b": {"dispatch_alive": True, "draining": False,
+                     "queue_depth": 5, "unfinished": 1},
+    }
+    router = Router(
+        ["http://a", "http://b"], transport=transport,
+        scrape=lambda url: scrapes[url.rstrip("/")],
+        hedge_pctl=0.0, sleep=lambda s: None)
+    router.scrape_once()
+    status, _, _ = router.handle("classify", {"text": "x"})
+    assert status == 200
+    assert calls == ["http://b"]  # least UNFINISHED wins, not queue_depth
+
+    # Brownout admission keys on unfinished too: queue_depth scrapes 0
+    # everywhere, yet the fleet is saturated mid-pipeline.
+    for s in scrapes.values():
+        s["unfinished"] = 500
+        s["queue_depth"] = 0
+    router2 = Router(
+        ["http://a", "http://b"], transport=transport,
+        scrape=lambda url: scrapes[url.rstrip("/")],
+        hedge_pctl=0.0, brownout_queue_depth=100, sleep=lambda s: None)
+    router2.scrape_once()
+    status, body, headers = router2.handle("classify", {"text": "x"})
+    assert status == 503
+    assert "Retry-After" in headers
+    assert "brownout" in body["error"]
+
+    # Replicas without the gauge fall back to queue_depth (the pre-gauge
+    # scrape shape keeps working).
+    old = {"http://a": {"dispatch_alive": True, "draining": False,
+                        "queue_depth": 7},
+           "http://b": {"dispatch_alive": True, "draining": False,
+                        "queue_depth": 2}}
+    calls.clear()
+    router3 = Router(
+        ["http://a", "http://b"], transport=transport,
+        scrape=lambda url: old[url.rstrip("/")],
+        hedge_pctl=0.0, sleep=lambda s: None)
+    router3.scrape_once()
+    status, _, _ = router3.handle("classify", {"text": "x"})
+    assert status == 200 and calls == ["http://b"]
+
+
+# ---------------------------------------------------------------------------
+# the "serve device idle share" report gate (fixture pair)
+
+
+def test_device_idle_share_gate_names_regression(capsys):
+    base = os.path.join(FIXTURES, "serve_idle_base.jsonl")
+    regressed = os.path.join(FIXTURES, "serve_idle_regressed.jsonl")
+    assert validate_file(base) == []
+    assert validate_file(regressed) == []
+    summary = report.summarize_file(regressed)
+    assert summary["serve_device_idle_share"] == pytest.approx(0.55)
+    assert summary["serve_admitted_late"] == 8
+    rc = report.main([regressed, base])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "serve device idle share" in out
+    assert "REGRESSION" in out
+    # The same artifact against itself stays clean.
+    assert report.main([base, base]) == 0

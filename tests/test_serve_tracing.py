@@ -285,6 +285,11 @@ def test_trace_schema_fixtures_lint():
     assert "queue_wait_share must be in [0, 1]" in text
     assert "total percentiles not ordered" in text
     assert "over_slo (12) exceeds window_requests (8)" in text
+    # continuous-batching field lints (docs/serving.md)
+    assert "'admitted_late' must be a boolean" in text
+    assert "staged_wait_ms must be a non-negative number" in text
+    assert "device_idle_share must be in [0, 1]" in text
+    assert "admitted_late (99) exceeds window_requests (8)" in text
     # And the repo tool (jax-free, file-path bootstrap) agrees end to end.
     proc = subprocess.run(
         [sys.executable, "tools/check_telemetry_schema.py", good, bad],

@@ -10,8 +10,13 @@ The serving resilience layer's acceptance gate, the serve analog of
 :class:`Router` front tier — then drives a closed-loop client burst
 through the router while injecting, in sequence:
 
-1. **SIGKILL mid-flight** — one replica is killed with requests in its
-   queue. The router's transport failures fail over to a different
+1. **SIGKILL inside the admission window** — replica 0 is armed with
+   ``admit_hold@N`` (testing/faults.py): its pipelined assembler emits
+   an injection record and then HOLDS its forming batch open inside
+   the admission window; the harness waits for that record and kills
+   the replica while requests are provably captive in the forming
+   batch (the continuous-batching stage a flush-then-wait server never
+   had). The router's transport failures fail over to a different
    replica inside the retry budget; the supervisor reaps the exit and
    respawns with crash backoff; the restarted replica must report
    ``compiles_cold == 0`` (PR 8's warm-restart property is what makes
@@ -339,7 +344,11 @@ def main(argv=None) -> int:
     # port + output dir (telemetry JSONL and the heartbeat file the
     # supervisor watches live under it). The LAST replica is armed with
     # the wedge fault — it hangs only after serving --wedge_at requests,
-    # so phases A (SIGKILL) and B (wedge) stay sequenced.
+    # so phases A (SIGKILL) and B (wedge) stay sequenced. Replica 0 is
+    # armed with admit_hold@2x6: on its SECOND formed batch the
+    # assembler emits the injection record and holds the admission
+    # window open for 6s — the cue (and the window) for phase A's
+    # SIGKILL-with-requests-in-the-forming-batch.
     shared_args = [
         "--model_config_file", config_path, "--vocab_file", vocab_path,
         "--tasks", "classify", "--classify_labels", "neg,pos",
@@ -355,6 +364,8 @@ def main(argv=None) -> int:
         env = {}
         if i == args.replicas - 1:
             env[faults.FAULTS_ENV] = f"wedge@{args.wedge_at}"
+        elif i == 0:
+            env[faults.FAULTS_ENV] = "admit_hold@2x6"
         port = free_port()
         specs.append(supervisor_mod.ReplicaSpec(
             index=i, port=port,
@@ -409,23 +420,54 @@ def main(argv=None) -> int:
                    args.warmup_timeout_s,
                    f"all {args.replicas} replicas healthy")
 
-        # -- phase A: SIGKILL one replica under load --------------------
+        # -- phase A: SIGKILL inside the admission window ----------------
+        # Replica 0's armed admit_hold@2x6 emits its injection record
+        # and then HOLDS the forming batch open; the kill callback waits
+        # for the record and kills during the hold, so the process dies
+        # with requests captive in the admission window — the stranded
+        # shape that only exists under pipelined (continuous-batching)
+        # dispatch. Those requests' clients must still see answers
+        # (failover), like every other phase.
         outcomes_a: list = []
-        kill_at = {"t": None}
+        kill_at = {"t": None, "admit_hold_observed": False}
+        replica0_jsonl = os.path.join(
+            workdir, "replica_0", "serve_telemetry.jsonl")
+
+        def admit_hold_recorded() -> bool:
+            try:
+                with open(replica0_jsonl) as f:
+                    return any('"injected_admit_hold"' in line for line in f)
+            except OSError:
+                return False
 
         def kill_replica_0() -> None:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if admit_hold_recorded():
+                    kill_at["admit_hold_observed"] = True
+                    break
+                time.sleep(0.2)
             pid = state_of(0)["pid"]
             kill_at["t"] = time.monotonic()
             if pid:
                 os.kill(pid, signal.SIGKILL)
+            # The respawned replica must not re-arm the hold: spec.env
+            # re-arms deliberately (the wedge depends on it), but a
+            # second 6s hold would just add tail latency to phases B/C.
+            specs[0].env.pop(faults.FAULTS_ENV, None)
 
         run_burst(router_url, args.phase_a_requests, args.burst_workers,
                   args.client_timeout_s, outcomes_a,
-                  mid=(args.phase_a_requests // 4, kill_replica_0))
+                  mid=(2, kill_replica_0))
         t_kill = kill_at["t"]
         check(t_kill is not None, "phase-A kill never fired")
         phase_a = classify_outcomes(outcomes_a)
+        phase_a["admit_hold_observed"] = kill_at["admit_hold_observed"]
         verdict["phase_a"] = phase_a
+        check(phase_a["admit_hold_observed"],
+              "phase A: the admit_hold injection record never appeared — "
+              "the SIGKILL cannot be placed inside the admission window "
+              "(is replica 0 running --dispatch_mode pipelined?)")
         check(phase_a["failures"] == 0,
               f"phase A (SIGKILL): client-visible failures: {phase_a}")
         wait_until(lambda: healthy(0), args.recover_timeout_s,
