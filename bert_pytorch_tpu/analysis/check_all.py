@@ -44,14 +44,46 @@ def _repo_root() -> str:
         os.path.abspath(__file__))))
 
 
-def _load_schema_module():
-    root = _repo_root()
-    path = os.path.join(root, "bert_pytorch_tpu", "telemetry", "schema.py")
-    spec = importlib.util.spec_from_file_location("_bert_lint_schema", path)
+def _load_by_path(name: str, *parts: str):
+    path = os.path.join(_repo_root(), *parts)
+    spec = importlib.util.spec_from_file_location(name, path)
     module = importlib.util.module_from_spec(spec)
-    sys.modules["_bert_lint_schema"] = module
+    sys.modules[name] = module
     spec.loader.exec_module(module)
     return module
+
+
+def _load_schema_module():
+    return _load_by_path("_bert_lint_schema",
+                         "bert_pytorch_tpu", "telemetry", "schema.py")
+
+
+def _load_autotune_module():
+    # ops/pallas/autotune.py keeps its module-level imports jax-free for
+    # exactly this loader: the winners-file FORMAT rules live once, next
+    # to the code that writes the files, and the lint gate reaches them
+    # without pulling jax through the ops package __init__.
+    return _load_by_path("_bert_lint_autotune",
+                         "bert_pytorch_tpu", "ops", "pallas", "autotune.py")
+
+
+def _winners_results(paths: List[str]) -> List[dict]:
+    """[{path, ok, errors}] per autotune winners JSON — same shape as
+    the schema results so both render through one presenter."""
+    autotune = _load_autotune_module()
+    root = _repo_root()
+    results = []
+    for path in paths:
+        rel = os.path.relpath(path, root) if os.path.exists(path) else path
+        if not os.path.exists(path):
+            results.append({"path": rel, "ok": False,
+                            "errors": [{"line": 0, "error": "no such file"}]})
+            continue
+        errors = autotune.validate_winners_file(path)
+        results.append({
+            "path": rel, "ok": not errors,
+            "errors": [{"line": 0, "error": err} for err in errors]})
+    return results
 
 
 def _schema_results(paths: List[str]) -> List[dict]:
@@ -106,7 +138,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "telemetry record schema over JSONL artifacts.")
     parser.add_argument(
         "jsonls", nargs="*",
-        help="JSONL artifacts to schema-lint (default: <repo>/*.jsonl)")
+        help="artifacts to lint: *.jsonl files go through the telemetry "
+             "record schema, *.json files through the Pallas autotune "
+             "winners-cache format (ops/pallas/autotune.py). Default: "
+             "<repo>/*.jsonl plus <repo>/*autotune*.json")
     parser.add_argument("--skip-jaxlint", action="store_true",
                         help="only schema-lint the JSONL artifacts")
     parser.add_argument("--skip-schema", action="store_true",
@@ -120,8 +155,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = parser.parse_args(argv)
 
     targets = [os.path.join(_repo_root(), t) for t in JAXLINT_TARGETS]
-    jsonls = list(args.jsonls) or sorted(
-        glob.glob(os.path.join(_repo_root(), "*.jsonl")))
+    if args.jsonls:
+        jsonls = [p for p in args.jsonls if not p.endswith(".json")]
+        winners = [p for p in args.jsonls if p.endswith(".json")]
+    else:
+        jsonls = sorted(glob.glob(os.path.join(_repo_root(), "*.jsonl")))
+        winners = sorted(
+            glob.glob(os.path.join(_repo_root(), "*autotune*.json")))
 
     if args.format == "json":
         rc = 0
@@ -142,6 +182,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             combined["schema"] = results
             if any(not r["ok"] for r in results):
                 rc = 1
+            if winners:
+                wresults = _winners_results(winners)
+                combined["autotune_winners"] = wresults
+                if any(not r["ok"] for r in wresults):
+                    rc = 1
         combined["rc"] = rc
         print(json.dumps(combined, indent=2, sort_keys=False))
         return rc
@@ -157,6 +202,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("bert-lint: no *.jsonl artifacts to lint")
         elif _lint_jsonls(jsonls):
             rc = 1
+        if winners:
+            print("== autotune winners ==")
+            for result in _winners_results(winners):
+                if result["ok"]:
+                    print(f"{result['path']}: ok")
+                    continue
+                rc = 1
+                for err in result["errors"]:
+                    print(f"{result['path']}: {err['error']}")
     print("bert-lint: " + ("OK" if rc == 0 else "FAILED"))
     return rc
 

@@ -374,6 +374,17 @@ REGISTRY: Tuple[Entry, ...] = (
               "start()/stop()/status() read and mutate the same table "
               "from control-plane threads"),
 
+    # -- ops/pallas/autotune.py: the geometry-winners registry -------------
+    # The process-global winners table is written by the serve engine's
+    # startup (load/measure) and read at TRACE time by every kernel
+    # call site; a pipelined dispatch plane traces from its executor
+    # thread while a test/control thread may load or clear winners, so
+    # every touch goes through the module lock.
+    Entry("bert_pytorch_tpu/ops/pallas/autotune.py", "_winners",
+          kind="lock", locks=("_lock",),
+          why="engine startup loads/measures winners while kernel trace "
+              "sites look geometry up from whichever thread traces"),
+
     # -- utils/logging.py: the JSONL sink background emitters write --------
     Entry("bert_pytorch_tpu/utils/logging.py", "_f",
           cls="JSONLHandler", kind="lock", locks=("_lock",),

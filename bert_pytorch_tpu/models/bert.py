@@ -727,11 +727,30 @@ class BertForMaskedLM(nn.Module):
         attention_mask: Optional[Array] = None,
         deterministic: bool = True,
         sequence_ids: Optional[Array] = None,
+        output_positions: Optional[Array] = None,
     ):
+        """``output_positions`` [B, P] selects the FUSED-EPILOGUE path
+        (docs/serving.md "Raw-speed kernels"): the hidden states are
+        gathered at those positions BEFORE the vocab projection, so the
+        head emits [B, P, V] instead of [B, S, V] — serve fill_mask only
+        ever reads its [MASK] slots, and projecting the other S-P
+        positions into the 30k vocab is pure HBM traffic (the serving
+        twin of BertForPreTraining's ``masked_positions``). The gather
+        is a one-hot matmul: rows multiply by exactly 1.0 and sum with
+        exact zeros, so gather-then-project is bit-equal to
+        project-then-gather for every param dtype (the matmul is linear
+        and row-independent; tests/test_kernels_fastpath.py asserts
+        fp32 bit-equality)."""
         sequence_output, _ = self.bert(
             input_ids, token_type_ids, attention_mask, deterministic,
             sequence_ids,
         )
+        if output_positions is not None:
+            onehot = jax.nn.one_hot(
+                output_positions, sequence_output.shape[1],
+                dtype=self.dtype)
+            sequence_output = jnp.einsum(
+                "bps,bsh->bph", onehot, sequence_output)
         word_embedding = self.bert.embeddings.word_embeddings.embedding
         return self.predictions(sequence_output, word_embedding)
 

@@ -76,22 +76,28 @@ def dot_product_attention(
         backend = (
             "pallas" if q.shape[1] >= 256 and not interpret_mode() else "xla"
         )
-    if backend == "pallas_infer":
-        # INFERENCE-ONLY fused forward (ops/pallas/attention.py
-        # flash_attention_infer): no dropout plumbing, no lse/residuals
-        # for a backward that never runs — selected by serve/engine.py's
-        # forwards. Deliberately NOT reachable from training (no vjp is
-        # defined); dropout args are rejected rather than ignored so a
-        # misrouted training call fails loudly.
-        from bert_pytorch_tpu.ops.pallas.attention import flash_attention_infer
+    if backend in ("pallas_infer", "pallas_infer_int8"):
+        # INFERENCE-ONLY fused forwards (ops/pallas/attention.py
+        # flash_attention_infer / flash_attention_infer_int8): no dropout
+        # plumbing, no lse/residuals for a backward that never runs —
+        # selected by serve/engine.py's forwards. Deliberately NOT
+        # reachable from training (no vjp is defined); dropout args are
+        # rejected rather than ignored so a misrouted training call
+        # fails loudly. The int8 variant quantizes QK^T with per-head
+        # symmetric scales (softmax and PV stay higher precision —
+        # docs/serving.md "Raw-speed kernels" for the parity bounds).
+        from bert_pytorch_tpu.ops.pallas.attention import (
+            flash_attention_infer, flash_attention_infer_int8)
 
         if not deterministic and dropout_rate > 0.0:
             raise ValueError(
-                "backend='pallas_infer' is forward-only; training "
+                f"backend={backend!r} is forward-only; training "
                 "dropout needs backend='pallas' or 'xla'")
         kbias = None if sequence_ids is not None else bias
-        return flash_attention_infer(q, k, v, bias=kbias,
-                                     sequence_ids=sequence_ids)
+        kernel = (flash_attention_infer_int8
+                  if backend == "pallas_infer_int8"
+                  else flash_attention_infer)
+        return kernel(q, k, v, bias=kbias, sequence_ids=sequence_ids)
     if backend == "pallas":
         # Fused kernel incl. in-kernel dropout from the TPU hardware PRNG
         # (the [B,H,S,S] mask never reaches HBM; see ops/pallas/attention.py).

@@ -61,6 +61,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bert_pytorch_tpu.ops.pallas import autotune
 from bert_pytorch_tpu.ops.pallas.common import interpret_mode, pick_block
 
 _NEG_INF = -1e30
@@ -135,6 +136,31 @@ def _pick_bh_block(seq, bh):
     while g * 2 <= target and bh % (g * 2) == 0:
         g *= 2
     return g
+
+
+def _infer_geometry(kernel, seq, bh, geometry):
+    """Resolve the (block_q, block_k, bh_block) triple for one inference
+    kernel call: an explicit ``geometry`` (the autotune measurement loop
+    forcing a candidate) wins, then a persisted autotune winner
+    (ops/pallas/autotune.py — read at TRACE time, so winners must load
+    before the first forward traces), then the hand-written heuristic.
+    Divisibility is validated here because a winner loaded from a file
+    is data, not code: a ragged grid must fail at trace with a real
+    message, not inside Mosaic."""
+    if geometry is not None:
+        block_q, block_k, g = geometry
+    else:
+        cached = autotune.lookup(kernel, seq, bh)
+        if cached is not None:
+            block_q, block_k, g = cached
+        else:
+            block_q, block_k = _pick_blocks(seq)
+            g = _pick_bh_block(seq, bh)
+    if seq % block_q or seq % block_k or bh % g:
+        raise ValueError(
+            f"attention geometry (block_q={block_q}, block_k={block_k}, "
+            f"bh_block={g}) does not tile seq={seq}, bh={bh}")
+    return int(block_q), int(block_k), int(g)
 
 
 def _seg_mask(q_seg, k_seg):
@@ -452,6 +478,37 @@ def _flash_bwd(scale, rate, segmented, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _infer_stream(score_fn, v_ref, g, block_k, num_kb, q_shape, out_dtype):
+    """The shared online-softmax + PV stream of the inference kernels:
+    ``score_fn(j)`` returns the j-th fully-masked fp32
+    [block_q, block_k] score tile, and everything downstream — the
+    running max/exp/sum bookkeeping, the PV contraction in the value
+    dtype with fp32 accumulation, the final normalization — is ONE body
+    shared by the fp and int8 score paths, so a fix to the stream can
+    never silently diverge between them."""
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        s = score_fn(j)
+        v = v_ref[g, pl.ds(j * block_k, block_k), :]
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc
+
+    m0 = jnp.full((q_shape[0],), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((q_shape[0],), jnp.float32)
+    acc0 = jnp.zeros(q_shape, jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
+    return (acc / l[:, None]).astype(out_dtype)
+
+
 def _infer_fwd_kernel(
     q_ref, k_ref, v_ref, bias_ref, seg_ref, out_ref,
     *, block_k, scale, bh_block, segmented
@@ -463,10 +520,11 @@ def _infer_fwd_kernel(
     per-tile mask regeneration), the ``lse`` output written for the
     backward kernels, and the unmasked-``l`` bookkeeping that keeps that
     lse exact. This variant drops all of it — no seed input, no second
-    output, one accumulator pair — while keeping the packed
-    block-diagonal tile mask (``segmented``; serve-side request packing
-    reuses it). Same tile geometry as training (_pick_blocks /
-    _pick_bh_block), so the VMEM/grid reasoning there carries over.
+    output, one accumulator pair (:func:`_infer_stream`) — while
+    keeping the packed block-diagonal tile mask (``segmented``;
+    serve-side request packing reuses it). Same tile geometry as
+    training (_pick_blocks / _pick_bh_block) unless an autotune winner
+    overrides it, so the VMEM/grid reasoning there carries over.
     """
     qb = pl.program_id(1)
     seq_k = k_ref.shape[1]
@@ -478,11 +536,10 @@ def _infer_fwd_kernel(
             block_q = q.shape[0]
             q_seg = seg_ref[g, 0, pl.ds(qb * block_q, block_q)]
 
-        def body(j, carry):
-            m_prev, l_prev, acc = carry
+        def score(j, g=g, q=q):
             k = k_ref[g, pl.ds(j * block_k, block_k), :]
-            v = v_ref[g, pl.ds(j * block_k, block_k), :]
-            b = bias_ref[g, 0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+            b = bias_ref[g, 0, pl.ds(j * block_k, block_k)].astype(
+                jnp.float32)
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -490,46 +547,19 @@ def _infer_fwd_kernel(
             if segmented:
                 k_seg = seg_ref[g, 0, pl.ds(j * block_k, block_k)]
                 s = s + _seg_mask(q_seg, k_seg)
-            m_cur = jnp.max(s, axis=-1)
-            m_new = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(s - m_new[:, None])
-            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-            acc = acc * alpha[:, None] + jax.lax.dot_general(
-                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return m_new, l_new, acc
+            return s
 
-        m0 = jnp.full((q.shape[0],), _NEG_INF, jnp.float32)
-        l0 = jnp.zeros((q.shape[0],), jnp.float32)
-        acc0 = jnp.zeros(q.shape, jnp.float32)
-        _, l, acc = jax.lax.fori_loop(0, num_kb, body, (m0, l0, acc0))
-        out_ref[g] = (acc / l[:, None]).astype(out_ref.dtype)
+        out_ref[g] = _infer_stream(score, v_ref, g, block_k, num_kb,
+                                   q.shape, out_ref.dtype)
 
 
-def flash_attention_infer(q, k, v, bias=None, sequence_ids=None):
-    """Forward-only fused attention over [B, S, H, D] tensors — the
-    serving path's kernel (``backend='pallas_infer'``,
-    ops/attention.py). Contract matches :func:`flash_attention` at
-    ``dropout_rate=0`` minus everything the backward needs: no residuals
-    are saved, no lse is written, and no vjp is defined (differentiating
-    through it is an error by design — training keeps its own kernel).
-    ``sequence_ids`` retains the packed block-diagonal tile mask so
-    packed serve batches (serve/engine.py) stay contamination-free
-    without a [B, 1, S, S] mask in HBM. Runs in interpret mode on CPU
-    (no PRNG primitives involved), which is how tier-1 tests parity.
-    """
-    batch, seq, heads, depth = q.shape
-    scale = 1.0 / float(depth) ** 0.5
-
-    def to3(t):
-        return t.transpose(0, 2, 1, 3).reshape(batch * heads, seq, depth)
-
+def _infer_bias_seg(bias, sequence_ids, batch, seq, heads, name):
+    """(bias3, seg3, segmented) — the shared [BH, 1, S] key-bias and
+    sequence-id rows of the inference wrappers."""
     segmented = sequence_ids is not None
     if segmented and bias is not None:
         raise ValueError(
-            "flash_attention_infer: pass either bias (padded batches) or "
+            f"{name}: pass either bias (padded batches) or "
             "sequence_ids (packed batches), not both")
     if segmented:
         seg3 = jnp.repeat(
@@ -542,11 +572,37 @@ def flash_attention_infer(q, k, v, bias=None, sequence_ids=None):
         key_bias = bias.reshape(batch, -1)[:, -seq:]  # [B, S]
         bias3 = jnp.repeat(
             key_bias.astype(jnp.float32), heads, axis=0)[:, None, :]
+    return bias3, seg3, segmented
 
+
+def flash_attention_infer(q, k, v, bias=None, sequence_ids=None,
+                          geometry=None):
+    """Forward-only fused attention over [B, S, H, D] tensors — the
+    serving path's kernel (``backend='pallas_infer'``,
+    ops/attention.py). Contract matches :func:`flash_attention` at
+    ``dropout_rate=0`` minus everything the backward needs: no residuals
+    are saved, no lse is written, and no vjp is defined (differentiating
+    through it is an error by design — training keeps its own kernel).
+    ``sequence_ids`` retains the packed block-diagonal tile mask so
+    packed serve batches (serve/engine.py) stay contamination-free
+    without a [B, 1, S, S] mask in HBM. Runs in interpret mode on CPU
+    (no PRNG primitives involved), which is how tier-1 tests parity.
+
+    ``geometry`` forces one (block_q, block_k, bh_block) triple — the
+    autotune measurement loop's hook; normal callers leave it None and
+    get the persisted winner or the heuristic (:func:`_infer_geometry`).
+    """
+    batch, seq, heads, depth = q.shape
+    scale = 1.0 / float(depth) ** 0.5
+
+    def to3(t):
+        return t.transpose(0, 2, 1, 3).reshape(batch * heads, seq, depth)
+
+    bias3, seg3, segmented = _infer_bias_seg(
+        bias, sequence_ids, batch, seq, heads, "flash_attention_infer")
     q3, k3, v3 = to3(q), to3(k), to3(v)
     bh = batch * heads
-    block_q, block_k = _pick_blocks(seq)
-    g = _pick_bh_block(seq, bh)
+    block_q, block_k, g = _infer_geometry("infer", seq, bh, geometry)
     out3 = pl.pallas_call(
         partial(_infer_fwd_kernel, block_k=block_k, scale=scale,
                 bh_block=g, segmented=segmented),
@@ -562,6 +618,111 @@ def flash_attention_infer(q, k, v, bias=None, sequence_ids=None):
         out_shape=jax.ShapeDtypeStruct((bh, seq, depth), q3.dtype),
         interpret=interpret_mode(),
     )(q3, k3, v3, bias3, seg3)
+    return out3.reshape(batch, heads, seq, depth).transpose(0, 2, 1, 3)
+
+
+def _infer_fwd_kernel_int8(
+    q_ref, k_ref, v_ref, qs_ref, ks_ref, bias_ref, seg_ref, out_ref,
+    *, block_k, scale, bh_block, segmented
+):
+    """Int8-score inference forward (ZeroQuant into the attention path,
+    docs/serving.md "Raw-speed kernels").
+
+    q_ref/k_ref are PRE-QUANTIZED int8 tiles ([G, block_q, D] /
+    [G, S, D]) with one symmetric fp32 scale per (batch*head) row
+    (qs_ref/ks_ref, [G, 1, 1] — the per-token dynamic-scale machinery
+    of ops/quant.py ``int8_matmul`` generalized to a per-head grain:
+    one head's q/k rows share dynamics, so one scale per head keeps the
+    rescale a scalar per program instead of a [block_q, block_k] outer
+    product). QK^T runs int8 x int8 -> int32 on the MXU; the rescale by
+    ``q_scale * k_scale * softmax_scale`` happens once per tile in
+    fp32, and everything downstream — the online softmax, the PV
+    contraction (v untouched: P·V stays in the input dtype with fp32
+    accumulation), the normalization — IS :func:`_infer_stream`, the
+    same body the fp kernel runs; only the score tile differs.
+    """
+    qb = pl.program_id(1)
+    seq_k = k_ref.shape[1]
+    num_kb = seq_k // block_k
+
+    for g in range(bh_block):
+        q8 = q_ref[g]
+        rescale = (qs_ref[g, 0, 0] * ks_ref[g, 0, 0]).astype(jnp.float32) \
+            * scale
+        if segmented:
+            block_q = q8.shape[0]
+            q_seg = seg_ref[g, 0, pl.ds(qb * block_q, block_q)]
+
+        def score(j, g=g, q8=q8, rescale=rescale):
+            k8 = k_ref[g, pl.ds(j * block_k, block_k), :]
+            b = bias_ref[g, 0, pl.ds(j * block_k, block_k)].astype(
+                jnp.float32)
+            s32 = jax.lax.dot_general(
+                q8, k8, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )  # [block_q, block_k] int32
+            s = s32.astype(jnp.float32) * rescale + b[None, :]
+            if segmented:
+                k_seg = seg_ref[g, 0, pl.ds(j * block_k, block_k)]
+                s = s + _seg_mask(q_seg, k_seg)
+            return s
+
+        out_ref[g] = _infer_stream(score, v_ref, g, block_k, num_kb,
+                                   q8.shape, out_ref.dtype)
+
+
+def flash_attention_infer_int8(q, k, v, bias=None, sequence_ids=None,
+                               geometry=None):
+    """Forward-only fused attention with INT8 QK^T over [B, S, H, D]
+    tensors (``backend='pallas_infer_int8'``, ops/attention.py).
+
+    Same contract as :func:`flash_attention_infer` (no vjp, packed
+    ``sequence_ids`` masking, interpret-mode on CPU) with the score
+    matmul quantized: q and k are dynamically quantized to int8 with one
+    symmetric scale PER HEAD (per [batch*head] row — ops/quant.py
+    ``quantize_symmetric``), the tile dot runs int8 x int8 -> int32,
+    and a single fp32 rescale recovers the scores. Softmax and the PV
+    contraction stay at the higher precision of the base kernel, so the
+    only new error source is score rounding: |Δscore| <=
+    (|q|·scale_k + |k|·scale_q + scale_q·scale_k·D/4) / sqrt(D) per
+    element — model-level bounds are documented (docs/serving.md) and
+    asserted by tests/test_kernels_fastpath.py on all four serve heads.
+    """
+    from bert_pytorch_tpu.ops import quant as quant_ops
+
+    batch, seq, heads, depth = q.shape
+    scale = 1.0 / float(depth) ** 0.5
+
+    def to3(t):
+        return t.transpose(0, 2, 1, 3).reshape(batch * heads, seq, depth)
+
+    bias3, seg3, segmented = _infer_bias_seg(
+        bias, sequence_ids, batch, seq, heads, "flash_attention_infer_int8")
+    q3, k3, v3 = to3(q), to3(k), to3(v)
+    bh = batch * heads
+    # Per-head symmetric dynamic quantization, computed by XLA outside
+    # the kernel (two cheap reductions fused into the surrounding
+    # program); the kernel consumes the int8 tensors + [BH, 1, 1] scales.
+    q8, q_scale = quant_ops.quantize_symmetric(q3, axes=(1, 2))
+    k8, k_scale = quant_ops.quantize_symmetric(k3, axes=(1, 2))
+    block_q, block_k, g = _infer_geometry("infer_int8", seq, bh, geometry)
+    out3 = pl.pallas_call(
+        partial(_infer_fwd_kernel_int8, block_k=block_k, scale=scale,
+                bh_block=g, segmented=segmented),
+        grid=(bh // g, seq // block_q),
+        in_specs=[
+            pl.BlockSpec((g, block_q, depth), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, seq, depth), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, 1), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, seq), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((g, 1, seq), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((g, block_q, depth), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq, depth), q3.dtype),
+        interpret=interpret_mode(),
+    )(q8, k8, v3, q_scale, k_scale, bias3, seg3)
     return out3.reshape(batch, heads, seq, depth).transpose(0, 2, 1, 3)
 
 

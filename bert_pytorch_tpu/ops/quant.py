@@ -119,6 +119,26 @@ def int8_matmul(x, q_kernel, kernel_scale):
     return acc.astype(jnp.float32) * a_scale * kernel_scale.astype(jnp.float32)
 
 
+def quantize_symmetric(x, axes: Union[int, Sequence[int]]):
+    """``(q_int8, scale)`` symmetric dynamic quantization of a traced
+    array, one scale per slice of the axes NOT in ``axes`` (kept as
+    size-1 dims so the scale broadcasts back over ``q``).
+
+    The jnp twin of :func:`int8_matmul`'s inline per-token activation
+    quantization, factored out at a caller-chosen grain: the int8
+    attention kernel (ops/pallas/attention.py
+    ``flash_attention_infer_int8``) reduces over ``axes=(1, 2)`` of a
+    [BH, S, D] tensor for one symmetric scale PER HEAD — the ZeroQuant
+    activation-scale machinery generalized beyond the dense layers.
+    """
+    axes = (axes,) if isinstance(axes, int) else tuple(axes)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / _QMAX
+    q = jnp.clip(jnp.round(xf / scale), -_QMAX, _QMAX).astype(jnp.int8)
+    return q, scale
+
+
 def _normalize_axis(axis: Union[int, Sequence[int]], ndim: int
                     ) -> Tuple[int, ...]:
     axes = (axis,) if isinstance(axis, int) else tuple(axis)

@@ -13,8 +13,10 @@ from __future__ import annotations
 import argparse
 
 QUANTIZE_CHOICES = ("none", "bf16", "int8")
-ATTENTION_BACKENDS = ("xla", "pallas", "pallas_infer", "auto")
+ATTENTION_BACKENDS = ("xla", "pallas", "pallas_infer", "pallas_infer_int8",
+                      "auto")
 DISPATCH_MODES = ("pipelined", "serial")
+AUTOTUNE_MODES = ("off", "load", "measure")
 
 
 def add_dispatch_args(parser: argparse.ArgumentParser) -> None:
@@ -47,7 +49,34 @@ def add_fast_path_args(parser: argparse.ArgumentParser) -> None:
         choices=ATTENTION_BACKENDS,
         help="encoder attention kernel for the serve forwards; "
              "pallas_infer is the forward-only fused kernel (TPU; "
-             "interpret-mode on CPU)")
+             "interpret-mode on CPU) and pallas_infer_int8 its "
+             "int8-QK^T variant (per-head symmetric scales; "
+             "docs/serving.md 'Raw-speed kernels' for parity bounds)")
+    parser.add_argument(
+        "--fuse_epilogues", action="store_true",
+        help="fold each head's output extraction into the forward's "
+             "epilogue (fill_mask gathers its [MASK] slots before the "
+             "vocab projection, squad stacks start/end into one "
+             "output) — same results, fewer device->host bytes "
+             "(docs/serving.md 'Raw-speed kernels')")
+    parser.add_argument(
+        "--epilogue_slots", type=int, default=8,
+        help="per-row gather quota for fused epilogues; a batch whose "
+             "rows carry more positions of interest falls back to the "
+             "unfused forward")
+    parser.add_argument(
+        "--autotune", type=str, default="off", choices=AUTOTUNE_MODES,
+        help="measured Pallas block-geometry pass for the "
+             "pallas_infer* backends (ops/pallas/autotune.py): 'load' "
+             "reads persisted winners from --autotune_cache, 'measure' "
+             "additionally times candidates for unseen shapes at "
+             "startup and persists the winners")
+    parser.add_argument(
+        "--autotune_cache", type=str, default="",
+        help="autotune winners JSON, kept next to the persisted AOT "
+             "compile cache with the same keying discipline (a warm "
+             "restart that loads the same winners compiles the same "
+             "programs under the same names — compiles_cold stays 0)")
 # The engine itself normalizes the "none" spelling to None
 # (InferenceEngine.__init__) — entry points pass args.quantize verbatim.
 

@@ -64,15 +64,15 @@ from bert_pytorch_tpu.utils import checkpoint as ckpt_util
 class TaskSpec:
     """One served head: its flax model, restored (possibly quantized)
     params, handler, and the jitted (instrumented) forwards — ONE per
-    (bucket, packedness), each with a stable per-spec function name (see
-    :meth:`InferenceEngine._build_forwards`)."""
+    (bucket, packedness, fused-epilogue), each with a stable per-spec
+    function name (see :meth:`InferenceEngine._build_forwards`)."""
 
     def __init__(self, name: str, model, params, handler):
         self.name = name
         self.model = model
         self.params = params
         self.handler = handler
-        self.forwards: Dict[Tuple[int, bool], Callable] = {}
+        self.forwards: Dict[Tuple[int, bool, bool], Callable] = {}
 
 
 class BatchPlan:
@@ -100,15 +100,25 @@ class StagedBatch:
     the host seconds spent filling the arrays — the engine's share of
     the trace's ``assembly`` span. ``staged_at`` is stamped by the
     dispatch plane (the assembler) when staging completes, so the
-    executor's pickup delay (``staged_wait``) is attributable."""
+    executor's pickup delay (``staged_wait``) is attributable.
+
+    ``fused`` selects the fused-epilogue forward variant (docs/
+    serving.md "Raw-speed kernels"); for a ``"gather"`` epilogue,
+    ``gather_slots`` maps request id -> (row, first slot, slot count)
+    into the [B, epilogue_slots, V] gathered output."""
 
     def __init__(self, task: str, plan: BatchPlan, args: tuple,
-                 offsets: Dict[int, Tuple[int, int, int]], pack_s: float):
+                 offsets: Dict[int, Tuple[int, int, int]], pack_s: float,
+                 fused: bool = False,
+                 gather_slots: Optional[Dict[int, Tuple[int, int, int]]]
+                 = None):
         self.task = task
         self.plan = plan
         self.args = args
         self.offsets = offsets
         self.pack_s = pack_s
+        self.fused = fused
+        self.gather_slots = gather_slots or {}
         self.staged_at: Optional[float] = None
 
 
@@ -127,6 +137,10 @@ class InferenceEngine:
         clock: Callable[[], float] = time.perf_counter,
         quantize: Optional[str] = None,
         attention_backend: str = "xla",
+        fuse_epilogues: bool = False,
+        epilogue_slots: int = 8,
+        autotune: str = "off",
+        autotune_cache: Optional[str] = None,
     ):
         """``quantize`` selects the inference weight format
         (ops/quant.py): None serves the checkpoint's fp32 params,
@@ -134,7 +148,28 @@ class InferenceEngine:
         weights and runs int8 GEMMs (per-token dynamic activation
         scales). ``attention_backend`` routes the encoder's attention
         (ops/attention.py); ``"pallas_infer"`` is the forward-only fused
-        kernel for serving on TPU (interpret-mode on CPU)."""
+        kernel for serving on TPU (interpret-mode on CPU) and
+        ``"pallas_infer_int8"`` its int8-QK^T variant (per-head
+        symmetric scales).
+
+        ``fuse_epilogues`` folds each head's output extraction into the
+        forward's epilogue (docs/serving.md "Raw-speed kernels"):
+        fill_mask gathers its [MASK] slots before the vocab projection
+        ([B, epilogue_slots, V] out instead of [B, S, V]); squad stacks
+        start/end into one output. ``epilogue_slots`` is the per-row
+        gather quota; a batch whose rows need more falls back to that
+        spec's unfused forward (both are AOT-warmed).
+
+        ``autotune`` drives the measured Pallas block-geometry pass
+        (ops/pallas/autotune.py) for the ``pallas_infer*`` backends:
+        ``"load"`` reads persisted winners from ``autotune_cache``,
+        ``"measure"`` additionally times candidates for any
+        (bucket, batch*heads) shape without one and persists the
+        result. Runs in ``__init__`` — BEFORE the forwards are built —
+        because geometry is read at trace time and the winner digest is
+        folded into the stable forward names (a warm restart that loads
+        the same winners file compiles the same programs under the same
+        names, keeping ``compiles_cold == 0``)."""
         import jax.numpy as jnp
 
         from bert_pytorch_tpu.ops import quant as quant_ops
@@ -142,6 +177,35 @@ class InferenceEngine:
         self.quantize = quant_ops.check_mode(
             None if quantize in (None, "none") else quantize)
         self.attention_backend = attention_backend
+        self.fuse_epilogues = bool(fuse_epilogues)
+        self.epilogue_slots = int(epilogue_slots)
+        if self.fuse_epilogues and self.epilogue_slots < 1:
+            raise ValueError(
+                f"epilogue_slots must be >= 1, got {epilogue_slots}")
+        if autotune not in ("off", "load", "measure"):
+            raise ValueError(
+                f"autotune must be off|load|measure, got {autotune!r}")
+        if autotune != "off" and not autotune_cache:
+            # Silently degrading to the heuristic would defeat the one
+            # guarantee the flag exists for (winners persisted -> warm
+            # restart compiles nothing new); a forgotten --autotune_cache
+            # must fail at construction, not at the next restart.
+            raise ValueError(
+                f"autotune={autotune!r} requires autotune_cache (the "
+                "winners JSON path next to the AOT compile cache)")
+        if autotune != "off" and attention_backend not in (
+                "pallas_infer", "pallas_infer_int8"):
+            # Same fail-loud policy for the backend pairing: only the
+            # Pallas inference kernels have geometry to tune — silently
+            # no-opping under xla would let an operator believe measured
+            # autotune is active when nothing was tuned.
+            raise ValueError(
+                f"autotune={autotune!r} tunes the Pallas inference "
+                f"kernels; attention_backend={attention_backend!r} has "
+                "no geometry to tune (use pallas_infer or "
+                "pallas_infer_int8)")
+        self.autotune = autotune
+        self.autotune_cache = autotune_cache
         self.startup: Optional[dict] = None
         self.config = config
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
@@ -157,6 +221,7 @@ class InferenceEngine:
         self.dtype = dtype if dtype is not None else jnp.float32
         self._clock = clock
         self.monitor = monitor or CompileMonitor(emit=lambda rec: None)
+        self._setup_autotune()
         handlers = tasks_lib.build_handlers(tokenizer, tasks)
         self.tasks: Dict[str, TaskSpec] = {}
         for name, options in tasks.items():
@@ -169,6 +234,53 @@ class InferenceEngine:
         self.warmed = False
 
     # -- construction ----------------------------------------------------
+
+    def _autotune_kernel(self) -> Optional[str]:
+        """The autotune registry kernel this engine's forwards trace, or
+        None when the backend has no Pallas geometry to tune."""
+        return {"pallas_infer": "infer",
+                "pallas_infer_int8": "infer_int8"}.get(
+                    self.attention_backend)
+
+    def _setup_autotune(self) -> None:
+        """Load (and, in ``"measure"`` mode, fill) the Pallas geometry
+        winners BEFORE any forward is built: geometry is read at trace
+        time, and the winner digest rides the stable forward names.
+        One ``kind="autotune"`` record per (bucket, bh) says where that
+        shape's geometry came from — measured now, loaded from the
+        cache, or the heuristic fallback."""
+        if self.autotune == "off":
+            return
+        from bert_pytorch_tpu.ops.pallas import autotune as autotune_lib
+
+        kernel = self._autotune_kernel()
+        if kernel is None:
+            return  # xla/pallas backends have no infer geometry to tune
+        autotune_lib.load_winners(self.autotune_cache)
+        bh = self.max_batch_size * self.config.num_attention_heads
+        measured = 0
+        for bucket in self.buckets:
+            geom = autotune_lib.lookup(kernel, bucket, bh)
+            record = {"kind": "autotune", "tag": "telemetry",
+                      "kernel": kernel, "seq": bucket, "bh": bh}
+            if geom is not None:
+                record["source"] = "cached"
+                record["winner"] = {"block_q": geom[0], "block_k": geom[1],
+                                    "bh_block": geom[2]}
+            elif self.autotune == "measure":
+                t0 = self._clock()
+                result = autotune_lib.measure(
+                    kernel, bucket, bh, self.config.head_dim,
+                    dtype=self.dtype)
+                measured += 1
+                record.update(source="measured", winner=result["winner"],
+                              candidates=result["candidates"],
+                              measure_s=round(self._clock() - t0, 3))
+            else:
+                record["source"] = "heuristic"
+            self.monitor.note(record)
+        if measured:
+            autotune_lib.save_winners(self.autotune_cache)
 
     def _build_task(self, name: str, options: dict, seed: int):
         import flax.linen as nn
@@ -218,9 +330,32 @@ class InferenceEngine:
             model = build(self.quantize)
         return model, params
 
+    def _name_suffix(self, bucket: int) -> str:
+        """The autotune-winner digest suffix for this bucket's forward
+        names (``_g<digest>``), or "" when no winner is cached.
+
+        The persistent compile cache keys on the fn-name-derived HLO
+        module name, so WITHOUT the suffix a new measured geometry
+        would compile a different program under the SAME name —
+        aliasing two executables to one cache identity, where a stale
+        entry for the old geometry could be served against the new
+        one's name. With it, a geometry change invalidates exactly its
+        own entry; no winner means the deterministic heuristic, whose
+        program the plain name already identifies."""
+        kernel = self._autotune_kernel()
+        if kernel is None:
+            return ""
+        from bert_pytorch_tpu.ops.pallas import autotune as autotune_lib
+
+        digest = autotune_lib.name_digest(
+            kernel, bucket,
+            self.max_batch_size * self.config.num_attention_heads)
+        return f"_g{digest}" if digest else ""
+
     def _build_forwards(self, spec: TaskSpec) -> None:
-        """One jitted forward per (bucket, packedness), each named
-        ``serve_<task>_b<bucket>[_packed]_<quant>``.
+        """One jitted forward per (bucket, packedness, fused-epilogue),
+        each named ``serve_<task>_b<bucket>[_packed][_fused]_<quant>``
+        (+ the autotune-winner digest, :meth:`_name_suffix`).
 
         The name is load-bearing twice over: the persistent compile
         cache keys on the HLO module name, which jax derives from the
@@ -231,38 +366,82 @@ class InferenceEngine:
         per-spec names make the warm-start cache hit deterministic
         across process restarts (the cold-start acceptance:
         second start => zero cold compiles) and compile telemetry
-        attributable per (task, bucket, packed, quant).
+        attributable per (task, bucket, packed, quant, epilogue,
+        geometry).
+
+        Fused-epilogue engines (docs/serving.md "Raw-speed kernels"):
+        a ``"gather"`` head (fill_mask) gets BOTH variants per
+        (bucket, packed) — the fused forward takes a [B, epilogue_slots]
+        positions argument and emits the gathered [B, P, V] logits; the
+        unfused twin stays as the slot-overflow fallback. A
+        ``"stack_span"`` head (squad) gets only the fused variant (the
+        stack always applies). Heads with nothing to fuse compile the
+        exact same program (and name) as an unfused engine, so they
+        share its persistent-cache entries.
         """
         import jax
 
         model = spec.model
         pooled = spec.handler.output_kind == "pooled"
+        epilogue = spec.handler.epilogue if self.fuse_epilogues else None
         qtag = self.quantize or "fp32"
         for bucket in self.buckets:
             for packed in ((False, True) if self.pack else (False,)):
+                variants = []  # (fused, closure)
                 if not packed:
-                    def fwd(params, input_ids, segment_ids, input_mask):
+                    def base(params, input_ids, segment_ids, input_mask):
                         return model.apply(
                             {"params": params}, input_ids, segment_ids,
                             input_mask)
                 elif pooled:
-                    def fwd(params, input_ids, segment_ids, input_mask,
-                            sequence_ids, cls_positions):
+                    def base(params, input_ids, segment_ids, input_mask,
+                             sequence_ids, cls_positions):
                         return model.apply(
                             {"params": params}, input_ids, segment_ids,
                             input_mask, True, sequence_ids, cls_positions)
                 else:
-                    def fwd(params, input_ids, segment_ids, input_mask,
-                            sequence_ids):
+                    def base(params, input_ids, segment_ids, input_mask,
+                             sequence_ids):
                         return model.apply(
                             {"params": params}, input_ids, segment_ids,
                             input_mask, True, sequence_ids)
-                name = (f"serve_{spec.name}_b{bucket}"
-                        f"{'_packed' if packed else ''}_{qtag}")
-                fwd.__name__ = name
-                fwd.__qualname__ = name
-                spec.forwards[(bucket, packed)] = self.monitor.instrument(
-                    jax.jit(fwd), name)
+                if epilogue == "gather":
+                    if not packed:
+                        def fused(params, input_ids, segment_ids,
+                                  input_mask, positions):
+                            return model.apply(
+                                {"params": params}, input_ids,
+                                segment_ids, input_mask, True, None,
+                                positions)
+                    else:
+                        def fused(params, input_ids, segment_ids,
+                                  input_mask, sequence_ids, positions):
+                            return model.apply(
+                                {"params": params}, input_ids,
+                                segment_ids, input_mask, True,
+                                sequence_ids, positions)
+                    variants = [(False, base), (True, fused)]
+                elif epilogue == "stack_span":
+                    import jax.numpy as jnp
+
+                    def fused(*args, _base=base):
+                        start, end = _base(*args)
+                        # One [B, 2, S] output: a single D2H transfer
+                        # (and one host conversion in demux) instead of
+                        # two — XLA fuses the stack into the epilogue.
+                        return jnp.stack([start, end], axis=1)
+                    variants = [(True, fused)]
+                else:
+                    variants = [(False, base)]
+                for is_fused, fwd in variants:
+                    name = (f"serve_{spec.name}_b{bucket}"
+                            f"{'_packed' if packed else ''}"
+                            f"{'_fused' if is_fused else ''}_{qtag}"
+                            f"{self._name_suffix(bucket)}")
+                    fwd.__name__ = name
+                    fwd.__qualname__ = name
+                    spec.forwards[(bucket, packed, is_fused)] = \
+                        self.monitor.instrument(jax.jit(fwd), name)
 
     def warmup(self) -> int:
         """AOT-compile every (task, bucket[, packed]) forward the serving
@@ -283,6 +462,8 @@ class InferenceEngine:
         t0 = self._clock()
         before = len(self.monitor.events)
         zeros = {}
+        pos_zeros = np.zeros((self.max_batch_size, self.epilogue_slots),
+                             np.int32)
         for bucket in self.buckets:
             B, S, K = (self.max_batch_size, bucket,
                        self.max_requests_per_pack)
@@ -292,14 +473,19 @@ class InferenceEngine:
                 np.zeros((B, K), np.int32))
         for spec in self.tasks.values():
             pooled = spec.handler.output_kind == "pooled"
-            for (bucket, packed), fwd in spec.forwards.items():
+            gathered = spec.handler.epilogue == "gather"
+            for (bucket, packed, fused), fwd in spec.forwards.items():
                 ids, seg, mask, sids, cpos = zeros[bucket]
-                if not packed:
-                    out = fwd(spec.params, ids, seg, mask)
+                if fused and gathered:
+                    args = ((ids, seg, mask, pos_zeros) if not packed
+                            else (ids, seg, mask, sids, pos_zeros))
+                elif not packed:
+                    args = (ids, seg, mask)
                 elif pooled:
-                    out = fwd(spec.params, ids, seg, mask, sids, cpos)
+                    args = (ids, seg, mask, sids, cpos)
                 else:
-                    out = fwd(spec.params, ids, seg, mask, sids)
+                    args = (ids, seg, mask, sids)
+                out = fwd(spec.params, *args)
                 jax.block_until_ready(out)
         compile_events = [e for e in self.monitor.events[before:]
                           if e.get("kind") == "compile"]
@@ -312,6 +498,8 @@ class InferenceEngine:
                                  if e.get("cache") == "hit"),
             "quantize": self.quantize or "none",
             "attention_backend": self.attention_backend,
+            "fuse_epilogues": self.fuse_epilogues,
+            "autotune": self.autotune,
             "weight_bytes": sum(quant_ops.weight_bytes(s.params)
                                 for s in self.tasks.values()),
         }
@@ -386,7 +574,13 @@ class InferenceEngine:
         arrays. HOST-ONLY — never touches the device, so the pipelined
         dispatch plane's assembler stage can run it concurrently with
         the executor's jitted forward (the one-device-thread
-        invariant)."""
+        invariant).
+
+        Fused-epilogue engines additionally stage the per-row gather
+        positions for ``"gather"`` heads ([B, epilogue_slots] absolute
+        row positions, zero-padded — slot 0 gathers position 0
+        harmlessly for unused slots); a batch whose rows overflow the
+        slot quota stages for the unfused fallback forward instead."""
         spec = self.tasks[task]
         t_host0 = self._clock()
         B, S = self.max_batch_size, plan.bucket
@@ -394,6 +588,27 @@ class InferenceEngine:
         seg = np.zeros((B, S), np.int32)
         mask = np.zeros((B, S), np.int32)
         offsets: Dict[int, Tuple[int, int, int]] = {}  # req id -> (row, off, slot)
+        epilogue = spec.handler.epilogue if self.fuse_epilogues else None
+        fused = epilogue == "stack_span"
+        gather_slots: Dict[int, Tuple[int, int, int]] = {}
+        row_positions: List[List[int]] = []
+        if epilogue == "gather":
+            # First pass (features only): do the rows fit the quota?
+            fused = True
+            for row in plan.rows:
+                positions: List[int] = []
+                offset = 0
+                for req in row:
+                    pts = spec.handler.gather_positions(req.features)
+                    gather_slots[req.id] = (len(row_positions),
+                                            len(positions), len(pts))
+                    positions.extend(offset + p for p in pts)
+                    offset += req.length if plan.packed else 0
+                if len(positions) > self.epilogue_slots:
+                    fused = False
+                    gather_slots = {}
+                    break
+                row_positions.append(positions)
         if plan.packed:
             K = self.max_requests_per_pack
             sids = np.zeros((B, S), np.int32)
@@ -422,8 +637,14 @@ class InferenceEngine:
                 mask[r, :n] = 1
                 offsets[req.id] = (r, 0, 0)
             args = (ids, seg, mask)
+        if fused and epilogue == "gather":
+            pos = np.zeros((B, self.epilogue_slots), np.int32)
+            for r, positions in enumerate(row_positions):
+                pos[r, :len(positions)] = positions
+            args = args + (pos,)
         return StagedBatch(task, plan, args, offsets,
-                           pack_s=self._clock() - t_host0)
+                           pack_s=self._clock() - t_host0,
+                           fused=fused, gather_slots=gather_slots)
 
     def execute_staged(self, staged: StagedBatch
                        ) -> Tuple[object, dict]:
@@ -437,7 +658,7 @@ class InferenceEngine:
         plan = staged.plan
         compiles_before = len(self.monitor.events)
         t0 = self._clock()
-        fwd = spec.forwards[(plan.bucket, plan.packed)]
+        fwd = spec.forwards[(plan.bucket, plan.packed, staged.fused)]
         out = fwd(spec.params, *staged.args)
         out = jax.block_until_ready(out)
         device_s = self._clock() - t0
@@ -452,6 +673,7 @@ class InferenceEngine:
             "pack_s": staged.pack_s,
             "compiles": compiles,
             "packed": plan.packed,
+            "fused": staged.fused,
         }
         return out, info
 
@@ -459,15 +681,29 @@ class InferenceEngine:
         """Slice each request's own output back out of the batch output
         (host conversion + per-request views, in ``plan.requests``
         order). Host-only — the completion stage runs it, so client
-        decode never blocks the next device step."""
+        decode never blocks the next device step.
+
+        Fused-epilogue batches consume the ALREADY-EXTRACTED outputs
+        (docs/serving.md "Raw-speed kernels"): a ``"gather"`` head's
+        [B, epilogue_slots, V] plane slices to each request's own slot
+        run (handed to postprocess as a
+        :class:`~bert_pytorch_tpu.serve.tasks.GatheredTokens`), and a
+        ``"stack_span"`` head's single [B, 2, S] output re-splits into
+        the usual (start, end) tuple — one host conversion instead of
+        two."""
         spec = self.tasks[staged.task]
         plan = staged.plan
         kind = spec.handler.output_kind
         if kind == "span":
-            start = np.asarray(out[0], np.float32)
-            end = np.asarray(out[1], np.float32)
+            if staged.fused:
+                both = np.asarray(out, np.float32)  # [B, 2, S]
+                start, end = both[:, 0], both[:, 1]
+            else:
+                start = np.asarray(out[0], np.float32)
+                end = np.asarray(out[1], np.float32)
         else:
             host = np.asarray(out, np.float32)
+        gathered = staged.fused and spec.handler.epilogue == "gather"
         results: List[object] = []
         for req in plan.requests:
             r, off, slot = staged.offsets[req.id]
@@ -476,6 +712,10 @@ class InferenceEngine:
                 results.append(host[r, slot] if plan.packed else host[r])
             elif kind == "span":
                 results.append((start[r, off:off + n], end[r, off:off + n]))
+            elif gathered:
+                gr, s0, count = staged.gather_slots[req.id]
+                results.append(
+                    tasks_lib.GatheredTokens(host[gr, s0:s0 + count]))
             else:
                 results.append(host[r, off:off + n])
         return results

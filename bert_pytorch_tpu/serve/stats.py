@@ -315,7 +315,7 @@ class ServeTelemetry:
                     if cs.get(key) is not None:
                         record[f"warmup_{key}"] = cs[key]
                 for key in ("quantize", "attention_backend",
-                            "weight_bytes"):
+                            "weight_bytes", "fuse_epilogues", "autotune"):
                     if cs.get(key) is not None:
                         record[key] = cs[key]
         # Outside the lock: the tracer takes its own lock, and nesting

@@ -38,11 +38,23 @@ into an opaque end-to-end number.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, NamedTuple, Optional
 
 import numpy as np
 
 from bert_pytorch_tpu import squad as squad_lib
+
+
+class GatheredTokens(NamedTuple):
+    """Per-request output of a FUSED-EPILOGUE forward (docs/serving.md
+    "Raw-speed kernels"): token-level logits already gathered at the
+    request's positions of interest — for fill_mask, one row per [MASK]
+    slot in ``features['mask_positions']`` order — instead of the full
+    [request_len, vocab] plane. An explicit wrapper type, not a bare
+    array: ``postprocess`` must never have to guess from shape whether
+    row i means token i or the i-th gathered position."""
+
+    logits: np.ndarray  # [n_positions, vocab]
 
 
 # -- tokenizer surface shims (the squad.py/ner_dataset.py conventions) ----
@@ -98,6 +110,26 @@ class TaskHandler:
     #   "pooled"  -> one vector per request (pooled/classifier logits)
     #   "span"    -> (start_logits[S], end_logits[S]) tuple
     output_kind: str = "tokens"
+    # Fused-epilogue capability (serve/engine.py fuse_epilogues;
+    # docs/serving.md "Raw-speed kernels"):
+    #   "gather"     -> the forward gathers this head's positions of
+    #                   interest (gather_positions below) before its
+    #                   final projection; demux hands postprocess a
+    #                   GatheredTokens instead of the full token plane
+    #   "stack_span" -> the forward stacks start/end into one [B, 2, S]
+    #                   output (one D2H transfer; demux re-splits, so
+    #                   postprocess sees the usual tuple)
+    #   None         -> no epilogue to fuse (pooled heads already
+    #                   extract in-model; ner reads per-word rows whose
+    #                   count is unbounded, so a fixed gather quota
+    #                   would cap the served word count)
+    epilogue: Optional[str] = None
+
+    def gather_positions(self, features: dict) -> List[int]:
+        """Positions (request-relative) a ``"gather"`` epilogue must
+        extract for this request; only heads declaring that epilogue
+        implement it."""
+        raise NotImplementedError
 
     def __init__(self, tokenizer):
         self.tokenizer = tokenizer
@@ -140,6 +172,10 @@ class FillMaskHandler(TaskHandler):
 
     name = "fill_mask"
     output_kind = "tokens"
+    epilogue = "gather"
+
+    def gather_positions(self, features: dict) -> List[int]:
+        return features["mask_positions"]
 
     def prepare(self, payload: dict, max_len: int) -> dict:
         text = payload["text"]
@@ -169,11 +205,18 @@ class FillMaskHandler(TaskHandler):
         return features
 
     def postprocess(self, features: dict, outputs, payload: dict) -> dict:
-        logits = np.asarray(outputs, np.float32)  # [len, vocab]
+        if isinstance(outputs, GatheredTokens):
+            # Fused-epilogue engines already gathered one row per mask
+            # slot (mask_positions order) on device; rows are bit-equal
+            # to the unfused plane's rows at those positions.
+            rows = [np.asarray(outputs.logits, np.float32)[i]
+                    for i in range(len(features["mask_positions"]))]
+        else:
+            logits = np.asarray(outputs, np.float32)  # [len, vocab]
+            rows = [logits[pos] for pos in features["mask_positions"]]
         top_k = int(payload.get("top_k", 5))
         slots = []
-        for pos in features["mask_positions"]:
-            row = logits[pos]
+        for row in rows:
             best = np.argsort(-row)[:top_k]
             probs = _softmax(row)[best]
             slots.append([
@@ -225,6 +268,7 @@ class SquadHandler(TaskHandler):
 
     name = "squad"
     output_kind = "span"
+    epilogue = "stack_span"
 
     def __init__(self, tokenizer, do_lower_case: bool = True,
                  max_query_length: int = 64):

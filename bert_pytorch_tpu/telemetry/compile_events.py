@@ -144,6 +144,15 @@ class CompileMonitor:
         self.cost_analysis = cost_analysis
         self._cost_done: set = set()
 
+    def note(self, record: dict) -> None:
+        """Append + emit one caller-built record through this monitor's
+        sink — the side channel for kernel-layer events that belong in
+        the same stream as the compile records they explain (the serve
+        engine's ``kind="autotune"`` geometry records ride here, next to
+        the compile events whose fn names carry the winner digest)."""
+        self.events.append(record)
+        self._emit(record)
+
     def instrument(self, fn, name: str):
         """Return ``fn`` wrapped so first-seen shape signatures (and any
         call during which compile activity fires) emit a compile record.

@@ -61,6 +61,12 @@ KIND_REQUIRED_KEYS = {
     # joined to the compile event by (fn, shapes_digest)
     # (telemetry/memory.py analyze_executable)
     "compile_cost": ("fn", "shapes_digest", "analysis"),
+    # one Pallas block-geometry decision for one (kernel, seq, bh)
+    # shape (ops/pallas/autotune.py, serve/engine.py _setup_autotune):
+    # where the geometry came from — measured this start, loaded from
+    # the persisted winners cache, or the heuristic fallback — plus the
+    # winning (block_q, block_k, bh_block) when one exists
+    "autotune": ("kernel", "seq", "bh", "source"),
     # end-of-run rollup
     "run_summary": ("steps",),
     # -- fault-tolerance record family (docs/fault_tolerance.md) -------
@@ -232,6 +238,8 @@ def validate_record(rec) -> list:
                     _check_obs_scrape_fields(rec, errors)
                 if kind == "obs_fleet_window":
                     _check_obs_fleet_fields(rec, errors)
+                if kind == "autotune":
+                    _check_autotune_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -633,6 +641,57 @@ def _check_obs_fleet_fields(rec, errors) -> None:
         if v is not None and (not _is_number(v) or v < 0):
             errors.append(
                 f"{key} must be a non-negative number, got {v!r}")
+
+
+# Where an autotune record's geometry may come from
+# (ops/pallas/autotune.py; serve/engine.py _setup_autotune).
+AUTOTUNE_SOURCES = ("measured", "cached", "heuristic")
+
+
+def _check_autotune_fields(rec, errors) -> None:
+    """autotune-record consistency (ops/pallas/autotune.py): the kernel
+    name is non-empty, seq/bh are positive integers, the source is one
+    of the known provenances, and — when a winner is attached — its
+    blocks tile the shape (a winner whose block does not divide seq
+    would describe a grid the kernel cannot run; recording it would
+    poison every consumer that replays geometry from artifacts)."""
+    kernel = rec.get("kernel")
+    if not isinstance(kernel, str) or not kernel:
+        errors.append(f"kernel must be a non-empty string, got {kernel!r}")
+    for key in ("seq", "bh"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errors.append(
+                f"{key} must be a positive integer, got {v!r}")
+    source = rec.get("source")
+    if source not in AUTOTUNE_SOURCES:
+        errors.append(
+            f"source must be one of {AUTOTUNE_SOURCES}, got {source!r}")
+    winner = rec.get("winner")
+    if winner is not None:
+        if not isinstance(winner, dict):
+            errors.append(f"winner must be an object, got {winner!r}")
+        else:
+            for field in ("block_q", "block_k", "bh_block"):
+                v = winner.get(field)
+                if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                    errors.append(
+                        f"winner.{field} must be a positive integer, "
+                        f"got {v!r}")
+                    continue
+                seq, bh = rec.get("seq"), rec.get("bh")
+                if field.startswith("block") and isinstance(seq, int) \
+                        and not isinstance(seq, bool) and seq >= 1 \
+                        and seq % v != 0:
+                    errors.append(
+                        f"winner.{field}={v} does not divide seq {seq}")
+                if field == "bh_block" and isinstance(bh, int) \
+                        and not isinstance(bh, bool) and bh >= 1 \
+                        and bh % v != 0:
+                    errors.append(
+                        f"winner.bh_block={v} does not divide bh {bh}")
+    elif source in ("measured", "cached"):
+        errors.append(f"source {source!r} requires a winner object")
 
 
 def _check_resume_fields(rec, errors) -> None:

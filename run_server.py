@@ -243,6 +243,10 @@ def build_service(args):
         monitor=monitor,
         quantize=args.quantize,  # "none" normalizes to None in the engine
         attention_backend=args.attention_backend,
+        fuse_epilogues=args.fuse_epilogues,
+        epilogue_slots=args.epilogue_slots,
+        autotune=args.autotune,
+        autotune_cache=args.autotune_cache or None,
     )
     batcher = Batcher(
         max_batch_size=args.max_batch_size,

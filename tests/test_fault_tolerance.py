@@ -574,6 +574,11 @@ def _kinds(workdir):
     return kinds
 
 
+@pytest.mark.slow  # ~43-100s: full runner compile+run (ISSUE 14 budget
+# fix). The SIGTERM->graceful-stop->restore invariant is carried tier-1
+# by test_graceful_stop_catches_sigterm_and_restores (in-process, no
+# jit); the verified-emergency-checkpoint half by
+# test_save_checkpoint_writes_verified_manifest_and_prunes.
 def test_pretraining_term_injection_stops_and_checkpoints(
         pretrain_workdir):
     """Injected SIGTERM at step 3: the run must stop at the next
@@ -688,6 +693,13 @@ def test_pretraining_nonfinite_injection_trips_abort_sentinel(
     assert [r["step"] for r in sentinels] == [2, 3]
 
 
+@pytest.mark.slow  # ~62-100s: three pretraining subprocesses (ISSUE 14
+# budget fix). The key invariant — resume walks back past a corrupt
+# newest checkpoint to the last VERIFIED one, recording what it skipped
+# — is carried tier-1 by test_walk_back_skips_all_corrupt_retained and
+# test_corruption_detected above (in-process, no jit); this acceptance
+# additionally proves the loss trajectory across the kill and runs
+# under ``-m slow``.
 def test_chaos_kill_corrupt_resume_acceptance():
     """ISSUE 5 acceptance: the chaos harness SIGKILLs a CPU pretraining
     child mid-run AND corrupts the newest checkpoint; the rerun

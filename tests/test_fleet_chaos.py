@@ -7,18 +7,90 @@ second kill — and no client ever sees it.
 Kept in its own module so the heavyweight subprocess gate (the
 supervisor spawns real ``run_server.py`` replicas; ~90s on a throttled
 2-core box) never slows collection of the in-process fleet tests
-(tests/test_fleet.py)."""
+(tests/test_fleet.py). Since ISSUE 14 the subprocess acceptance itself
+is second-tier (``-m slow``); the harness's VERDICT ARITHMETIC — the
+ok/shed/failure decomposition and the cold-start record scan every
+chaos assertion trusts — is carried tier-1 by the cheap in-process
+tests below (chaos_serve.py is stdlib-only and loads by file path, so
+they cost milliseconds)."""
 
 from __future__ import annotations
 
+import importlib.util
 import json
 import os
 import subprocess
 import sys
 
+import pytest
+
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _load_chaos_serve():
+    # chaos_serve.py resolves its siblings through tools/_bootstrap.py
+    # (the harness runs with cwd=tools/), so the loader mirrors that.
+    tools_dir = os.path.join(REPO_ROOT, "tools")
+    spec = importlib.util.spec_from_file_location(
+        "_test_chaos_serve", os.path.join(tools_dir, "chaos_serve.py"))
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, tools_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(tools_dir)
+    return module
+
+
+def test_classify_outcomes_decomposition():
+    """The burst verdict the acceptance trusts: 2xx is ok, a 503 WITH
+    Retry-After is an explicit shed, everything else — including the
+    router's own deadline 503, which carries no Retry-After — is a
+    client-visible failure."""
+    chaos = _load_chaos_serve()
+    outcomes = [
+        {"status": 200},
+        {"status": 201},
+        {"status": 503, "retry_after": "1"},   # admission-control shed
+        {"status": 503},                        # deadline 503: FAILURE
+        {"status": 500},
+        {"status": None},                       # transport error
+    ]
+    verdict = chaos.classify_outcomes(outcomes)
+    assert verdict["requests"] == 6
+    assert verdict["ok"] == 2
+    assert verdict["sheds"] == 1
+    assert verdict["failures"] == 3
+    assert len(verdict["failure_samples"]) == 3
+
+
+def test_cold_start_record_scan(tmp_path):
+    """The warm-restart assertion reads serve_cold_start records from
+    the replica's telemetry artifact; the scan must pick exactly that
+    kind and preserve order (the RESPAWNED replica's record is the one
+    the compiles_cold==0 check targets)."""
+    chaos = _load_chaos_serve()
+    out_dir = str(tmp_path)
+    path = os.path.join(out_dir, "serve_telemetry.jsonl")
+    records = [
+        {"kind": "serve_cold_start", "compiles_cold": 4,
+         "compiles_warm": 0, "compiles": 4, "cold_start_s": 2.0},
+        {"kind": "serve_window", "window_requests": 8},
+        {"kind": "serve_cold_start", "compiles_cold": 0,
+         "compiles_warm": 4, "compiles": 4, "cold_start_s": 0.5},
+    ]
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    found = chaos.cold_start_records(out_dir)
+    assert [r["compiles_cold"] for r in found] == [4, 0]
+    assert chaos.cold_start_records(str(tmp_path / "missing")) == []
+
+
+@pytest.mark.slow  # ~47-90s: supervisor + real run_server.py replica
+# subprocesses (ISSUE 14 budget fix); the in-process supervisor/router
+# behavior is tier-1 in tests/test_fleet.py and the verdict arithmetic
+# in the tests above.
 def test_chaos_serve_fleet_failover_acceptance():
     """Zero client-visible failures beyond explicit 503 sheds; failover
     inside the retry budget (p95 under the tolerance the

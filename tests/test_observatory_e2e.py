@@ -203,6 +203,41 @@ class TrainerPlane:
         self.tele.close()
 
 
+def test_trainer_plane_collector_pass_in_process(tmp_path):
+    """The acceptance's key invariant, carried tier-1 in-process
+    (ISSUE 14 budget fix): a live trainer debug plane scrapes healthy
+    through the collector, the scraped /metricsz gauges agree with the
+    trainer's own JSONL window, and the merged timeline the collector
+    writes is schema-clean — no subprocess fleet, one TrainerPlane
+    thread, one collector pass."""
+    workdir = str(tmp_path)
+    trainer = TrainerPlane(workdir)
+    trainer.start()
+    timeline_path = os.path.join(workdir, "timeline.jsonl")
+    try:
+        wait_until(lambda: os.path.exists(trainer.jsonl), 10.0,
+                   "trainer telemetry artifact")
+        collector = FleetCollector(
+            targets=[Target(name="pretrain", kind="trainer",
+                            url=trainer.url)],
+            tails=[JsonlTailer(trainer.jsonl, "trainer")],
+            out_path=timeline_path, emit=lambda rec: None)
+        wait_until(lambda: collector.collect_once()["targets_healthy"]
+                   == 1, 15.0, "healthy trainer scrape")
+    finally:
+        trainer.stop()
+    records = [json.loads(line) for line in open(timeline_path)]
+    scrapes = [r for r in records if r.get("kind") == "obs_scrape"]
+    assert scrapes and scrapes[-1]["ok"] is True
+    assert scrapes[-1]["target_kind"] == "trainer"
+    fleet = [r for r in records if r.get("kind") == "obs_fleet_window"]
+    assert fleet and fleet[-1]["targets_total"] == 1
+    assert schema.validate_file(timeline_path) == []
+
+
+@pytest.mark.slow  # ~30-50s: supervised run_server.py replicas + kill/
+# recover cycle (ISSUE 14 budget fix); the collector/introspection
+# behavior is tier-1 above and in tests/test_observatory.py.
 def test_fleet_observatory_acceptance(tmp_path):
     workdir = str(tmp_path)
     cache_dir = os.path.join(workdir, "compile_cache")

@@ -552,10 +552,19 @@ def pretrain_workdir(tmp_path):
             "model": str(config_path)}
 
 
+@pytest.mark.slow
 def test_pretraining_smoke_emits_telemetry(pretrain_workdir):
     """ISSUE 1 acceptance: >=20 synthetic CPU steps must leave a JSONL
     stream holding the per-window step-time decomposition, MFU, a compile
-    event with cache status, and a heartbeat file that advanced."""
+    event with cache status, and a heartbeat file that advanced.
+
+    Slow-gated (ISSUE 14 budget fix; ~47-100s on the throttled box: a
+    full runner compile+run): the key invariant — the telemetry facade
+    leaves a SCHEMA-CLEAN artifact with step_window/sentinel/
+    run_summary records and an advancing heartbeat — is carried tier-1
+    by the cheap in-process ``test_train_telemetry_loop_protocol``
+    above (fake clock, no jit); this E2E additionally proves
+    run_pretraining.py plumbs it and runs under ``-m slow``."""
     import run_pretraining
 
     args = run_pretraining.parse_arguments([
