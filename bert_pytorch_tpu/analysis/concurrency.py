@@ -192,7 +192,22 @@ REGISTRY: Tuple[Entry, ...] = (
               "would double-read or skip sink records"),
     Entry("bert_pytorch_tpu/telemetry/collector.py", "_passes",
           cls="FleetCollector", kind="lock", locks=("_lock",),
-          why="pass counter bumped by whichever thread runs the pass"),
+          allow=("_feed_stitch_locked", "_flush_stitch_locked"),
+          why="pass counter bumped by whichever thread runs the pass; "
+              "the stitch helpers read it for the orphan-grace clock "
+              "with _lock held (the _locked suffix is their contract)"),
+    Entry("bert_pytorch_tpu/telemetry/collector.py", "_stitch_pending",
+          cls="FleetCollector", kind="lock", locks=("_lock",),
+          allow=("_feed_stitch_locked", "_flush_stitch_locked",
+                 "_stitch_record"),
+          why="pending trace joins fed by whichever thread drains the "
+              "tailers and drained by close() on the control thread; "
+              "the _locked helpers (and _stitch_record, called only "
+              "from _flush_stitch_locked) run with _lock held"),
+    Entry("bert_pytorch_tpu/telemetry/collector.py", "_stitch_finalized",
+          cls="FleetCollector", kind="lock", locks=("_lock",),
+          why="close() may race a manual pass; the flag makes the "
+              "force-drain exactly-once"),
     Entry("bert_pytorch_tpu/telemetry/collector.py", "_out_f",
           cls="FleetCollector", kind="lock", locks=("_lock",),
           allow=("_write_locked",),
@@ -363,6 +378,11 @@ REGISTRY: Tuple[Entry, ...] = (
           cls="Router", kind="lock", locks=("_lock",),
           why="run-level accumulator shared by request threads and "
               "/statsz snapshot readers"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_trace_seq",
+          cls="Router", kind="lock", locks=("_lock",),
+          why="trace-id sequence bumped by every concurrent request "
+              "thread in _mint_trace; a duplicate id would stitch two "
+              "requests into one tree"),
 
     # -- serve/supervisor.py: monitor thread vs control-plane callers ------
     # The replica table (and every _Replica field reached through it) is

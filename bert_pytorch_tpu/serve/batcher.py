@@ -79,6 +79,11 @@ class Request:
         # Host prepare() time measured by the submitting thread
         # (serve/service.py) — pre-queue, so trace context, not a span.
         self.prepare_s: float = 0.0
+        # Inbound router trace context (serve/tracing.py
+        # parse_trace_header), stamped by ServingService.submit like
+        # prepare_s; the dispatch thread forwards it to the tracer so
+        # the emitted serve_trace chains to the router's span tree.
+        self.trace_ctx: Optional[dict] = None
         self.completed_at: Optional[float] = None
         self.result: Optional[dict] = None
         self.error: Optional[str] = None

@@ -38,6 +38,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from bert_pytorch_tpu.serve.batcher import BatcherFull
 from bert_pytorch_tpu.serve.service import ServiceDraining, ServingService
+from bert_pytorch_tpu.serve.tracing import (TRACE_HEADER,
+                                            TRACE_ID_RESPONSE_HEADER,
+                                            parse_trace_header)
 
 MAX_BODY_BYTES = 1 << 20  # 1 MiB: plenty for text payloads, bounds abuse
 
@@ -57,15 +60,19 @@ def _make_handler():
         def log_message(self, fmt, *args):  # quiet; telemetry is the log
             pass
 
-        def _reply(self, code: int, payload: dict) -> None:
-            self._reply_text(code, json.dumps(payload), "application/json")
+        def _reply(self, code: int, payload: dict,
+                   headers: dict = None) -> None:
+            self._reply_text(code, json.dumps(payload), "application/json",
+                             headers)
 
-        def _reply_text(self, code: int, text: str,
-                        content_type: str) -> None:
+        def _reply_text(self, code: int, text: str, content_type: str,
+                        headers: dict = None) -> None:
             body = text.encode("utf-8")
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.end_headers()
             self.wfile.write(body)
 
@@ -100,36 +107,47 @@ def _make_handler():
 
         def do_POST(self):
             service = self.server.service
+            # Inbound router trace context (docs/observability.md "Trace
+            # propagation"): adopted by the tracer so fleet-wide sampling
+            # is consistent, and ECHOED on every response — sampled or
+            # not — so clients correlate without relying on sampling.
+            ctx = parse_trace_header(self.headers.get(TRACE_HEADER))
+            echo = ({TRACE_ID_RESPONSE_HEADER: ctx["trace_id"]}
+                    if ctx else None)
             if not self.path.startswith("/v1/"):
-                self._reply(404, {"error": f"no route {self.path}"})
+                self._reply(404, {"error": f"no route {self.path}"}, echo)
                 return
             task = self.path[len("/v1/"):].strip("/")
             try:
                 length = int(self.headers.get("Content-Length", 0))
                 if length > MAX_BODY_BYTES:
-                    self._reply(413, {"error": "payload too large"})
+                    self._reply(413, {"error": "payload too large"}, echo)
                     return
                 payload = json.loads(
                     self.rfile.read(length).decode("utf-8") or "{}")
                 if not isinstance(payload, dict):
                     raise ValueError("payload must be a JSON object")
             except ValueError as exc:
-                self._reply(400, {"error": f"bad JSON payload: {exc}"})
+                self._reply(400, {"error": f"bad JSON payload: {exc}"},
+                            echo)
                 return
             try:
                 result = service.submit(
-                    task, payload, timeout=self.server.request_timeout_s)
+                    task, payload, timeout=self.server.request_timeout_s,
+                    trace_ctx=ctx)
             except ValueError as exc:
                 code = 404 if "unknown task" in str(exc) else 400
-                self._reply(code, {"error": str(exc)})
+                self._reply(code, {"error": str(exc)}, echo)
             except KeyError as exc:
-                self._reply(400, {"error": f"missing payload field {exc}"})
+                self._reply(400, {"error": f"missing payload field {exc}"},
+                            echo)
             except (TimeoutError, BatcherFull, ServiceDraining) as exc:
-                self._reply(503, {"error": str(exc)})
+                self._reply(503, {"error": str(exc)}, echo)
             except Exception as exc:
-                self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+                self._reply(500, {"error": f"{type(exc).__name__}: {exc}"},
+                            echo)
             else:
-                self._reply(200, result)
+                self._reply(200, result, echo)
 
     return Handler
 

@@ -46,6 +46,7 @@ from __future__ import annotations
 import collections
 import http.client
 import http.server
+import inspect
 import json
 import os
 import queue
@@ -53,6 +54,7 @@ import sys
 import threading
 import time
 import urllib.parse
+import uuid
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 
@@ -87,6 +89,47 @@ RetryPolicy = _load_util("retry").RetryPolicy
 RETRYABLE_STATUSES = frozenset((500, 502, 503, 504))
 
 _SAMPLE_CAP = 512  # recent-latency history for the hedge threshold
+
+# -- cross-tier trace propagation (ISSUE 16) --------------------------------
+# The router mints one fleet-unique trace id + head-sampling decision per
+# client request and forwards them on EVERY dispatch attempt; the replica
+# adopts the decision (serve/tracing.py parse_trace_header) so sampling
+# is consistent fleet-wide, and both tiers echo the id back so clients
+# correlate without relying on sampling. The wire format is duplicated
+# from serve/tracing.py on purpose: this module stays stdlib-only and
+# dual-loadable by file path (no serve-package imports); the round-trip
+# is pinned by tests/test_fleet_tracing.py.
+TRACE_HEADER = "X-Bert-Trace"
+TRACE_ID_RESPONSE_HEADER = "X-Bert-Trace-Id"
+
+
+def format_trace_header(trace_id: str, attempt: int,
+                        sampled: bool) -> str:
+    """``X-Bert-Trace`` request-header value for one dispatch attempt
+    (serve/tracing.py parse_trace_header is the inverse)."""
+    return f"{trace_id};attempt={int(attempt)};sampled={1 if sampled else 0}"
+
+
+def _sample_hash(seq: int) -> float:
+    """Deterministic [0, 1) hash of the request sequence number (the
+    Knuth multiplicative hash serve/tracing.py uses, duplicated for the
+    same dual-load reason as the wire format)."""
+    return ((int(seq) * 2654435761) & 0xFFFFFFFF) / float(1 << 32)
+
+
+def _transport_takes_headers(transport) -> bool:
+    """Does the injected transport accept the trace-propagation
+    ``headers`` kwarg? Tests and older harnesses inject 4-arg
+    transports; the router must keep working with them (trace headers
+    are then simply not forwarded on that path)."""
+    try:
+        sig = inspect.signature(transport)
+    except (TypeError, ValueError):
+        return False
+    params = sig.parameters.values()
+    if any(p.kind == p.VAR_KEYWORD for p in params):
+        return True
+    return "headers" in sig.parameters
 
 
 def _pctl(sorted_vals: List[float], frac: float) -> float:
@@ -139,17 +182,23 @@ class RouterShed(RuntimeError):
 
 
 def default_transport(url: str, task: str, payload: dict,
-                      timeout_s: float) -> Tuple[int, dict]:
+                      timeout_s: float,
+                      headers: Optional[Dict[str, str]] = None
+                      ) -> Tuple[int, dict]:
     """POST ``payload`` to ``url``/v1/``task``; returns (status, body).
     Raises OSError-family errors on transport failure (connection
-    refused/reset, timeout) — the retry-on-another-replica signal."""
+    refused/reset, timeout) — the retry-on-another-replica signal.
+    ``headers`` are extra request headers (the router's ``X-Bert-Trace``
+    propagation rides here)."""
     parsed = urllib.parse.urlsplit(url)
     conn = http.client.HTTPConnection(
         parsed.hostname, parsed.port, timeout=max(0.05, timeout_s))
     try:
         body = json.dumps(payload).encode("utf-8")
+        send_headers = {"Content-Type": "application/json"}
+        send_headers.update(headers or {})
         conn.request("POST", f"/v1/{task}", body=body,
-                     headers={"Content-Type": "application/json"})
+                     headers=send_headers)
         resp = conn.getresponse()
         data = resp.read()
         try:
@@ -239,14 +288,27 @@ class Router:
         hedge_min_samples: int = 32,
         brownout_queue_depth: int = 128,
         shed_retry_after_s: float = 1.0,
+        trace_sample_rate: float = 0.0,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
         if not replica_urls:
             raise ValueError("need at least one replica URL")
+        if not 0.0 <= float(trace_sample_rate) <= 1.0:
+            raise ValueError(
+                f"trace_sample_rate must be in [0, 1], got "
+                f"{trace_sample_rate}")
         self._emit_fn = emit
         self.window = max(1, int(window))
         self._transport = transport
+        self._transport_headers = _transport_takes_headers(transport)
+        # Fleet-wide head sampling (ISSUE 16): the router's decision per
+        # client request wins over every replica's local rate, so one
+        # sampled request yields spans on BOTH tiers (the stitchable
+        # tree) instead of two uncorrelated coin flips.
+        self.trace_sample_rate = float(trace_sample_rate)
+        self._trace_token = uuid.uuid4().hex[:8]
+        self._trace_seq = 0
         self._scrape = scrape
         self.scrape_interval_s = float(scrape_interval_s)
         self.scrape_failures_unhealthy = int(scrape_failures_unhealthy)
@@ -281,7 +343,20 @@ class Router:
     def _zero_window() -> dict:
         return {"requests": 0, "ok": 0, "sheds": 0, "errors": 0,
                 "retries": 0, "hedges": 0, "hedge_wins": 0,
+                "hedge_wasted_ms": 0.0,
                 "failovers": 0, "latency_ms": [], "failover_ms": []}
+
+    def _mint_trace(self) -> Tuple[str, bool]:
+        """One fleet-unique trace id + head-sampling decision per client
+        request. The run token namespaces ids across router restarts
+        (serve/tracing.py discipline); the sequence hash keeps sampling
+        deterministic for replayed bursts."""
+        with self._lock:
+            seq = self._trace_seq
+            self._trace_seq += 1
+        sampled = (self.trace_sample_rate > 0.0
+                   and _sample_hash(seq) < self.trace_sample_rate)
+        return f"rt-{self._trace_token}-{seq:x}", sampled
 
     # -- health scraping --------------------------------------------------
 
@@ -404,33 +479,76 @@ class Router:
         """Route one request end to end: (status, body, extra headers).
         Never raises — every outcome is an HTTP answer, including the
         deadline (503), brownout (503 + Retry-After), and exhausted
-        retries (502)."""
+        retries (502). Every answer carries ``X-Bert-Trace-Id`` (sampled
+        or not), and a head-sampled request additionally emits ONE
+        ``router_trace`` record: admission / per-attempt dispatch /
+        backoff spans plus the hedge win/waste accounting — the
+        router-tier half of the stitched end-to-end tree
+        (telemetry/collector.py)."""
         t0 = self._clock()
+        trace_id, sampled = self._mint_trace()
         deadline = t0 + self.deadline_s
         exclude: set = set()
         rounds = 0
         failed_rounds = 0
         hedges_fired = 0
+        hedge_wasted_s = 0.0
+        spans: List[dict] = []
+        attempt_base = 1
+
+        def finish(status: int, body: dict, extra: Optional[dict] = None,
+                   ok: bool = False, shed: bool = False,
+                   hedge_won: bool = False, failover: bool = False,
+                   winning_attempt: Optional[int] = None
+                   ) -> Tuple[int, dict, Dict[str, str]]:
+            self._observe(ok=ok, shed=shed, t0=t0, retries=failed_rounds,
+                          hedges=hedges_fired, hedge_won=hedge_won,
+                          failover=failover,
+                          hedge_wasted_ms=hedge_wasted_s * 1000.0)
+            if sampled:
+                self._emit_trace(
+                    trace_id, task, status, t0, spans,
+                    hedges=hedges_fired, hedge_won=hedge_won,
+                    hedge_wasted_s=hedge_wasted_s,
+                    winning_attempt=winning_attempt)
+            headers = {TRACE_ID_RESPONSE_HEADER: trace_id}
+            headers.update(extra or {})
+            return status, body, headers
+
         while True:
+            t_admit = self._clock()
             try:
                 replica = self._admit(frozenset(exclude))
             except RouterShed as shed:
-                self._observe(ok=False, shed=True, t0=t0,
-                              retries=failed_rounds, hedges=hedges_fired)
-                return 503, {"error": str(shed)}, {
-                    "Retry-After": f"{shed.retry_after_s:g}"}
+                spans.append(self._span("admission", t0, t_admit))
+                return finish(503, {"error": str(shed)},
+                              {"Retry-After": f"{shed.retry_after_s:g}"},
+                              shed=True)
+            spans.append(self._span("admission", t0, t_admit))
             remaining = deadline - self._clock()
             if remaining <= 0:
                 self._release(replica, failed=False)
-                self._observe(ok=False, shed=False, t0=t0,
-                              retries=failed_rounds, hedges=hedges_fired)
-                return 503, {"error": "router deadline exceeded "
-                                      f"({self.deadline_s:g}s)"}, {}
+                return finish(503, {"error": "router deadline exceeded "
+                                             f"({self.deadline_s:g}s)"})
             rounds += 1
-            status, body, hedged, hedge_won, failed_urls = \
+            status, body, hedged, hedge_won, failed_urls, attempts = \
                 self._dispatch_hedged(
-                    replica, task, payload, remaining, exclude)
+                    replica, task, payload, remaining, exclude,
+                    trace_id=trace_id, trace_sampled=sampled,
+                    attempt_base=attempt_base)
+            attempt_base += len(attempts)
             hedges_fired += 1 if hedged else 0
+            winner = None
+            for att in attempts:
+                spans.append(self._attempt_span(att, t0))
+                if att["won"]:
+                    winner = att["attempt"]
+                if hedged and not att["won"]:
+                    # Hedge-loser waste (The Tail at Scale): in a round
+                    # where a duplicate was racing, every non-winning
+                    # attempt's latency was spent for an answer nobody
+                    # used.
+                    hedge_wasted_s += att["dur_s"]
             final = (status is not None
                      and status not in RETRYABLE_STATUSES)
             if final:
@@ -440,11 +558,10 @@ class Router:
                 # exhausted-retry paths below — count into ``errors``,
                 # the zero-tolerance "client saw a server failure" gate
                 # (telemetry/report.py).
-                self._observe(ok=status < 500, shed=False, t0=t0,
-                              retries=failed_rounds,
-                              hedges=hedges_fired, hedge_won=hedge_won,
-                              failover=(failed_rounds > 0))
-                return status, body, {}
+                return finish(status, body, ok=status < 500,
+                              hedge_won=hedge_won,
+                              failover=(failed_rounds > 0),
+                              winning_attempt=winner)
             # Retryable failure: this replica (and any hedge target that
             # also failed) is out of the running for THIS request.
             failed_rounds += 1
@@ -452,40 +569,122 @@ class Router:
             exclude.update(failed_urls)
             policy = self.retry_policy
             if rounds >= policy.attempts:
-                self._observe(ok=False, shed=False, t0=t0,
-                              retries=failed_rounds, hedges=hedges_fired)
-                return 502, {
+                return finish(502, {
                     "error": f"request failed on {rounds} replica(s) "
-                             f"(last status {status})"}, {}
+                             f"(last status {status})"})
             backoff = policy.backoff_s(rounds - 1)
             if self._clock() + backoff >= deadline:
-                self._observe(ok=False, shed=False, t0=t0,
-                              retries=failed_rounds, hedges=hedges_fired)
-                return 503, {"error": "router deadline exceeded during "
-                                      "failover backoff"}, {}
+                return finish(503, {
+                    "error": "router deadline exceeded during "
+                             "failover backoff"})
+            t_backoff = self._clock()
             self._sleep(backoff)
+            spans.append(self._span("backoff", t0, t_backoff))
+
+    def _span(self, name: str, t0: float, start_abs: float) -> dict:
+        """One closed router span ending NOW, relative to the request
+        start (start/dur in ms like serve_trace spans)."""
+        now = self._clock()
+        return {"name": name,
+                "start_ms": round(max(0.0, (start_abs - t0)) * 1000.0, 3),
+                "dur_ms": round(max(0.0, (now - start_abs)) * 1000.0, 3)}
+
+    @staticmethod
+    def _attempt_span(att: dict, t0: float) -> dict:
+        span = {"name": "attempt",
+                "start_ms": round(
+                    max(0.0, (att["start"] - t0)) * 1000.0, 3),
+                "dur_ms": round(max(0.0, att["dur_s"]) * 1000.0, 3),
+                "attempt": att["attempt"],
+                "replica": att["replica"],
+                "outcome": att["outcome"],
+                "hedge": att["hedge"]}
+        if att["status"] is not None:
+            span["status"] = att["status"]
+        return span
+
+    def _emit_trace(self, trace_id: str, task: str, status: int,
+                    t0: float, spans: List[dict], hedges: int,
+                    hedge_won: bool, hedge_wasted_s: float,
+                    winning_attempt: Optional[int]) -> None:
+        if self._emit_fn is None:
+            return
+        total_ms = (self._clock() - t0) * 1000.0
+        # Round the total UP to the latest span end at the same
+        # precision so the lint's per-span sub-interval bound survives
+        # rounding (serve/tracing.py discipline).
+        span_end = max((s["start_ms"] + s["dur_ms"] for s in spans),
+                       default=0.0)
+        record = {
+            "kind": "router_trace", "tag": "router",
+            "trace_id": trace_id, "task": task, "status": int(status),
+            "total_ms": round(max(total_ms, span_end), 3),
+            "sampled": True,
+            "attempts": sum(1 for s in spans if s["name"] == "attempt"),
+            "hedges": int(hedges),
+            "hedge_won": bool(hedge_won),
+            "hedge_wasted_ms": round(max(0.0, hedge_wasted_s) * 1000.0, 3),
+            "spans": spans,
+        }
+        if winning_attempt is not None:
+            record["winning_attempt"] = int(winning_attempt)
+        try:
+            self._emit_fn(record)
+        except Exception:
+            pass
 
     def _dispatch_hedged(self, primary: ReplicaState, task: str,
-                         payload: dict, timeout_s: float, exclude: set
-                         ) -> Tuple[Optional[int], dict, bool, bool, set]:
+                         payload: dict, timeout_s: float, exclude: set,
+                         trace_id: str, trace_sampled: bool,
+                         attempt_base: int
+                         ) -> Tuple[Optional[int], dict, bool, bool, set,
+                                    List[dict]]:
         """One dispatch round, possibly hedged: (status, body, hedged,
-        hedge_won, failed_urls). ``status`` None = transport-level
-        failure; ``failed_urls`` is every replica that failed in this
-        round (the caller's exclude list for the retry)."""
+        hedge_won, failed_urls, attempts). ``status`` None =
+        transport-level failure; ``failed_urls`` is every replica that
+        failed in this round (the caller's exclude list for the retry).
+        ``attempts`` is one accounting dict per launched attempt —
+        attempt index (numbered from ``attempt_base`` across the whole
+        request), target replica, outcome, latency — closed out at the
+        round's decision instant so a still-in-flight loser is measured
+        by the time the race actually cost, not a latency nobody waited
+        for. Each attempt propagates the request's trace context via
+        ``X-Bert-Trace`` (when the transport takes headers)."""
         results: "queue.Queue" = queue.Queue()
         launched_urls = {primary.url}
-        n_launched = 1
         failed_urls: set = set()
+        attempts: List[dict] = []
 
-        def worker(rep: ReplicaState, is_hedge: bool) -> None:
+        def launch(rep: ReplicaState, is_hedge: bool) -> dict:
+            att = {"attempt": attempt_base + len(attempts),
+                   "replica": rep.url, "hedge": is_hedge,
+                   "start": self._clock(), "end": None, "status": None,
+                   "outcome": "pending", "won": False}
+            attempts.append(att)
+            threading.Thread(
+                target=worker, args=(rep, is_hedge, att),
+                name="router-hedge" if is_hedge else "router-dispatch",
+                daemon=True).start()
+            return att
+
+        def worker(rep: ReplicaState, is_hedge: bool, att: dict) -> None:
             start = self._clock()
             try:
-                status, body = self._transport(
-                    rep.url, task, payload, timeout_s)
+                if self._transport_headers:
+                    status, body = self._transport(
+                        rep.url, task, payload, timeout_s,
+                        headers={TRACE_HEADER: format_trace_header(
+                            trace_id, att["attempt"], trace_sampled)})
+                else:
+                    status, body = self._transport(
+                        rep.url, task, payload, timeout_s)
             except Exception as exc:
                 self._release(rep, failed=True)
+                att["end"] = self._clock()
+                att["outcome"] = "transport_error"
                 results.put((None, {"error": f"{type(exc).__name__}: "
-                                             f"{exc}"}, rep, is_hedge))
+                                             f"{exc}"}, rep, is_hedge,
+                             att))
                 return
             retryable = status in RETRYABLE_STATUSES
             # A 503 is the replica ALIVE and telling us it is draining
@@ -494,10 +693,25 @@ class Router:
             self._release(rep, failed=(retryable and status != 503))
             if not retryable:
                 self.note_latency(self._clock() - start)
-            results.put((status, body, rep, is_hedge))
+            att["status"] = status
+            att["end"] = self._clock()
+            att["outcome"] = "error" if retryable else "final"
+            results.put((status, body, rep, is_hedge, att))
 
-        threading.Thread(target=worker, args=(primary, False),
-                         name="router-dispatch", daemon=True).start()
+        def close_round(winner: Optional[dict]) -> None:
+            """Stamp every attempt's decision-time latency and loser
+            disposition (the hedge-waste basis)."""
+            now = self._clock()
+            for att in attempts:
+                end = att["end"] if att["end"] is not None else now
+                att["dur_s"] = max(0.0, end - att["start"])
+                if att["outcome"] == "pending":
+                    att["outcome"] = ("lost" if winner is not None
+                                      else "abandoned")
+                if winner is att:
+                    att["won"] = True
+
+        launch(primary, False)
         start = self._clock()
         deadline = start + timeout_s
         hedge_delay = self._hedge_delay_s()
@@ -530,35 +744,35 @@ class Router:
                     if hedge_rep is not None:
                         hedged = True
                         launched_urls.add(hedge_rep.url)
-                        n_launched += 1
-                        threading.Thread(
-                            target=worker, args=(hedge_rep, True),
-                            name="router-hedge", daemon=True).start()
+                        launch(hedge_rep, True)
                     continue
                 wait = min(wait, hedge_in)
             try:
-                status, body, rep, is_hedge = results.get(
+                status, body, rep, is_hedge, att = results.get(
                     timeout=max(0.001, wait))
             except queue.Empty:
                 continue
             if status is not None and status not in RETRYABLE_STATUSES:
-                return status, body, hedged, is_hedge, failed_urls
+                close_round(att)
+                return status, body, hedged, is_hedge, failed_urls, \
+                    attempts
             failures += 1
             failed_urls.add(rep.url)
             if first_failure is None:
                 first_failure = (status, body)
-            if failures >= n_launched:
+            if failures >= len(attempts):
                 # Everything launched has failed; a not-yet-fired hedge
                 # would only duplicate a request the retry path is
                 # about to place better.
                 break
+        close_round(None)
         if first_failure is not None:
             status, body = first_failure
         else:
             status, body = None, {
                 "error": f"dispatch timed out after {timeout_s:.3f}s"}
             failed_urls.add(primary.url)
-        return status, body, hedged, False, failed_urls
+        return status, body, hedged, False, failed_urls, attempts
 
     def _pick_hedge(self, exclude: set) -> Optional[ReplicaState]:
         with self._lock:
@@ -577,7 +791,8 @@ class Router:
 
     def _observe(self, ok: bool, shed: bool, t0: float, retries: int = 0,
                  hedges: int = 0, hedge_won: bool = False,
-                 failover: bool = False) -> None:
+                 failover: bool = False,
+                 hedge_wasted_ms: float = 0.0) -> None:
         latency_ms = (self._clock() - t0) * 1000.0
         with self._lock:
             for acc in (self._win, self._run):
@@ -587,6 +802,11 @@ class Router:
                 # instant as its potential hedge_win so hedge_wins <=
                 # hedges holds within EVERY window (schema invariant).
                 acc["hedges"] += hedges
+                # Hedge-loser waste rides the SAME acquisition: a window
+                # flush can never see waste without the hedge that
+                # produced it (the PR 11 flush-race discipline; the
+                # schema lint rejects wasted>0 with hedges==0).
+                acc["hedge_wasted_ms"] += max(0.0, hedge_wasted_ms)
                 if shed:
                     acc["sheds"] += 1
                 elif ok:
@@ -611,6 +831,7 @@ class Router:
             "ok": acc["ok"], "sheds": acc["sheds"],
             "errors": acc["errors"], "retries": acc["retries"],
             "hedges": acc["hedges"], "hedge_wins": acc["hedge_wins"],
+            "hedge_wasted_ms": round(acc["hedge_wasted_ms"], 3),
             "failovers": acc["failovers"],
             "healthy_replicas": healthy,
             "replicas": len(self._replicas),
@@ -687,6 +908,8 @@ class Router:
         for key in ("ok", "sheds", "errors", "retries", "hedges",
                     "hedge_wins", "failovers"):
             metric(f"{key}_total", snap.get(key), "counter")
+        metric("hedge_wasted_ms_total", snap.get("hedge_wasted_ms"),
+               "counter", "Hedge-loser latency burned (ms, run total).")
         metric("healthy_replicas", snap.get("healthy_replicas"), "gauge",
                "Replicas currently eligible for routing.")
         metric("replicas", snap.get("replicas"), "gauge")

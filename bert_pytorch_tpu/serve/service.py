@@ -170,11 +170,15 @@ class ServingService:
     # -- request side ----------------------------------------------------
 
     def submit(self, task: str, payload: dict,
-               timeout: Optional[float] = 30.0) -> dict:
+               timeout: Optional[float] = 30.0,
+               trace_ctx: Optional[dict] = None) -> dict:
         """Prepare, enqueue, and wait for one request; returns the task
         handler's JSON-able result. Raises ValueError for bad payloads /
         unknown tasks, TimeoutError when the deadline passes,
-        ServiceDraining once shutdown has begun."""
+        ServiceDraining once shutdown has begun. ``trace_ctx`` is the
+        inbound router trace context (serve/http.py parses the
+        ``X-Bert-Trace`` header) forwarded to the tracer so fleet-wide
+        sampling stays consistent."""
         with self._state_lock:
             draining = self._draining
         if draining:
@@ -189,6 +193,7 @@ class ServingService:
         features = spec.handler.prepare(payload, self.engine.max_len())
         request = Request(task, features, payload)
         request.prepare_s = self._clock() - t_prep0
+        request.trace_ctx = trace_ctx
         self.batcher.submit(request)
         if not request.wait(timeout):
             # Nobody will read the result: let the dispatch plane skip
@@ -326,6 +331,7 @@ class ServingService:
                     prepare_s=req.prepare_s,
                     pack_s=info.get("pack_s"),
                     admitted_late=req.admitted_late,
+                    trace_ctx=req.trace_ctx,
                 )
             except Exception:
                 pass  # observability must never break serving
@@ -651,6 +657,7 @@ class ServingService:
                     pack_s=info.get("pack_s"),
                     admitted_late=req.admitted_late,
                     staged_wait_s=staged_wait_s,
+                    trace_ctx=req.trace_ctx,
                 )
             except Exception:
                 pass  # observability must never break serving

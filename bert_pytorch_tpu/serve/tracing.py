@@ -108,6 +108,63 @@ def _sample_hash(request_id: int) -> float:
     return ((int(request_id) * 2654435761) & 0xFFFFFFFF) / float(1 << 32)
 
 
+# -- cross-tier trace-context propagation (ISSUE 16, Dapper-style) -------
+# The router mints one fleet-unique trace id + sampling decision per
+# client request and forwards them on every dispatch attempt:
+#
+#     X-Bert-Trace: <trace_id>;attempt=<n>;sampled=<0|1>
+#
+# and every HTTP response (replica and router relay alike) echoes
+#
+#     X-Bert-Trace-Id: <trace_id>
+#
+# so clients and the chaos harness can correlate WITHOUT relying on
+# sampling. serve/router.py keeps its own copy of the wire format (it
+# loads by file path, jax-free, and must not import this module); the
+# round-trip is pinned by tests/test_fleet_tracing.py.
+TRACE_HEADER = "X-Bert-Trace"
+TRACE_ID_RESPONSE_HEADER = "X-Bert-Trace-Id"
+
+
+def parse_trace_header(value) -> Optional[dict]:
+    """Decode an inbound ``X-Bert-Trace`` header into a trace context
+    ``{"trace_id", "attempt", "sampled"}``; None on anything malformed
+    (a bad header must never fail the request — tracing is best-effort
+    observability, not admission control)."""
+    if not isinstance(value, str) or not value.strip():
+        return None
+    parts = [p.strip() for p in value.split(";")]
+    trace_id = parts[0]
+    if not trace_id:
+        return None
+    ctx = {"trace_id": trace_id, "attempt": 1, "sampled": False}
+    for part in parts[1:]:
+        key, sep, raw = part.partition("=")
+        if not sep:
+            return None
+        if key == "attempt":
+            try:
+                attempt = int(raw)
+            except ValueError:
+                return None
+            if attempt < 1:
+                return None
+            ctx["attempt"] = attempt
+        elif key == "sampled":
+            if raw not in ("0", "1"):
+                return None
+            ctx["sampled"] = raw == "1"
+        # Unknown keys are forward-compatible: ignored, not fatal.
+    return ctx
+
+
+def format_trace_header(trace_id: str, attempt: int,
+                        sampled: bool) -> str:
+    """Encode a trace context for the ``X-Bert-Trace`` request header
+    (the inverse of :func:`parse_trace_header`)."""
+    return f"{trace_id};attempt={int(attempt)};sampled={1 if sampled else 0}"
+
+
 class _TaskStats:
     """Per-task aggregates: run counters, /metricsz histograms, and the
     current serve_phase window. Only ever touched under the collector's
@@ -208,7 +265,8 @@ class TraceCollector:
                 prepare_s: Optional[float] = None,
                 pack_s: Optional[float] = None,
                 admitted_late: Optional[bool] = None,
-                staged_wait_s: Optional[float] = None) -> Optional[dict]:
+                staged_wait_s: Optional[float] = None,
+                trace_ctx: Optional[dict] = None) -> Optional[dict]:
         """Record one completed request's phase decomposition; returns
         the emitted ``serve_trace`` record when the request was sampled
         (head rate, or forced by the over-SLO slow rule), else None.
@@ -216,14 +274,24 @@ class TraceCollector:
         seconds. ``admitted_late`` marks a request that joined a forming
         batch through the pipelined plane's admission window;
         ``staged_wait_s`` is its batch's staging-complete -> executor
-        pickup delay (pipeline buffering — context, not a span)."""
+        pickup delay (pipeline buffering — context, not a span).
+        ``trace_ctx`` is the inbound router context
+        (:func:`parse_trace_header`): when present, the ROUTER'S
+        sampling decision replaces the local head hash — both ways, so
+        sampling is consistent fleet-wide — while the always-sample-slow
+        rule still fires locally, and the emitted record chains to the
+        router's span tree via ``parent_trace_id``/``attempt``."""
         phases_s = {name: max(0.0, float(phases_s.get(name, 0.0)))
                     for name in PHASES}
         total_s = max(float(total_s), sum(phases_s.values()))
         total_ms = total_s * 1000.0
         over_slo = bool(self.slo_p99_ms and total_ms > self.slo_p99_ms)
-        head = (self.sample_rate > 0.0
-                and _sample_hash(request_id) < self.sample_rate)
+        if trace_ctx is not None and trace_ctx.get("trace_id"):
+            head = bool(trace_ctx.get("sampled"))
+        else:
+            trace_ctx = None
+            head = (self.sample_rate > 0.0
+                    and _sample_hash(request_id) < self.sample_rate)
         phase_record = None
         emit_trace = False
         with self._lock:
@@ -262,7 +330,8 @@ class TraceCollector:
                 over_slo=over_slo,
                 bucket=bucket, packed=packed, batch_requests=batch_requests,
                 occupancy=occupancy, prepare_s=prepare_s, pack_s=pack_s,
-                admitted_late=admitted_late, staged_wait_s=staged_wait_s)
+                admitted_late=admitted_late, staged_wait_s=staged_wait_s,
+                trace_ctx=trace_ctx)
             self.emit(trace_record)
         if phase_record is not None:
             self.emit(phase_record)
@@ -277,7 +346,7 @@ class TraceCollector:
     def _trace_record(self, task, request_id, phases_s, total_ms, sampled,
                       over_slo, bucket, packed, batch_requests, occupancy,
                       prepare_s, pack_s=None, admitted_late=None,
-                      staged_wait_s=None) -> dict:
+                      staged_wait_s=None, trace_ctx=None) -> dict:
         spans = []
         start = 0.0
         for name in PHASES:
@@ -303,6 +372,13 @@ class TraceCollector:
             "sample_reason": "slow" if over_slo else "head",
             "spans": spans,
         }
+        if trace_ctx is not None:
+            # Chain to the router's span tree (the fleet collector's
+            # stitch join key). `attempt` is the router's 1-based
+            # dispatch attempt that reached this replica — a failed-over
+            # request's surviving serve_trace carries attempt 2+.
+            record["parent_trace_id"] = trace_ctx["trace_id"]
+            record["attempt"] = int(trace_ctx.get("attempt", 1))
         if self.slo_p99_ms:
             record["slo_target_ms"] = self.slo_p99_ms
         if bucket is not None:

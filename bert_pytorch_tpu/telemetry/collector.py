@@ -32,7 +32,17 @@ The :class:`FleetCollector` owns that join:
   passes), worst-replica p99 (histogram-quantile over each replica's
   exported phase-latency histogram — the "fleet worst-replica p99"
   gate), trainer step rate, max staleness, and the fleet error-budget
-  burn (over-SLO counts against the configured budget).
+  burn (over-SLO counts against the configured budget);
+* **trace stitching** — tailed ``router_trace`` and ``serve_trace``
+  records that share a trace id (the ``X-Bert-Trace`` propagation,
+  docs/observability.md "Trace propagation") are joined into one
+  ``trace_stitch`` record per client request: the router's winning
+  attempt span is matched to the replica's ``serve_trace`` by attempt
+  index and the client-observed total is decomposed into router
+  overhead + network gap + replica time (the gap is the residual, so
+  the decomposition sums exactly at record precision). A side that
+  never shows up within :data:`STITCH_GRACE_PASSES` passes is emitted
+  as ``orphan: true`` — counted, never dropped silently.
 
 Stdlib-only and dual-loadable like the supervisor/router: imported
 normally it is part of the telemetry package; loaded by FILE PATH
@@ -80,6 +90,14 @@ def _load_schema():
 _schema = _load_schema()
 SCHEMA_VERSION = _schema.SCHEMA_VERSION
 TARGET_KINDS = _schema.OBS_TARGET_KINDS
+
+# How many collector passes an unmatched trace side may wait for its
+# counterpart before it is emitted as an orphan. Router and replica
+# sinks are tailed by the same pass loop, so the only real skew is one
+# flush interval; three passes is generous without letting the pending
+# table grow unboundedly under sustained one-sided traffic.
+STITCH_GRACE_PASSES = 3
+_STITCH_EPS_MS = _schema._STITCH_EPS_MS
 
 
 # -- scrape transports -------------------------------------------------------
@@ -376,6 +394,13 @@ class FleetCollector:
         self._targets = list(targets)
         self._tails = list(tails)
         self._passes = 0
+        # Pending trace joins keyed by router trace id: each entry holds
+        # the router_trace record (if seen), the sampled serve_trace
+        # records chained to it, and the pass it was first seen on (the
+        # orphan-grace clock). Guarded by the collector lock like
+        # everything else.
+        self._stitch_pending: Dict[str, dict] = {}
+        self._stitch_finalized = False
         self._started_at = clock()
         self._out_f = open(out_path, "a", encoding="utf-8") \
             if out_path else None
@@ -465,6 +490,7 @@ class FleetCollector:
                     ts = rec.get("ts")
                     ts = float(ts) if isinstance(ts, (int, float)) \
                         and not isinstance(ts, bool) else wall_ts
+                    self._feed_stitch_locked(rec)
                     harvest.append((ts, 1 + tail_idx, line_no, rec))
             for scrape_idx, (_, _, rec) in enumerate(scrapes):
                 harvest.append((wall_ts, 0, scrape_idx, rec))
@@ -476,6 +502,10 @@ class FleetCollector:
             harvest.sort(key=lambda item: (item[0], item[1], item[2]))
             for ts, _, _, rec in harvest:
                 self._write_locked(rec, ts)
+            # Stitch AFTER the pass's harvest lands: a router_trace and
+            # its serve_trace tailed in the same pass join immediately;
+            # one-sided entries age toward the orphan grace.
+            self._flush_stitch_locked(wall_ts, final=False)
         return window
 
     def _fleet_window_locked(self, targets: List[Target],
@@ -538,6 +568,127 @@ class FleetCollector:
                 record["error_budget_burn"] = round(over_slo / budget, 4)
         return record
 
+    # -- trace stitching --------------------------------------------------
+
+    def _feed_stitch_locked(self, rec: dict) -> None:
+        """Index one tailed record into the pending-stitch table.
+        Only head-sampled serve_traces enter: a slow-forced record
+        (``sampled: false``) has no router_trace counterpart by
+        construction (the router's sampling decision wins fleet-wide),
+        so stitching it would manufacture orphans."""
+        kind = rec.get("kind")
+        if kind == "router_trace":
+            tid = rec.get("trace_id")
+            if isinstance(tid, str) and tid:
+                entry = self._stitch_pending.setdefault(
+                    tid, {"router": None, "replicas": [],
+                          "pass": self._passes})
+                entry["router"] = rec
+        elif kind == "serve_trace":
+            parent = rec.get("parent_trace_id")
+            if isinstance(parent, str) and parent \
+                    and rec.get("sampled") is True:
+                entry = self._stitch_pending.setdefault(
+                    parent, {"router": None, "replicas": [],
+                             "pass": self._passes})
+                entry["replicas"].append(rec)
+
+    def _flush_stitch_locked(self, wall_ts: float, final: bool) -> None:
+        """Emit every pending entry that is complete, expired past the
+        orphan grace, or (``final``) being force-drained at close."""
+        for tid in list(self._stitch_pending):
+            entry = self._stitch_pending[tid]
+            aged = (self._passes - entry["pass"]) >= STITCH_GRACE_PASSES
+            rec = self._stitch_record(tid, entry, force=final or aged)
+            if rec is not None:
+                del self._stitch_pending[tid]
+                self._write_locked(rec, wall_ts)
+
+    def _stitch_record(self, tid: str, entry: dict,
+                       force: bool) -> Optional[dict]:
+        """One ``trace_stitch`` for a pending entry, or None to keep
+        waiting. Complete = router 2xx joined to the winning attempt's
+        serve_trace; router non-2xx is a non-orphan singleton (the
+        router tracer only hands a request to a replica span on
+        successful dispatch); anything one-sided past the grace is an
+        orphan — counted, never dropped."""
+        router = entry["router"]
+        reps = entry["replicas"]
+        if router is None:
+            if not force:
+                return None
+            rec = {"kind": "trace_stitch", "tag": "obs", "trace_id": tid,
+                   "orphan": True, "orphan_side": "router",
+                   "router_spans": 0, "replica_spans": len(reps)}
+            if reps:
+                rec["replica_ms"] = round(
+                    float(reps[0].get("total_ms", 0.0)), 3)
+            return rec
+        spans = router.get("spans") or []
+        status = int(router.get("status", 0))
+        winning = router.get("winning_attempt")
+        base = {
+            "kind": "trace_stitch", "tag": "obs", "trace_id": tid,
+            "orphan": False,
+            "router_spans": len(spans), "replica_spans": len(reps),
+            "status": status,
+            "task": router.get("task"),
+            "attempts": int(router.get("attempts", 0)),
+            "hedges": int(router.get("hedges", 0)),
+            "hedge_wasted_ms": round(
+                float(router.get("hedge_wasted_ms", 0.0)), 3),
+            "client_total_ms": round(float(router.get("total_ms", 0.0)), 3),
+        }
+        if not (200 <= status < 300):
+            # No replica span expected; emit immediately so error bursts
+            # never pool in the pending table.
+            return base
+        win = None
+        if winning is not None:
+            win = next((r for r in reps if r.get("attempt") == winning),
+                       None)
+        elif len(reps) == 1:
+            win = reps[0]
+        if win is None:
+            if not force:
+                return None
+            base["orphan"] = True
+            base["orphan_side"] = "replica"
+            return base
+        wspan = next(
+            (s for s in spans if s.get("name") == "attempt"
+             and s.get("attempt") == win.get("attempt")), None)
+        total = base["client_total_ms"]
+        attempt_ms = float(wspan.get("dur_ms", 0.0)) if wspan else 0.0
+        replica_ms = round(float(win.get("total_ms", 0.0)), 3)
+        # Decomposition with the gap as the RESIDUAL: overhead is the
+        # client total minus the winning attempt's wall time (queueing,
+        # admission, backoff, hedge management), the gap is whatever the
+        # attempt spent outside the replica (network + HTTP framing +
+        # cross-process clock noise) — so the three parts sum to the
+        # client total EXACTLY at record precision, and the schema's
+        # decomposition identity holds by construction.
+        overhead = round(max(0.0, total - attempt_ms), 3)
+        gap = round(total - overhead - replica_ms, 3)
+        base.update({
+            "router_overhead_ms": overhead,
+            "network_gap_ms": gap,
+            "replica_ms": replica_ms,
+            # Slightly negative gaps are unsynchronized-clock noise, not
+            # broken stitching; anything past the epsilon is flagged.
+            "consistent": bool(gap >= -_STITCH_EPS_MS),
+            "winning_attempt": int(win.get("attempt", 1)),
+            "winning_trace_id": win.get("trace_id"),
+        })
+        if win.get("obs_source"):
+            base["winning_source"] = win["obs_source"]
+        rep_spans = win.get("spans") or []
+        if rep_spans:
+            dominant = max(rep_spans,
+                           key=lambda s: float(s.get("dur_ms", 0.0)))
+            base["replica_critical_phase"] = dominant.get("name")
+        return base
+
     def _write_locked(self, rec: dict, ts: float) -> None:
         out = dict(rec)
         out.setdefault("schema", SCHEMA_VERSION)
@@ -578,8 +729,14 @@ class FleetCollector:
         self.close()
 
     def close(self) -> None:
-        """Close the timeline output without another pass."""
+        """Close the timeline output without another pass. Pending trace
+        joins are force-drained first — an entry still waiting for its
+        counterpart becomes an orphan stitch rather than vanishing with
+        the process."""
         with self._lock:
+            if not self._stitch_finalized:
+                self._stitch_finalized = True
+                self._flush_stitch_locked(self._wall(), final=True)
             if self._out_f is not None:
                 self._out_f.close()
                 self._out_f = None
@@ -587,3 +744,107 @@ class FleetCollector:
     def passes(self) -> int:
         with self._lock:
             return self._passes
+
+
+def stitch_tree(records: Sequence[dict], trace_id: str) -> str:
+    """Render one client request's stitched trace as an indented tree
+    (``tools/obs_collect.py --trace <id>``): the router's span taxonomy
+    in dispatch order, each attempt's replica ``serve_trace`` phases
+    nested under the attempt that reached it, and the stitch verdict
+    last. Works on any record iterable — a timeline read back from
+    disk, or the chaos harness's in-memory index."""
+    router = None
+    stitch = None
+    reps_by_id: Dict[str, dict] = {}
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "router_trace" and rec.get("trace_id") == trace_id:
+            router = rec
+        elif kind == "serve_trace" \
+                and rec.get("parent_trace_id") == trace_id:
+            # Dedup by the replica's own trace id (the same record can
+            # reach a merged iterable twice, e.g. sink + timeline); the
+            # copy carrying obs_source attribution wins.
+            key = str(rec.get("trace_id"))
+            if key not in reps_by_id or rec.get("obs_source"):
+                reps_by_id[key] = rec
+        elif kind == "trace_stitch" and rec.get("trace_id") == trace_id:
+            stitch = rec
+    reps = list(reps_by_id.values())
+    if router is None and stitch is None and not reps:
+        return f"trace {trace_id}: not found in timeline"
+
+    lines: List[str] = []
+    by_attempt: Dict[int, List[dict]] = {}
+    for rep in reps:
+        by_attempt.setdefault(int(rep.get("attempt", 1)), []).append(rep)
+
+    def replica_lines(rep: dict, indent: str, span_indent: str) -> None:
+        src = f" ({rep['obs_source']})" if rep.get("obs_source") else ""
+        lines.append(
+            f"{indent}serve_trace {rep.get('trace_id', '?')}{src}"
+            f"  total={rep.get('total_ms', '?')}ms"
+            f"  reason={rep.get('sample_reason', '?')}")
+        for span in rep.get("spans") or []:
+            lines.append(
+                f"{span_indent}{span.get('name', '?'):<12}"
+                f"@{span.get('start_ms', 0)}ms"
+                f"  +{span.get('dur_ms', 0)}ms")
+
+    if router is not None:
+        winning = router.get("winning_attempt")
+        lines.append(
+            f"trace {trace_id}  task={router.get('task', '?')}"
+            f"  status={router.get('status', '?')}"
+            f"  client_total={router.get('total_ms', '?')}ms"
+            f"  attempts={router.get('attempts', '?')}"
+            f"  hedges={router.get('hedges', 0)}")
+        for span in router.get("spans") or []:
+            name = span.get("name", "?")
+            head = (f"├─ router {name:<9}"
+                    f"@{span.get('start_ms', 0)}ms"
+                    f"  +{span.get('dur_ms', 0)}ms")
+            if name == "attempt":
+                att = span.get("attempt")
+                marks = []
+                if span.get("hedge"):
+                    marks.append("hedge")
+                if winning is not None and att == winning:
+                    marks.append("win")
+                mark = f"  [{','.join(marks)}]" if marks else ""
+                head += (f"  #{att} -> {span.get('replica', '?')}"
+                         f"  outcome={span.get('outcome', '?')}{mark}")
+            lines.append(head)
+            if name == "attempt":
+                for rep in by_attempt.get(span.get("attempt"), ()):  # type: ignore[arg-type]
+                    replica_lines(rep, "│    └─ ", "│       ")
+        matched = {s.get("attempt")
+                   for s in router.get("spans") or []
+                   if s.get("name") == "attempt"}
+        strays = [rep for rep in reps
+                  if int(rep.get("attempt", 1)) not in matched]
+    else:
+        lines.append(f"trace {trace_id}  (no router_trace span — orphan)")
+        strays = reps
+    for rep in strays:
+        lines.append(f"├─ unmatched replica attempt "
+                     f"{rep.get('attempt', '?')}")
+        replica_lines(rep, "│    └─ ", "│       ")
+    if stitch is not None:
+        if stitch.get("orphan"):
+            lines.append(
+                f"└─ stitch: ORPHAN ({stitch.get('orphan_side', '?')} "
+                f"side missing)  router_spans="
+                f"{stitch.get('router_spans')}"
+                f"  replica_spans={stitch.get('replica_spans')}")
+        else:
+            lines.append(
+                f"└─ stitch: overhead={stitch.get('router_overhead_ms')}ms"
+                f"  gap={stitch.get('network_gap_ms')}ms"
+                f"  replica={stitch.get('replica_ms')}ms"
+                f"  == client {stitch.get('client_total_ms')}ms"
+                f"  consistent={stitch.get('consistent')}"
+                f"  critical={stitch.get('replica_critical_phase', '-')}")
+    else:
+        lines.append("└─ stitch: (pending — no trace_stitch record yet)")
+    return "\n".join(lines)

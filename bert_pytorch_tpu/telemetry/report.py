@@ -4,7 +4,9 @@ verdict over the JSONL artifacts the telemetry layer writes.
 ``summarize_file`` folds one artifact's records (``step_window``,
 ``compile``, ``sentinel``, ``grad_health``, ``divergence``, ``memory``,
 ``serve_*`` — including the request-tracing ``serve_phase``/
-``serve_trace`` decomposition and its SLO verdict — and
+``serve_trace`` decomposition and its SLO verdict — the cross-tier
+``router_trace``/``trace_stitch`` records with their per-tier latency
+shares and the "router overhead share" / "orphan span share" gates, and
 ``run_summary``) into a flat summary; ``compare`` diffs two summaries
 against relative tolerances and returns named regressions. The CLI
 (`tools/telemetry_report.py`, console entry ``telemetry-report``) prints
@@ -112,6 +114,8 @@ def summarize_records(records, name: str = "") -> dict:
     faults = []
     resumes = []
     router_windows = []
+    router_traces = []
+    trace_stitches = []
     fleet_events = []
     obs_scrapes = []
     obs_windows = []
@@ -152,6 +156,10 @@ def summarize_records(records, name: str = "") -> dict:
             router_windows.append(rec)
         elif kind == "router_summary":
             router_summary = rec
+        elif kind == "router_trace":
+            router_traces.append(rec)
+        elif kind == "trace_stitch":
+            trace_stitches.append(rec)
         elif kind == "fleet_event":
             fleet_events.append(rec)
         elif kind == "obs_scrape":
@@ -458,6 +466,7 @@ def summarize_records(records, name: str = "") -> dict:
                          ("retries", "router_retries"),
                          ("hedges", "router_hedges"),
                          ("hedge_wins", "router_hedge_wins"),
+                         ("hedge_wasted_ms", "router_hedge_wasted_ms"),
                          ("failovers", "router_failovers"),
                          ("latency_p50_ms", "router_latency_p50_ms"),
                          ("latency_p95_ms", "router_latency_p95_ms"),
@@ -474,6 +483,10 @@ def summarize_records(records, name: str = "") -> dict:
                          ("hedge_wins", "router_hedge_wins"),
                          ("failovers", "router_failovers")):
             out[dst] = sum(int(w.get(src, 0)) for w in router_windows)
+        wasted = sum(float(w.get("hedge_wasted_ms", 0.0))
+                     for w in router_windows)
+        if wasted or out.get("router_hedges"):
+            out["router_hedge_wasted_ms"] = round(wasted, 3)
         p50 = _weighted_median(
             [(float(w["latency_p50_ms"]), int(w.get("window_requests", 1)))
              for w in router_windows if "latency_p50_ms" in w])
@@ -484,6 +497,69 @@ def summarize_records(records, name: str = "") -> dict:
             vals = [float(w[key]) for w in router_windows if key in w]
             if vals:
                 out[dst] = round(max(vals), 3)
+    # -- end-to-end trace section (telemetry/collector.py stitching,
+    # docs/observability.md "Trace propagation") ------------------------
+    # trace_stitch records decompose each sampled client request into
+    # router overhead + network gap + replica time. Shares are
+    # aggregate ratios (sum of parts over sum of client totals), NOT
+    # means of per-trace ratios — a 1 ms request with 50% overhead must
+    # not outweigh a 100 ms request with 5%. ``trace_orphans`` counts
+    # the stitches whose other tier never showed up: zero on a healthy
+    # fleet, and any new one is the propagation or the collector
+    # breaking (the "orphan span share" gate).
+    if router_traces:
+        out["router_traces"] = len(router_traces)
+    if trace_stitches:
+        out["trace_stitches"] = len(trace_stitches)
+        orphans = sum(1 for s in trace_stitches if s.get("orphan"))
+        out["trace_orphans"] = orphans
+        out["trace_orphan_share"] = round(
+            orphans / len(trace_stitches), 4)
+        complete = [s for s in trace_stitches
+                    if not s.get("orphan")
+                    and s.get("client_total_ms") is not None
+                    and s.get("router_overhead_ms") is not None
+                    and s.get("replica_ms") is not None]
+        total = sum(float(s["client_total_ms"]) for s in complete)
+        if complete and total > 0:
+            out["trace_router_overhead_share"] = round(
+                sum(float(s["router_overhead_ms"]) for s in complete)
+                / total, 4)
+            out["trace_network_gap_share"] = round(
+                sum(max(0.0, float(s.get("network_gap_ms", 0.0)))
+                    for s in complete) / total, 4)
+            out["trace_replica_share"] = round(
+                sum(float(s["replica_ms"]) for s in complete) / total, 4)
+        inconsistent = sum(1 for s in complete
+                           if s.get("consistent") is False)
+        if inconsistent:
+            out["trace_inconsistent"] = inconsistent
+        # Cross-tier critical path of the slowest decile: which TIER
+        # dominated each of the worst 10% of stitched requests — and
+        # when the replica did, its own dominant phase (carried on the
+        # stitch record) names the hop, so "where do I look first" spans
+        # tiers in one answer.
+        by_total = sorted(complete,
+                          key=lambda s: float(s["client_total_ms"]),
+                          reverse=True)
+        decile = by_total[: max(1, len(by_total) // 10)] if by_total \
+            else []
+        path: dict = {}
+        for s in decile:
+            parts = {
+                "router_overhead": float(s["router_overhead_ms"]),
+                "network_gap": max(0.0,
+                                   float(s.get("network_gap_ms", 0.0))),
+                "replica": float(s["replica_ms"]),
+            }
+            worst = max(parts, key=parts.get)
+            if worst == "replica" and s.get("replica_critical_phase"):
+                worst = f"replica:{s['replica_critical_phase']}"
+            path[worst] = path.get(worst, 0) + 1
+        if path:
+            out["trace_critical_path"] = dict(
+                sorted(path.items(), key=lambda kv: -kv[1]))
+
     # Supervisor history: operational counts by decision type — "how
     # often did something need restarting, and did anything get given up
     # on" is answerable offline from the artifact alone.
@@ -615,6 +691,12 @@ _CHECKS = (
     # hold down even while a replica dies and recovers.
     ("fleet_scrape_staleness_s", "fleet scrape staleness", "up", "p95"),
     ("fleet_worst_replica_p99_ms", "fleet worst-replica p99", "up", "p95"),
+    # End-to-end trace gate (telemetry/collector.py stitching): the
+    # router's share of each stitched request's client-observed total.
+    # It growing means time moved INTO the routing tier — admission
+    # queueing, retry backoff, hedge management — which per-tier p95s
+    # can miss entirely when the replica got faster at the same time.
+    ("trace_router_overhead_share", "router overhead share", "up", "p95"),
 )
 
 
@@ -655,11 +737,17 @@ def compare(base: dict, new: dict, tolerances: Optional[dict] = None):
     # router_errors (exhausted failover: a client saw a 5xx) and
     # fleet_gave_up (a replica crash-looped past the restart budget) are
     # zero in any healthy run, so any new occurrence is a regression.
+    # trace_orphans rides the zero-tolerance loop (not the ratio
+    # checks): a clean baseline has ZERO orphans, which the ratio path
+    # would wave through as "n/a" — while a single new orphan means a
+    # span went missing between tiers, which is exactly the regression
+    # the "orphan span share" gate exists to name.
     for key, label in (("nonfinite_steps", "non-finite steps"),
                        ("divergence_warnings", "divergence warnings"),
                        ("serve_compiles_cold", "serve cold compiles"),
                        ("router_errors", "router client-visible errors"),
-                       ("fleet_gave_up", "fleet replicas given up")):
+                       ("fleet_gave_up", "fleet replicas given up"),
+                       ("trace_orphans", "orphan span share")):
         b, n = int(base.get(key, 0)), int(new.get(key, 0))
         if n > b:
             entry = {"metric": key, "label": label, "base": b, "new": n,
@@ -705,9 +793,14 @@ def format_summary(summary: dict) -> str:
              "serve_slo_over", "serve_slo_budget_burn", "serve_slo_verdict",
              "router_requests", "router_ok", "router_sheds",
              "router_errors", "router_retries", "router_hedges",
-             "router_hedge_wins", "router_failovers",
+             "router_hedge_wins", "router_hedge_wasted_ms",
+             "router_failovers",
              "router_latency_p50_ms", "router_latency_p95_ms",
              "router_failover_p95_ms",
+             "router_traces", "trace_stitches", "trace_orphans",
+             "trace_orphan_share", "trace_inconsistent",
+             "trace_router_overhead_share", "trace_network_gap_share",
+             "trace_replica_share",
              "fleet_events", "fleet_spawns", "fleet_crash_restarts",
              "fleet_wedged_kills", "fleet_gave_up",
              "obs_scrapes", "obs_targets", "obs_scrape_failures",
@@ -729,6 +822,11 @@ def format_summary(summary: dict) -> str:
                      + ", ".join(f"{k}={v}" for k, v
                                  in summary["serve_critical_path"].items())
                      + " (dominant phase, slowest decile)")
+    if summary.get("trace_critical_path"):
+        lines.append(f"  {'trace_critical_path':>22}: "
+                     + ", ".join(f"{k}={v}" for k, v
+                                 in summary["trace_critical_path"].items())
+                     + " (dominant tier, slowest decile)")
     if summary.get("fleet_event_kinds"):
         lines.append(f"  {'fleet_event_kinds':>22}: "
                      + ", ".join(f"{k}={v}" for k, v

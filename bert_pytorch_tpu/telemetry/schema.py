@@ -140,6 +140,24 @@ KIND_REQUIRED_KEYS = {
         "retries", "hedges", "hedge_wins", "failovers",
         "healthy_replicas", "replicas",
     ),
+    # one sampled client request's router-tier span tree
+    # (serve/router.py): admission, per-attempt dispatch (attempt
+    # index, target replica, outcome), backoff waits, hedge
+    # launch/win/loss with loser-latency waste — the cross-tier parent
+    # every replica serve_trace chains to via ``parent_trace_id``
+    # (docs/observability.md "Trace propagation")
+    "router_trace": (
+        "trace_id", "task", "status", "total_ms", "sampled",
+        "attempts", "spans",
+    ),
+    # one stitched end-to-end trace tree (telemetry/collector.py): the
+    # join of a router_trace with the serve_trace records chained to it,
+    # decomposing the client-observed total into router overhead +
+    # network gap + winning-attempt replica time — or an orphan marker
+    # when one side never arrived (counted, never dropped silently)
+    "trace_stitch": (
+        "trace_id", "orphan", "router_spans", "replica_spans",
+    ),
     # -- fleet observatory family (telemetry/collector.py,
     # docs/observability.md) --------------------------------------------
     # one collector probe of one registered endpoint (trainer debug
@@ -163,10 +181,23 @@ OBS_TARGET_KINDS = ("trainer", "replica", "router")
 # loads it by file path).
 TRACE_PHASES = ("queue", "assembly", "execute", "postprocess")
 
+# Router-tier span names (serve/router.py, mirrored here so the schema
+# module stays stdlib-only/jax-free like TRACE_PHASES). Unlike the
+# replica phases, router spans may OVERLAP in time — a hedged race runs
+# two attempt spans concurrently — so the additive sum rule does not
+# apply; each span is individually bounded by the request interval.
+ROUTER_TRACE_SPANS = ("admission", "attempt", "backoff")
+
 # Rounding slack for the serve_trace additive invariants: spans and the
 # total are independently rounded to 3 decimals at emission, so exact <=
 # comparisons would flag sub-microsecond rounding noise as corruption.
 _TRACE_EPS_MS = 0.01
+
+# Rounding slack for the trace_stitch additive identity: the three
+# components are independently rounded to 3 decimals, and the replica
+# total is measured on a different process's clock than the router's
+# attempt span.
+_STITCH_EPS_MS = 0.05
 
 # Serve-kind consistency rules (lintable offline): percentiles must be
 # ordered, and occupancy is a ratio of real work to dispatched budget —
@@ -234,6 +265,10 @@ def validate_record(rec) -> list:
                     _check_fleet_fields(rec, errors)
                 if kind in ("router_window", "router_summary"):
                     _check_router_fields(rec, errors)
+                if kind == "router_trace":
+                    _check_router_trace_fields(rec, errors)
+                if kind == "trace_stitch":
+                    _check_stitch_fields(rec, errors)
                 if kind == "obs_scrape":
                     _check_obs_scrape_fields(rec, errors)
                 if kind == "obs_fleet_window":
@@ -398,6 +433,21 @@ def _check_trace_fields(rec, errors) -> None:
     if reason is not None and reason not in ("head", "slow"):
         errors.append(
             f"sample_reason must be 'head' or 'slow', got {reason!r}")
+    parent = rec.get("parent_trace_id")
+    if parent is not None and (not isinstance(parent, str) or not parent):
+        # The cross-tier chain to the router's router_trace (ISSUE 16):
+        # optional — direct-to-replica traffic has no parent — but the
+        # stitcher joins on it, so a present-but-empty value is
+        # corruption, not data.
+        errors.append(
+            f"parent_trace_id must be a non-empty string, got {parent!r}")
+    attempt = rec.get("attempt")
+    if attempt is not None and (not isinstance(attempt, int)
+                                or isinstance(attempt, bool)
+                                or attempt < 1):
+        errors.append(
+            f"serve_trace 'attempt' must be a positive integer, got "
+            f"{attempt!r}")
     late = rec.get("admitted_late")
     if late is not None and not isinstance(late, bool):
         # The continuous-batching admission marker (serve/service.py
@@ -569,6 +619,20 @@ def _check_router_fields(rec, errors) -> None:
         errors.append(
             f"healthy_replicas ({ints['healthy_replicas']}) exceeds "
             f"replicas ({ints['replicas']})")
+    wasted = rec.get("hedge_wasted_ms")
+    if wasted is not None:
+        # Hedge-loser waste (ISSUE 16): optional — pre-tracing windows
+        # omit it — but non-negative, and zero whenever no hedge fired
+        # (waste with no hedge would mean the counters were folded in
+        # different lock acquisitions, the PR 11 race all over again).
+        if not _is_number(wasted) or wasted < 0:
+            errors.append(
+                f"hedge_wasted_ms must be a non-negative number, got "
+                f"{wasted!r}")
+        elif wasted > 0 and ints.get("hedges") == 0:
+            errors.append(
+                f"hedge_wasted_ms ({wasted}) positive with zero hedges: "
+                "waste is accounted per hedged race")
     for prefix, pcts in (("latency", ("p50", "p95", "p99")),
                          ("failover", ("p50", "p95"))):
         vals = [rec.get(f"{prefix}_{p}_ms") for p in pcts]
@@ -582,6 +646,197 @@ def _check_router_fields(rec, errors) -> None:
             errors.append(
                 f"{prefix} percentiles not ordered "
                 f"({' <= '.join(pcts)}): {present}")
+
+
+def _check_router_trace_fields(rec, errors) -> None:
+    """router_trace consistency (serve/router.py): the router-tier span
+    tree behind the end-to-end stitch. Every span is a sub-interval of
+    the request (spans may overlap — a hedged race runs two attempts
+    concurrently — so there is no additive sum rule), every attempt span
+    names its target replica and outcome, and the ``attempts`` counter
+    must equal the number of attempt spans — the stitcher joins the
+    winning attempt by index and must be able to trust it."""
+    for key in ("trace_id", "task"):
+        v = rec.get(key)
+        if not isinstance(v, str) or not v:
+            errors.append(f"{key} must be a non-empty string, got {v!r}")
+    status = rec.get("status")
+    if not isinstance(status, int) or isinstance(status, bool) or \
+            status < 0:
+        errors.append(
+            f"status must be a non-negative integer, got {status!r}")
+    total = rec.get("total_ms")
+    if not _is_number(total) or total < 0:
+        errors.append(
+            f"total_ms must be a non-negative number, got {total!r}")
+        total = None
+    if not isinstance(rec.get("sampled"), bool):
+        errors.append(
+            f"router_trace 'sampled' must be a boolean, got "
+            f"{rec.get('sampled')!r}")
+    attempts = rec.get("attempts")
+    if not isinstance(attempts, int) or isinstance(attempts, bool) or \
+            attempts < 0:
+        errors.append(
+            f"attempts must be a non-negative integer, got {attempts!r}")
+        attempts = None
+    for key in ("hedges",):
+        v = rec.get(key)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            errors.append(
+                f"{key} must be a non-negative integer, got {v!r}")
+    wasted = rec.get("hedge_wasted_ms")
+    if wasted is not None and (not _is_number(wasted) or wasted < 0):
+        errors.append(
+            f"hedge_wasted_ms must be a non-negative number, got "
+            f"{wasted!r}")
+    winning = rec.get("winning_attempt")
+    if winning is not None:
+        if not isinstance(winning, int) or isinstance(winning, bool) or \
+                winning < 1:
+            errors.append(
+                f"winning_attempt must be a positive integer, got "
+                f"{winning!r}")
+        elif attempts is not None and winning > attempts:
+            errors.append(
+                f"winning_attempt ({winning}) exceeds attempts "
+                f"({attempts})")
+    spans = rec.get("spans")
+    if not isinstance(spans, list) or not spans:
+        errors.append(
+            f"router_trace 'spans' must be a non-empty list, got "
+            f"{spans!r}")
+        return
+    attempt_spans = 0
+    for i, span in enumerate(spans):
+        if not isinstance(span, dict) or not {"name", "start_ms",
+                                              "dur_ms"} <= set(span):
+            errors.append(
+                f"spans[{i}] must be an object with name/start_ms/dur_ms, "
+                f"got {span!r}")
+            continue
+        name = span["name"]
+        if name not in ROUTER_TRACE_SPANS:
+            errors.append(
+                f"spans[{i}].name must be one of {ROUTER_TRACE_SPANS}, "
+                f"got {name!r}")
+        bad_number = False
+        for key in ("start_ms", "dur_ms"):
+            v = span[key]
+            if not _is_number(v) or v < 0:
+                errors.append(
+                    f"spans[{i}].{key} must be a non-negative number, "
+                    f"got {v!r}")
+                bad_number = True
+        if not bad_number and total is not None and \
+                span["start_ms"] + span["dur_ms"] > total + _TRACE_EPS_MS:
+            errors.append(
+                f"spans[{i}] ends past total_ms "
+                f"({span['start_ms']} + {span['dur_ms']} > {total}): "
+                "router spans must be sub-intervals of the request")
+        if name == "attempt":
+            attempt_spans += 1
+            idx = span.get("attempt")
+            if not isinstance(idx, int) or isinstance(idx, bool) or \
+                    idx < 1:
+                errors.append(
+                    f"spans[{i}].attempt must be a positive integer, "
+                    f"got {idx!r}")
+            replica = span.get("replica")
+            if not isinstance(replica, str) or not replica:
+                errors.append(
+                    f"spans[{i}].replica must be a non-empty string, "
+                    f"got {replica!r}")
+            outcome = span.get("outcome")
+            if not isinstance(outcome, str) or not outcome:
+                errors.append(
+                    f"spans[{i}].outcome must be a non-empty string, "
+                    f"got {outcome!r}")
+    if attempts is not None and attempt_spans != attempts:
+        errors.append(
+            f"attempts ({attempts}) must equal the number of attempt "
+            f"spans ({attempt_spans})")
+
+
+def _check_stitch_fields(rec, errors) -> None:
+    """trace_stitch consistency (telemetry/collector.py): the stitched
+    tree's arithmetic must hold — client_total_ms decomposes exactly
+    into router_overhead_ms + network_gap_ms + replica_ms (the
+    acceptance invariant ``client_total >= router_overhead + winning
+    replica span sum`` follows whenever the gap is non-negative, which
+    is what ``consistent`` asserts) — and the orphan marker must be a
+    real boolean consumers can count on: a replica span with no router
+    parent is ALWAYS an orphan, never silently re-labeled."""
+    v = rec.get("trace_id")
+    if not isinstance(v, str) or not v:
+        errors.append(f"trace_id must be a non-empty string, got {v!r}")
+    orphan = rec.get("orphan")
+    if not isinstance(orphan, bool):
+        errors.append(
+            f"trace_stitch 'orphan' must be a boolean, got {orphan!r}")
+        orphan = None
+    counts = {}
+    for key in ("router_spans", "replica_spans"):
+        n = rec.get(key)
+        if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+            errors.append(
+                f"{key} must be a non-negative integer, got {n!r}")
+        else:
+            counts[key] = n
+    if len(counts) == 2:
+        if counts["router_spans"] + counts["replica_spans"] == 0:
+            errors.append(
+                "trace_stitch must join at least one span "
+                "(router_spans + replica_spans >= 1)")
+        if orphan is False and counts["router_spans"] == 0:
+            errors.append(
+                "a stitch with no router_trace parent must be marked "
+                "orphan (replica spans never lose their orphanhood "
+                "silently)")
+    parts = {}
+    for key in ("client_total_ms", "router_overhead_ms", "replica_ms"):
+        v = rec.get(key)
+        if v is not None:
+            if not _is_number(v) or v < 0:
+                errors.append(
+                    f"{key} must be a non-negative number, got {v!r}")
+            else:
+                parts[key] = v
+    gap = rec.get("network_gap_ms")
+    if gap is not None:
+        # The gap alone may be slightly negative (replica and router
+        # measure on different clocks); ``consistent`` flags that.
+        if not _is_number(gap):
+            errors.append(
+                f"network_gap_ms must be a number, got {gap!r}")
+        else:
+            parts["network_gap_ms"] = gap
+    consistent = rec.get("consistent")
+    if consistent is not None and not isinstance(consistent, bool):
+        errors.append(
+            f"trace_stitch 'consistent' must be a boolean, got "
+            f"{consistent!r}")
+    if len(parts) == 4:
+        lhs = parts["router_overhead_ms"] + parts["network_gap_ms"] + \
+            parts["replica_ms"]
+        if abs(lhs - parts["client_total_ms"]) > _STITCH_EPS_MS:
+            errors.append(
+                f"stitch decomposition must sum to client_total_ms "
+                f"({round(lhs, 3)} != {parts['client_total_ms']}): "
+                "router_overhead_ms + network_gap_ms + replica_ms is "
+                "an exact decomposition, not an estimate")
+        if consistent is True and \
+                parts["network_gap_ms"] < -_STITCH_EPS_MS:
+            errors.append(
+                f"consistent stitch requires a non-negative "
+                f"network_gap_ms, got {parts['network_gap_ms']}")
+    winning = rec.get("winning_attempt")
+    if winning is not None and (not isinstance(winning, int)
+                                or isinstance(winning, bool)
+                                or winning < 1):
+        errors.append(
+            f"winning_attempt must be a positive integer, got {winning!r}")
 
 
 def _check_obs_scrape_fields(rec, errors) -> None:

@@ -117,3 +117,26 @@ def test_chaos_serve_fleet_failover_acceptance():
     assert verdict["router"]["failovers"] >= 1
     assert verdict["router"]["failover_p95_ms"] <= 8000.0
     assert verdict["drain"]["rcs"]["0"] == 75  # EXIT_PREEMPTED
+    # End-to-end tracing acceptance (docs/observability.md "Trace
+    # propagation"): every sampled client request stitched into exactly
+    # one trace tree with zero orphans, and the SIGKILL-mid-flight
+    # retried request yields ONE stitched trace whose attempt-1 span
+    # names the killed replica (transport_error) and whose winning
+    # attempt 2+ chains to the surviving replica's serve_trace.
+    trace = verdict["trace"]
+    assert trace["stitches"] == trace["router_traces"]
+    assert trace["orphans"] == 0
+    assert trace["complete"] >= 1
+    fo = verdict["failover_trace"]
+    assert fo["winning_attempt"] >= 2
+    assert fo["attempt_1_replica"] != fo["winning_replica"]
+    assert fo["winning_trace_id"]          # chains to a serve_trace
+    assert fo["winning_source"]            # ... from a named replica sink
+    # Every answered request echoed the router's trace id (satellite-2
+    # correlation contract), and the report gates fired live: doctored
+    # router delay -> rc 1 naming "router overhead share"; clean
+    # self-diff -> rc 0.
+    for phase in ("phase_a", "phase_b", "phase_c"):
+        assert verdict[phase]["traced"] >= verdict[phase]["ok"], \
+            verdict[phase]
+    assert verdict["report_gate"] == {"doctored_rc": 1, "clean_rc": 0}

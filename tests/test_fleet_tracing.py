@@ -1,0 +1,610 @@
+"""End-to-end fleet tracing (ISSUE 16, docs/observability.md "Trace
+propagation"): the router's trace-context minting + ``X-Bert-Trace``
+propagation, its admission/attempt/backoff span taxonomy and hedge-waste
+accounting, the replica tracer's adoption of the router's sampling
+decision, the fleet collector's stitcher (complete trees, orphan grace,
+slow-forced exclusion), the ``trace_stitch`` schema rules, the
+telemetry-report trace section with its two named gates, and the
+``obs_collect.py --trace`` drill-down.
+
+Everything here is in-process and engine-free (the router, collector,
+schema, and report layers are deliberately jax-light); the live
+2-replica SIGKILL acceptance that exercises the same surfaces over real
+HTTP is tools/chaos_serve.py, gated slow in tests/test_fleet_chaos.py.
+The replica HTTP half (header echo + adoption through a real service)
+is tests/test_serve_tracing.py."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from bert_pytorch_tpu.serve import router as router_mod
+from bert_pytorch_tpu.serve.router import Router
+from bert_pytorch_tpu.serve.tracing import (TRACE_HEADER,
+                                            TRACE_ID_RESPONSE_HEADER,
+                                            TraceCollector,
+                                            format_trace_header,
+                                            parse_trace_header)
+from bert_pytorch_tpu.telemetry import report
+from bert_pytorch_tpu.telemetry.collector import (STITCH_GRACE_PASSES,
+                                                  FleetCollector,
+                                                  JsonlTailer, stitch_tree)
+from bert_pytorch_tpu.telemetry.schema import validate_file, validate_record
+from bert_pytorch_tpu.utils.retry import RetryPolicy
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+
+
+def _valid(rec: dict) -> list:
+    """Schema errors for one record (stamped with the envelope the
+    emitters add)."""
+    return validate_record(dict({"schema": 1, "ts": 0.0}, **rec))
+
+
+# ---------------------------------------------------------------------------
+# the wire format: both tiers speak the SAME header
+
+
+def test_trace_header_round_trip_cross_module():
+    """router.py duplicates the wire format on purpose (stdlib-only,
+    dual-loadable by file path); this pins the two copies together."""
+    assert router_mod.TRACE_HEADER == TRACE_HEADER
+    assert router_mod.TRACE_ID_RESPONSE_HEADER == TRACE_ID_RESPONSE_HEADER
+    for attempt, sampled in ((1, True), (3, False)):
+        wire = router_mod.format_trace_header("rt-abc123-7", attempt,
+                                              sampled)
+        assert wire == format_trace_header("rt-abc123-7", attempt, sampled)
+        ctx = parse_trace_header(wire)
+        assert ctx == {"trace_id": "rt-abc123-7", "attempt": attempt,
+                       "sampled": sampled}
+    # Malformed/absent headers parse to None — never an exception on
+    # the request path.
+    for junk in (None, "", ";;;", "id;attempt=x;sampled=2",
+                 ";attempt=1;sampled=1"):
+        assert parse_trace_header(junk) is None
+    # Sampling hashes agree too: the router's fleet-wide decision and a
+    # replica replay of the same sequence must see the SAME coin.
+    from bert_pytorch_tpu.serve.tracing import _sample_hash as serve_hash
+    assert all(router_mod._sample_hash(i) == serve_hash(i)
+               for i in range(64))
+
+
+# ---------------------------------------------------------------------------
+# router tier: minting, propagation, span taxonomy
+
+
+def _healthy_scrape(url):
+    return {"dispatch_alive": True, "draining": False, "queue_depth": 0}
+
+
+def _router(transport, urls=("http://a:1", "http://b:2"), events=None,
+            **kwargs):
+    kwargs.setdefault("retry_policy", RetryPolicy(
+        attempts=3, base_delay_s=0.0, jitter=0.0))
+    kwargs.setdefault("hedge_pctl", 0.0)
+    r = Router(list(urls), emit=events.append if events is not None
+               else None, transport=transport, scrape=_healthy_scrape,
+               sleep=lambda s: None, **kwargs)
+    r.scrape_once()
+    return r
+
+
+def test_router_mints_propagates_and_echoes():
+    seen_headers = []
+
+    def transport(url, task, payload, timeout_s, headers=None):
+        seen_headers.append(dict(headers or {}))
+        return 200, {"ok": True}
+
+    events = []
+    r = _router(transport, events=events, trace_sample_rate=1.0)
+    status, _, headers = r.handle("classify", {"text": "hi"})
+    assert status == 200
+    # Satellite 2: the response echoes the trace id on EVERY request.
+    tid = headers[TRACE_ID_RESPONSE_HEADER]
+    assert tid.startswith("rt-") and len(tid.split("-")) == 3
+    # The attempt carried the full context on the wire.
+    ctx = parse_trace_header(seen_headers[0][TRACE_HEADER])
+    assert ctx == {"trace_id": tid, "attempt": 1, "sampled": True}
+    # Rate 1.0: exactly one schema-clean router_trace for the request.
+    traces = [e for e in events if e["kind"] == "router_trace"]
+    assert len(traces) == 1
+    t = traces[0]
+    assert _valid(t) == []
+    assert t["trace_id"] == tid and t["sampled"] is True
+    assert t["attempts"] == 1 and t["winning_attempt"] == 1
+    names = [s["name"] for s in t["spans"]]
+    assert names == ["admission", "attempt"]
+    att = t["spans"][1]
+    assert att["replica"] == "http://a:1" and att["outcome"] == "final"
+    assert att["status"] == 200 and att["hedge"] is False
+
+    # Rate 0: NOT sampled — the header still rides (sampled=0, so the
+    # replica's local head hash is overridden OFF fleet-wide) and the
+    # echo still lands, but no router_trace is emitted.
+    seen_headers.clear()
+    events2 = []
+    r0 = _router(transport, events=events2, trace_sample_rate=0.0)
+    status, _, headers = r0.handle("classify", {"text": "hi"})
+    assert status == 200 and TRACE_ID_RESPONSE_HEADER in headers
+    ctx = parse_trace_header(seen_headers[0][TRACE_HEADER])
+    assert ctx["sampled"] is False
+    assert not [e for e in events2 if e["kind"] == "router_trace"]
+
+    # Minting is deterministic per sequence: a fresh router at rate 0.5
+    # makes the same decisions for the same sequence numbers (replayed
+    # bursts sample the same requests).
+    a = [Router(["http://a:1"], trace_sample_rate=0.5)._mint_trace()[1]
+         for _ in range(1)] + \
+        [Router(["http://a:1"], trace_sample_rate=0.5)._mint_trace()[1]]
+    assert a[0] == a[1] == (router_mod._sample_hash(0) < 0.5)
+
+
+def test_router_legacy_4arg_transport_still_works():
+    """PR-11 test transports take (url, task, payload, timeout_s);
+    tracing must degrade to not-forwarded, never to a TypeError."""
+    calls = []
+
+    def transport(url, task, payload, timeout_s):
+        calls.append(url)
+        return 200, {"ok": True}
+
+    events = []
+    r = _router(transport, events=events, trace_sample_rate=1.0)
+    status, _, headers = r.handle("classify", {"text": "hi"})
+    assert status == 200 and calls == ["http://a:1"]
+    assert TRACE_ID_RESPONSE_HEADER in headers
+    # The router-side trace is still whole; only the wire hop is lost.
+    (t,) = [e for e in events if e["kind"] == "router_trace"]
+    assert _valid(t) == [] and t["attempts"] == 1
+
+
+def test_router_failover_attempt_spans():
+    """A SIGKILL-shaped failover in miniature: attempt 1 dies in
+    transport, the backoff wait is its own span, attempt 2 wins on the
+    other replica — the exact tree the chaos acceptance asserts on."""
+    def transport(url, task, payload, timeout_s, headers=None):
+        if url == "http://a:1":
+            raise ConnectionRefusedError("replica a is dead")
+        return 200, {"ok": True}
+
+    events = []
+    r = _router(transport, events=events, trace_sample_rate=1.0)
+    status, _, _ = r.handle("classify", {"text": "hi"})
+    assert status == 200
+    (t,) = [e for e in events if e["kind"] == "router_trace"]
+    assert _valid(t) == []
+    assert t["attempts"] == 2 and t["winning_attempt"] == 2
+    atts = [s for s in t["spans"] if s["name"] == "attempt"]
+    assert [a["attempt"] for a in atts] == [1, 2]
+    assert atts[0]["replica"] == "http://a:1"
+    assert atts[0]["outcome"] == "transport_error"
+    assert "status" not in atts[0]      # it never answered
+    assert atts[1]["replica"] == "http://b:2"
+    assert atts[1]["outcome"] == "final" and atts[1]["status"] == 200
+    # The retry wait is visible, not folded into overhead anonymously.
+    assert "backoff" in [s["name"] for s in t["spans"]]
+    # Two admissions (one per round) bracket the attempts.
+    assert [s["name"] for s in t["spans"]].count("admission") == 2
+
+
+def test_router_hedge_waste_accounting():
+    """Satellite 1: a hedged race's losing attempt is wasted work —
+    summed into the trace AND the window in the same _observe lock
+    acquisition as hedge_wins, so a window flush can never land between
+    the two and emit waste with no hedge (the schema forbids it)."""
+    slow_started = threading.Event()
+    release_slow = threading.Event()
+
+    def transport(url, task, payload, timeout_s, headers=None):
+        if url == "http://a:1":
+            slow_started.set()
+            release_slow.wait(timeout=10.0)
+            return 200, {"who": "slow"}
+        return 200, {"who": "hedge"}
+
+    events = []
+    r = _router(transport, events=events, trace_sample_rate=1.0,
+                hedge_pctl=0.5, hedge_min_ms=1.0, hedge_min_samples=4)
+    for _ in range(8):                  # seed the latency history
+        r.note_latency(0.002)
+    try:
+        status, body, _ = r.handle("classify", {"text": "hi"})
+    finally:
+        release_slow.set()
+    assert status == 200 and body == {"who": "hedge"}
+    (t,) = [e for e in events if e["kind"] == "router_trace"]
+    assert _valid(t) == []
+    assert t["hedges"] == 1 and t["hedge_won"] is True
+    assert t["hedge_wasted_ms"] > 0.0
+    atts = {a["replica"]: a for a in t["spans"]
+            if a["name"] == "attempt"}
+    assert atts["http://a:1"]["outcome"] == "lost"
+    assert atts["http://b:2"]["hedge"] is True
+    assert atts["http://b:2"]["outcome"] == "final"
+    # Loser measured at the decision instant: the waste is what the
+    # race cost, not the latency nobody waited for.
+    assert t["hedge_wasted_ms"] == pytest.approx(
+        atts["http://a:1"]["dur_ms"], abs=0.01)
+    win = r.flush_window()
+    assert _valid(win) == []
+    assert win["hedges"] == 1 and win["hedge_wins"] == 1
+    assert win["hedge_wasted_ms"] == pytest.approx(
+        t["hedge_wasted_ms"], abs=0.5)
+    assert "bert_router_hedge_wasted_ms_total" in r.metrics_text()
+
+
+# ---------------------------------------------------------------------------
+# replica tier: the router's sampling decision wins both ways
+
+
+def _phases():
+    return {"queue": 0.002, "assembly": 0.001, "execute": 0.010,
+            "postprocess": 0.001}
+
+
+def test_tracer_adopts_router_decision_both_ways():
+    # Local rate 0, router says SAMPLED: traced, chained to the parent.
+    records = []
+    tc = TraceCollector(emit=records.append, sample_rate=0.0, window=64)
+    rec = tc.observe("classify", 1, _phases(), total_s=0.02,
+                     trace_ctx={"trace_id": "rt-x-1", "attempt": 2,
+                                "sampled": True})
+    assert rec is not None and _valid(rec) == []
+    assert rec["parent_trace_id"] == "rt-x-1" and rec["attempt"] == 2
+    assert rec["sampled"] is True and rec["sample_reason"] == "head"
+    # Local rate 1.0, router says NOT sampled: the router wins that way
+    # too — one fleet-wide coin, not two.
+    tc2 = TraceCollector(emit=records.append, sample_rate=1.0, window=64)
+    assert tc2.observe("classify", 1, _phases(), total_s=0.02,
+                       trace_ctx={"trace_id": "rt-x-2", "attempt": 1,
+                                  "sampled": False}) is None
+    # ...except the always-sample-slow rule, which is LOCAL: an over-SLO
+    # request is exported regardless, marked sampled=false (so the
+    # stitcher knows it has no router counterpart) but still chained.
+    tc3 = TraceCollector(emit=records.append, sample_rate=0.0,
+                         slo_p99_ms=5.0, window=64)
+    slow = tc3.observe("classify", 1, _phases(), total_s=0.5,
+                       trace_ctx={"trace_id": "rt-x-3", "attempt": 1,
+                                  "sampled": False})
+    assert slow is not None and _valid(slow) == []
+    assert slow["sampled"] is False and slow["sample_reason"] == "slow"
+    assert slow["parent_trace_id"] == "rt-x-3"
+
+
+# ---------------------------------------------------------------------------
+# schema: the router_trace / trace_stitch rules
+
+
+def test_schema_rules_for_router_trace_and_stitch():
+    good = {"kind": "router_trace", "tag": "router", "trace_id": "rt-1",
+            "task": "classify", "status": 200, "total_ms": 10.0,
+            "sampled": True, "attempts": 2, "hedges": 1,
+            "hedge_wasted_ms": 4.0, "winning_attempt": 2, "spans": [
+                {"name": "admission", "start_ms": 0.0, "dur_ms": 0.1},
+                # OVERLAPPING attempts: legal (a hedged race) — only the
+                # per-span sub-interval bound applies, not the
+                # serve_trace additive-sum rule.
+                {"name": "attempt", "start_ms": 0.2, "dur_ms": 9.0,
+                 "attempt": 1, "replica": "http://a:1",
+                 "outcome": "lost"},
+                {"name": "attempt", "start_ms": 4.0, "dur_ms": 5.5,
+                 "attempt": 2, "replica": "http://b:2",
+                 "outcome": "final"}]}
+    assert _valid(good) == []
+
+    def err(**over):
+        return " | ".join(_valid(dict(good, **over)))
+
+    assert "spans[1].name must be one of" in err(spans=[
+        good["spans"][0], dict(good["spans"][1], name="retry"),
+        good["spans"][2]], attempts=1)
+    assert "must equal the number of attempt spans" in err(attempts=3)
+    assert "ends past total_ms" in err(total_ms=5.0)
+    assert "winning_attempt (9) exceeds attempts" in err(winning_attempt=9)
+    assert "must be a non-negative number" in err(hedge_wasted_ms=-1.0)
+
+    # Satellite 1's window rule: waste with no hedge fired means the
+    # counters were folded in different lock acquisitions (the PR-11
+    # race all over again) — the schema rejects the record outright.
+    window = {"kind": "router_window", "tag": "router",
+              "window_requests": 8, "ok": 8, "sheds": 0, "errors": 0,
+              "retries": 0, "hedges": 1, "hedge_wins": 1,
+              "hedge_wasted_ms": 3.0, "failovers": 0,
+              "healthy_replicas": 2, "replicas": 2}
+    assert _valid(window) == []
+    assert any("positive with zero hedges" in e
+               for e in _valid(dict(window, hedges=0, hedge_wins=0)))
+
+    stitch = {"kind": "trace_stitch", "tag": "obs", "trace_id": "rt-1",
+              "orphan": False, "router_spans": 3, "replica_spans": 1,
+              "status": 200, "client_total_ms": 10.0,
+              "router_overhead_ms": 4.5, "network_gap_ms": 0.5,
+              "replica_ms": 5.0, "consistent": True,
+              "winning_attempt": 2}
+    assert _valid(stitch) == []
+
+    def serr(**over):
+        return " | ".join(_valid(dict(stitch, **over)))
+
+    assert "must be marked orphan" in serr(router_spans=0)
+    assert "decomposition must sum" in serr(replica_ms=9.0)
+    assert "non-negative network_gap_ms" in serr(
+        network_gap_ms=-5.0, router_overhead_ms=10.0)
+    # Orphans carry no decomposition and that is fine.
+    assert _valid({"kind": "trace_stitch", "tag": "obs",
+                   "trace_id": "rt-2", "orphan": True,
+                   "orphan_side": "router", "router_spans": 0,
+                   "replica_spans": 1}) == []
+
+
+def test_trace_stitch_fixtures_lint():
+    good = os.path.join(HERE, "fixtures", "telemetry",
+                        "trace_stitch_good.jsonl")
+    bad = os.path.join(HERE, "fixtures", "telemetry",
+                       "trace_stitch_bad.jsonl")
+    assert validate_file(good) == []
+    errors = validate_file(bad)
+    assert len(errors) == 9             # one named violation per line
+    text = " | ".join(err for _, err in errors)
+    assert "spans[0].name must be one of" in text
+    assert "attempts (2) must equal the number of attempt spans" in text
+    assert "ends past total_ms" in text
+    assert "winning_attempt (3) exceeds attempts" in text
+    assert "hedge_wasted_ms (3.0) positive with zero hedges" in text
+    assert "must be marked orphan" in text
+    assert "decomposition must sum to client_total_ms" in text
+    assert "non-negative network_gap_ms" in text
+    assert "'attempt' must be a positive integer" in text
+    # And the repo tool agrees end to end (jax-free file-path load).
+    proc = subprocess.run(
+        [sys.executable, "tools/check_telemetry_schema.py", good, bad],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "trace_stitch_good.jsonl: ok" in proc.stdout
+    assert proc.stdout.count("trace_stitch_bad.jsonl:") == 9
+
+
+# ---------------------------------------------------------------------------
+# the stitcher (telemetry/collector.py)
+
+
+def _router_trace(tid, status=200, total=18.4, winning=2):
+    spans = [
+        {"name": "admission", "start_ms": 0.0, "dur_ms": 0.2},
+        {"name": "attempt", "start_ms": 0.3, "dur_ms": 2.1, "attempt": 1,
+         "replica": "http://a:1", "outcome": "transport_error",
+         "hedge": False},
+        {"name": "backoff", "start_ms": 2.5, "dur_ms": 1.0},
+        {"name": "attempt", "start_ms": 3.6, "dur_ms": 14.6, "attempt": 2,
+         "replica": "http://b:2", "outcome": "final", "hedge": False,
+         "status": 200}]
+    rec = {"schema": 1, "ts": 100.0, "kind": "router_trace",
+           "tag": "router", "trace_id": tid, "task": "classify",
+           "status": status, "total_ms": total, "sampled": True,
+           "attempts": 2, "hedges": 0, "hedge_wasted_ms": 0.0,
+           "spans": spans}
+    if winning is not None:
+        rec["winning_attempt"] = winning
+    return rec
+
+
+def _serve_trace(parent, attempt=2, total=12.8, sampled=True,
+                 tid="beefcafe-1"):
+    return {"schema": 1, "ts": 100.1, "kind": "serve_trace",
+            "tag": "serve", "trace_id": tid, "task": "classify",
+            "total_ms": total, "queue_wait_ms": 2.0, "sampled": sampled,
+            "sample_reason": "head" if sampled else "slow",
+            "parent_trace_id": parent, "attempt": attempt,
+            "spans": [
+                {"name": "queue", "start_ms": 0.0, "dur_ms": 2.0},
+                {"name": "assembly", "start_ms": 2.0, "dur_ms": 1.5},
+                {"name": "execute", "start_ms": 3.5, "dur_ms": 8.0},
+                {"name": "postprocess", "start_ms": 11.5, "dur_ms": 1.3}]}
+
+
+class _Sink:
+    """A JSONL file the collector tails, appendable between passes."""
+
+    def __init__(self, tmp_path, name):
+        self.path = str(tmp_path / f"{name}.jsonl")
+        open(self.path, "w").close()
+        self.tailer = JsonlTailer(self.path, name)
+
+    def append(self, rec):
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_stitcher_complete_orphan_grace_and_slow_exclusion(tmp_path):
+    router_sink = _Sink(tmp_path, "router")
+    replica_sink = _Sink(tmp_path, "replica-1")
+    timeline = []
+    coll = FleetCollector([], tails=[router_sink.tailer,
+                                     replica_sink.tailer],
+                          emit=timeline.append)
+
+    def stitches():
+        return [r for r in timeline if r["kind"] == "trace_stitch"]
+
+    # -- complete: both halves land in the same pass -> joined at once.
+    router_sink.append(_router_trace("rt-ok-1"))
+    replica_sink.append(_serve_trace("rt-ok-1"))
+    coll.collect_once()
+    (s,) = stitches()
+    assert _valid(s) == []
+    assert s["trace_id"] == "rt-ok-1" and s["orphan"] is False
+    # Decomposition sums EXACTLY at record precision (the gap is the
+    # residual), and the winning join carries provenance.
+    assert s["router_overhead_ms"] + s["network_gap_ms"] \
+        + s["replica_ms"] == pytest.approx(s["client_total_ms"], abs=1e-9)
+    assert s["router_overhead_ms"] == pytest.approx(18.4 - 14.6)
+    assert s["consistent"] is True and s["winning_attempt"] == 2
+    assert s["winning_trace_id"] == "beefcafe-1"
+    assert s["winning_source"] == "replica-1"
+    assert s["replica_critical_phase"] == "execute"
+
+    # -- a replica span with NO router parent ages through the grace,
+    # then orphans (router side missing). A slow-forced record
+    # (sampled=false) never enters at all.
+    replica_sink.append(_serve_trace("rt-gone-1", tid="beefcafe-2"))
+    replica_sink.append(_serve_trace("rt-slow-1", sampled=False,
+                                     tid="beefcafe-3"))
+    coll.collect_once()
+    assert len(stitches()) == 1          # inside the grace: pending
+    for _ in range(STITCH_GRACE_PASSES):
+        coll.collect_once()
+    orphans = [s for s in stitches() if s.get("orphan")]
+    (o,) = orphans
+    assert _valid(o) == []
+    assert o["trace_id"] == "rt-gone-1" and o["orphan_side"] == "router"
+    assert o["replica_spans"] == 1 and o["router_spans"] == 0
+    assert not any(s["trace_id"] == "rt-slow-1" for s in stitches())
+
+    # -- a router non-2xx is a complete singleton immediately (no
+    # replica span is ever expected for a shed/deadline answer).
+    router_sink.append(_router_trace("rt-shed-1", status=503,
+                                     winning=None))
+    coll.collect_once()
+    (shed,) = [s for s in stitches() if s["trace_id"] == "rt-shed-1"]
+    assert _valid(shed) == []
+    assert shed["orphan"] is False and shed["replica_spans"] == 0
+    assert "router_overhead_ms" not in shed
+
+    # -- a router 2xx whose winning serve_trace never shows up is
+    # force-drained as a REPLICA-side orphan at close, not dropped.
+    router_sink.append(_router_trace("rt-lost-1"))
+    coll.collect_once()
+    coll.close()
+    (lost,) = [s for s in stitches() if s["trace_id"] == "rt-lost-1"]
+    assert _valid(lost) == []
+    assert lost["orphan"] is True and lost["orphan_side"] == "replica"
+    # Close is idempotent about the drain: nothing doubles.
+    coll.close()
+    assert len([s for s in stitches()
+                if s["trace_id"] == "rt-lost-1"]) == 1
+
+
+def test_stitch_tree_rendering():
+    records = [_router_trace("rt-tree-1"),
+               dict(_serve_trace("rt-tree-1"), obs_source="replica-1"),
+               {"kind": "trace_stitch", "trace_id": "rt-tree-1",
+                "orphan": False, "router_spans": 4, "replica_spans": 1,
+                "client_total_ms": 18.4, "router_overhead_ms": 3.8,
+                "network_gap_ms": 1.8, "replica_ms": 12.8,
+                "consistent": True, "replica_critical_phase": "execute"}]
+    tree = stitch_tree(records, "rt-tree-1")
+    assert "trace rt-tree-1" in tree
+    assert "outcome=transport_error" in tree
+    assert "#2 -> http://b:2" in tree and "[win]" in tree
+    # The winning replica's phases nest under its attempt with source
+    # attribution.
+    assert "serve_trace beefcafe-1 (replica-1)" in tree
+    assert "execute" in tree
+    assert "stitch: overhead=3.8ms" in tree
+    assert "consistent=True" in tree
+    # Orphan rendering names the missing side.
+    orphan_tree = stitch_tree(
+        [dict(_serve_trace("rt-tree-2"), obs_source="replica-1"),
+         {"kind": "trace_stitch", "trace_id": "rt-tree-2",
+          "orphan": True, "orphan_side": "router", "router_spans": 0,
+          "replica_spans": 1}], "rt-tree-2")
+    assert "no router_trace span — orphan" in orphan_tree
+    assert "ORPHAN (router side missing)" in orphan_tree
+    assert "not found in timeline" in stitch_tree(records, "rt-nope")
+
+
+def test_obs_collect_trace_drilldown_subprocess(tmp_path):
+    timeline = str(tmp_path / "fleet_timeline.jsonl")
+    with open(timeline, "w") as f:
+        f.write(json.dumps(_router_trace("rt-cli-1")) + "\n")
+        f.write(json.dumps(dict(_serve_trace("rt-cli-1"),
+                                obs_source="replica-1")) + "\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "obs_collect.py"),
+         "--trace", "rt-cli-1", "--out", timeline],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "trace rt-cli-1" in proc.stdout
+    assert "http://b:2" in proc.stdout
+    assert "stitch: (pending" in proc.stdout   # no stitch record yet
+    missing = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                      "obs_collect.py"),
+         "--trace", "rt-nope", "--out", timeline],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert missing.returncode == 1
+    assert "not found in timeline" in missing.stdout
+
+
+# ---------------------------------------------------------------------------
+# telemetry-report: the trace section + the two named gates
+
+
+def _stitch_rec(tid, total=20.0, overhead=2.0, gap=1.0, orphan=False):
+    rec = {"schema": 1, "ts": 0.0, "kind": "trace_stitch", "tag": "obs",
+           "trace_id": tid, "orphan": orphan, "router_spans": 2,
+           "replica_spans": 0 if orphan else 1}
+    if orphan:
+        rec.update({"orphan_side": "replica", "router_spans": 2,
+                    "status": 200, "client_total_ms": total})
+    else:
+        rec.update({"status": 200, "client_total_ms": total,
+                    "router_overhead_ms": overhead, "network_gap_ms": gap,
+                    "replica_ms": round(total - overhead - gap, 3),
+                    "consistent": True, "winning_attempt": 1,
+                    "replica_critical_phase": "execute"})
+    return rec
+
+
+def test_report_trace_section_aggregates_shares():
+    recs = [_router_trace(f"rt-{i}") for i in range(4)]
+    # Aggregate-ratio property: a tiny request with a huge overhead
+    # SHARE must not dominate — the share is sum/sum, not mean-of-ratios.
+    recs += [_stitch_rec("rt-0", total=100.0, overhead=5.0, gap=5.0),
+             _stitch_rec("rt-1", total=1.0, overhead=0.9, gap=0.05),
+             _stitch_rec("rt-2", total=99.0, overhead=4.1, gap=4.95),
+             _stitch_rec("rt-3", orphan=True)]
+    summary = report.summarize_records(recs, name="t")
+    assert summary["router_traces"] == 4
+    assert summary["trace_stitches"] == 4
+    assert summary["trace_orphans"] == 1
+    assert summary["trace_orphan_share"] == pytest.approx(0.25)
+    assert summary["trace_router_overhead_share"] == pytest.approx(
+        10.0 / 200.0)
+    assert summary["trace_replica_share"] == pytest.approx(0.9)
+    assert "trace_critical_path" in summary
+    text = report.format_summary(summary)
+    assert "trace_router_overhead_share" in text
+    assert "dominant tier, slowest decile" in text
+
+
+def test_trace_gates_trip_by_name():
+    base = report.summarize_records(
+        [_stitch_rec(f"rt-{i}", total=20.0, overhead=1.0, gap=0.5)
+         for i in range(8)])
+    # Gate 1 ("router overhead share", ratio check): time moving INTO
+    # the routing tier trips it even when replicas got no slower.
+    bloated = report.summarize_records(
+        [_stitch_rec(f"rt-{i}", total=30.0, overhead=11.0, gap=0.5)
+         for i in range(8)])
+    regressions, _ = report.compare(base, bloated)
+    assert "router overhead share" in [r["label"] for r in regressions]
+    # Gate 2 ("orphan span share", zero-tolerance): a clean baseline has
+    # ZERO orphans (the ratio path would n/a it) — ONE new orphan fires.
+    with_orphan = report.summarize_records(
+        [_stitch_rec(f"rt-{i}", total=20.0, overhead=1.0, gap=0.5)
+         for i in range(7)] + [_stitch_rec("rt-7", orphan=True)])
+    regressions, _ = report.compare(base, with_orphan)
+    assert "orphan span share" in [r["label"] for r in regressions]
+    # Self-compare stays green.
+    regressions, _ = report.compare(base, base)
+    assert regressions == []

@@ -607,6 +607,83 @@ def test_tracing_overhead_guard(engine):
     assert traced <= untraced * 2.5 + 0.02, (traced, untraced)
 
 
+def test_http_trace_context_adoption_and_echo(engine, tmp_path):
+    """The ISSUE-16 replica half of trace propagation over REAL HTTP
+    (docs/observability.md "Trace propagation"): an inbound
+    ``X-Bert-Trace`` header is adopted — the ROUTER'S sampling decision
+    replaces the local head hash, so a replica at rate 0 still traces a
+    sampled=1 request — and the trace id is ECHOED on every response
+    (200s, 400s, context-free requests get no echo), which is what the
+    chaos harness's per-request correlation check rides on."""
+    import http.client
+
+    from bert_pytorch_tpu.serve import make_server
+    from bert_pytorch_tpu.serve.tracing import (TRACE_HEADER,
+                                                TRACE_ID_RESPONSE_HEADER)
+    from bert_pytorch_tpu.utils.logging import JSONLHandler
+
+    def post(port, task, payload, headers=None):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            hdrs = {"Content-Type": "application/json"}
+            hdrs.update(headers or {})
+            conn.request("POST", f"/v1/{task}", json.dumps(payload), hdrs)
+            resp = conn.getresponse()
+            body = resp.read()
+            return resp.status, body, dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    jsonl = str(tmp_path / "ctx_adoption.jsonl")
+    sink = JSONLHandler(jsonl, overwrite=True)
+    # Rate 0, no SLO: left alone, this tracer NEVER emits a trace — every
+    # serve_trace below exists only because the router context said so.
+    tracer = TraceCollector(emit=sink.write_record, sample_rate=0.0,
+                            window=8)
+    service = _serve(engine, sink=sink, tracer=tracer)
+    service.start()
+    server = make_server(service, port=0, request_timeout_s=60.0)
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        # Router-sampled request: traced despite local rate 0, echoed.
+        status, body, headers = post(
+            port, "classify", {"text": "paris is big"},
+            {TRACE_HEADER: "rt-cafe0001-1;attempt=2;sampled=1"})
+        assert status == 200 and json.loads(body)["label"] in CLS_LABELS
+        assert headers.get(TRACE_ID_RESPONSE_HEADER) == "rt-cafe0001-1"
+        # Router said NOT sampled: echoed anyway, but no trace emitted.
+        status, _, headers = post(
+            port, "classify", {"text": "england is old"},
+            {TRACE_HEADER: "rt-cafe0001-2;attempt=1;sampled=0"})
+        assert status == 200
+        assert headers.get(TRACE_ID_RESPONSE_HEADER) == "rt-cafe0001-2"
+        # No context: no echo header at all (nothing to correlate with).
+        status, _, headers = post(port, "classify", {"text": "paris"})
+        assert status == 200
+        assert TRACE_ID_RESPONSE_HEADER not in headers
+        # Error paths echo too — correlation must survive failures.
+        status, _, headers = post(
+            port, "nosuchtask", {"text": "x"},
+            {TRACE_HEADER: "rt-cafe0001-3;attempt=1;sampled=1"})
+        assert status == 404
+        assert headers.get(TRACE_ID_RESPONSE_HEADER) == "rt-cafe0001-3"
+    finally:
+        server.shutdown()
+        service.stop()
+        sink.close()
+    assert validate_file(jsonl) == []
+    traces = [json.loads(line) for line in open(jsonl)
+              if '"serve_trace"' in line]
+    # Exactly ONE: the sampled=1 request. The sampled=0 request obeyed
+    # the router both ways; the context-free one fell back to rate 0.
+    assert len(traces) == 1
+    t = traces[0]
+    assert t["parent_trace_id"] == "rt-cafe0001-1"
+    assert t["attempt"] == 2
+    assert t["sampled"] is True and t["sample_reason"] == "head"
+
+
 def test_serve_heartbeat_is_written_and_resumable(engine, tmp_path):
     from bert_pytorch_tpu.telemetry.sentinels import Heartbeat
 

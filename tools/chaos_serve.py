@@ -38,6 +38,24 @@ failures (every request answers 2xx, except explicit brownout sheds —
 the backoff budget; and every artifact (router/fleet events + each
 replica's serve telemetry) schema-clean.
 
+End-to-end tracing acceptance (docs/observability.md "Trace
+propagation") rides the same run: the router samples EVERY request
+(``trace_sample_rate=1``) while the replicas keep their local head
+sampling at 0 — so every serve_trace that appears proves the router's
+decision won fleet-wide — and every response (including a replica
+probed directly with an unsampled context) must echo
+``X-Bert-Trace-Id``. Post-hoc, a :class:`FleetCollector` stitches the
+router + replica sinks into one timeline and the harness asserts:
+every sampled client request resolves to exactly ONE stitched trace
+tree, zero orphan stitches, every complete stitch's decomposition is
+``consistent`` (client_total >= router overhead + replica time), the
+phase-A failover request's tree shows attempt 1 on the killed replica
+chaining to the surviving replica's serve_trace on attempt 2, and
+``tools/obs_collect.py --trace <id>`` prints that tree. Finally the
+report gates are proven live: a copy of the timeline doctored with a
+router-side delay makes ``telemetry-report`` exit 1 naming "router
+overhead share" while the clean timeline self-diffs green.
+
 Verdict is one JSON line on stdout; exit 0 = every assertion held.
 
 ``--smoke`` is the documented one-command local gate (2 replicas, small
@@ -74,6 +92,8 @@ supervisor_mod = load_by_path(
     "_fleet_supervisor", "bert_pytorch_tpu", "serve", "supervisor.py")
 router_mod = load_by_path(
     "_fleet_router", "bert_pytorch_tpu", "serve", "router.py")
+collector_mod = load_by_path(
+    "_fleet_collector", "bert_pytorch_tpu", "telemetry", "collector.py")
 faults = load_by_path(
     "_fleet_faults", "bert_pytorch_tpu", "testing", "faults.py")
 synth = load_by_path(
@@ -180,19 +200,32 @@ def make_spawn(log_dir: str):
 
 # -- the closed-loop client --------------------------------------------------
 
-def post(url: str, task: str, payload: dict, timeout_s: float):
+def post(url: str, task: str, payload: dict, timeout_s: float,
+         extra_headers: dict = None):
     parsed = urllib.parse.urlsplit(url)
     conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
                                       timeout=timeout_s)
+    headers = {"Content-Type": "application/json"}
+    headers.update(extra_headers or {})
     try:
         conn.request("POST", f"/v1/{task}",
                      body=json.dumps(payload).encode("utf-8"),
-                     headers={"Content-Type": "application/json"})
+                     headers=headers)
         resp = conn.getresponse()
         resp.read()
         return resp.status, dict(resp.getheaders())
     finally:
         conn.close()
+
+
+def header(headers: dict, name: str):
+    """Case-insensitive response-header lookup (http.client preserves
+    whatever case the server sent)."""
+    lower = name.lower()
+    for key, value in headers.items():
+        if key.lower() == lower:
+            return value
+    return None
 
 
 def run_burst(url: str, total: int, workers: int, timeout_s: float,
@@ -231,6 +264,10 @@ def run_burst(url: str, total: int, workers: int, timeout_s: float,
                 outcomes.append({
                     "status": status,
                     "retry_after": headers.get("Retry-After"),
+                    # The router's minted trace id, echoed on EVERY
+                    # response (sampled or not) — the correlation handle
+                    # the post-hoc stitch assertions join on.
+                    "trace_id": header(headers, "X-Bert-Trace-Id"),
                     "latency_s": round(time.monotonic() - t0, 4),
                 })
                 if (mid is not None and not mid_fired[0]
@@ -263,7 +300,20 @@ def classify_outcomes(outcomes: list) -> dict:
         else:
             failures.append(o)
     return {"requests": len(outcomes), "ok": ok, "sheds": shed,
-            "failures": len(failures), "failure_samples": failures[:5]}
+            "failures": len(failures), "failure_samples": failures[:5],
+            "traced": sum(1 for o in outcomes if o.get("trace_id"))}
+
+
+def check_traced(outcomes: list, phase: str) -> None:
+    """Every ANSWERED request — ok or shed, sampled or not — must carry
+    the router's echoed trace id (the correlation contract): the only
+    excusable blanks are transport-level failures that never produced a
+    response at all."""
+    untraced = [o for o in outcomes
+                if o["status"] is not None and not o.get("trace_id")]
+    check(not untraced,
+          f"{phase}: {len(untraced)} answered requests carried no "
+          f"X-Bert-Trace-Id response header: {untraced[:3]}")
 
 
 def wait_until(pred, timeout_s: float, what: str, poll_s: float = 0.25):
@@ -391,7 +441,12 @@ def main(argv=None) -> int:
             attempts=3, base_delay_s=0.05, max_delay_s=0.5,
             full_jitter=True),
         hedge_pctl=0.95, hedge_min_ms=30.0, hedge_min_samples=24,
-        brownout_queue_depth=64, shed_retry_after_s=0.5)
+        brownout_queue_depth=64, shed_retry_after_s=0.5,
+        # Sample EVERYTHING at the router while the replicas keep their
+        # local head rate at 0 (shared_args): every serve_trace that
+        # shows up proves the router's sampling decision won fleet-wide,
+        # and every client request gets a stitchable trace tree.
+        trace_sample_rate=1.0)
     router_server = router_mod.make_router_server(router, port=0)
     router_url = "http://%s:%d" % router_server.server_address[:2]
 
@@ -419,6 +474,22 @@ def main(argv=None) -> int:
         wait_until(lambda: router.healthy_count() == args.replicas,
                    args.warmup_timeout_s,
                    f"all {args.replicas} replicas healthy")
+
+        # Replica-side echo, decoupled from sampling: probe a replica
+        # DIRECTLY with an unsampled trace context. The response must
+        # echo the trace id even though sampled=0 means no serve_trace
+        # will be exported for it — correlation must never depend on
+        # the sampling decision.
+        st, hdrs = post(specs[0].url, "classify",
+                        {"text": PHRASES[0]}, args.client_timeout_s,
+                        extra_headers={
+                            "X-Bert-Trace": "chaos-probe-1;attempt=1;"
+                                            "sampled=0"})
+        check(st == 200, f"direct replica probe failed: {st}")
+        check(header(hdrs, "X-Bert-Trace-Id") == "chaos-probe-1",
+              "replica did not echo X-Bert-Trace-Id for an UNSAMPLED "
+              f"context (got {header(hdrs, 'X-Bert-Trace-Id')!r}): the "
+              "echo must not depend on the sampling decision")
 
         # -- phase A: SIGKILL inside the admission window ----------------
         # Replica 0's armed admit_hold@2x6 emits its injection record
@@ -470,6 +541,7 @@ def main(argv=None) -> int:
               "(is replica 0 running --dispatch_mode pipelined?)")
         check(phase_a["failures"] == 0,
               f"phase A (SIGKILL): client-visible failures: {phase_a}")
+        check_traced(outcomes_a, "phase A")
         wait_until(lambda: healthy(0), args.recover_timeout_s,
                    "killed replica respawned and healthy")
         verdict["phase_a"]["recovery_s"] = round(
@@ -517,6 +589,7 @@ def main(argv=None) -> int:
         verdict["phase_b"] = phase_b
         check(phase_b["failures"] == 0,
               f"phase B (wedge): client-visible failures: {phase_b}")
+        check_traced(outcomes_b, "phase B")
         wait_until(lambda: healthy(wedge_idx), args.recover_timeout_s,
                    "wedged replica respawned and healthy")
 
@@ -547,6 +620,7 @@ def main(argv=None) -> int:
         check(phase_c["failures"] == 0,
               f"phase C (kill-during-drain): client-visible failures: "
               f"{phase_c}")
+        check_traced(outcomes_c, "phase C")
         wait_until(
             lambda: any(r.get("event") == "exit"
                         and r.get("replica") == wedge_idx
@@ -591,6 +665,158 @@ def main(argv=None) -> int:
         for i in range(args.replicas):
             lint(os.path.join(workdir, f"replica_{i}",
                               "serve_telemetry.jsonl"))
+
+        # -- end-to-end trace stitching ---------------------------------
+        # Post-hoc FleetCollector pass over the router's sink + every
+        # replica's serve telemetry: one ordered timeline with one
+        # trace_stitch per sampled client request. Everything is already
+        # on disk, so one pass joins both sides and close() force-drains
+        # anything one-sided into an orphan record.
+        timeline_path = os.path.join(workdir, "fleet_timeline.jsonl")
+        timeline: list = []
+        tails = [collector_mod.JsonlTailer(
+            os.path.join(workdir, "fleet_telemetry.jsonl"), "fleet")]
+        for i in range(args.replicas):
+            tails.append(collector_mod.JsonlTailer(
+                os.path.join(workdir, f"replica_{i}",
+                             "serve_telemetry.jsonl"), f"replica-{i}"))
+        coll = collector_mod.FleetCollector([], tails=tails,
+                                            out_path=timeline_path,
+                                            emit=timeline.append)
+        coll.collect_once()
+        coll.close()
+        lint(timeline_path)
+        router_traces = {r["trace_id"]: r for r in timeline
+                         if r.get("kind") == "router_trace"}
+        stitches = [r for r in timeline
+                    if r.get("kind") == "trace_stitch"]
+        check(router_traces, "router sampled at 1.0 but emitted no "
+                             "router_trace records")
+        stitch_ids = [s["trace_id"] for s in stitches]
+        check(len(stitch_ids) == len(set(stitch_ids)),
+              "a trace id stitched more than once: every sampled client "
+              "request must resolve to exactly ONE stitched tree")
+        check(set(stitch_ids) == set(router_traces),
+              f"stitch/trace mismatch: {len(stitches)} stitches for "
+              f"{len(router_traces)} router traces")
+        orphans = [s for s in stitches if s.get("orphan")]
+        check(not orphans,
+              f"{len(orphans)} orphan stitches on a fully-sampled run "
+              f"(first: {orphans[:2]}): a span went missing between "
+              "tiers")
+        complete = [s for s in stitches
+                    if s.get("router_overhead_ms") is not None]
+        check(complete, "no complete stitch decompositions")
+        bad_decomp = [s for s in complete if not s.get("consistent")]
+        check(not bad_decomp,
+              f"inconsistent stitch decomposition (client_total < "
+              f"router overhead + replica time): {bad_decomp[:2]}")
+        # Every 2xx client outcome's echoed trace id names a stitch.
+        ok_ids = {o["trace_id"]
+                  for o in outcomes_a + outcomes_b + outcomes_c
+                  if o["status"] is not None and 200 <= o["status"] < 300}
+        missing = ok_ids - set(stitch_ids)
+        check(not missing,
+              f"{len(missing)} answered requests never resolved to a "
+              f"stitched tree: {sorted(missing)[:5]}")
+        # The phase-A failover tree: attempt 1 on the SIGKILLed replica
+        # 0, winning attempt 2+ chaining to a surviving replica's
+        # serve_trace.
+        failover_stitch = None
+        for s in complete:
+            if s.get("winning_attempt", 1) < 2:
+                continue
+            rt = router_traces[s["trace_id"]]
+            first = next((sp for sp in rt["spans"]
+                          if sp.get("name") == "attempt"
+                          and sp.get("attempt") == 1), None)
+            if first and first["replica"] == specs[0].url \
+                    and first.get("outcome") == "transport_error":
+                failover_stitch = s
+                break
+        check(failover_stitch is not None,
+              "no stitched trace shows attempt 1 dying on the killed "
+              "replica (transport_error) and failing over to a winning "
+              "attempt 2+")
+        rt = router_traces[failover_stitch["trace_id"]]
+        win_span = next(sp for sp in rt["spans"]
+                        if sp.get("name") == "attempt"
+                        and sp.get("attempt")
+                        == failover_stitch["winning_attempt"])
+        check(win_span["replica"] != specs[0].url,
+              f"winning attempt stayed on the killed replica: {win_span}")
+        check(failover_stitch.get("winning_trace_id"),
+              "failover stitch does not chain to a replica serve_trace")
+        verdict["trace"] = {
+            "router_traces": len(router_traces),
+            "stitches": len(stitches),
+            "orphans": len(orphans),
+            "complete": len(complete),
+        }
+        verdict["failover_trace"] = {
+            "trace_id": failover_stitch["trace_id"],
+            "attempts": failover_stitch.get("attempts"),
+            "winning_attempt": failover_stitch["winning_attempt"],
+            "attempt_1_replica": specs[0].url,
+            "winning_replica": win_span["replica"],
+            "winning_trace_id": failover_stitch["winning_trace_id"],
+            "winning_source": failover_stitch.get("winning_source"),
+        }
+        # The operator drill-down path: obs_collect --trace prints the
+        # stitched tree for the failover request out of the timeline.
+        tree_proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools",
+                                          "obs_collect.py"),
+             "--out", timeline_path,
+             "--trace", failover_stitch["trace_id"]],
+            capture_output=True, text=True)
+        check(tree_proc.returncode == 0,
+              f"obs_collect --trace failed: {tree_proc.stdout}"
+              f"{tree_proc.stderr}")
+        check(specs[0].url in tree_proc.stdout
+              and win_span["replica"] in tree_proc.stdout
+              and "stitch:" in tree_proc.stdout,
+              f"obs_collect --trace tree missing expected spans:\n"
+              f"{tree_proc.stdout}")
+
+        # -- report gates, proven live ----------------------------------
+        # A copy of the timeline doctored with one router-delay-dominated
+        # stitch must make telemetry-report exit 1 naming the gate, while
+        # the clean timeline self-diffs green (the observatory E2E
+        # discipline: the gate is proven to FIRE, not just to exist).
+        doctored_path = timeline_path + ".doctored"
+        shutil.copyfile(timeline_path, doctored_path)
+        with open(doctored_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "schema": schema.SCHEMA_VERSION,
+                "ts": round(time.time(), 3),
+                "kind": "trace_stitch", "tag": "obs",
+                "trace_id": "rt-injected-router-delay", "orphan": False,
+                "router_spans": 2, "replica_spans": 1, "status": 200,
+                "task": "classify", "attempts": 1, "hedges": 0,
+                "hedge_wasted_ms": 0.0,
+                "client_total_ms": 60000.0,
+                "router_overhead_ms": 59900.0,
+                "network_gap_ms": 50.0, "replica_ms": 50.0,
+                "consistent": True, "winning_attempt": 1}) + "\n")
+        report_tool = os.path.join(REPO_ROOT, "tools",
+                                   "telemetry_report.py")
+        bad = subprocess.run(
+            [sys.executable, report_tool, doctored_path, timeline_path],
+            capture_output=True, text=True)
+        check(bad.returncode == 1
+              and "router overhead share" in bad.stdout,
+              f"injected router delay did not trip the 'router overhead "
+              f"share' gate (rc {bad.returncode}):\n{bad.stdout}")
+        clean = subprocess.run(
+            [sys.executable, report_tool, timeline_path, timeline_path],
+            capture_output=True, text=True)
+        check(clean.returncode == 0,
+              f"clean timeline failed its own self-diff (rc "
+              f"{clean.returncode}):\n{clean.stdout}")
+        verdict["report_gate"] = {"doctored_rc": bad.returncode,
+                                  "clean_rc": clean.returncode}
+        os.remove(doctored_path)
 
         verdict.update(ok=True, wall_s=round(time.monotonic() - t_start, 1))
         print(json.dumps(verdict))

@@ -36,8 +36,22 @@ the fleet-timeline JSONLs ``tools/obs_collect.py`` writes and self-
 lints by default) carry theirs: a non-empty target of a known kind
 (trainer/replica/router), a boolean ``ok``, non-negative staleness/
 latency/rate aggregates, and healthy counts bounded by totals. The
-chaos harnesses (tools/chaos_run.py, tools/chaos_serve.py) lint their
-artifacts through this same module.
+cross-tier tracing kinds (docs/observability.md "Trace propagation")
+have the strictest rules of all: a ``router_trace`` must carry a
+non-empty trace id, a span list restricted to the router taxonomy
+(admission/attempt/backoff) where every span fits inside ``total_ms``
+(spans may OVERLAP — hedged attempts race — so the serve_trace
+sum-of-durations rule does NOT apply), every attempt span names its
+1-based attempt index, target replica, and outcome, the ``attempts``
+counter equals the attempt-span count, ``winning_attempt`` is bounded
+by it, and ``hedge_wasted_ms`` needs at least one hedge fired; a
+``trace_stitch`` must mark itself ``orphan`` when it has no router
+parent, and when it carries the full decomposition,
+``router_overhead_ms + network_gap_ms + replica_ms`` must equal
+``client_total_ms`` within epsilon with a ``consistent`` verdict that
+may only be true when the gap is non-negative (minus clock-noise
+epsilon). The chaos harnesses (tools/chaos_run.py,
+tools/chaos_serve.py) lint their artifacts through this same module.
 
 Usage::
 

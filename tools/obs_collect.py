@@ -24,6 +24,10 @@ Usage::
 ``--target`` is ``kind:name=url`` with kind in trainer/replica/router;
 ``--tail`` is ``name=path``. Bounded by ``--duration_s`` or
 ``--passes`` (whichever lands first; Ctrl-C stops cleanly either way).
+``--trace <id>`` skips collecting entirely and prints the stitched
+span tree of one trace id out of an existing timeline (``--out`` names
+the file to read): the router's admission/attempt/backoff spans, each
+attempt's replica phases nested under it, and the stitch verdict.
 The output is schema-linted by default at exit (exit 1 on violations) —
 the collector's own artifact is held to the same bar as everything it
 collects; ``--no-lint`` skips that.
@@ -36,6 +40,7 @@ accelerator processes it watches are hung.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -100,7 +105,35 @@ def main(argv=None) -> int:
                              "fleet error-budget burn exceeds 1")
     parser.add_argument("--no-lint", action="store_true",
                         help="skip schema-linting the timeline at exit")
+    parser.add_argument("--trace", type=str, default=None,
+                        metavar="TRACE_ID",
+                        help="print the stitched span tree of one trace "
+                             "id from the existing --out timeline and "
+                             "exit (no collecting)")
     args = parser.parse_args(argv)
+
+    if args.trace:
+        # Read-only mode: render one stitched trace out of an already
+        # collected timeline (the chaos harness / operator drill-down).
+        if not os.path.exists(args.out):
+            print(f"obs-collect: {args.out}: no such timeline",
+                  file=sys.stderr)
+            return 2
+        records = []
+        with open(args.out, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+        tree = collector_mod.stitch_tree(records, args.trace)
+        print(tree)
+        return 0 if "not found" not in tree.splitlines()[0] else 1
 
     if not args.target and not args.tail:
         parser.error("need at least one --target or --tail")
