@@ -242,6 +242,17 @@ TELEMETRY_BASELINE = os.environ.get(
     "BENCH_TELEMETRY_BASELINE",
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  "BENCH_TELEMETRY.jsonl"))
+# Longitudinal perf ledger (telemetry/ledger.py, docs/telemetry.md "Perf
+# ledger"): every successful capture appends one schema-linted
+# ledger_entry (headline metrics + this config's digest), so the bench
+# trajectory accumulates — the rolling-median drift gate
+# (telemetry-report --ledger / tools/perf_ledger.py check) catches the
+# slow regressions a single hand-picked baseline walks past.
+# BENCH_LEDGER=0 disables; any other value overrides the path.
+LEDGER_PATH = os.environ.get(
+    "BENCH_LEDGER", os.path.join(REPO_ROOT, "PERF_LEDGER.jsonl"))
+if LEDGER_PATH == "0":
+    LEDGER_PATH = ""
 
 
 def _config_digest(degraded=None, local_batch=None):
@@ -1603,6 +1614,64 @@ def _attach_regression(result, offset=0):
     return result
 
 
+def _ledger_leg():
+    """Ledger leg name for the active bench configuration — entries are
+    only comparable within a leg, so each child flavor gets its own."""
+    if SERVE_SATURATION:
+        return "serve_saturation"
+    if SERVE:
+        return "serve"
+    if KERNELS:
+        return "kernels"
+    if ASYNC:
+        return "async"
+    if DEGRADED:
+        return "train_degraded"
+    return "train"
+
+
+def _append_ledger(result):
+    """Append this capture's headline metrics to the perf ledger
+    (advisory like the regression gate: a ledger failure must never
+    break the bench result line). The ledger module is stdlib-only and
+    loaded by file path — the parent stays jax-free."""
+    if not LEDGER_PATH or result.get("error"):
+        return
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "_bench_ledger",
+            os.path.join(REPO_ROOT, "bert_pytorch_tpu", "telemetry",
+                         "ledger.py"))
+        ledger = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(ledger)
+        metrics = {}
+        for src, dst, scale in (
+                ("mfu", "mfu", 1.0),
+                ("latency_p50_ms", "serve_p50_ms", 1.0),
+                ("latency_p99_ms", "serve_p99_ms", 1.0),
+                ("cold_start_s", "cold_start_s", 1.0),
+                ("padding_efficiency", "padding_efficiency", 1.0),
+                # Direction-less extras: recorded for the trajectory
+                # (perf_ledger.py show), not gated by the drift check.
+                ("value", "headline", 1.0),
+                ("seq_per_sec_per_chip", "seq_per_sec_per_chip", 1.0)):
+            v = result.get(src)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                metrics[dst] = float(v) * scale
+        rec = ledger.append_entry(
+            LEDGER_PATH, _ledger_leg(), metrics,
+            digest=_config_digest(),
+            extra={"metric": result.get("metric")})
+        if rec is not None:
+            print(f"perf ledger: appended {rec['leg']} "
+                  f"[{rec['config_digest']}] to {LEDGER_PATH}",
+                  file=sys.stderr)
+    except Exception as exc:
+        print(f"perf ledger append failed: {exc}", file=sys.stderr)
+
+
 _PROBE_SRC = ("import jax; ds = jax.devices(); "
               "print('BENCH_PROBE_OK', len(ds), ds[0].device_kind)")
 
@@ -1756,7 +1825,9 @@ def main():
             if not ok:
                 result.setdefault(
                     "child_exit", "non-zero after printing result")
-            print(json.dumps(_attach_regression(result, tele_offset)))
+            result = _attach_regression(result, tele_offset)
+            _append_ledger(result)
+            print(json.dumps(result))
             return
         last_err = f"bench child failed (attempt {attempt}): {out[-400:]}"
         print(last_err, file=sys.stderr)
@@ -1789,7 +1860,9 @@ def main():
                 if not ok:
                     result.setdefault(
                         "child_exit", "non-zero after printing result")
-                print(json.dumps(_attach_regression(result, tele_offset)))
+                result = _attach_regression(result, tele_offset)
+                _append_ledger(result)
+                print(json.dumps(result))
                 return
             last_err = (f"degraded fallback also failed: {out[-300:]}; "
                         f"after: {last_err}")

@@ -117,6 +117,11 @@ REGISTRY: Tuple[Entry, ...] = (
           why="emit() notes records into the ring from every emitting "
               "thread; the recorder locks itself, the binding must not "
               "move"),
+    Entry("bert_pytorch_tpu/telemetry/runner.py", "capture",
+          cls="TrainTelemetry", kind="frozen",
+          why="debug-plane HTTP threads arm it while the train loop "
+              "ticks it every step boundary; the controller locks its "
+              "own state, the binding must not move"),
 
     # -- telemetry/introspect.py: train loop vs debug-plane HTTP threads ---
     # The hub's single state dict is the debug plane's ONLY shared
@@ -127,6 +132,54 @@ REGISTRY: Tuple[Entry, ...] = (
           cls="IntrospectionHub", kind="lock", locks=("_lock",),
           why="train loop + background emitters write the live snapshot "
               "while debug-server HTTP threads render it"),
+    Entry("bert_pytorch_tpu/telemetry/introspect.py", "capture",
+          cls="IntrospectionHub", kind="frozen",
+          why="attached once at wiring (TrainTelemetry.__init__, before "
+              "the debug server starts) and then read by /profilez and "
+              "/statsz HTTP threads; the controller locks its own state"),
+
+    # -- telemetry/sampler.py: the host sampler + capture controller -------
+    # The sampler's tallies are written by its own daemon thread per tick
+    # and folded by result() after stop() joins — but stop() may race one
+    # final in-flight tick, so every touch locks; _sample_once_locked
+    # runs with the lock held (the suffix contract). The controller's
+    # phase dict is the arm/disarm handshake: any HTTP worker arms it
+    # while the owning boundary loop (train step / serve dispatch) ticks
+    # it — only the phase state is shared, the trace begin/end and the
+    # sampler lifecycle are serialized by boundary-loop ownership.
+    Entry("bert_pytorch_tpu/telemetry/sampler.py", "_samples",
+          cls="ThreadSampler", kind="lock", locks=("_lock",),
+          allow=("_sample_once_locked",),
+          why="tick counter bumped by the sampler thread, read by "
+              "result() and the _run bound check"),
+    Entry("bert_pytorch_tpu/telemetry/sampler.py", "_counts",
+          cls="ThreadSampler", kind="lock", locks=("_lock",),
+          allow=("_sample_once_locked",),
+          why="self-time tallies written per tick, folded by result()"),
+    Entry("bert_pytorch_tpu/telemetry/sampler.py", "_stacks",
+          cls="ThreadSampler", kind="lock", locks=("_lock",),
+          allow=("_sample_once_locked",),
+          why="collapsed-stack exemplars written with the tallies"),
+    Entry("bert_pytorch_tpu/telemetry/sampler.py", "_state",
+          cls="CaptureController", kind="lock", locks=("_lock",),
+          why="arm() (any HTTP worker thread) flips idle->armed while "
+              "tick() (the owning boundary loop) advances armed->active"
+              "->idle and status() snapshots it from /statsz threads"),
+    Entry("bert_pytorch_tpu/telemetry/sampler.py", "_sampler",
+          cls="CaptureController", kind="lock", locks=("_lock",),
+          why="active-phase sampler handle published by tick() and "
+              "cleared on collect; shared so status/teardown paths "
+              "never see a half-built sampler"),
+
+    # -- telemetry/profiler.py: the process-wide trace latch ---------------
+    # jax.profiler traces are a process-wide singleton: one latch under
+    # one lock is the whole discipline — begin() refuses (returns False)
+    # instead of stacking a second start_trace, whichever plane asks.
+    Entry("bert_pytorch_tpu/telemetry/profiler.py", "_TRACE_ACTIVE",
+          kind="lock", locks=("_TRACE_LOCK",),
+          why="startup windows and on-demand captures (train loop, serve "
+              "dispatch loop) race for the one process-wide "
+              "jax.profiler trace; the latch decides who wins"),
 
     # -- telemetry/flightrec.py: every emitting thread vs flush paths ------
     # The ring (and its accounting) is written by the train loop /
@@ -330,6 +383,12 @@ REGISTRY: Tuple[Entry, ...] = (
           why="beaten by the dispatch loop while stop()/start() run on "
               "other threads; the binding must never change after "
               "__init__ (beats are serialized by the thread lifecycle)"),
+    Entry("bert_pytorch_tpu/serve/service.py", "capture",
+          cls="ServingService", kind="frozen",
+          why="armed by /profilez HTTP workers while the dispatch/"
+              "completion loop ticks it at the same boundary the "
+              "heartbeat rides; the controller locks its own state, the "
+              "binding must not move"),
 
     # -- serve/stats.py: dispatch thread vs /statsz scrapes ----------------
     Entry("bert_pytorch_tpu/serve/stats.py", "total_requests",
@@ -383,6 +442,12 @@ REGISTRY: Tuple[Entry, ...] = (
           why="trace-id sequence bumped by every concurrent request "
               "thread in _mint_trace; a duplicate id would stitch two "
               "requests into one tree"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_heartbeat",
+          cls="Router", kind="frozen",
+          why="beaten from the scrape thread (plus one final flush in "
+              "stop() after that thread is joined); Heartbeat.beat is "
+              "single-owner, so safety rests on the binding never "
+              "moving"),
 
     # -- serve/supervisor.py: monitor thread vs control-plane callers ------
     # The replica table (and every _Replica field reached through it) is

@@ -23,7 +23,16 @@ Routes:
 * ``GET  /statsz``     — the live ServeTelemetry rollup (requests,
   latency percentiles, batch occupancy, compile count; with tracing
   enabled, the ``phases`` sub-object carries the run-level queue-wait
-  share and per-phase p95s);
+  share and per-phase p95s; with a capture controller attached, the
+  ``profile`` sub-object carries the live capture phase / last window);
+* ``POST /profilez``   — arm an on-demand profiling capture
+  (docs/observability.md "Profiling plane"): the dispatch plane starts
+  a bounded host-thread-sampler + ``jax.profiler`` window at the next
+  boundary and emits a ``profile_window`` record when it expires. JSON
+  body (all optional): ``duration_s``, ``sample_interval_s``,
+  ``max_samples``, ``top_k``, ``trigger``. 200 with the armed
+  parameters, 409 while a capture is already armed or active (traces
+  cannot nest), 404 when the service was built without a controller;
 * ``GET  /metricsz``   — Prometheus text exposition (serve/tracing.py):
   per-task request/error/over-SLO counters, per-(task, phase) latency
   histograms, queue depth / occupancy / cold-start gauges — the scrape
@@ -47,6 +56,11 @@ MAX_BODY_BYTES = 1 << 20  # 1 MiB: plenty for text payloads, bounds abuse
 
 class ServeHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
+    # socketserver's default listen backlog is 5 — a concurrent connect
+    # burst (the router fanning out, a probe storm) overflows it and the
+    # kernel RSTs the excess mid-handshake, surfacing as client-side
+    # ConnectionResetError before the service ever sees the request.
+    request_queue_size = 128
     # The service rides on the server object so handler instances (one per
     # request) can reach it without globals.
     service: ServingService = None
@@ -88,7 +102,10 @@ def _make_handler():
                 self._reply(200 if health["status"] == "ok" else 503,
                             health)
             elif self.path == "/statsz":
-                self._reply(200, service.telemetry.snapshot())
+                snap = service.telemetry.snapshot()
+                if service.capture is not None:
+                    snap["profile"] = service.capture.status()
+                self._reply(200, snap)
             elif self.path == "/metricsz":
                 text = service.metrics_text()
                 if text is None:
@@ -114,6 +131,9 @@ def _make_handler():
             ctx = parse_trace_header(self.headers.get(TRACE_HEADER))
             echo = ({TRACE_ID_RESPONSE_HEADER: ctx["trace_id"]}
                     if ctx else None)
+            if self.path.rstrip("/") == "/profilez":
+                self._profilez(service, echo)
+                return
             if not self.path.startswith("/v1/"):
                 self._reply(404, {"error": f"no route {self.path}"}, echo)
                 return
@@ -148,6 +168,38 @@ def _make_handler():
                             echo)
             else:
                 self._reply(200, result, echo)
+
+        def _profilez(self, service, echo) -> None:
+            """Arm an on-demand capture. 409 — not a second start — when
+            one is already armed/active: ``jax.profiler`` traces cannot
+            nest, and the controller's refusal is what keeps two POSTs
+            from stacking two ``start_trace`` calls."""
+            if service.capture is None:
+                self._reply(404, {
+                    "error": "profiling disabled: the service has no "
+                             "capture controller"}, echo)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if length > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "payload too large"}, echo)
+                    return
+                body = json.loads(
+                    self.rfile.read(length).decode("utf-8") or "{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except ValueError as exc:
+                self._reply(400, {"error": f"bad JSON payload: {exc}"},
+                            echo)
+                return
+            kwargs = {k: body[k] for k in (
+                "duration_s", "sample_interval_s", "max_samples",
+                "top_k", "trigger") if k in body}
+            ok, payload = service.capture.arm(**kwargs)
+            # Busy (the payload names the blocking phase) is 409; a
+            # refused parameter is the caller's fault, 400.
+            code = 200 if ok else (409 if "phase" in payload else 400)
+            self._reply(code, payload, echo)
 
     return Handler
 

@@ -82,6 +82,31 @@ def _load_util(modname: str):
 
 RetryPolicy = _load_util("retry").RetryPolicy
 
+
+def _load_telemetry(modname: str):
+    """Same dual-load trick for telemetry modules (all stdlib-only):
+    Heartbeat lives in telemetry/sentinels.py, and the router writes the
+    SAME resumable liveness file the training loop and serve replicas
+    write, so one watchdog contract covers every tier."""
+    if __package__:
+        import importlib
+
+        return importlib.import_module(
+            f"bert_pytorch_tpu.telemetry.{modname}")
+    import importlib.util
+
+    name = f"_router_tel_{modname}"
+    module = sys.modules.get(name)
+    if module is not None:
+        return module
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "telemetry", f"{modname}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
 # Statuses worth trying on another replica: server-side trouble that is
 # plausibly replica-local (a draining or saturated or crashed replica).
 # Everything else — 2xx, 4xx — is final: the answer would be the same
@@ -289,6 +314,8 @@ class Router:
         brownout_queue_depth: int = 128,
         shed_retry_after_s: float = 1.0,
         trace_sample_rate: float = 0.0,
+        heartbeat_file: Optional[str] = None,
+        heartbeat_interval_s: float = 1.0,
         clock: Callable[[], float] = time.monotonic,
         sleep: Callable[[float], None] = time.sleep,
     ):
@@ -338,6 +365,18 @@ class Router:
         self._run = self._zero_window()
         self._stop_event = threading.Event()
         self._scrape_thread: Optional[threading.Thread] = None
+        # Router heartbeat: the same resumable liveness file the trainer
+        # and replicas write (telemetry/sentinels.py), step = routed
+        # requests — counter resumes across restarts, so "did the router
+        # route anything lately" is one file read for any watchdog.
+        # Beaten only from the scrape thread (Heartbeat.beat is
+        # single-owner by design) plus one final flush in stop() after
+        # that thread is joined; the binding itself is frozen
+        # (concurrency registry, analysis/concurrency.py).
+        self._heartbeat = (
+            _load_telemetry("sentinels").Heartbeat(heartbeat_file)
+            if heartbeat_file else None)
+        self._heartbeat_interval_s = float(heartbeat_interval_s)
 
     @staticmethod
     def _zero_window() -> dict:
@@ -371,9 +410,27 @@ class Router:
         self._scrape_thread.start()
 
     def _scrape_loop(self) -> None:
+        # last_beat stays a local: beat cadence state is owned by this
+        # thread alone (same discipline as serve/service.py's loops).
+        last_beat = 0.0
         while not self._stop_event.is_set():
             self.scrape_once()
+            last_beat = self._maybe_beat(last_beat)
             self._sleep(self.scrape_interval_s)
+
+    def _maybe_beat(self, last_beat: float) -> float:
+        """Beat the liveness file with step = routed requests; called
+        only from the scrape thread (and once from stop() after that
+        thread is joined — ownership passes to the stopping thread)."""
+        if self._heartbeat is None:
+            return last_beat
+        now = self._clock()
+        if now - last_beat < self._heartbeat_interval_s:
+            return last_beat
+        with self._lock:
+            routed = self._run["requests"]
+        self._heartbeat.beat(routed)
+        return now
 
     def scrape_once(self) -> None:
         """One health pass over every replica (public so tests and the
@@ -951,6 +1008,13 @@ class Router:
         if self._scrape_thread is not None:
             self._scrape_thread.join(timeout=5.0)
             self._scrape_thread = None
+        if self._heartbeat is not None:
+            # Final flush so the file records the closing request count;
+            # the scrape thread is joined, so this thread is the sole
+            # owner of the beat now.
+            with self._lock:
+                routed = self._run["requests"]
+            self._heartbeat.beat(routed)
         self.flush_window()
         with self._lock:
             routed_any = self._run["requests"] > 0
@@ -970,6 +1034,9 @@ MAX_BODY_BYTES = 1 << 20
 
 class RouterHTTPServer(http.server.ThreadingHTTPServer):
     daemon_threads = True
+    # The fleet front door: a client connect burst overflows the stdlib
+    # listen backlog of 5 and the kernel RSTs the excess mid-handshake.
+    request_queue_size = 128
     router: Router = None
 
 

@@ -101,6 +101,7 @@ class ServingService:
         tracer: Optional[TraceCollector] = None,
         heartbeat=None,
         heartbeat_interval_s: float = 1.0,
+        capture=None,
         clock: Callable[[], float] = time.monotonic,
         dispatch_mode: str = "pipelined",
     ):
@@ -115,7 +116,11 @@ class ServingService:
         completion stage in pipelined mode — the thread whose progress
         means clients are getting answers) — the same resumable liveness
         file the training runners write, so the capture harness covers
-        serving processes too. ``dispatch_mode`` selects the pipelined
+        serving processes too. ``capture`` is an optional
+        :class:`~bert_pytorch_tpu.telemetry.sampler.CaptureController`
+        (``POST /profilez`` arms it via serve/http.py; the dispatch
+        plane ticks it at the same boundary the heartbeat rides, with
+        position = requests served). ``dispatch_mode`` selects the pipelined
         continuous-batching plane (default) or the serial
         flush-then-wait loop (module docstring)."""
         if dispatch_mode not in DISPATCH_MODES:
@@ -133,6 +138,9 @@ class ServingService:
             self.telemetry.attach_tracer(tracer)
         self._heartbeat = heartbeat
         self._heartbeat_interval_s = float(heartbeat_interval_s)
+        # Frozen binding (concurrency registry): HTTP workers arm it,
+        # the dispatch plane ticks it; the controller locks itself.
+        self.capture = capture
         self._clock = clock
         # Guards _threads, _draining, _forming, and _stage_inflight (the
         # concurrency registry, analysis/concurrency.py, enforced by
@@ -367,6 +375,7 @@ class ServingService:
                     self.telemetry.request_count(),
                     emit=self.telemetry.emit)
             last_beat = self._maybe_beat(last_beat)
+            self._capture_tick()
 
     # -- pipelined dispatch: assembler / executor / completion -----------
 
@@ -557,6 +566,7 @@ class ServingService:
                 if self._stop.is_set():
                     return
                 last_beat = self._maybe_beat(last_beat)
+                self._capture_tick()
                 continue
             self._note_stage_inflight("completion", done)
             self._complete(done)
@@ -565,6 +575,7 @@ class ServingService:
                 self.telemetry.request_count(),
                 emit=self.telemetry.emit)
             last_beat = self._maybe_beat(last_beat)
+            self._capture_tick()
 
     def _complete(self, done: _Executed) -> None:
         """Finish one executed batch: demux, postprocess, fulfil,
@@ -674,6 +685,15 @@ class ServingService:
                 exec_gap_s=done.gap_s,
             )
         self.batcher.done(len(plan.requests))
+
+    def _capture_tick(self) -> None:
+        """On-demand capture boundary (telemetry/sampler.py): starts an
+        armed capture, collects an expired one. Rides the same
+        single-owner position as the heartbeat — the serial dispatch
+        thread, or the completion stage in pipelined mode — with
+        position = requests served (``covered_unit: "requests"``)."""
+        if self.capture is not None:
+            self.capture.tick(self.telemetry.request_count())
 
     def _maybe_beat(self, last_beat: float) -> float:
         if self._heartbeat is None:

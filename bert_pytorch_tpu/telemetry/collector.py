@@ -33,6 +33,13 @@ The :class:`FleetCollector` owns that join:
   exported phase-latency histogram — the "fleet worst-replica p99"
   gate), trainer step rate, max staleness, and the fleet error-budget
   burn (over-SLO counts against the configured budget);
+* **coordinated capture** — :meth:`FleetCollector.trigger_profile`
+  POSTs ``/profilez`` to every trainer/replica target concurrently
+  (fired together, so the bounded capture windows ALIGN across the
+  fleet) and records one trigger ``obs_scrape`` (``probe:
+  "profilez"``) per target; the resulting ``profile_window`` records
+  land in each process's sink and are tailed into the same timeline
+  (``tools/obs_collect.py --profile``);
 * **trace stitching** — tailed ``router_trace`` and ``serve_trace``
   records that share a trace id (the ``X-Bert-Trace`` propagation,
   docs/observability.md "Trace propagation") are joined into one
@@ -108,6 +115,22 @@ def _http_get(url: str, path: str, timeout_s: float) -> Tuple[int, str]:
                                       timeout=max(0.05, timeout_s))
     try:
         conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def _http_post_json(url: str, path: str, body: dict,
+                    timeout_s: float) -> Tuple[int, str]:
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=max(0.05, timeout_s))
+    try:
+        data = json.dumps(body).encode("utf-8")
+        conn.request("POST", path, body=data,
+                     headers={"Content-Type": "application/json",
+                              "Content-Length": str(len(data))})
         resp = conn.getresponse()
         return resp.status, resp.read().decode("utf-8", "replace")
     finally:
@@ -567,6 +590,82 @@ class FleetCollector:
             if budget > 0:
                 record["error_budget_burn"] = round(over_slo / budget, 4)
         return record
+
+    # -- coordinated capture ----------------------------------------------
+
+    def trigger_profile(self, duration_s: float = 2.0,
+                        params: Optional[dict] = None,
+                        post: Optional[Callable] = None) -> List[dict]:
+        """One ALIGNED fleet-wide capture: POST ``/profilez`` to every
+        trainer/replica target concurrently (one bounded thread per
+        target, all fired together — alignment is the point: the
+        windows cover the same wall-clock slice, so the timeline shows
+        the fleet under the same load). Routers have no capture plane
+        and are skipped. Returns (and writes to the timeline) one
+        trigger ``obs_scrape`` record per target, ``probe:
+        "profilez"``; the captures themselves land as
+        ``profile_window`` records in each process's sink and reach
+        the timeline through the normal tailers. ``post`` is
+        injectable for deterministic tests: ``(url, path, body,
+        timeout_s) -> (status, text)``."""
+        body = dict(params or {})
+        body["duration_s"] = float(duration_s)
+        body.setdefault("trigger", "fleet")
+        do_post = post or _http_post_json
+        with self._lock:
+            targets = [t for t in self._targets
+                       if t.kind in ("trainer", "replica")]
+            results: list = [None] * len(targets)
+            costs: list = [0.0] * len(targets)
+
+            def probe(i: int, target: Target) -> None:
+                t0 = self._clock()
+                try:
+                    status, text = do_post(target.url, "/profilez", body,
+                                           target.timeout_s)
+                    try:
+                        payload = json.loads(text)
+                    except ValueError:
+                        payload = {}
+                    results[i] = (status,
+                                  payload if isinstance(payload, dict)
+                                  else {})
+                except Exception:
+                    results[i] = None
+                finally:
+                    costs[i] = self._clock() - t0
+
+            threads = [threading.Thread(target=probe, args=(i, t),
+                                        name="obs-profile-trigger",
+                                        daemon=True)
+                       for i, t in enumerate(targets)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall_ts = self._wall()
+            out: List[dict] = []
+            for idx, (target, res) in enumerate(zip(targets, results)):
+                rec = {
+                    "kind": "obs_scrape", "tag": "obs",
+                    "target": target.name, "target_kind": target.kind,
+                    "url": target.url, "probe": "profilez",
+                    "ok": res is not None and res[0] == 200,
+                    "staleness_s": 0.0,
+                    "scrape_ms": round(costs[idx] * 1000.0, 3),
+                }
+                if res is not None:
+                    status, payload = res
+                    rec["status"] = status
+                    if payload.get("error"):
+                        rec["error"] = str(payload["error"])
+                    elif payload.get("armed"):
+                        rec["armed_duration_s"] = payload.get("duration_s")
+                else:
+                    rec["error"] = "unreachable"
+                out.append(rec)
+                self._write_locked(rec, wall_ts)
+        return out
 
     # -- trace stitching --------------------------------------------------
 

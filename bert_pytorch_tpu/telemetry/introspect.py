@@ -22,7 +22,12 @@ and replicas with one format:
   the last step_window record is exported as
   ``bert_train_window_<field>`` VERBATIM (rendered with ``repr`` so the
   float round-trips), which is what makes "the scrape agrees with the
-  JSONL artifact per metric name" a testable property, not a hope.
+  JSONL artifact per metric name" a testable property, not a hope;
+* ``POST /profilez`` — arm a bounded on-demand capture at the next step
+  boundary (telemetry/sampler.py; docs/observability.md "Profiling
+  plane"): 200 with the armed parameters, 409 while a capture is
+  already armed or active (jax traces cannot nest), 404 when the
+  runner attached no capture controller.
 
 The :class:`IntrospectionHub` is the shared state: ``TrainTelemetry``
 tees every emitted record into :meth:`observe_record` and notes step
@@ -71,6 +76,11 @@ class IntrospectionHub:
         self.process = str(process)
         self.stale_after_s = float(stale_after_s)
         self._clock = clock
+        # On-demand capture controller (telemetry/sampler.py), attached
+        # once by TrainTelemetry before the debug server starts; None
+        # keeps /profilez answering 404. Frozen binding (concurrency
+        # registry): the controller locks itself.
+        self.capture = None
         self._lock = threading.Lock()
         # The ONE shared mutable slot (concurrency registry): written by
         # the train loop (note_step) and background emitters (the
@@ -174,6 +184,12 @@ class IntrospectionHub:
         if state["last_step_at"] is not None:
             state["step_age_s"] = round(now - state["last_step_at"], 3)
         state.pop("last_step_at", None)
+        if self.capture is not None:
+            # Capture status rides the same surface operators already
+            # watch: armed/active phase, completed-capture count, and
+            # the last window's headline (docs/observability.md
+            # "Profiling plane").
+            state["profile"] = self.capture.status()
         return state
 
     def metrics_text(self, prefix: str = "bert_train") -> str:
@@ -280,6 +296,9 @@ def _render(value) -> str:
 
 class DebugHTTPServer(http.server.ThreadingHTTPServer):
     daemon_threads = True
+    # Above the stdlib backlog of 5: a coordinated scrape/capture sweep
+    # (obs_collect --profile) connects to every process at once.
+    request_queue_size = 64
     hub: IntrospectionHub = None
 
 
@@ -313,6 +332,39 @@ def _make_handler():
             else:
                 self._reply(404, json.dumps(
                     {"error": f"no route {self.path}"}), "application/json")
+
+        def do_POST(self):
+            hub = self.server.hub
+            if self.path != "/profilez":
+                self._reply(404, json.dumps(
+                    {"error": f"no route {self.path}"}), "application/json")
+                return
+            if hub.capture is None:
+                self._reply(404, json.dumps(
+                    {"error": "profiling plane not attached (the runner "
+                              "built no capture controller)"}),
+                    "application/json")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = json.loads(
+                    self.rfile.read(length).decode("utf-8") or "{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+            except ValueError as exc:
+                self._reply(400, json.dumps(
+                    {"error": f"bad JSON body: {exc}"}), "application/json")
+                return
+            ok, payload = hub.capture.arm(**{
+                k: body[k] for k in ("duration_s", "sample_interval_s",
+                                     "max_samples", "top_k", "trigger")
+                if k in body})
+            # 409, not 500, on double-arm: jax traces cannot nest, and
+            # the second operator must learn a capture is already
+            # running, not crash the first one's window. A refused
+            # PARAMETER (no blocking phase in the payload) is 400.
+            code = 200 if ok else (409 if "phase" in payload else 400)
+            self._reply(code, _finite_json(payload), "application/json")
 
     return Handler
 

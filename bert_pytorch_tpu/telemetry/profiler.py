@@ -1,4 +1,6 @@
-"""Bounded ``jax.profiler`` trace windows for the training loop.
+"""Bounded ``jax.profiler`` trace windows for the training loop — and
+the generalized ``begin``/``end`` facility the on-demand profiling plane
+drives (telemetry/sampler.py, ``POST /profilez``).
 
 ``--profile_steps`` accepts either ``"N"`` (legacy: N steady-state steps
 starting after the compile step, i.e. the window ``[2, 2+N)`` in
@@ -11,6 +13,18 @@ While a trace is active each step is wrapped in
 ``jax.profiler.StepTraceAnnotation``, which makes XLA's trace viewer group
 events per training step.
 
+The startup window used to be this module's ONLY contract — one window
+per process lifetime, latched by ``done``. :meth:`ProfilerWindow.begin`
+and :meth:`ProfilerWindow.end` generalize past it: an on-demand capture
+(``POST /profilez``) re-uses the same instance for any number of bounded
+windows after the startup one, each to its own trace directory. What
+does NOT generalize is concurrency — ``jax.profiler.start_trace`` is a
+process-wide singleton and a second start while one is active raises —
+so every start goes through the module-level exclusivity latch
+(``_TRACE_ACTIVE``, concurrency registry): ``begin`` REFUSES (returns
+False) instead of stacking traces, which is what lets two HTTP planes
+and a startup window coexist on one process without coordinating.
+
 On TPU the trace contains device (XLA op) timelines; on CPU it degrades to
 host tracing only — both are readable with TensorBoard's profile plugin or
 xprof. See docs/telemetry.md for the workflow.
@@ -19,7 +33,35 @@ xprof. See docs/telemetry.md for the workflow.
 from __future__ import annotations
 
 import contextlib
+import threading
 from typing import Optional, Tuple
+
+# Process-wide trace exclusivity (concurrency registry): jax.profiler
+# allows one active trace per process; flipped by whichever thread's
+# begin/end wins, checked by every other would-be starter.
+_TRACE_LOCK = threading.Lock()
+_TRACE_ACTIVE = False
+
+
+def _acquire_trace() -> bool:
+    global _TRACE_ACTIVE
+    with _TRACE_LOCK:
+        if _TRACE_ACTIVE:
+            return False
+        _TRACE_ACTIVE = True
+        return True
+
+
+def _release_trace() -> None:
+    global _TRACE_ACTIVE
+    with _TRACE_LOCK:
+        _TRACE_ACTIVE = False
+
+
+def trace_active() -> bool:
+    """Whether ANY trace window is live in this process (status surface)."""
+    with _TRACE_LOCK:
+        return _TRACE_ACTIVE
 
 
 def parse_profile_spec(spec) -> Optional[Tuple[int, int]]:
@@ -44,30 +86,80 @@ def parse_profile_spec(spec) -> Optional[Tuple[int, int]]:
 
 
 class ProfilerWindow:
-    """Drives one bounded trace window from per-step calls.
+    """Drives bounded trace windows from per-step calls.
 
     ``enabled`` gates everything (non-primary processes pass False: traces
     are per-host artifacts and rank 0's is the one the tooling reads).
+    The spec-driven startup window remains one-shot (``done`` latches
+    after it); ``begin``/``end`` windows are unlimited.
     """
 
     def __init__(self, spec, trace_dir: Optional[str],
                  enabled: bool = True, annotate: bool = True):
         self.range = parse_profile_spec(spec) if enabled else None
         self.trace_dir = trace_dir
+        self.enabled = bool(enabled)
         self.annotate = annotate
         self.active = False
         self.done = False
+        # True only while the SPEC-driven startup window is tracing:
+        # maybe_stop's auto-stop rule applies to it alone — an on-demand
+        # begin() window at step 50 must not be killed by the startup
+        # range having ended at step 4.
+        self._startup_active = False
+
+    def begin(self, trace_dir: Optional[str] = None) -> bool:
+        """Start a trace window outside the startup contract (on-demand
+        captures). Returns False — never raises, never stacks — when
+        this window is disabled, already tracing, or ANY other trace is
+        active in the process (the startup window of this or another
+        ProfilerWindow included)."""
+        if not self.enabled or self.active:
+            return False
+        if not _acquire_trace():
+            return False
+        try:
+            import jax
+
+            jax.profiler.start_trace(trace_dir or self.trace_dir)
+        except Exception:
+            # A refused/failed start must release the latch or no trace
+            # could ever start again in this process.
+            _release_trace()
+            return False
+        self.active = True
+        return True
+
+    def end(self, sync_target=None) -> bool:
+        """Stop the active trace window (on-demand counterpart of
+        ``begin``; does NOT latch ``done`` — the startup contract's
+        one-shot marker belongs to ``stop``)."""
+        if not self.active:
+            return False
+        import jax
+
+        if sync_target is not None:
+            # The trace must hold the device work of every step in the
+            # window, not just their dispatches.
+            jax.block_until_ready(sync_target)
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self.active = False
+            self._startup_active = False
+            _release_trace()
+        return True
 
     def maybe_start(self, step_in_run: int) -> bool:
-        """Start the trace when ``step_in_run`` enters the window."""
+        """Start the startup trace when ``step_in_run`` enters the
+        spec's window (one-shot: ``done`` latches after it)."""
         if (self.range is None or self.active or self.done
                 or step_in_run < self.range[0]
                 or step_in_run >= self.range[1]):
             return False
-        import jax
-
-        jax.profiler.start_trace(self.trace_dir)
-        self.active = True
+        if not self.begin():
+            return False
+        self._startup_active = True
         return True
 
     def annotation(self, step_in_run: int):
@@ -80,22 +172,20 @@ class ProfilerWindow:
         return contextlib.nullcontext()
 
     def maybe_stop(self, step_in_run: int, sync_target=None) -> bool:
-        """Stop when the window's last step completed (auto-stop)."""
-        if not self.active or step_in_run < self.range[1] - 1:
+        """Stop when the STARTUP window's last step completed
+        (auto-stop; on-demand ``begin`` windows are bounded by their
+        controller, not the spec range)."""
+        if not self._startup_active or step_in_run < self.range[1] - 1:
             return False
         return self.stop(sync_target)
 
     def stop(self, sync_target=None) -> bool:
-        """Unconditional stop (end of run inside the window)."""
-        if not self.active:
+        """Unconditional stop (end of run inside the window); latches
+        the startup one-shot ``done`` marker only when the startup
+        window was the one tracing."""
+        was_startup = self._startup_active
+        if not self.end(sync_target=sync_target):
             return False
-        import jax
-
-        if sync_target is not None:
-            # The trace must hold the device work of every step in the
-            # window, not just their dispatches.
-            jax.block_until_ready(sync_target)
-        jax.profiler.stop_trace()
-        self.active = False
-        self.done = True
+        if was_startup:
+            self.done = True
         return True

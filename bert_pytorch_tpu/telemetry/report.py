@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 from typing import Optional
 
 # Relative tolerances (fraction of the baseline value) per check; chosen
@@ -119,6 +120,8 @@ def summarize_records(records, name: str = "") -> dict:
     fleet_events = []
     obs_scrapes = []
     obs_windows = []
+    profile_windows = []
+    compile_costs = []
     serve_summary: Optional[dict] = None
     router_summary: Optional[dict] = None
     run_summary: Optional[dict] = None
@@ -166,6 +169,10 @@ def summarize_records(records, name: str = "") -> dict:
             obs_scrapes.append(rec)
         elif kind == "obs_fleet_window":
             obs_windows.append(rec)
+        elif kind == "profile_window":
+            profile_windows.append(rec)
+        elif kind == "compile_cost":
+            compile_costs.append(rec)
         elif kind == "run_summary":
             run_summary = rec
 
@@ -621,6 +628,54 @@ def summarize_records(records, name: str = "") -> dict:
         if burns:
             out["fleet_error_budget_burn"] = round(max(burns), 4)
 
+    # -- profiling plane section (telemetry/sampler.py, docs/
+    # observability.md "Profiling plane") -------------------------------
+    # profile_window records carry the HOST view (thread-sampler self
+    # time) of each on-demand capture; compile_cost records carry the
+    # DEVICE view (static FLOP/byte attribution per jitted entry point).
+    # The join names the dominant cost per phase in one place: the
+    # hottest host frame across every capture, and the heaviest
+    # compiled function it was feeding.
+    if profile_windows:
+        out["profile_windows"] = len(profile_windows)
+        out["profile_samples"] = sum(
+            int(w.get("samples", 0)) for w in profile_windows)
+        out["profile_trace_bytes"] = sum(
+            int(w.get("trace_bytes", 0)) for w in profile_windows)
+        sources = sorted({str(w.get("source", "?"))
+                          for w in profile_windows})
+        out["profile_sources"] = ",".join(sources)
+        covered: dict = {}
+        for w in profile_windows:
+            unit = str(w.get("covered_unit", "?"))
+            covered[unit] = covered.get(unit, 0) + int(w.get("covered", 0))
+        out["profile_covered"] = dict(sorted(covered.items()))
+        # Aggregate host self time per leaf frame across every capture
+        # (sample counts are comparable: all captures share the wall
+        # clock, and a frame hot in two windows is hotter than one).
+        frames: dict = {}
+        for w in profile_windows:
+            for row in w.get("top_frames") or []:
+                if not isinstance(row, dict):
+                    continue
+                key = str(row.get("frame", "?"))
+                frames[key] = frames.get(key, 0) + int(row.get("samples", 0))
+        if frames:
+            top = sorted(frames.items(), key=lambda kv: (-kv[1], kv[0]))
+            out["profile_host_frames"] = dict(top[:5])
+            out["profile_critical_host"] = top[0][0]
+    if compile_costs:
+        # The device side of the join: heaviest analyzed executable by
+        # static FLOPs (bytes accessed breaks ties — a bandwidth-bound
+        # fn can dominate at modest FLOPs).
+        def _cost(rec):
+            return (float(rec.get("flops", 0.0) or 0.0),
+                    float(rec.get("bytes_accessed", 0.0) or 0.0))
+
+        heaviest = max(compile_costs, key=_cost)
+        if _cost(heaviest) > (0.0, 0.0):
+            out["profile_critical_device"] = str(heaviest.get("fn", "?"))
+
     if run_summary:
         for key, value in run_summary.items():
             if key in ("schema", "ts", "kind", "tag"):
@@ -808,6 +863,9 @@ def format_summary(summary: dict) -> str:
              "fleet_scrape_staleness_s", "fleet_worst_replica_p99_ms",
              "fleet_rps", "fleet_trainer_steps_per_sec",
              "fleet_error_budget_burn",
+             "profile_windows", "profile_samples", "profile_trace_bytes",
+             "profile_sources", "profile_critical_host",
+             "profile_critical_device",
              "compiles", "compile_s", "cold_start",
              "nonfinite_steps", "divergence_warnings", "grad_norm_last",
              "grad_norm_max", "update_ratio_max", "memory_supported",
@@ -827,6 +885,15 @@ def format_summary(summary: dict) -> str:
                      + ", ".join(f"{k}={v}" for k, v
                                  in summary["trace_critical_path"].items())
                      + " (dominant tier, slowest decile)")
+    if summary.get("profile_host_frames"):
+        lines.append(f"  {'profile_host_frames':>22}: "
+                     + ", ".join(f"{k}={v}" for k, v
+                                 in summary["profile_host_frames"].items())
+                     + " (host self-time samples)")
+    if summary.get("profile_covered"):
+        lines.append(f"  {'profile_covered':>22}: "
+                     + ", ".join(f"{v} {k}" for k, v
+                                 in summary["profile_covered"].items()))
     if summary.get("fleet_event_kinds"):
         lines.append(f"  {'fleet_event_kinds':>22}: "
                      + ", ".join(f"{k}={v}" for k, v
@@ -866,20 +933,75 @@ def format_checks(checks) -> str:
     return "\n".join(lines)
 
 
+def _load_ledger():
+    """Ledger module both ways (the collector's _load_schema pattern):
+    package import when report.py was imported normally, sibling
+    file-path import when report.py was itself loaded by path
+    (tools/telemetry_report.py on a jax-free box)."""
+    if __package__:
+        import importlib
+
+        return importlib.import_module(
+            "bert_pytorch_tpu.telemetry.ledger")
+    import importlib.util
+
+    module = sys.modules.get("_report_ledger")
+    if module is not None:
+        return module
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "ledger.py")
+    spec = importlib.util.spec_from_file_location("_report_ledger", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules["_report_ledger"] = module
+    spec.loader.exec_module(module)
+    return module
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="telemetry-report",
         description="Summarize a telemetry JSONL artifact; with a "
                     "baseline, diff the two and exit 1 on regression "
                     "(docs/telemetry.md).")
-    parser.add_argument("run", help="telemetry JSONL of the run under test")
+    parser.add_argument("run", nargs="?", default=None,
+                        help="telemetry JSONL of the run under test "
+                             "(optional with --ledger: a bare drift "
+                             "check over the existing trajectory)")
     parser.add_argument("baseline", nargs="?", default=None,
                         help="baseline telemetry JSONL to diff against")
     parser.add_argument("--baseline", dest="baseline_flag", default=None,
                         help="alternative spelling of the baseline path")
     parser.add_argument("--json", action="store_true",
-                        help="machine-readable output (summaries + checks "
-                             "+ verdict) instead of the human tables")
+                        help="legacy machine-readable output (summaries + "
+                             "checks + verdict) instead of the human "
+                             "tables (bench.py's regression attachment "
+                             "depends on its exact keys; --format json "
+                             "is the stable-contract successor)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text", dest="out_format",
+                        help="output format; 'json' emits one stable "
+                             "versioned object ({\"version\": 1, ..., "
+                             "\"rc\": N} — the tools/check_all.py "
+                             "contract)")
+    parser.add_argument("--ledger", default=None, metavar="PATH",
+                        help="longitudinal perf ledger JSONL "
+                             "(telemetry/ledger.py): append the run "
+                             "under test as a ledger_entry, then gate "
+                             "the newest entry of every (leg, config) "
+                             "trajectory against its rolling median — "
+                             "'perf ledger drift' by name, exit 1")
+    parser.add_argument("--ledger-leg", default="report",
+                        help="ledger leg name for the appended entry "
+                             "(default %(default)s)")
+    parser.add_argument("--ledger-window", type=int, default=None,
+                        help="rolling-median history depth per "
+                             "trajectory (default: the ledger module's)")
+    parser.add_argument("--ledger-tol", type=float, default=None,
+                        help="relative drift tolerance vs the rolling "
+                             "median (default: the ledger module's)")
+    parser.add_argument("--no-ledger-append", action="store_true",
+                        help="gate the existing trajectory without "
+                             "appending the run under test")
     parser.add_argument("--last-run", action="store_true",
                         help="summarize only each artifact's FINAL run "
                              "(append-mode artifacts accumulate runs, "
@@ -905,37 +1027,120 @@ def main(argv=None) -> int:
                              "envelopes (1.0 = 2x the baseline max)")
     args = parser.parse_args(argv)
     baseline = args.baseline_flag or args.baseline
+    if args.run is None and not args.ledger:
+        parser.error("need a run artifact (or --ledger for a bare "
+                     "drift check)")
+    if args.run is None and baseline is not None:
+        parser.error("a baseline needs a run artifact to diff against")
 
     for path in filter(None, (args.run, baseline)):
         if not os.path.exists(path):
             print(f"telemetry-report: {path}: no such file")
             return 2
-    new = summarize_file(args.run, last_run=args.last_run)
-    if baseline is None:
-        if args.json:
-            print(json.dumps({"run": new}))
-        else:
-            print(format_summary(new))
-        return 0
+    new = summarize_file(args.run, last_run=args.last_run) \
+        if args.run else None
+    base = summarize_file(baseline, last_run=args.last_run) \
+        if baseline else None
+    regressions: list = []
+    checks: list = []
+    if base is not None and new is not None:
+        tolerances = {"step": args.step_tol, "p95": args.p95_tol,
+                      "mfu": args.mfu_tol, "mem": args.mem_tol,
+                      "grad": args.grad_tol}
+        regressions, checks = compare(base, new, tolerances)
 
-    base = summarize_file(baseline, last_run=args.last_run)
-    tolerances = {"step": args.step_tol, "p95": args.p95_tol,
-                  "mfu": args.mfu_tol, "mem": args.mem_tol,
-                  "grad": args.grad_tol}
-    regressions, checks = compare(base, new, tolerances)
+    # -- perf ledger gate (telemetry/ledger.py, docs/telemetry.md) ------
+    # Append the run under test (one ledger_entry per report run — the
+    # trajectory is the point), then gate the NEWEST entry of every
+    # (leg, config) trajectory against its rolling median: the named
+    # "perf ledger drift" regression a single hand-picked baseline can
+    # never catch (a slow drift walks in one in-tolerance step at a
+    # time).
+    ledger_info = None
+    if args.ledger:
+        ledger = _load_ledger()
+        window = args.ledger_window if args.ledger_window is not None \
+            else ledger.DEFAULT_WINDOW
+        tol = args.ledger_tol if args.ledger_tol is not None \
+            else ledger.DEFAULT_TOLERANCE
+        appended = None
+        if new is not None and not args.no_ledger_append:
+            metrics = ledger.metrics_from_summary(new)
+            appended = ledger.append_entry(
+                args.ledger, args.ledger_leg, metrics,
+                extra={"source": new.get("name") or args.run})
+        entries = ledger.read_entries(args.ledger)
+        findings = ledger.check_drift(entries, window=window,
+                                      tolerance=tol)
+        ledger_info = {"path": args.ledger, "entries": len(entries),
+                       "appended": appended is not None,
+                       "findings": findings}
+        for f in findings:
+            entry = {
+                "metric": f"ledger:{f['leg']}:{f['metric']}",
+                "label": "perf ledger drift",
+                "base": f["median"], "new": f["latest"],
+                "change": f["change"], "tolerance": f["tolerance"],
+                "verdict": "regression",
+            }
+            checks.append(entry)
+            regressions.append(entry)
+
     verdict = "regression" if regressions else "ok"
+    rc = 1 if regressions else 0
+
+    if args.out_format == "json":
+        # The stable machine contract (tools/check_all.py's shape): one
+        # versioned object, rc mirrored inside so a pipe consumer never
+        # needs the process exit code.
+        combined: dict = {"version": 1, "verdict": verdict,
+                          "regressions": regressions, "checks": checks}
+        if new is not None:
+            combined["run"] = new
+        if base is not None:
+            combined["baseline"] = base
+        if ledger_info is not None:
+            combined["ledger"] = ledger_info
+        combined["rc"] = rc
+        print(json.dumps(combined, indent=2))
+        return rc
     if args.json:
-        print(json.dumps({"verdict": verdict, "regressions": regressions,
-                          "checks": checks, "run": new, "baseline": base}))
-    else:
+        # Legacy shapes, preserved exactly (bench.py parses them); the
+        # ledger verdict rides as extra keys only when requested.
+        if base is not None:
+            out = {"verdict": verdict, "regressions": regressions,
+                   "checks": checks, "run": new, "baseline": base}
+        else:
+            out = {"run": new} if new is not None else {}
+            if args.ledger:
+                out["verdict"] = verdict
+                out["regressions"] = regressions
+        if ledger_info is not None:
+            out["ledger"] = ledger_info
+        print(json.dumps(out))
+        return rc
+
+    if base is not None and new is not None:
         print(format_summary(base))
         print(format_summary(new))
         print(f"== regression check (run vs baseline: {verdict})")
         print(format_checks(checks))
-        if regressions:
-            names = ", ".join(r["label"] for r in regressions)
-            print(f"telemetry-report: REGRESSION in: {names}")
-    return 1 if regressions else 0
+    elif new is not None:
+        print(format_summary(new))
+    if ledger_info is not None:
+        state = "DRIFT" if ledger_info["findings"] else "ok"
+        print(f"== perf ledger ({ledger_info['path']}: "
+              f"{ledger_info['entries']} entries, {state})")
+        for f in ledger_info["findings"]:
+            print(f"  REGRESSION perf ledger drift: "
+                  f"{f['leg']}/{f['metric']} [{f['digest']}]: "
+                  f"median {f['median']:g} -> {f['latest']:g} "
+                  f"({f['change']:+.1%}, tolerance {f['tolerance']:.0%}, "
+                  f"window {f['window']})")
+    if regressions:
+        names = ", ".join(dict.fromkeys(r["label"] for r in regressions))
+        print(f"telemetry-report: REGRESSION in: {names}")
+    return rc
 
 
 if __name__ == "__main__":

@@ -10,6 +10,11 @@ One object owns the telemetry pieces and their lifecycle:
   data-wait / host-dispatch / device-compute decomposition + MFU windows;
 * a :class:`~bert_pytorch_tpu.telemetry.profiler.ProfilerWindow` for
   bounded ``jax.profiler`` traces with per-step annotations;
+* a :class:`~bert_pytorch_tpu.telemetry.sampler.CaptureController` — the
+  on-demand profiling plane: ``POST /profilez`` on the introspection hub
+  arms it from an HTTP thread; :meth:`TrainTelemetry.step_done` ticks it
+  at each step boundary, starting/collecting the bounded host-sampler +
+  trace capture and emitting the ``profile_window`` record;
 * a :class:`~bert_pytorch_tpu.telemetry.compile_events.CompileMonitor`
   (``instrument()``) attributing every XLA compile / cache hit to the
   jitted entry point and shapes digest that triggered it;
@@ -49,6 +54,7 @@ from bert_pytorch_tpu.telemetry.memory import MemorySampler
 from bert_pytorch_tpu.telemetry.model_stats import (DivergenceMonitor,
                                                     health_record)
 from bert_pytorch_tpu.telemetry.profiler import ProfilerWindow
+from bert_pytorch_tpu.telemetry.sampler import CaptureController
 from bert_pytorch_tpu.telemetry.sentinels import (FailureSentinel, Heartbeat,
                                                   HeartbeatWatchdog)
 from bert_pytorch_tpu.telemetry.step_timer import StepTimer
@@ -135,6 +141,17 @@ class TrainTelemetry:
         # each object does its own locking.
         self.introspect = introspect
         self.flight_recorder = flight_recorder
+        # On-demand capture plane (telemetry/sampler.py): armed over
+        # HTTP (POST /profilez on the hub), started/collected at the
+        # step boundary in step_done. It shares the startup window's
+        # ProfilerWindow — the process-wide trace latch (profiler.py
+        # _TRACE_ACTIVE) is what keeps the two from stacking traces.
+        # Frozen binding after __init__ like the hub itself.
+        self.capture = CaptureController(
+            source="trainer", covered_unit="steps", window=self.profiler,
+            trace_dir=profile_dir, emit=self.emit)
+        if self.introspect is not None:
+            self.introspect.capture = self.capture
         # The debug HTTP server serving the hub, attached by
         # telemetry/cli.from_args (or tests); finish()/close() shut it
         # down so a runner that opened --debug_port never leaks the port.
@@ -285,6 +302,10 @@ class TrainTelemetry:
         self.profiler.maybe_stop(
             step if profile_step is None else profile_step,
             sync_target=target)
+        # On-demand capture boundary: starts an armed capture, collects
+        # an expired one (the finished profile_window record rides the
+        # normal emit tee into hub/recorder/sink).
+        self.capture.tick(step, sync_target=target)
         window = self.timer.step_done(step)
         if window is not None:
             if self._loader_stats is not None:

@@ -170,11 +170,52 @@ KIND_REQUIRED_KEYS = {
     # p99, fleet request rate, trainer step rate, error-budget burn
     "obs_fleet_window": ("targets_total", "targets_healthy",
                          "max_staleness_s"),
+    # -- profiling plane (telemetry/sampler.py, telemetry/profiler.py,
+    # docs/observability.md "Profiling plane") --------------------------
+    # one bounded on-demand capture (POST /profilez): the jax-profiler
+    # trace artifact written (path + on-disk bytes; empty path when the
+    # trace was skipped — e.g. another trace window was already active),
+    # the steps/requests the window covered, and the host thread
+    # sampler's top-K self-time frames
+    "profile_window": (
+        "source", "trigger", "covered", "covered_unit", "duration_s",
+        "samples", "top_frames", "trace_path", "trace_bytes",
+    ),
+    # one point on the longitudinal perf trajectory (telemetry/ledger.py,
+    # tools/perf_ledger.py): a named bench/report leg's headline numbers
+    # plus the config digest that makes entries comparable — the
+    # "perf ledger drift" gate regresses the newest entry against the
+    # rolling median of its leg's history
+    "ledger_entry": ("leg", "config_digest", "metrics"),
 }
 
 # Target kinds the collector scrapes (telemetry/collector.py; mirrored
 # here so the schema module stays stdlib-only/jax-free like TRACE_PHASES).
 OBS_TARGET_KINDS = ("trainer", "replica", "router")
+
+# How a profile_window came to be (telemetry/sampler.py): the startup
+# --profile_steps window, an operator's POST /profilez, or the
+# collector's coordinated fleet-wide capture (obs_collect --profile).
+PROFILE_TRIGGERS = ("startup", "ondemand", "fleet")
+
+# What a profile_window's ``covered`` counts: training steps (trainer
+# captures) or completed dispatch batches' requests (replica captures).
+PROFILE_COVERED_UNITS = ("steps", "requests")
+
+# The ledger metrics the drift gate knows a direction for
+# (telemetry/ledger.py): "up" metrics regress by growing (latencies,
+# cold start), "down" metrics regress by shrinking (MFU, padding
+# efficiency). Extra metric keys are allowed in entries — they are
+# recorded but not drift-gated.
+LEDGER_METRIC_DIRECTIONS = {
+    "step_ms_p50": "up",
+    "step_ms_p95": "up",
+    "mfu": "down",
+    "serve_p50_ms": "up",
+    "serve_p99_ms": "up",
+    "cold_start_s": "up",
+    "padding_efficiency": "down",
+}
 
 # serve_trace span names (serve/tracing.py PHASES, mirrored here so the
 # schema module stays stdlib-only/jax-free — tools/check_telemetry_schema
@@ -275,6 +316,10 @@ def validate_record(rec) -> list:
                     _check_obs_fleet_fields(rec, errors)
                 if kind == "autotune":
                     _check_autotune_fields(rec, errors)
+                if kind == "profile_window":
+                    _check_profile_fields(rec, errors)
+                if kind == "ledger_entry":
+                    _check_ledger_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -947,6 +992,126 @@ def _check_autotune_fields(rec, errors) -> None:
                         f"winner.bh_block={v} does not divide bh {bh}")
     elif source in ("measured", "cached"):
         errors.append(f"source {source!r} requires a winner object")
+
+
+def _check_profile_fields(rec, errors) -> None:
+    """profile_window consistency (telemetry/sampler.py): the capture
+    names its source and trigger, the covered count is a non-negative
+    integer of a known unit, and the host-frame table is internally
+    consistent — every frame's sample count is a positive integer
+    bounded by the capture's total, and the self-time shares are in
+    (0, 1] summing to no more than 1 (within rounding slack). A frame
+    claiming more samples than the sampler took would mean the
+    attribution folded two captures together — the double-arm race the
+    409 guard exists to prevent."""
+    source = rec.get("source")
+    if not isinstance(source, str) or not source:
+        errors.append(f"source must be a non-empty string, got {source!r}")
+    trigger = rec.get("trigger")
+    if trigger not in PROFILE_TRIGGERS:
+        errors.append(
+            f"trigger must be one of {PROFILE_TRIGGERS}, got {trigger!r}")
+    unit = rec.get("covered_unit")
+    if unit not in PROFILE_COVERED_UNITS:
+        errors.append(
+            f"covered_unit must be one of {PROFILE_COVERED_UNITS}, "
+            f"got {unit!r}")
+    covered = rec.get("covered")
+    if not isinstance(covered, int) or isinstance(covered, bool) \
+            or covered < 0:
+        errors.append(
+            f"covered must be a non-negative integer, got {covered!r}")
+    samples = rec.get("samples")
+    if not isinstance(samples, int) or isinstance(samples, bool) \
+            or samples < 0:
+        errors.append(
+            f"samples must be a non-negative integer, got {samples!r}")
+        samples = None
+    for key in ("duration_s", "trace_bytes", "sample_interval_s"):
+        v = rec.get(key)
+        if key == "sample_interval_s" and v is None:
+            continue  # optional: trace-only captures omit it
+        if not _is_number(v) or v < 0:
+            errors.append(
+                f"{key} must be a non-negative number, got {v!r}")
+    path = rec.get("trace_path")
+    if not isinstance(path, str):
+        # Empty is legal (trace skipped: another window active, or a
+        # jax-free host); a non-string would break every path consumer.
+        errors.append(f"trace_path must be a string, got {path!r}")
+    frames = rec.get("top_frames")
+    if not isinstance(frames, list):
+        errors.append(
+            f"top_frames must be a list, got {type(frames).__name__}")
+        return
+    share_sum = 0.0
+    for i, frame in enumerate(frames):
+        if not isinstance(frame, dict):
+            errors.append(f"top_frames[{i}] must be an object, "
+                          f"got {frame!r}")
+            continue
+        name = frame.get("frame")
+        if not isinstance(name, str) or not name:
+            errors.append(
+                f"top_frames[{i}].frame must be a non-empty string, "
+                f"got {name!r}")
+        n = frame.get("samples")
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            errors.append(
+                f"top_frames[{i}].samples must be a positive integer, "
+                f"got {n!r}")
+        elif samples is not None and n > samples:
+            errors.append(
+                f"top_frames[{i}].samples ({n}) exceeds the capture's "
+                f"total samples ({samples})")
+        share = frame.get("share")
+        if not _is_number(share) or share <= 0 or share > 1:
+            errors.append(
+                f"top_frames[{i}].share must be a number in (0, 1], "
+                f"got {share!r}")
+        else:
+            share_sum += share
+    if share_sum > 1.0 + 1e-6 + 0.005 * max(1, len(frames)):
+        # Per-frame rounding slack: shares are rounded at emission.
+        errors.append(
+            f"top_frames shares sum to {share_sum:.4f} > 1: self-time "
+            "attribution must decompose the capture, not exceed it")
+
+
+def _check_ledger_fields(rec, errors) -> None:
+    """ledger_entry consistency (telemetry/ledger.py): the trajectory
+    point names its leg and config digest (the comparability join keys
+    the drift gate filters on) and carries a non-empty metrics object of
+    finite non-negative numbers, with the same percentile-ordering and
+    ratio-domain rules the live record kinds obey — a ledger whose
+    history is internally inconsistent cannot anchor a drift verdict."""
+    for key in ("leg", "config_digest"):
+        v = rec.get(key)
+        if not isinstance(v, str) or not v:
+            errors.append(f"{key} must be a non-empty string, got {v!r}")
+    metrics = rec.get("metrics")
+    if not isinstance(metrics, dict) or not metrics:
+        errors.append(
+            f"metrics must be a non-empty object, got {metrics!r}")
+        return
+    nums = {}
+    for key, v in metrics.items():
+        if not _is_number(v) or v < 0:
+            errors.append(
+                f"metrics.{key} must be a non-negative number, got {v!r}")
+        else:
+            nums[key] = v
+    for lo, hi in (("step_ms_p50", "step_ms_p95"),
+                   ("serve_p50_ms", "serve_p99_ms")):
+        if {lo, hi} <= set(nums) and nums[lo] > nums[hi]:
+            errors.append(
+                f"metrics.{lo} ({nums[lo]}) exceeds metrics.{hi} "
+                f"({nums[hi]}): percentiles must be ordered")
+    for key in ("padding_efficiency", "mfu"):
+        if key in nums and nums[key] > 1:
+            errors.append(
+                f"metrics.{key} must be a ratio in [0, 1], "
+                f"got {nums[key]!r}")
 
 
 def _check_resume_fields(rec, errors) -> None:

@@ -229,6 +229,20 @@ def build_service(args):
         os.path.join(args.output_dir, "heartbeat.json")
         if args.output_dir else None)
     heartbeat = Heartbeat(heartbeat_path) if heartbeat_path else None
+    # On-demand profiling plane (telemetry/sampler.py, docs/
+    # observability.md): POST /profilez arms a bounded host-sampler +
+    # jax trace capture; the dispatch plane ticks it per boundary with
+    # position = requests served. The ProfilerWindow here exists only
+    # for the on-demand begin/end facility (no startup spec).
+    from bert_pytorch_tpu.telemetry.profiler import ProfilerWindow
+    from bert_pytorch_tpu.telemetry.sampler import CaptureController
+
+    profile_dir = (os.path.join(args.output_dir, "profile")
+                   if args.output_dir else None)
+    capture = CaptureController(
+        source="replica", covered_unit="requests",
+        window=ProfilerWindow(None, profile_dir, enabled=bool(profile_dir)),
+        trace_dir=profile_dir, emit=emit)
 
     engine = InferenceEngine(
         config,
@@ -254,7 +268,7 @@ def build_service(args):
         max_requests_per_pack=engine.max_requests_per_pack,
         max_pending=args.max_pending)
     service = ServingService(engine, batcher, serve_tele, tracer=tracer,
-                             heartbeat=heartbeat,
+                             heartbeat=heartbeat, capture=capture,
                              dispatch_mode=getattr(args, "dispatch_mode",
                                                    "pipelined"))
     # Rides the service so main()/tests reach it without widening the

@@ -50,8 +50,21 @@ parent, and when it carries the full decomposition,
 ``router_overhead_ms + network_gap_ms + replica_ms`` must equal
 ``client_total_ms`` within epsilon with a ``consistent`` verdict that
 may only be true when the gap is non-negative (minus clock-noise
-epsilon). The chaos harnesses (tools/chaos_run.py,
-tools/chaos_serve.py) lint their artifacts through this same module.
+epsilon). The profiling-plane kinds (docs/observability.md "Profiling
+plane") carry theirs: a ``profile_window`` must name its source, a
+known trigger (startup/ondemand/fleet) and covered unit
+(steps/requests), carry non-negative covered/samples/duration/
+trace-byte counts and a string ``trace_path`` (empty = trace skipped),
+and its host-frame table must be internally consistent — every frame a
+positive sample count bounded by the capture's total, shares in (0, 1]
+summing to no more than 1 (a frame over the total would mean two
+captures folded together — the double-arm race the 409 guard
+prevents); a ``ledger_entry`` (telemetry/ledger.py, the longitudinal
+perf ledger) must name its leg and config digest and carry a non-empty
+metrics object of non-negative numbers with ordered percentiles and
+ratio metrics (mfu/padding_efficiency) in [0, 1]. The chaos harnesses
+(tools/chaos_run.py, tools/chaos_serve.py) lint their artifacts
+through this same module.
 
 Usage::
 

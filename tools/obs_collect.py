@@ -28,6 +28,12 @@ Usage::
 span tree of one trace id out of an existing timeline (``--out`` names
 the file to read): the router's admission/attempt/backoff spans, each
 attempt's replica phases nested under it, and the stitch verdict.
+``--profile`` fires ONE coordinated fleet-wide capture before the pass
+loop: ``POST /profilez`` to every trainer/replica target concurrently
+(the windows align on the same wall-clock slice), one trigger
+``obs_scrape`` record per target in the timeline; the resulting
+``profile_window`` records arrive through the tailed sinks
+(docs/observability.md "Profiling plane").
 The output is schema-linted by default at exit (exit 1 on violations) —
 the collector's own artifact is held to the same bar as everything it
 collects; ``--no-lint`` skips that.
@@ -105,6 +111,16 @@ def main(argv=None) -> int:
                              "fleet error-budget burn exceeds 1")
     parser.add_argument("--no-lint", action="store_true",
                         help="skip schema-linting the timeline at exit")
+    parser.add_argument("--profile", action="store_true",
+                        help="fire one coordinated fleet-wide capture "
+                             "(POST /profilez to every trainer/replica "
+                             "target) before the pass loop; keep "
+                             "collecting past the capture duration so "
+                             "the profile_window records reach the "
+                             "timeline through the tailed sinks")
+    parser.add_argument("--profile_duration_s", type=float, default=2.0,
+                        help="bounded capture window per target for "
+                             "--profile")
     parser.add_argument("--trace", type=str, default=None,
                         metavar="TRACE_ID",
                         help="print the stitched span tree of one trace "
@@ -148,6 +164,16 @@ def main(argv=None) -> int:
         slo_error_budget=args.slo_error_budget)
     deadline = (time.monotonic() + args.duration_s
                 if args.duration_s > 0 else None)
+    if args.profile:
+        triggers = coll.trigger_profile(
+            duration_s=args.profile_duration_s)
+        armed = sum(1 for t in triggers if t["ok"])
+        print(f"profile: armed {armed}/{len(triggers)} targets "
+              f"({args.profile_duration_s:g}s window)")
+        for t in triggers:
+            if not t["ok"]:
+                print(f"profile: {t['target']}: "
+                      f"{t.get('error', 'unreachable')}", file=sys.stderr)
     done = 0
     try:
         while True:
