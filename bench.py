@@ -193,6 +193,22 @@ KERNELS_REQUESTS = int(os.environ.get("BENCH_KERNELS_REQUESTS", "32"))
 KERNELS_BATCH = int(os.environ.get("BENCH_KERNELS_BATCH", "4"))
 KERNELS_BUCKETS = os.environ.get("BENCH_KERNELS_BUCKETS", "32")
 KERNELS_VOCAB = int(os.environ.get("BENCH_KERNELS_VOCAB", "8192"))
+# BENCH_MESH=1 runs the STRATEGY-PRODUCT sweep (docs/parallelism.md): the
+# SAME tiny model steps under several composed mesh specs on a forced-host
+# 8-device CPU mesh (the one-mesh MeshSpec path end to end — spec parse,
+# derived rules, composed collectives), stamping per-product step-time
+# p50, seq/s/chip, and MFU. Products are only comparable WITHIN a spec,
+# so each appends its own perf-ledger entry under a distinct config
+# digest (CONFIG_DIGEST + the product's canonical spec). A product whose
+# engine cannot run on this jax (gpipe needs the jax>=0.5 shard_map
+# typing on CPU) is recorded as skipped with the reason, not a failure.
+# Knobs: BENCH_MESH_SPECS (';'-separated spec strings), BENCH_MESH_STEPS
+# (default 8), BENCH_MESH_WARMUP (default 2).
+MESH_SWEEP = os.environ.get("BENCH_MESH", "0") == "1"
+MESH_SPECS = os.environ.get(
+    "BENCH_MESH_SPECS", "dp=8;dp=4,fsdp=2;dp=2,fsdp=4;dp=4,pipe=2")
+MESH_STEPS = int(os.environ.get("BENCH_MESH_STEPS", "8"))
+MESH_WARMUP = int(os.environ.get("BENCH_MESH_WARMUP", "2"))
 PACK = (os.environ.get("BENCH_PACK", "0") == "1"
         or "--pack_sequences" in sys.argv[1:])
 PACK_K = int(os.environ.get("BENCH_PACK_K", "8"))
@@ -296,6 +312,11 @@ def _config_digest(degraded=None, local_batch=None):
         # identity only); keyed so its marker never collides with a
         # training config's.
         key += f"+async{ASYNC_STATE_MB}"
+    if MESH_SWEEP:
+        # The mesh sweep compiles one tiny train step per product on a
+        # forced-host mesh; keyed on the product list so its marker and
+        # ledger digests never collide with a real training config's.
+        key += f"+mesh{MESH_SPECS}"
     return hashlib.sha1(key.encode()).hexdigest()[:12]
 
 
@@ -1471,9 +1492,184 @@ def _async_child_main():
     print(_json.dumps(result))
 
 
+def _mesh_child_main():
+    """BENCH_MESH leg: step-time/MFU across composed strategy products on
+    a forced-host 8-device CPU mesh (docs/parallelism.md).
+
+    Every product steps the SAME tiny model with the SAME global batch
+    through the one-mesh path — ``MeshSpec.parse`` -> derived rules ->
+    composed collectives — so the numbers rank the parallelism overhead,
+    not the model. Each captured product appends its own perf-ledger
+    entry under a distinct config digest (products are only comparable
+    with themselves across time); the printed result carries the full
+    per-product table.
+    """
+    import hashlib
+    import json as _json
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bert_pytorch_tpu import optim, pretrain
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.models import BertForPreTraining
+    from bert_pytorch_tpu.parallel import (
+        MeshSpec,
+        MeshSpecError,
+        create_mesh,
+        logical_axis_rules,
+    )
+    from bert_pytorch_tpu.telemetry import ledger as ledger_mod
+    from bert_pytorch_tpu.utils import flops as flops_util
+
+    seq, global_batch, n_mb, max_pred = 128, 16, 4, 20
+    config = BertConfig.from_dict({
+        "vocab_size": 1024, "hidden_size": 128, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "intermediate_size": 512,
+        "max_position_embeddings": seq, "type_vocab_size": 2,
+        "hidden_dropout_prob": 0.1, "attention_probs_dropout_prob": 0.1,
+        "next_sentence": True,
+    })
+    model = BertForPreTraining(config, dtype=jnp.float32)
+    schedule = optim.warmup_poly_schedule(1e-3, 0.25, 1000)
+    sample = (jnp.zeros((1, seq), jnp.int32),) * 3
+    rng = np.random.default_rng(0)
+    host_flat = {
+        "input_ids": rng.integers(
+            0, config.vocab_size, (global_batch, seq)).astype(np.int32),
+        "segment_ids": rng.integers(0, 2, (global_batch, seq)).astype(np.int32),
+        "input_mask": np.ones((global_batch, seq), np.int32),
+        "masked_lm_labels": np.where(
+            rng.random((global_batch, seq)) < 0.15,
+            rng.integers(0, config.vocab_size, (global_batch, seq)),
+            -1).astype(np.int32),
+        "next_sentence_labels": rng.integers(
+            0, 2, (global_batch,)).astype(np.int32),
+    }
+    batch_dims = {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
+                  "masked_lm_labels": 3, "next_sentence_labels": 2}
+
+    products, captured = [], 0
+    for text in [s.strip() for s in MESH_SPECS.split(";") if s.strip()]:
+        try:
+            spec = MeshSpec.parse(text)
+            spec.validate(n_devices=len(jax.devices()))
+        except MeshSpecError as e:
+            products.append({"spec": text, "skipped": f"invalid spec: {e}"})
+            continue
+        entry = {"spec": spec.canonical()}
+        try:
+            mesh = create_mesh(spec.mesh_config())
+            rules = logical_axis_rules(spec)
+            tx = optim.lamb(schedule, weight_decay_mask=optim.no_decay_mask)
+            pipe = spec.pipe > 1
+            # pp consumes explicit microbatches; dp/fsdp take one stacked
+            # macrobatch (ACCUM=1) — same sequences per optimizer step.
+            accum = n_mb if pipe else 1
+            with mesh:
+                shardings = pretrain.state_shardings(
+                    mesh, model, rules, sample)
+                b_shardings = pretrain.batch_shardings(
+                    mesh, batch_dims, seq_sharded=spec.seq > 1)
+                state = pretrain.make_init_fn(model, tx, sample, shardings)(
+                    jax.random.PRNGKey(0))
+                if pipe:
+                    step = pretrain.make_pp_train_step(
+                        model, tx, mesh, schedule=schedule,
+                        next_sentence=True, shardings=shardings,
+                        batch_shardings_=b_shardings,
+                        max_pred_per_seq=max_pred)
+                else:
+                    step = pretrain.make_train_step(
+                        model, tx, schedule=schedule, next_sentence=True,
+                        shardings=shardings, batch_shardings_=b_shardings,
+                        max_pred_per_seq=max_pred)
+                batch = pretrain.put_batch(
+                    pretrain.stack_microbatches(host_flat, accum),
+                    b_shardings)
+                for _ in range(MESH_WARMUP):
+                    state, metrics = step(state, batch)
+                    _ = float(metrics["loss"])
+                start = time.perf_counter()
+                for _ in range(MESH_STEPS):
+                    state, metrics = step(state, batch)
+                _ = float(metrics["loss"])  # forces the chained dispatch
+                elapsed = time.perf_counter() - start
+        except Exception as e:  # per-product: record, keep sweeping
+            entry["skipped"] = f"{type(e).__name__}: {e}"
+            products.append(entry)
+            continue
+        step_s = elapsed / MESH_STEPS
+        seq_per_sec_chip = global_batch / step_s / len(jax.devices())
+        mfu = flops_util.mfu(
+            seq_per_sec_chip,
+            flops_util.bert_train_flops_per_seq(
+                config, seq, max_pred, next_sentence=True),
+            jax.devices()[0].device_kind)
+        entry.update({
+            "step_ms_p50": round(step_s * 1000, 2),
+            "seq_per_sec_chip": round(seq_per_sec_chip, 2),
+            "mfu": round(mfu, 6),
+        })
+        products.append(entry)
+        captured += 1
+        if LEDGER_PATH:
+            # Distinct digest per product: entries are only comparable
+            # within one (config, product) pair across time.
+            digest = hashlib.sha1(
+                f"{CONFIG_DIGEST}|{spec.canonical()}".encode()
+            ).hexdigest()[:12]
+            try:
+                ledger_mod.append_entry(
+                    LEDGER_PATH, "mesh",
+                    {"step_ms_p50": entry["step_ms_p50"],
+                     "mfu": entry["mfu"],
+                     "seq_per_sec_per_chip": entry["seq_per_sec_chip"]},
+                    digest=digest,
+                    extra={"metric": "mesh_product_step",
+                           "mesh_spec": spec.canonical()})
+                print(f"perf ledger: appended mesh [{digest}] "
+                      f"{spec.canonical()}", file=sys.stderr)
+            except Exception as exc:  # advisory, like the parent's append
+                print(f"perf ledger append failed: {exc}", file=sys.stderr)
+
+    if not captured:
+        print("BENCH_CONFIG_ERROR: no mesh product captured: "
+              + "; ".join(f"{p['spec']}: {p.get('skipped')}"
+                          for p in products))
+        sys.exit(2)
+    best = max(p["seq_per_sec_chip"] for p in products
+               if "seq_per_sec_chip" in p)
+    try:
+        with open(_warm_marker_path(), "w") as f:
+            f.write("ok\n")
+    except OSError:
+        pass
+    print(_json.dumps({
+        "metric": "mesh_products_seq_per_sec_chip",
+        "value": round(best, 2),
+        "unit": "seq/s/chip (best product)",
+        "vs_baseline": 1.0,
+        "products": products,
+        "captured": captured,
+        "steps": MESH_STEPS,
+        "global_batch": global_batch,
+    }))
+
+
 def _metric_name_and_anchor():
     kfac_tag = "_kfac" if KFAC else ""
     pack_tag = "_packed" if PACK else ""
+    if MESH_SWEEP:
+        # No external anchor: products are compared against each other
+        # (and longitudinally via the per-product ledger entries).
+        return ("mesh_products_seq_per_sec_chip", 1.0)
     if KERNELS:
         # Anchor 1.0 like the serve legs: no external baseline exists;
         # the child prints its own richer result.
@@ -1625,6 +1821,8 @@ def _ledger_leg():
         return "kernels"
     if ASYNC:
         return "async"
+    if MESH_SWEEP:
+        return "mesh"
     if DEGRADED:
         return "train_degraded"
     return "train"
@@ -1756,7 +1954,7 @@ def main():
                   and not DEGRADED and PHASE == 1 and not KFAC
                   and not LONG_SEQ and not N_DEVICES and not PACK
                   and not SERVE and not ASYNC and not SERVE_SATURATION
-                  and not KERNELS)
+                  and not KERNELS and not MESH_SWEEP)
     degraded_warm = degrade_ok and os.path.exists(
         os.path.join(CACHE_DIR, f"warm_{_degraded_digest()}"))
     if not degrade_ok:
@@ -1877,6 +2075,8 @@ if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
         if ASYNC:
             _async_child_main()
+        elif MESH_SWEEP:
+            _mesh_child_main()
         elif KERNELS:
             _kernels_child_main()
         elif SERVE_SATURATION:

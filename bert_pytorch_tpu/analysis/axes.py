@@ -47,7 +47,8 @@ AXIS_CONSTANTS: Dict[str, str] = {
     "AXIS_MODEL": AXIS_MODEL,
 }
 
-# -- logical-axis rules (mirror of mesh.py _BASE_RULES/_STRATEGY_RULES) --
+# -- logical-axis rules (mirror of mesh.py _BASE_RULES/_RULE_TEMPLATE/
+# _STRATEGY_AXES) --
 # Values are mesh axes (or None = replicated); only the KEY COVERAGE is
 # what SD602 enforces — an unmatched logical name silently replicates —
 # but the mirror keeps the values too so the consistency test can pin
@@ -62,79 +63,82 @@ BASE_RULES: Tuple[Tuple[str, object], ...] = (
     ("layers", None),
 )
 
-STRATEGY_RULES: Dict[str, Tuple[Tuple[str, object], ...]] = {
-    "pp": (
-        ("layers", AXIS_PIPE),
-        ("embed", None),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ),
-    "sp": (
-        ("embed", None),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ),
-    "dp": (
-        ("embed", None),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ),
-    "fsdp": (
-        ("embed", AXIS_FSDP),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ),
-    "tp": (
-        ("embed", None),
-        ("embed_out", AXIS_MODEL),
-        ("vocab", AXIS_MODEL),
-        ("heads", AXIS_MODEL),
-        ("kv", None),
-        ("mlp", AXIS_MODEL),
-    ),
-    "tp_fsdp": (
-        ("embed", AXIS_FSDP),
-        ("embed_out", AXIS_MODEL),
-        ("vocab", AXIS_MODEL),
-        ("heads", AXIS_MODEL),
-        ("kv", None),
-        ("mlp", AXIS_MODEL),
-    ),
-    "pp_tp": (
-        ("layers", AXIS_PIPE),
-        ("embed", None),
-        ("embed_out", AXIS_MODEL),
-        ("vocab", AXIS_MODEL),
-        ("heads", AXIS_MODEL),
-        ("kv", None),
-        ("mlp", AXIS_MODEL),
-    ),
+# Mirror of mesh.py _RULE_TEMPLATE: per param logical axis, the mesh axis
+# that controls it when active in the mesh spec (else replicated). The
+# one-mesh refactor derives EVERY strategy product's rules from this one
+# table; a new logical name in model code must land here (or in
+# BASE_RULES) or SD602 flags it as silently replicating.
+RULE_TEMPLATE: Tuple[Tuple[str, object], ...] = (
+    ("embed", AXIS_FSDP),
+    ("embed_out", AXIS_MODEL),
+    ("vocab", AXIS_MODEL),
+    ("heads", AXIS_MODEL),
+    ("kv", None),
+    ("mlp", AXIS_MODEL),
+)
+
+# Mirror of mesh.py _STRATEGY_AXES: legacy alias -> activated mesh axes.
+STRATEGY_AXES: Dict[str, Tuple[str, ...]] = {
+    "dp": (),
+    "sp": (AXIS_SEQ,),
+    "fsdp": (AXIS_FSDP,),
+    "tp": (AXIS_MODEL,),
+    "tp_fsdp": (AXIS_FSDP, AXIS_MODEL),
+    "pp": (AXIS_PIPE,),
+    "pp_tp": (AXIS_PIPE, AXIS_MODEL),
 }
 
 
+def derive_rules(active) -> Tuple[Tuple[str, object], ...]:
+    """Stdlib re-derivation of mesh.derive_rules: param rules for a set
+    of active mesh axes (an active 'pipe' prepends the stacked-layer
+    rule; template rules resolve to their controlling axis when active,
+    else None)."""
+    active = frozenset(active)
+    rules = []
+    if AXIS_PIPE in active:
+        rules.append(("layers", AXIS_PIPE))
+    for name, axis in RULE_TEMPLATE:
+        rules.append((name, axis if axis is not None and axis in active
+                      else None))
+    return tuple(rules)
+
+
+# Legacy aliases, regenerated exactly like mesh.py regenerates its
+# _STRATEGY_RULES (tests/test_mesh.py pins the two derivations equal).
+STRATEGY_RULES: Dict[str, Tuple[Tuple[str, object], ...]] = {
+    name: derive_rules(axes) for name, axes in STRATEGY_AXES.items()
+}
+
+# Every expressible strategy PRODUCT over the param-sharding axes
+# (fsdp × pipe × model, with/without seq): SD602 coverage runs over
+# these generated products too, so a logical name that resolves under
+# the legacy aliases but not under some composed mesh is still caught.
+_PRODUCT_AXES = (AXIS_FSDP, AXIS_PIPE, AXIS_SEQ, AXIS_MODEL)
+
+PRODUCT_RULES: Dict[str, Tuple[Tuple[str, object], ...]] = {}
+for _mask in range(1 << len(_PRODUCT_AXES)):
+    _active = tuple(a for i, a in enumerate(_PRODUCT_AXES)
+                    if _mask & (1 << i))
+    _name = "dp" if not _active else "dp*" + "*".join(_active)
+    PRODUCT_RULES[_name] = derive_rules(_active)
+del _mask, _active, _name
+
+
 def strategies() -> Tuple[str, ...]:
-    return tuple(sorted(STRATEGY_RULES))
+    """Legacy aliases plus every generated axis product."""
+    return tuple(sorted(set(STRATEGY_RULES) | set(PRODUCT_RULES)))
 
 
 def logical_coverage(strategy: str) -> FrozenSet[str]:
     """Logical names that RESOLVE (to a mesh axis or an explicit None =
-    replicated) under ``strategy``: its own rules plus the shared base
-    rules — the first-wins matching of mesh.logical_axis_rules means key
-    membership in the union is exactly 'has a rule'."""
-    return frozenset(
-        name for name, _ in STRATEGY_RULES[strategy] + BASE_RULES)
+    replicated) under ``strategy`` (a legacy alias or a generated
+    product name): its own rules plus the shared base rules — the
+    first-wins matching of mesh.logical_axis_rules means key membership
+    in the union is exactly 'has a rule'."""
+    rules = (STRATEGY_RULES.get(strategy)
+             if strategy in STRATEGY_RULES else PRODUCT_RULES[strategy])
+    return frozenset(name for name, _ in rules + BASE_RULES)
 
 
 def uncovered_strategies(logical_name: str) -> Tuple[str, ...]:

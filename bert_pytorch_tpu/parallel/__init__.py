@@ -21,14 +21,21 @@ Strategies (rule sets):
                 stacked layers shard into contiguous stage blocks and
                 microbatches rotate through them on a GPipe schedule
                 (parallel/pipeline.py).
-These compose: a mesh may use several axes at once.
+These compose: a mesh may use several axes at once. The composition is
+first-class via ``MeshSpec`` (``--mesh dp=4,fsdp=2,pipe=2`` style): the
+legacy names above are aliases that lower onto specs, and any axis
+product's rules derive from one template (docs/parallelism.md).
 """
 
 from bert_pytorch_tpu.parallel.mesh import (
     MeshConfig,
+    MeshSpec,
+    MeshSpecError,
     create_mesh,
     current_mesh,
+    derive_rules,
     logical_axis_rules,
+    parse_mesh_spec,
 )
 from bert_pytorch_tpu.parallel.pipeline import gpipe, stage_layer_count
 from bert_pytorch_tpu.parallel.sharding import (
@@ -40,9 +47,13 @@ from bert_pytorch_tpu.parallel.sharding import (
 
 __all__ = [
     "MeshConfig",
+    "MeshSpec",
+    "MeshSpecError",
     "create_mesh",
     "current_mesh",
+    "derive_rules",
     "logical_axis_rules",
+    "parse_mesh_spec",
     "gpipe",
     "stage_layer_count",
     "batch_sharding",

@@ -118,93 +118,252 @@ _BASE_RULES = [
     ("pos", None),
     ("types", None),
     ("classes", None),
-    ("layers", None),  # scan axis; the 'pp' strategy overrides this to 'pipe'
+    ("layers", None),  # scan axis; an active 'pipe' axis overrides this
 ]
 
-_STRATEGY_RULES = {
-    # pipeline parallelism: the stacked-layer axis shards over 'pipe' (each
-    # stage holds L/P contiguous layers); everything else replicates like dp.
-    # The 'layers' base rule is overridden below (first match wins in
-    # flax.linen.logical_to_mesh_sharding).
-    "pp": [
-        ("layers", "pipe"),
-        ("embed", None),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ],
-    # sequence/context parallelism: params replicated like dp; the activation
-    # sequence axis ('seq_act', in _BASE_RULES) shards over the seq mesh axis.
-    "sp": [
-        ("embed", None),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ],
-    "dp": [
-        ("embed", None),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ],
-    "fsdp": [
-        ("embed", "fsdp"),
-        ("embed_out", None),
-        ("vocab", None),
-        ("heads", None),
-        ("kv", None),
-        ("mlp", None),
-    ],
-    "tp": [
-        ("embed", None),
-        ("embed_out", "model"),
-        ("vocab", "model"),
-        ("heads", "model"),
-        ("kv", None),
-        ("mlp", "model"),
-    ],
-    # tp + fsdp composed: sharded params gather over fsdp, split over model.
-    "tp_fsdp": [
-        ("embed", "fsdp"),
-        ("embed_out", "model"),
-        ("vocab", "model"),
-        ("heads", "model"),
-        ("kv", None),
-        ("mlp", "model"),
-    ],
-    # pipeline + tensor parallel composed: stage blocks over 'pipe', each
-    # stage's matmuls split over 'model'. The pipeline engine runs 'pipe'
-    # manually (explicit ppermute) and leaves 'model' to the compiler
-    # (shard_map axis_names={'pipe'}), so these are the tp rules plus the
-    # pipe-stacked layer axis.
-    "pp_tp": [
-        ("layers", "pipe"),
-        ("embed", None),
-        ("embed_out", "model"),
-        ("vocab", "model"),
-        ("heads", "model"),
-        ("kv", None),
-        ("mlp", "model"),
-    ],
+# The rule TEMPLATE: for each param logical axis, the mesh axis that
+# controls it WHEN that axis is active in the mesh spec (size > 1), else
+# the param replicates (None). Rules for any strategy product — dp×fsdp,
+# dp×pipe, dp×fsdp×pipe×tp — derive from this one table instead of a
+# fixed enumeration of named strategies; the legacy names below are
+# aliases that lower onto specs with byte-identical rules (pinned by
+# tests/test_one_mesh.py::test_legacy_alias_rules_byte_identical).
+_RULE_TEMPLATE = [
+    ("embed", AXIS_FSDP),  # ZeRO-style gather-on-use sharding
+    ("embed_out", AXIS_MODEL),
+    ("vocab", AXIS_MODEL),
+    ("heads", AXIS_MODEL),
+    ("kv", None),  # per-head dim: never sharded (heads already split)
+    ("mlp", AXIS_MODEL),
+]
+
+# Legacy strategy aliases -> the mesh axes they activate. 'dp' activates
+# only the (always-on) data axis; 'sp' activates seq, which shards
+# activations via the base 'seq_act' rule but no params — hence its rule
+# list equals dp's.
+_STRATEGY_AXES = {
+    "dp": (),
+    "sp": (AXIS_SEQ,),
+    "fsdp": (AXIS_FSDP,),
+    "tp": (AXIS_MODEL,),
+    "tp_fsdp": (AXIS_FSDP, AXIS_MODEL),
+    "pp": (AXIS_PIPE,),
+    "pp_tp": (AXIS_PIPE, AXIS_MODEL),
 }
 
 
-def logical_axis_rules(strategy: str = "dp") -> list[tuple]:
+def derive_rules(active) -> list[tuple]:
+    """Param-sharding rules for the set of ACTIVE mesh axes.
+
+    An active 'pipe' prepends ``('layers', 'pipe')`` — each pipeline stage
+    holds L/P contiguous layers; the pipeline engine runs 'pipe' manually
+    (explicit ppermute) and leaves the other axes to the compiler. Every
+    template rule then resolves to its controlling axis when active, else
+    to None (replicated). Only param axes appear here; batch/seq_act
+    sharding lives in ``_BASE_RULES`` (first-wins matching)."""
+    active = frozenset(active)
+    rules = []
+    if AXIS_PIPE in active:
+        rules.append(("layers", AXIS_PIPE))
+    for name, axis in _RULE_TEMPLATE:
+        rules.append((name, axis if axis is not None and axis in active
+                      else None))
+    return rules
+
+
+# Derived per-alias tables, kept for introspection and the shardlint
+# mirror (analysis/axes.py regenerates the same dict from the same two
+# literal tables; tests/test_jaxlint.py pins them together by AST).
+_STRATEGY_RULES = {
+    name: derive_rules(axes) for name, axes in _STRATEGY_AXES.items()
+}
+
+
+class MeshSpecError(ValueError):
+    """A mesh spec that cannot be realized, with the reason why."""
+
+
+# Accepted spelling aliases for spec keys: strategy-flavored names map
+# onto the canonical mesh axes.
+_SPEC_KEY_ALIASES = {
+    "dp": "data",
+    "data": "data",
+    "fsdp": "fsdp",
+    "pipe": "pipe",
+    "pp": "pipe",
+    "seq": "seq",
+    "sp": "seq",
+    "ring": "seq",
+    "model": "model",
+    "tp": "model",
+    "dcn": "dcn_data",
+    "dcn_data": "dcn_data",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative parallelism product: sizes of every mesh axis.
+
+    The one-mesh configuration surface (``--mesh dp=4,fsdp=2,pipe=2``):
+    device mesh, logical-axis rules, and collective wiring are all
+    DERIVED from this — any axis product is expressible, and the combos
+    that cannot work are rejected by :meth:`validate` with the reason.
+    Legacy ``--parallel_strategy`` names lower onto specs via
+    :meth:`from_strategy`. ``data == -1`` means 'all remaining devices'.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    pipe: int = 1
+    seq: int = 1
+    model: int = 1
+    dcn_data: int = 1
+
+    @staticmethod
+    def parse(text: str) -> "MeshSpec":
+        """Parse ``"dp=4,fsdp=2,pipe=2,seq=1"`` (keys accept the
+        strategy-flavored aliases pp→pipe, sp/ring→seq, tp→model)."""
+        sizes = {}
+        for item in str(text).split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, sep, value = item.partition("=")
+            key = key.strip().lower()
+            if key not in _SPEC_KEY_ALIASES:
+                raise MeshSpecError(
+                    f"unknown mesh-spec key '{key}' in {text!r}; "
+                    f"options: {sorted(set(_SPEC_KEY_ALIASES))}")
+            canon = _SPEC_KEY_ALIASES[key]
+            if not sep:
+                raise MeshSpecError(
+                    f"mesh-spec entry {item!r} wants KEY=SIZE")
+            try:
+                size = int(value)
+            except ValueError:
+                raise MeshSpecError(
+                    f"mesh-spec size for '{key}' must be an integer, "
+                    f"got {value!r}") from None
+            if canon in sizes:
+                raise MeshSpecError(
+                    f"mesh-spec key '{canon}' given twice in {text!r}")
+            sizes[canon] = size
+        spec = MeshSpec(**sizes)
+        spec.validate()
+        return spec
+
+    @staticmethod
+    def from_strategy(strategy: str, *, data: int = -1, fsdp: int = 1,
+                      pipe: int = 1, seq: int = 1, model: int = 1,
+                      dcn_data: int = 1) -> "MeshSpec":
+        """Lower a legacy ``--parallel_strategy`` name plus the legacy
+        ``--mesh_*`` sizes onto a spec (rules stay byte-identical)."""
+        if strategy not in _STRATEGY_AXES:
+            raise MeshSpecError(
+                f"unknown strategy '{strategy}'; "
+                f"options: {sorted(_STRATEGY_AXES)}")
+        return MeshSpec(data=data, fsdp=fsdp, pipe=pipe, seq=seq,
+                        model=model, dcn_data=dcn_data)
+
+    def canonical(self) -> str:
+        """Round-trippable spec string; inactive axes are elided."""
+        parts = [f"dp={self.data}"]
+        for key in ("fsdp", "pipe", "seq", "model"):
+            size = getattr(self, key)
+            if size != 1:
+                parts.append(f"{key}={size}")
+        if self.dcn_data != 1:
+            parts.append(f"dcn={self.dcn_data}")
+        return ",".join(parts)
+
+    def as_dict(self) -> dict:
+        """Plain-int dict for the (stdlib-only) checkpoint manifest."""
+        return {"data": self.data, "fsdp": self.fsdp, "pipe": self.pipe,
+                "seq": self.seq, "model": self.model,
+                "dcn_data": self.dcn_data}
+
+    @staticmethod
+    def from_dict(d: dict) -> "MeshSpec":
+        known = {f.name for f in dataclasses.fields(MeshSpec)}
+        return MeshSpec(**{k: int(v) for k, v in dict(d).items()
+                           if k in known})
+
+    def active_axes(self) -> frozenset:
+        """Mesh axes with size > 1 (data counts when -1 = 'remaining')."""
+        active = set()
+        if self.data != 1:
+            active.add(AXIS_DATA)
+        for axis, size in ((AXIS_FSDP, self.fsdp), (AXIS_PIPE, self.pipe),
+                           (AXIS_SEQ, self.seq), (AXIS_MODEL, self.model)):
+            if size > 1:
+                active.add(axis)
+        return frozenset(active)
+
+    def validate(self, *, n_devices: Optional[int] = None,
+                 packed: bool = False) -> None:
+        """Reject specs that cannot be realized, naming the reason.
+
+        ``packed`` enables the sequence-packing compatibility check; pass
+        ``n_devices`` to also enforce the axis-product divisibility."""
+        for key in ("fsdp", "pipe", "seq", "model", "dcn_data"):
+            size = getattr(self, key)
+            if size < 1:
+                raise MeshSpecError(
+                    f"mesh-spec axis '{key}' must be >= 1, got {size}")
+        if self.data < 1 and self.data != -1:
+            raise MeshSpecError(
+                f"mesh-spec axis 'data' must be >= 1 or -1 "
+                f"(= all remaining devices), got {self.data}")
+        if packed and self.seq > 1:
+            raise MeshSpecError(
+                "sequence packing composes with dp/fsdp/pipe/model but "
+                "not with seq>1 (ring context parallelism): the packed "
+                "block-diagonal attention mask ties together positions "
+                "of one packed row, and the ring shards exactly that "
+                "axis — segment boundaries cannot cross seq shards "
+                "without a per-segment halo exchange")
+        if n_devices is not None:
+            try:
+                self.mesh_config().resolve(n_devices)
+            except MeshSpecError:
+                raise
+            except ValueError as e:
+                # resolve() predates the spec layer; unify its divisibility
+                # errors under the one spec-rejection type.
+                raise MeshSpecError(str(e)) from None
+
+    def mesh_config(self, *,
+                    dcn_process_granule: bool = False) -> MeshConfig:
+        return MeshConfig(data=self.data, fsdp=self.fsdp, pipe=self.pipe,
+                          seq=self.seq, model=self.model,
+                          dcn_data=self.dcn_data,
+                          dcn_process_granule=dcn_process_granule)
+
+    def rules(self) -> list[tuple]:
+        """Full rule list for ``nn.logical_to_mesh_sharding``."""
+        return derive_rules(self.active_axes()) + _BASE_RULES
+
+
+def parse_mesh_spec(text: str) -> MeshSpec:
+    """Module-level alias for :meth:`MeshSpec.parse`."""
+    return MeshSpec.parse(text)
+
+
+def logical_axis_rules(strategy="dp") -> list[tuple]:
     """Rule list for ``nn.logical_to_mesh_sharding``.
 
-    Strategy rules come first: matching is first-wins, and 'pp' overrides the
-    base ``('layers', None)`` with ``('layers', 'pipe')``."""
-    if strategy not in _STRATEGY_RULES:
+    Accepts a legacy strategy alias (str) or a :class:`MeshSpec`.
+    Derived rules come first: matching is first-wins, and an active
+    'pipe' axis overrides the base ``('layers', None)`` with
+    ``('layers', 'pipe')``."""
+    if isinstance(strategy, MeshSpec):
+        return strategy.rules()
+    if strategy not in _STRATEGY_AXES:
         raise ValueError(
-            f"unknown strategy '{strategy}'; options: {sorted(_STRATEGY_RULES)}"
+            f"unknown strategy '{strategy}'; options: {sorted(_STRATEGY_AXES)}"
         )
-    return _STRATEGY_RULES[strategy] + _BASE_RULES
+    return derive_rules(_STRATEGY_AXES[strategy]) + _BASE_RULES
 
 
 def current_mesh() -> Optional[Mesh]:

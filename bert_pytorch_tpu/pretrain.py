@@ -750,25 +750,40 @@ def make_pp_train_step(
 
     def loss_fn(params, batch, rng):
         n_mb, b, seq = batch["input_ids"].shape
+        # Packed rows (data/packing.py) carry the extra arrays; their
+        # block-diagonal attention bias replaces the [.., 1, S] padding
+        # bias and already encodes the no-cross-contamination mask, so the
+        # stages need no extra plumbing. packed x seq-sharding is rejected
+        # at spec validation (parallel/mesh.py MeshSpec.validate).
+        packed = "sequence_ids" in batch
         if seq_manual and seq % mesh.shape[AXIS_SEQ] != 0:
             raise ValueError(
                 f"pp x sp: sequence length {seq} is not divisible by the "
                 f"mesh 'seq' axis ({mesh.shape[AXIS_SEQ]})")
+        if seq_manual and packed:
+            raise ValueError(
+                "packed batches cannot shard the sequence axis "
+                "(MeshSpec.validate(packed=True) rejects seq>1)")
         # Two streams: embeddings dropout + the per-(layer, microbatch)
         # folding inside the pipeline. The heads are dropout-free.
         emb_rng, pipe_rng = jax.random.split(rng)
 
         flat = lambda a: a.reshape((n_mb * b,) + a.shape[2:])
+        seq_ids = flat(batch["sequence_ids"]) if packed else None
         hidden = emb_mod.apply(
             {"params": params["bert"]["embeddings"]},
             flat(batch["input_ids"]),
             flat(batch["segment_ids"]),
             False,  # deterministic
+            seq_ids,
             rngs={"dropout": emb_rng},
         )
         hidden = hidden.reshape(n_mb, b, seq, -1)
-        bias = make_attention_bias(flat(batch["input_mask"]), dtype=jnp.float32)
-        bias = bias.reshape(n_mb, b, 1, 1, seq)
+        bias = make_attention_bias(flat(batch["input_mask"]), dtype=jnp.float32,
+                                   sequence_ids=seq_ids)
+        # Unpacked: [A*B, 1, 1, S] -> [A, B, 1, 1, S]; packed
+        # block-diagonal: [A*B, 1, S, S] -> [A, B, 1, S, S].
+        bias = bias.reshape((n_mb, b) + bias.shape[1:])
 
         def apply_one(carry, lp, key, bias_mb):
             out, _ = layer_mod.apply(
@@ -832,9 +847,13 @@ def make_pp_train_step(
         nsp_logits = None
         nsp_labels = None
         if next_sentence:
+            # Packed rows pool at each packed sequence's own [CLS] offset
+            # ([A*B, K, hidden]); empty pack slots are neutralized by
+            # their -1 NSP label (same contract as the non-pp path).
             pooled = pooler_mod.apply(
                 {"params": params["bert"]["pooler"]},
                 hidden.reshape(n_mb * b, seq, -1),
+                flat(batch["cls_positions"]) if packed else None,
             )
             nsp_logits = nsp_mod.apply(
                 {"params": params["seq_relationship"]}, pooled

@@ -23,6 +23,15 @@ walk back across ALL retained checkpoints past corrupt files
 on device and stream + write from a background thread (one in flight per
 output directory, blob committed before manifest), so a periodic save
 stalls training for the device-side copy only.
+
+``layout="sharded"`` swaps the gather for a per-process slice-record
+layout: each process writes ``ckpt_{step}.shard{p}of{n}.msgpack`` holding
+only its addressable shards, and the index file replaces array leaves
+with shape/dtype stubs. Loads reassemble full host arrays from the slice
+records and re-shard under the CALLER's mesh, so a run saved on one
+topology resumes on another (elastic resume, docs/parallelism.md) — and
+because no collective is involved, ``async_write`` covers sharded state
+with the same device-snapshot path.
 """
 
 from __future__ import annotations
@@ -42,6 +51,19 @@ from bert_pytorch_tpu.utils import integrity
 from bert_pytorch_tpu.utils.dist import is_main_process
 
 CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+# Sharded-layout shard files (``ckpt_{step}.shard{p}of{n}.msgpack``)
+# deliberately do NOT match CKPT_RE: the resume scan, retention count,
+# and walk-back see only the index file.
+SHARD_RE = re.compile(r"ckpt_(\d+)\.shard(\d+)of(\d+)\.msgpack$")
+
+# Index-file marker of the sharded layout: the top-level msgpack map
+# carries this key with {version, n_shards, shard_files, mesh_spec};
+# array leaves are replaced by {_LEAF_KEY: 1, shape, dtype} stubs whose
+# bytes live in the shard files as slice records. Load reassembles full
+# host arrays, so the checkpoint restores under ANY topology — elastic
+# resume (save on 8 ways, resume on 4) falls out of the layout.
+SHARDED_KEY = "__sharded__"
+_LEAF_KEY = "__elastic_leaf__"
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -222,6 +244,25 @@ def load_params_only(path: str, target: Any, key: str = "model",
     status, detail = integrity.verify_blob(path, blob)
     if status == integrity.CORRUPT:
         raise CheckpointCorruptError(f"{path}: {detail}")
+    marker = _extract_toplevel_subtree(blob, SHARDED_KEY)
+    if marker is not None:
+        # Sharded-layout index: the key subtree holds elastic-leaf stubs,
+        # not tensors, so the streaming extract cannot apply. Reassemble
+        # ONLY that subtree's slice records from the shard files (the
+        # optimizer/preconditioner leaves are filtered out before any
+        # bytes decode — the memory contract holds).
+        index = serialization.msgpack_restore(blob)
+        if key not in index:
+            raise KeyError(
+                f"checkpoint {path} has no top-level {key!r} subtree "
+                f"(keys: {sorted(k for k in index if k != SHARDED_KEY)})")
+        trimmed = {key: index[key], SHARDED_KEY: index[SHARDED_KEY]}
+        state = _assemble_sharded(path, trimmed, only_prefix=key)[key]
+        if quantize is not None:
+            from bert_pytorch_tpu.ops import quant as quant_ops
+
+            return quant_ops.quantize_params(state, quantize)
+        return serialization.from_state_dict(target, state)
     convert = _make_module_converter(
         serialization.to_state_dict(target), quantize)
     state = _extract_toplevel_subtree(blob, key, convert=convert)
@@ -476,10 +517,9 @@ def _device_snapshot(tree: Any) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def _write_and_prune(state: Any, output_dir: str, step: int, keep: int) -> None:
-    blob = serialization.msgpack_serialize(state)
-    path = checkpoint_path(output_dir, step)
-    fd, tmp = tempfile.mkstemp(dir=output_dir, suffix=".tmp")
+def _atomic_write(path: str, blob: bytes) -> None:
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(blob)
@@ -487,6 +527,30 @@ def _write_and_prune(state: Any, output_dir: str, step: int, keep: int) -> None:
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+
+
+def _prune_old(output_dir: str, keep: int) -> None:
+    steps = _ckpt_steps(output_dir)
+    for old in steps[:-keep] if keep > 0 else []:
+        old_path = checkpoint_path(output_dir, old)
+        stale_names = [old_path, integrity.manifest_path(old_path)]
+        for name in os.listdir(output_dir):
+            m = SHARD_RE.search(name)
+            if m and int(m.group(1)) == old:
+                shard = os.path.join(output_dir, name)
+                stale_names += [shard, integrity.manifest_path(shard)]
+        for stale in stale_names:
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+
+
+def _write_and_prune(state: Any, output_dir: str, step: int, keep: int,
+                     mesh_spec: Optional[dict] = None) -> None:
+    blob = serialization.msgpack_serialize(state)
+    path = checkpoint_path(output_dir, step)
+    _atomic_write(path, blob)
     # Integrity sidecar, hashed from the in-memory blob (no re-read) and
     # itself tmp+renamed. Blob first, manifest second: a crash in the gap
     # leaves a manifestless blob — reported as unverifiable, like any
@@ -495,16 +559,153 @@ def _write_and_prune(state: Any, output_dir: str, step: int, keep: int) -> None:
     # deleted checkpoint).
     integrity.write_manifest(
         path, integrity.build_manifest(
-            step, blob, keys=state.keys() if isinstance(state, dict) else ()))
+            step, blob, keys=state.keys() if isinstance(state, dict) else (),
+            mesh_spec=mesh_spec))
+    _prune_old(output_dir, keep)
 
-    steps = _ckpt_steps(output_dir)
-    for old in steps[:-keep] if keep > 0 else []:
-        old_path = checkpoint_path(output_dir, old)
-        for stale in (old_path, integrity.manifest_path(old_path)):
-            try:
-                os.unlink(stale)
-            except OSError:
-                pass
+
+def _shard_name(step: int, proc: int, n_procs: int) -> str:
+    return f"ckpt_{step}.shard{proc}of{n_procs}.msgpack"
+
+
+def _np_dtype(name: str):
+    """np.dtype from its string name, including the ml_dtypes extension
+    types (bfloat16) numpy alone cannot spell."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _slice_records(x) -> list[dict]:
+    """This process's unique {start, limit, data} slice records of a
+    jax.Array — one record per distinct shard index (replicated shards
+    dedup), data fetched per-shard, so nothing here is a collective even
+    when the full value spans processes."""
+    shape = tuple(x.shape)
+    records, seen = [], set()
+    for shard in x.addressable_shards:
+        bounds = tuple(
+            (idx.start or 0, dim if idx.stop is None else idx.stop)
+            for idx, dim in zip(shard.index, shape))
+        if bounds in seen:
+            continue
+        seen.add(bounds)
+        records.append({
+            "start": [int(b[0]) for b in bounds],
+            "limit": [int(b[1]) for b in bounds],
+            "data": np.asarray(shard.data),
+        })
+    return records
+
+
+def _build_sharded(state_sd: Any, records: dict, path=()) -> Any:
+    """Walk a state dict, replacing jax.Array leaves with elastic-leaf
+    stubs and collecting their slice records into ``records`` (flat-path
+    keyed). Host-side leaves (numpy, scalars, strings — sampler state,
+    epoch) stay inline in the index: they are small and replicated."""
+    if isinstance(state_sd, dict):
+        return {k: _build_sharded(v, records, path + (str(k),))
+                for k, v in state_sd.items()}
+    if isinstance(state_sd, jax.Array):
+        key = "/".join(path)
+        records[key] = _slice_records(state_sd)
+        return {_LEAF_KEY: 1, "shape": [int(d) for d in state_sd.shape],
+                "dtype": str(state_sd.dtype)}
+    return state_sd
+
+
+def _write_sharded(contents: Any, output_dir: str, step: int, keep: int,
+                   mesh_spec: Optional[dict]) -> None:
+    """Sharded-layout write: every process writes ONE shard file of its
+    addressable slice records (own sidecar manifest — no process can
+    hash another's shard), then the main process writes the index +
+    manifest naming them all. Shards first, index last: a torn write
+    leaves orphan shard files but no visible step. No collective
+    anywhere — this is why ``async_write`` covers sharded state."""
+    state_sd = serialization.to_state_dict(contents)
+    records: dict = {}
+    index = _build_sharded(state_sd, records)
+    proc, n_procs = jax.process_index(), jax.process_count()
+    os.makedirs(output_dir, exist_ok=True)
+    shard_files = [_shard_name(step, p, n_procs) for p in range(n_procs)]
+    shard_path = os.path.join(output_dir, _shard_name(step, proc, n_procs))
+    shard_blob = serialization.msgpack_serialize({"leaves": records})
+    _atomic_write(shard_path, shard_blob)
+    integrity.write_manifest(
+        shard_path, integrity.build_manifest(step, shard_blob,
+                                             mesh_spec=mesh_spec))
+    if not is_main_process():
+        return
+    index[SHARDED_KEY] = {
+        "version": 1,
+        "n_shards": n_procs,
+        "shard_files": shard_files,
+        "mesh_spec": dict(mesh_spec) if mesh_spec else {},
+    }
+    index_blob = serialization.msgpack_serialize(index)
+    path = checkpoint_path(output_dir, step)
+    _atomic_write(path, index_blob)
+    integrity.write_manifest(
+        path, integrity.build_manifest(
+            step, index_blob,
+            keys=[k for k in index if k != SHARDED_KEY],
+            mesh_spec=mesh_spec, layout="sharded",
+            shard_files=shard_files))
+    _prune_old(output_dir, keep)
+
+
+def _assemble_sharded(path: str, index: dict, verify: bool = True,
+                      only_prefix: Optional[str] = None) -> dict:
+    """Reassemble full host arrays from a sharded checkpoint's index +
+    shard files. Every elastic leaf allocates its global shape and fills
+    from the slice records of ALL shards, with a coverage mask so a
+    missing slice fails loudly instead of restoring zeros. The result is
+    a plain state dict of full numpy arrays — restore re-shards it via
+    the caller's device_put, so the saving and resuming topologies are
+    completely decoupled (elastic resume)."""
+    meta = index.pop(SHARDED_KEY)
+    directory = os.path.dirname(os.path.abspath(path))
+    leaves: dict = {}
+    for name in meta.get("shard_files", ()):
+        shard_path = os.path.join(directory, os.path.basename(str(name)))
+        with open(shard_path, "rb") as f:
+            blob = f.read()
+        if verify:
+            status, detail = integrity.verify_blob(shard_path, blob)
+            if status == integrity.CORRUPT:
+                raise CheckpointCorruptError(f"{shard_path}: {detail}")
+        shard = serialization.msgpack_restore(blob)
+        for key, records in shard.get("leaves", {}).items():
+            if only_prefix is not None and key != only_prefix \
+                    and not key.startswith(only_prefix + "/"):
+                continue  # params-only load: never materialize optimizer
+            leaves.setdefault(key, []).extend(records)
+
+    def fill(node, path_parts):
+        if not (isinstance(node, dict) and node.get(_LEAF_KEY)):
+            if isinstance(node, dict):
+                return {k: fill(v, path_parts + (str(k),))
+                        for k, v in node.items()}
+            return node
+        key = "/".join(path_parts)
+        shape = tuple(int(d) for d in node["shape"])
+        arr = np.zeros(shape, _np_dtype(node["dtype"]))
+        covered = np.zeros(shape, bool)
+        for rec in leaves.get(key, ()):
+            window = tuple(slice(int(s), int(l))
+                           for s, l in zip(rec["start"], rec["limit"]))
+            arr[window] = rec["data"]
+            covered[window] = True
+        if not covered.all():
+            raise CheckpointCorruptError(
+                f"{path}: sharded leaf {key} has uncovered elements "
+                "(missing shard slices)")
+        return arr
+
+    return fill(index, ())
 
 
 def save_checkpoint(
@@ -513,6 +714,8 @@ def save_checkpoint(
     contents: dict,
     keep: int = 3,
     async_write: bool = False,
+    layout: str = "gathered",
+    mesh_spec: Optional[dict] = None,
 ) -> Optional[str]:
     """Serialize ``contents`` (a dict of pytrees/plain values) to
     ``ckpt_{step}.msgpack``. Main-process-only; prunes to the newest ``keep``
@@ -526,11 +729,52 @@ def save_checkpoint(
     copy, not the D2H fetch or the multi-second msgpack+disk write of a
     BERT-large state. Errors surface at the next save to the same
     directory or at :func:`wait_for_pending_save`. At most one write per
-    output directory is in flight; a newer save joins it first. Multi-host
-    SHARDED state (non-addressable leaves) still gathers synchronously —
-    the gather is a collective every process must join at the same point —
-    and only the serialize+write goes to the background.
+    output directory is in flight; a newer save joins it first.
+
+    ``layout`` picks the on-disk shape:
+
+    * ``"gathered"`` (default) — one full-state file. Multi-host SHARDED
+      state (non-addressable leaves) gathers synchronously first — the
+      gather is a collective every process must join at the same point —
+      and only the serialize+write goes to the background under
+      ``async_write``.
+    * ``"sharded"`` — every process writes its own shard of slice records
+      plus a main-process index (:func:`_write_sharded`). No collective
+      at all, so ``async_write`` covers sharded state too: the device
+      snapshot is donation-safe and the whole fetch+write runs in the
+      background — closing the PR 6 gap where sharded async saves fell
+      back to a synchronous gather. Loads reassemble full arrays and
+      re-shard under the CALLER's mesh: elastic resume.
+
+    ``mesh_spec`` (a plain ``{axis: size}`` dict, ``MeshSpec.as_dict()``)
+    is recorded in the integrity manifest either way, labeling the saving
+    topology for ``tools/verify_checkpoint.py --strict`` and audits.
     """
+    if layout not in ("gathered", "sharded"):
+        raise ValueError(
+            f"unknown checkpoint layout {layout!r}; options: gathered, sharded")
+    # Forwarded to _write_and_prune only when set: tests (and any caller)
+    # that stub the writer with the pre-one-mesh 4-arg signature keep
+    # working for spec-less saves.
+    _spec_kw = {} if mesh_spec is None else {"mesh_spec": mesh_spec}
+    if layout == "sharded":
+        key = _pending_key(output_dir)
+        pending_error = _join_pending_save(key)
+        path = checkpoint_path(output_dir, step)
+        if async_write:
+            box = [_device_snapshot(contents)]
+
+            def write_snapshot():
+                snapshot = box.pop()
+                _write_sharded(snapshot, output_dir, step, keep, mesh_spec)
+
+            _start_pending_save(key, step, write_snapshot)
+        else:
+            _write_sharded(contents, output_dir, step, keep, mesh_spec)
+        if pending_error is not None:
+            raise RuntimeError(
+                "async checkpoint write failed") from pending_error
+        return path if is_main_process() else None
     # Multi-host sharded state: the gather below is a COLLECTIVE, so every
     # process must run it (with the same tree) before non-main processes
     # bail out. Single-host / replicated state skips straight to rank 0.
@@ -567,7 +811,7 @@ def save_checkpoint(
             snapshot = box.pop()
             state = serialization.to_state_dict(_to_host(snapshot))
             del snapshot
-            _write_and_prune(state, output_dir, step, keep)
+            _write_and_prune(state, output_dir, step, keep, **_spec_kw)
 
         _start_pending_save(key, step, fetch_and_write)
         raise_pending()
@@ -579,11 +823,12 @@ def save_checkpoint(
     os.makedirs(output_dir, exist_ok=True)
     path = checkpoint_path(output_dir, step)
     if not async_write:
-        _write_and_prune(state, output_dir, step, keep)
+        _write_and_prune(state, output_dir, step, keep, **_spec_kw)
         raise_pending()
         return path
     _start_pending_save(
-        key, step, lambda: _write_and_prune(state, output_dir, step, keep))
+        key, step,
+        lambda: _write_and_prune(state, output_dir, step, keep, **_spec_kw))
     raise_pending()
     return path
 
@@ -605,7 +850,13 @@ def load_checkpoint(path: str, verify: bool = True) -> dict:
         status, detail = integrity.verify_blob(path, blob)
         if status == integrity.CORRUPT:
             raise CheckpointCorruptError(f"{path}: {detail}")
-    return serialization.msgpack_restore(blob)
+    state = serialization.msgpack_restore(blob)
+    if isinstance(state, dict) and SHARDED_KEY in state:
+        # Sharded-layout index: reassemble the full arrays from the shard
+        # files next to it. The result is topology-free host state —
+        # restore re-shards it under the caller's mesh (elastic resume).
+        return _assemble_sharded(path, state, verify=verify)
+    return state
 
 
 def restore_tree(target: Any, state: Any) -> Any:

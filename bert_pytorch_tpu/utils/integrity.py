@@ -62,16 +62,33 @@ def sha256_file(path: str, chunk_bytes: int = 1 << 20) -> str:
     return digest.hexdigest()
 
 
-def build_manifest(step: int, blob: bytes, keys=()) -> dict:
+def build_manifest(step: int, blob: bytes, keys=(), mesh_spec=None,
+                   layout=None, shard_files=None) -> dict:
     """Manifest dict for an in-memory serialized checkpoint (the save path
-    has the bytes in hand — hashing them costs no extra IO)."""
-    return {
+    has the bytes in hand — hashing them costs no extra IO).
+
+    ``mesh_spec`` (a plain dict of axis sizes, ``MeshSpec.as_dict()``)
+    labels the topology the checkpoint was saved under — what elastic
+    resume and ``tools/verify_checkpoint.py --strict`` read. Sharded-save
+    layouts pass ``layout='sharded'`` plus the shard file NAMES; each
+    shard carries its own sidecar manifest (multi-host saves cannot hash
+    another process's shard), and :func:`verify_checkpoint` chases them.
+    """
+    manifest = {
         "schema": MANIFEST_SCHEMA,
         "step": int(step),
         "sha256": hashlib.sha256(blob).hexdigest(),
         "size_bytes": len(blob),
         "keys": sorted(keys),
     }
+    if mesh_spec is not None:
+        manifest["mesh_spec"] = {str(k): int(v)
+                                 for k, v in dict(mesh_spec).items()}
+    if layout is not None:
+        manifest["layout"] = str(layout)
+    if shard_files is not None:
+        manifest["shard_files"] = sorted(str(n) for n in shard_files)
+    return manifest
 
 
 def write_manifest(ckpt_path: str, manifest: dict) -> str:
@@ -128,12 +145,59 @@ def _verify_against_manifest(ckpt_path: str, actual_size: int,
 def verify_checkpoint(ckpt_path: str) -> Tuple[str, str]:
     """(status, detail) for one checkpoint file — see the module docstring
     for the status vocabulary. Detail is a human-readable reason string.
+
+    A sharded-layout INDEX whose manifest lists ``shard_files`` chases
+    every shard: a missing or corrupt shard corrupts the whole
+    checkpoint (the resume walk-back must not half-load it), and an
+    unverifiable shard caps the status at ``no_manifest``.
     """
     if not os.path.isfile(ckpt_path):
         return CORRUPT, "checkpoint file missing"
-    return _verify_against_manifest(
+    status, detail = _verify_against_manifest(
         ckpt_path, os.path.getsize(ckpt_path),
         lambda: sha256_file(ckpt_path))
+    if status != VERIFIED:
+        return status, detail
+    manifest = read_manifest(ckpt_path)
+    directory = os.path.dirname(os.path.abspath(ckpt_path))
+    for name in (manifest or {}).get("shard_files", ()):
+        shard = os.path.join(directory, os.path.basename(str(name)))
+        if not os.path.isfile(shard):
+            return CORRUPT, f"shard file missing: {name}"
+        shard_status, shard_detail = _verify_against_manifest(
+            shard, os.path.getsize(shard), lambda s=shard: sha256_file(s))
+        if shard_status == CORRUPT:
+            return CORRUPT, f"shard {name}: {shard_detail}"
+        if shard_status == NO_MANIFEST:
+            status, detail = NO_MANIFEST, f"shard {name}: {shard_detail}"
+    return status, detail
+
+
+def validate_mesh_spec(manifest: dict) -> Tuple[bool, str]:
+    """Jax-free consistency check of a manifest's mesh-spec vs its shard
+    layout (``tools/verify_checkpoint.py --strict``): axis sizes must be
+    concrete positives, and a sharded layout's device product must be
+    divisible by its process-shard count (each process wrote one shard
+    of an evenly-distributed mesh). Returns (ok, reason)."""
+    spec = manifest.get("mesh_spec")
+    if spec is None:
+        return True, "no mesh_spec recorded (pre-one-mesh checkpoint)"
+    if not isinstance(spec, dict) or not spec:
+        return False, "mesh_spec is not a non-empty object"
+    product = 1
+    for key, size in spec.items():
+        if not isinstance(size, int) or size < 1:
+            return False, (f"mesh_spec axis '{key}' must be a concrete "
+                           f"positive size, got {size!r}")
+        product *= size
+    shards = manifest.get("shard_files")
+    if manifest.get("layout") == "sharded":
+        if not shards:
+            return False, "layout=sharded but no shard_files listed"
+        if product % len(shards) != 0:
+            return False, (f"device product {product} not divisible by "
+                           f"{len(shards)} process shards")
+    return True, f"mesh_spec consistent ({product} devices)"
 
 
 def verify_blob(ckpt_path: str, blob: bytes) -> Tuple[str, str]:

@@ -18,6 +18,7 @@ Single-host example (smoke config, CPU-runnable):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import math
 import os
 import sys
@@ -32,10 +33,10 @@ from bert_pytorch_tpu import optim, pretrain, telemetry
 from bert_pytorch_tpu.config import BertConfig, parse_args_with_config_file, require_args
 from bert_pytorch_tpu.data import DataLoader, DistributedSampler, ShardedPretrainingDataset
 from bert_pytorch_tpu.models import BertForPreTraining
-from bert_pytorch_tpu.parallel import MeshConfig, create_mesh, logical_axis_rules
+from bert_pytorch_tpu.parallel import (MeshSpec, MeshSpecError, create_mesh,
+                                       logical_axis_rules)
 from bert_pytorch_tpu.parallel import launcher
-from bert_pytorch_tpu.parallel.mesh import (AXIS_DATA, AXIS_FSDP, AXIS_MODEL,
-                                            AXIS_PIPE, AXIS_SEQ)
+from bert_pytorch_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_PIPE
 from bert_pytorch_tpu.testing import faults
 from bert_pytorch_tpu.utils import checkpoint as ckpt
 from bert_pytorch_tpu.utils import logging as logger
@@ -141,6 +142,16 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "BENCH_ASYNC leg and checkpoint-step p95 "
                              "telemetry compare. Final/emergency "
                              "checkpoints are always synchronous")
+    parser.add_argument("--checkpoint_layout", type=str, default="gathered",
+                        choices=["gathered", "sharded"],
+                        help="'gathered' (default) writes one full msgpack "
+                             "per checkpoint (state gathered to host); "
+                             "'sharded' writes per-process shard files of "
+                             "slice records plus an index, records the "
+                             "mesh spec in the integrity manifest, and "
+                             "loads back under ANY topology (elastic "
+                             "resume: save on 8 ways, resume on 4; "
+                             "utils/checkpoint.py)")
     parser.add_argument("--skip_final_checkpoint", action="store_true",
                         help="skip the end-of-run checkpoint write. For "
                              "benchmark/capture runs whose artifact is the "
@@ -307,8 +318,18 @@ def parse_arguments(argv=None) -> argparse.Namespace:
                              "mesh); every other axis stays within a "
                              "slice on ICI")
     parser.add_argument("--mesh_model", type=int, default=1)
+    parser.add_argument("--mesh", type=str, default=None,
+                        help="declarative mesh spec, e.g. "
+                             "'dp=4,fsdp=2,pipe=2,seq=1' (keys accept "
+                             "pp/sp/tp aliases; parallel/mesh.py MeshSpec). "
+                             "Any axis product is expressible — rules, "
+                             "device mesh, and collective wiring derive "
+                             "from the spec. Overrides --parallel_strategy "
+                             "and the individual --mesh_* sizes")
     parser.add_argument("--parallel_strategy", type=str, default="dp",
-                        choices=["dp", "fsdp", "tp", "tp_fsdp", "sp", "pp", "pp_tp"])
+                        choices=["dp", "fsdp", "tp", "tp_fsdp", "sp", "pp", "pp_tp"],
+                        help="legacy strategy alias; lowers onto a MeshSpec "
+                             "with byte-identical rules (prefer --mesh)")
     parser.add_argument("--seed", type=int, default=42)
 
     args = parse_args_with_config_file(parser, argv)
@@ -323,11 +344,44 @@ def setup_training(args):
     jax.config.update("jax_default_prng_impl", args.rng_impl)
     enable_compile_cache(args.compile_cache_dir)
     launcher.initialize()
-    mesh = create_mesh(MeshConfig(
-        data=args.mesh_data, fsdp=args.mesh_fsdp, pipe=args.mesh_pipe,
-        seq=args.mesh_seq, model=args.mesh_model,
-        dcn_data=args.mesh_dcn_data,
-    ))
+    if args.mesh:
+        spec = MeshSpec.parse(args.mesh)
+    else:
+        # Legacy surface: --parallel_strategy + --mesh_* lower onto a
+        # spec (byte-identical rules). The named strategies promise axis
+        # shapes, so misuse of the ALIAS stays an error here even though
+        # the spec itself could realize the product (--mesh lifts these).
+        spec = MeshSpec.from_strategy(
+            args.parallel_strategy, data=args.mesh_data,
+            fsdp=args.mesh_fsdp, pipe=args.mesh_pipe, seq=args.mesh_seq,
+            model=args.mesh_model, dcn_data=args.mesh_dcn_data)
+        if args.mesh_pipe > 1 \
+                and args.parallel_strategy not in ("pp", "pp_tp"):
+            raise ValueError(
+                f"--mesh_pipe {args.mesh_pipe} requires --parallel_strategy "
+                "pp or pp_tp (or express the product with --mesh)")
+        if args.parallel_strategy in ("pp", "pp_tp") and args.mesh_pipe < 2:
+            raise ValueError(
+                "--parallel_strategy pp/pp_tp needs --mesh_pipe >= 2 (a "
+                "1-stage pipeline is just dp with schedule overhead)")
+        if args.parallel_strategy == "pp_tp" and args.mesh_model < 2:
+            raise ValueError(
+                "--parallel_strategy pp_tp needs --mesh_model >= 2 "
+                "(with one model shard use plain pp)")
+        if args.parallel_strategy == "pp" and args.mesh_model > 1:
+            # The engine would run, but plain pp replicates every stage
+            # weight over the model axis: identical work on every model
+            # shard at 1/model throughput — never what anyone wants.
+            raise ValueError(
+                f"--mesh_model {args.mesh_model} with "
+                "--parallel_strategy pp replicates all stage weights "
+                "over the model axis; use pp_tp (or --mesh)")
+    spec.validate(packed=bool(args.pack_sequences))
+    mesh = create_mesh(spec.mesh_config())
+    # Record the RESOLVED spec (data=-1 replaced by the realized size):
+    # checkpoint manifests and telemetry label topologies with it.
+    args.mesh_spec = dataclasses.replace(
+        spec, data=mesh.shape[AXIS_DATA] // spec.dcn_data)
     # Fail fast if any batch shard's pipe/seq/model replicas span hosts:
     # the per-process loaders would feed the same global rows different data.
     pretrain.check_batch_process_locality(mesh)
@@ -363,7 +417,8 @@ def setup_training(args):
     logger.init(handlers=handlers)
     logger.info(
         f"mesh initialized: {dict(zip(mesh.axis_names, mesh.devices.shape))} "
-        f"({jax.process_count()} processes, {len(jax.devices())} devices)"
+        f"({jax.process_count()} processes, {len(jax.devices())} devices, "
+        f"spec {args.mesh_spec.canonical()})"
     )
     if args.rng_impl != "threefry2x32":
         # rbg streams are not stable across platforms/XLA versions the way
@@ -378,7 +433,7 @@ def setup_training(args):
             raise ValueError(
                 "--dtype float16 is the first-order parity mode; K-FAC "
                 "runs in bf16/f32 (no loss scaler needed on TPU)")
-        if args.parallel_strategy in ("pp", "pp_tp"):
+        if args.mesh_spec.pipe > 1:
             raise ValueError(
                 "--dtype float16 is not supported with pipeline "
                 "parallelism; use bfloat16 (the TPU default)")
@@ -394,34 +449,23 @@ def setup_training(args):
             f"local_batch_size*data_shards={global_microbatch}"
         )
     args.accumulation_steps = args.global_batch_size // global_microbatch
-    if args.mesh_pipe > 1 and args.parallel_strategy not in ("pp", "pp_tp"):
-        # Without the pp rules the layer stack REPLICATES over the pipe axis
-        # and those devices duplicate work — never what anyone wants.
-        raise ValueError(
-            f"--mesh_pipe {args.mesh_pipe} requires --parallel_strategy "
-            "pp or pp_tp")
-    if args.pack_sequences and args.parallel_strategy in ("sp", "pp", "pp_tp"):
-        # sp shards the sequence axis (the block-diagonal mask would need
-        # per-shard id exchange, ops/attention.py); the pipeline step has
-        # no packed loss path. Packing targets the padded dp/fsdp/tp
-        # phase-1/2 shapes where the win lives.
-        raise ValueError(
-            f"--pack_sequences is not supported with --parallel_strategy "
-            f"{args.parallel_strategy}; use dp/fsdp/tp/tp_fsdp")
     if args.overlap_grad_reduce and (
-            args.parallel_strategy != "dp" or args.kfac
+            args.mesh_spec.active_axes() - {AXIS_DATA} or args.kfac
             or args.dtype == "float16"):
         # The bucketed collectives are defined over the batch axes with
-        # fully-replicated params: sharded-param strategies, K-FAC's
+        # fully-replicated params: sharded-param products, K-FAC's
         # fused capture, and the fp16 scaler keep the default path.
         raise ValueError(
-            "--overlap_grad_reduce requires --parallel_strategy dp with a "
-            "first-order optimizer (no --kfac) and bf16/fp32")
-    if (args.parallel_strategy == "sp" and mesh.shape[AXIS_SEQ] > 1
+            "--overlap_grad_reduce requires a pure data-parallel mesh "
+            "(fsdp=pipe=seq=model=1) with a first-order optimizer "
+            "(no --kfac) and bf16/fp32")
+    if (args.mesh_spec.seq > 1 and args.mesh_spec.pipe == 1
             and args.attention_backend != "ring"):
-        # sp exists to avoid O(S^2) dense attention; never silently densify
-        # (same stance as ops/attention.py's non-divisible check).
-        logger.info("parallel_strategy=sp: switching attention_backend to "
+        # A seq axis exists to avoid O(S^2) dense attention; never
+        # silently densify (same stance as ops/attention.py's
+        # non-divisible check). seq x pipe instead runs the manual ring
+        # body inside the pipeline's shard_map (pretrain.py).
+        logger.info("mesh seq>1: switching attention_backend to "
                     "'ring' (was '%s')" % args.attention_backend)
         args.attention_backend = "ring"
     if args.global_batch_size % jax.process_count() != 0:
@@ -624,20 +668,22 @@ def main(args) -> dict:
     tx, schedule = prepare_optimizer(args)
     loader, sampler, val_loader = prepare_dataset(args, config, checkpoint)
 
-    rules = logical_axis_rules(args.parallel_strategy)
+    rules = logical_axis_rules(args.mesh_spec)
     seq_len = config.max_position_embeddings
     sample = (jnp.zeros((1, seq_len), jnp.int32),) * 3
     # Packed rows: per-sequence NSP labels [B, K] + the packing arrays;
     # max_predictions_per_seq stays a per-SEQUENCE budget, so the per-ROW
     # MLM gather cap scales by the pack limit.
     packed = getattr(args, "packed", False)
-    if packed and args.parallel_strategy in ("sp", "pp", "pp_tp"):
+    if packed:
         # Catches OFFLINE-packed shards too (auto-detected, no flag) —
         # setup_training's early check only sees --pack_sequences.
-        raise ValueError(
-            "packed pretraining data is not supported with "
-            f"--parallel_strategy {args.parallel_strategy}; "
-            "use dp/fsdp/tp/tp_fsdp or re-encode the shards unpacked")
+        try:
+            args.mesh_spec.validate(packed=True)
+        except MeshSpecError as e:
+            raise ValueError(
+                f"packed pretraining data: {e}; re-encode the shards "
+                "unpacked or drop the seq axis") from None
     eff_max_pred = args.max_predictions_per_seq * (
         args.pack_k if packed else 1)
     batch_spec = {"input_ids": 3, "segment_ids": 3, "input_mask": 3,
@@ -650,9 +696,7 @@ def main(args) -> dict:
         shardings = pretrain.state_shardings(mesh, model, rules, sample,
                                              loss_scaled=fp16)
         b_shardings = pretrain.batch_shardings(
-            mesh, batch_spec,
-            seq_sharded=(mesh.shape[AXIS_SEQ] > 1 and
-                         args.parallel_strategy in ("sp", "pp", "pp_tp")))
+            mesh, batch_spec, seq_sharded=args.mesh_spec.seq > 1)
         init_fn = pretrain.make_init_fn(model, tx, sample, shardings)
         state = init_fn(jax.random.PRNGKey(args.seed))
 
@@ -682,7 +726,7 @@ def main(args) -> dict:
         kfac_fused = False
         if args.kfac:
             kfac_fused = args.kfac_capture == "train"
-            if kfac_fused and args.parallel_strategy in ("pp", "pp_tp"):
+            if kfac_fused and args.mesh_spec.pipe > 1:
                 # The pipeline step has no fused-capture path (factors
                 # would need per-stage reassembly); fall back to the
                 # decoupled stats pass.
@@ -748,24 +792,7 @@ def main(args) -> dict:
         stats_phase = int(jax.device_get(
             optim.opt_step_count(state.opt_state)))
 
-        if args.parallel_strategy in ("pp", "pp_tp"):
-            if mesh.shape[AXIS_PIPE] < 2:
-                raise ValueError(
-                    "--parallel_strategy pp/pp_tp needs --mesh_pipe >= 2 (a "
-                    "1-stage pipeline is just dp with schedule overhead)")
-            if args.parallel_strategy == "pp_tp" \
-                    and mesh.shape[AXIS_MODEL] < 2:
-                raise ValueError(
-                    "--parallel_strategy pp_tp needs --mesh_model >= 2 "
-                    "(with one model shard use plain pp)")
-            if args.parallel_strategy == "pp" and mesh.shape[AXIS_MODEL] > 1:
-                # The engine would run, but the 'pp' rules replicate every
-                # weight over the model axis: identical work on every model
-                # shard at 1/model throughput — never what anyone wants.
-                raise ValueError(
-                    f"--mesh_model {mesh.shape[AXIS_MODEL]} with "
-                    "--parallel_strategy pp replicates all stage weights "
-                    "over the model axis; use --parallel_strategy pp_tp")
+        if args.mesh_spec.pipe > 1:
             if args.accumulation_steps < mesh.shape[AXIS_PIPE]:
                 raise ValueError(
                     f"pp needs accumulation_steps >= pipeline stages "
@@ -1069,7 +1096,9 @@ def main(args) -> dict:
                             ckpt.save_checkpoint(
                                 args.model_output_dir, save_step, contents,
                                 keep=args.keep_checkpoints,
-                                async_write=args.checkpoint_write == "async")
+                                async_write=args.checkpoint_write == "async",
+                                layout=args.checkpoint_layout,
+                                mesh_spec=args.mesh_spec.as_dict())
                         logger.info(f"Saved checkpoint at step {save_step}")
 
                     if fault_plan.active:
@@ -1152,7 +1181,9 @@ def main(args) -> dict:
                 with tele.checkpoint_stall():
                     ckpt.save_checkpoint(
                         args.model_output_dir, save_step, contents,
-                        keep=args.keep_checkpoints)
+                        keep=args.keep_checkpoints,
+                        layout=args.checkpoint_layout,
+                        mesh_spec=args.mesh_spec.as_dict())
             ckpt.wait_for_pending_save()
             # Flush the partial telemetry window + final heartbeat + run
             # summary (the JSONL sink itself is closed by logger.close()).
@@ -1160,6 +1191,9 @@ def main(args) -> dict:
                 "training_seq_per_sec": round(seq_per_sec, 2),
                 "training_mfu": round(train_mfu, 4),
                 "terminated_by_signal": terminated,
+                # Topology label: telemetry-report groups/labels loss and
+                # step-time trajectories per mesh product with this.
+                "mesh_spec": args.mesh_spec.canonical(),
             }
             # Run-level padding accounting: what fraction of the token
             # budget was real work, and the throughput in real tokens —

@@ -319,8 +319,11 @@ def test_axes_registry_mirrors_mesh_py():
     the analysis package must stay stdlib-only (it cannot import the
     real ones). This pins the two copies together by PARSING mesh.py —
     a one-mesh-refactor edit to MESH_AXES / _BASE_RULES /
-    _STRATEGY_RULES that forgets the mirror fails tier-1 here, not a
-    sharding bug three PRs later."""
+    _RULE_TEMPLATE / _STRATEGY_AXES that forgets the mirror fails
+    tier-1 here, not a sharding bug three PRs later. The derived
+    _STRATEGY_RULES dicts (both sides regenerate them from these
+    literals) are pinned equal by tests/test_mesh.py, which may import
+    jax."""
     from bert_pytorch_tpu.analysis import axes as axes_registry
 
     mesh_py = os.path.join(REPO_ROOT, "bert_pytorch_tpu", "parallel",
@@ -356,7 +359,14 @@ def test_axes_registry_mirrors_mesh_py():
         == axes_registry.AXIS_CONSTANTS
     assert env["MESH_AXES"] == axes_registry.MESH_AXES
     assert env["_BASE_RULES"] == axes_registry.BASE_RULES
-    assert env["_STRATEGY_RULES"] == axes_registry.STRATEGY_RULES
+    assert env["_RULE_TEMPLATE"] == axes_registry.RULE_TEMPLATE
+    assert env["_STRATEGY_AXES"] == axes_registry.STRATEGY_AXES
+    # The registry's regenerated alias rules must agree with a
+    # re-derivation from mesh.py's parsed literals (same first-wins
+    # semantics as mesh.derive_rules).
+    for name, active in env["_STRATEGY_AXES"].items():
+        assert axes_registry.STRATEGY_RULES[name] == \
+            axes_registry.derive_rules(active)
 
 
 # -- the unified gate ----------------------------------------------------

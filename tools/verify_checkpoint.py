@@ -16,6 +16,15 @@ Usage::
     python tools/verify_checkpoint.py out/pretrain_ckpts [more paths...]
     python tools/verify_checkpoint.py --strict out/   # no_manifest fails too
 
+Checkpoints saved by the one-mesh runner carry a ``mesh_spec`` manifest
+field (the topology they were saved under) and, for sharded layouts, the
+shard-file list; both are printed, and under ``--strict`` the spec is
+validated against the shard layout (``integrity.validate_mesh_spec`` —
+concrete positive axis sizes, device product divisible by the process
+shard count). Shard files verify against their OWN sidecars and are
+chased from the index's manifest, so pointing this tool at the index
+covers the whole step.
+
 Exit 0 = nothing corrupt (``--strict``: everything verified), 1 =
 corruption found (or unverified under ``--strict``), 2 = a named path is
 missing. Imports only the stdlib integrity module — no jax — so it runs
@@ -73,6 +82,18 @@ def main(argv=None) -> int:
         if status == integrity.CORRUPT or (
                 args.strict and status != integrity.VERIFIED):
             failed = True
+        manifest = integrity.read_manifest(path)
+        if manifest and "mesh_spec" in manifest:
+            spec = ",".join(f"{k}={v}"
+                            for k, v in sorted(manifest["mesh_spec"].items()))
+            layout = manifest.get("layout")
+            suffix = f" (layout={layout})" if layout else ""
+            print(f"{path}: mesh_spec {spec}{suffix}")
+            ok, reason = integrity.validate_mesh_spec(manifest)
+            if not ok:
+                print(f"{path}: mesh_spec INVALID ({reason})")
+                if args.strict:
+                    failed = True
     return 1 if failed else 0
 
 
