@@ -448,6 +448,47 @@ REGISTRY: Tuple[Entry, ...] = (
               "stop() after that thread is joined); Heartbeat.beat is "
               "single-owner, so safety rests on the binding never "
               "moving"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_split",
+          cls="Router", kind="lock", locks=("_lock",),
+          why="rollout controller installs/widens/clears the canary "
+              "split while every request thread reads it for cohort "
+              "assignment and folds outcomes into its accumulators; "
+              "split_window swaps the cohorts out from the observe "
+              "loop's thread"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_version_requests",
+          cls="Router", kind="lock", locks=("_lock",),
+          why="per-version counters bumped by every admitting/hedging "
+              "request thread while /metricsz and /statsz scrape "
+              "threads snapshot them"),
+
+    # -- serve/engine.py: the swappable params slot ------------------------
+    # _swap_lock makes (spec.params, serving_version, _swap_epoch) one
+    # atomic unit: swap_params flips all three in one acquisition while
+    # the executor thread captures all three in one acquisition — a
+    # mixed read (new params, old version) is the torn serve the
+    # _torn_serves counter exists to falsify.
+    Entry("bert_pytorch_tpu/serve/engine.py", "serving_version",
+          cls="InferenceEngine", kind="lock", locks=("_swap_lock",),
+          why="swap_params (control/HTTP thread) flips it with the "
+              "params reference while the executor thread captures "
+              "both for the forward pass and /statsz reports it"),
+    Entry("bert_pytorch_tpu/serve/engine.py", "_swap_epoch",
+          cls="InferenceEngine", kind="lock", locks=("_swap_lock",),
+          why="bumped per flip; the executor re-reads it after the "
+              "forward pass to detect a torn capture"),
+    Entry("bert_pytorch_tpu/serve/engine.py", "_swaps",
+          cls="InferenceEngine", kind="lock", locks=("_swap_lock",),
+          why="swap counter written by swap_params, read by "
+              "swap_stats() from scrape threads"),
+    Entry("bert_pytorch_tpu/serve/engine.py", "_torn_serves",
+          cls="InferenceEngine", kind="lock", locks=("_swap_lock",),
+          why="executor increments on a detected torn capture while "
+              "scrape threads read it for /statsz (the rollout's "
+              "zero-tolerance gate)"),
+    Entry("bert_pytorch_tpu/serve/engine.py", "_swap_inflight",
+          cls="InferenceEngine", kind="lock", locks=("_swap_lock",),
+          why="single-swap admission flag: concurrent /swapz callers "
+              "race to set it; the loser gets SwapBusy (409)"),
 
     # -- serve/supervisor.py: monitor thread vs control-plane callers ------
     # The replica table (and every _Replica field reached through it) is
@@ -458,6 +499,36 @@ REGISTRY: Tuple[Entry, ...] = (
           why="monitor thread reaps/restarts/kills replicas while "
               "start()/stop()/status() read and mutate the same table "
               "from control-plane threads"),
+
+    # -- serve/rollout.py: observe loop vs status readers ------------------
+    # One lock guards the whole stage state: observe() runs on a
+    # scheduler thread while status() is read from HTTP handlers and
+    # start() from the control plane.
+    Entry("bert_pytorch_tpu/serve/rollout.py", "_stage",
+          cls="RolloutController", kind="lock", locks=("_lock",),
+          why="observe() advances it while status() renders it from "
+              "HTTP handler threads"),
+    Entry("bert_pytorch_tpu/serve/rollout.py", "_greens",
+          cls="RolloutController", kind="lock", locks=("_lock",),
+          why="consecutive-green counter bumped/reset by observe() "
+              "while status() reads it"),
+    Entry("bert_pytorch_tpu/serve/rollout.py", "_state",
+          cls="RolloutController", kind="lock", locks=("_lock",),
+          why="idle/canary/promoted/rolled_back transitions from "
+              "start()/observe() while status() and the next observe() "
+              "check it"),
+    Entry("bert_pytorch_tpu/serve/rollout.py", "_windows",
+          cls="RolloutController", kind="lock", locks=("_lock",),
+          why="window counter bumped per observation, read by "
+              "status()"),
+
+    # -- serve/registry.py: manifest cache ---------------------------------
+    Entry("bert_pytorch_tpu/serve/registry.py", "_cache",
+          cls="ModelRegistry", kind="lock", locks=("_lock",),
+          allow=("_read_locked", "_write_locked"),
+          why="publish/set_state write-through while list_versions/get "
+              "read from rollout, CLI, and HTTP threads; disk is the "
+              "source of truth, the cache only skips re-reads"),
 
     # -- ops/pallas/autotune.py: the geometry-winners registry -------------
     # The process-global winners table is written by the serve engine's

@@ -48,6 +48,8 @@ single-request forward holds to fp32 exactness; tests/test_serve.py).
 
 from __future__ import annotations
 
+import os
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -58,7 +60,13 @@ from bert_pytorch_tpu.data.packing import first_fit_decreasing
 from bert_pytorch_tpu.serve import tasks as tasks_lib
 from bert_pytorch_tpu.serve.batcher import Request
 from bert_pytorch_tpu.telemetry.compile_events import CompileMonitor
+from bert_pytorch_tpu.testing import faults
 from bert_pytorch_tpu.utils import checkpoint as ckpt_util
+
+
+class SwapBusy(RuntimeError):
+    """A second hot-swap was requested while one is already in flight
+    (loads cannot overlap — serve/http.py maps this to HTTP 409)."""
 
 
 class TaskSpec:
@@ -141,6 +149,7 @@ class InferenceEngine:
         epilogue_slots: int = 8,
         autotune: str = "off",
         autotune_cache: Optional[str] = None,
+        version: str = "v0",
     ):
         """``quantize`` selects the inference weight format
         (ops/quant.py): None serves the checkpoint's fp32 params,
@@ -222,15 +231,33 @@ class InferenceEngine:
         self._clock = clock
         self.monitor = monitor or CompileMonitor(emit=lambda rec: None)
         self._setup_autotune()
+        # Hot-swap state (docs/serving.md "Model registry & canary
+        # rollouts"): _swap_lock makes (spec.params, serving_version,
+        # _swap_epoch) flip as ONE atomic unit — the executor captures
+        # all three in a single acquisition, so an in-flight batch
+        # always runs against exactly one consistent version, and the
+        # epoch check counts any params change that bypassed the flip
+        # into _torn_serves (the zero-tolerance report gate).
+        self._swap_lock = threading.Lock()
+        self.serving_version = str(version)
+        self._swap_epoch = 0
+        self._swaps = 0
+        self._torn_serves = 0
+        self._swap_inflight = False
         handlers = tasks_lib.build_handlers(tokenizer, tasks)
         self.tasks: Dict[str, TaskSpec] = {}
+        # Per-task (options, seed) as __init__ built them: swap_params
+        # re-creates the SAME fp32 init template (the streaming-decode
+        # load target) for the incoming checkpoint.
+        self._task_build: Dict[str, Tuple[dict, int]] = {}
         for name, options in tasks.items():
             options = options or {}
-            model, params = self._build_task(
-                name, options, seed=seed + len(self.tasks))
+            task_seed = seed + len(self.tasks)
+            model, params = self._build_task(name, options, seed=task_seed)
             spec = TaskSpec(name, model, params, handlers[name])
             self._build_forwards(spec)
             self.tasks[name] = spec
+            self._task_build[name] = (dict(options), task_seed)
         self.warmed = False
 
     # -- construction ----------------------------------------------------
@@ -506,6 +533,96 @@ class InferenceEngine:
         self.warmed = True
         return len(self.monitor.events) - before
 
+    # -- hot swap (docs/serving.md "Model registry & canary rollouts") ---
+
+    def version(self) -> str:
+        """The serving model version (stamped atomically with the params
+        flip — what /healthz, /statsz, and /metricsz report)."""
+        with self._swap_lock:
+            return self.serving_version
+
+    def swap_stats(self) -> dict:
+        """Swap counters for /statsz: the serving version, completed
+        swaps, and torn serves (forwards whose params reference changed
+        without the epoch-bumping flip — structurally 0; the
+        zero-tolerance "rollout torn-model serves" gate reads it)."""
+        with self._swap_lock:
+            return {"version": self.serving_version,
+                    "swaps": self._swaps,
+                    "torn_serves": self._torn_serves}
+
+    def swap_params(self, task: str, checkpoint: str, version: str,
+                    emit: Optional[Callable[[dict], None]] = None) -> dict:
+        """Hot-swap one task's params to ``checkpoint``, stamping the
+        engine as serving ``version``. Raises :class:`SwapBusy` when a
+        swap is already in flight (serve/http.py maps it to 409).
+
+        The load runs OFF the dispatch path: the new params stream
+        through the same quantize-at-decode path as startup (the fp32
+        tree never materializes), built against a fresh init template
+        from the task's original (options, seed) — so geometry, dtype,
+        and quant layout match the forwards exactly. Because the jitted
+        forwards key the persistent compile cache on their STABLE names
+        and the staged shapes are unchanged, a same-geometry swap hits
+        the already-compiled executables: zero compiles, cold or warm
+        (the info dict proves it from the CompileMonitor's counter
+        events, never wall clock).
+
+        The flip itself is one lock acquisition that replaces the params
+        reference, the version stamp, and the swap epoch together; an
+        in-flight batch that captured the old reference keeps executing
+        the old version to completion — there is no intermediate state
+        to serve from."""
+        spec = self.tasks.get(task)
+        if spec is None:
+            raise ValueError(
+                f"unknown task {task!r} (serving: {sorted(self.tasks)})")
+        if not checkpoint or not os.path.isfile(checkpoint):
+            raise FileNotFoundError(f"swap checkpoint missing: "
+                                    f"{checkpoint!r}")
+        with self._swap_lock:
+            if self._swap_inflight:
+                raise SwapBusy(
+                    "a hot-swap is already in flight; retry after it "
+                    "completes")
+            self._swap_inflight = True
+            swap_attempt = self._swaps + 1
+        try:
+            options, seed = self._task_build[task]
+            compiles_before = len(self.monitor.events)
+            t0 = self._clock()
+            _, new_params = self._build_task(
+                task, dict(options, checkpoint=checkpoint), seed=seed)
+            load_s = self._clock() - t0
+            # Chaos hook: hold the swap window open between load and
+            # flip (testing/faults.py swap_hold) — a SIGKILL landing
+            # here proves in-flight batches only ever saw the OLD
+            # consistent version.
+            faults.get_plan().serve_swap_check(swap_attempt, emit=emit)
+            with self._swap_lock:
+                from_version = self.serving_version
+                spec.params = new_params
+                self.serving_version = str(version)
+                self._swap_epoch += 1
+                self._swaps += 1
+        finally:
+            with self._swap_lock:
+                self._swap_inflight = False
+        compile_events = [e for e in self.monitor.events[compiles_before:]
+                          if e.get("kind") == "compile"]
+        return {
+            "task": task,
+            "version": str(version),
+            "from_version": from_version,
+            "checkpoint": checkpoint,
+            "load_s": round(load_s, 3),
+            "compiles": len(compile_events),
+            "compiles_cold": sum(1 for e in compile_events
+                                 if e.get("cache") in ("miss", "uncached")),
+            "compiles_warm": sum(1 for e in compile_events
+                                 if e.get("cache") == "hit"),
+        }
+
     # -- planning --------------------------------------------------------
 
     def select_bucket(self, length: int) -> int:
@@ -659,8 +776,24 @@ class InferenceEngine:
         compiles_before = len(self.monitor.events)
         t0 = self._clock()
         fwd = spec.forwards[(plan.bucket, plan.packed, staged.fused)]
-        out = fwd(spec.params, *staged.args)
+        # Capture the params reference, its swap epoch, and the version
+        # stamp in ONE lock acquisition: the whole forward runs against
+        # this single consistent tree no matter when a hot-swap flips
+        # the spec (docs/serving.md "Model registry & canary rollouts").
+        with self._swap_lock:
+            params = spec.params
+            epoch = self._swap_epoch
+            version = self.serving_version
+        out = fwd(params, *staged.args)
         out = jax.block_until_ready(out)
+        # Flip-atomicity audit: the params reference may only change
+        # through the epoch-bumping swap. A changed reference at an
+        # UNCHANGED epoch means something mutated params outside the
+        # flip while this batch ran — counted as a torn serve (the
+        # zero-tolerance "rollout torn-model serves" gate).
+        with self._swap_lock:
+            if spec.params is not params and self._swap_epoch == epoch:
+                self._torn_serves += 1
         device_s = self._clock() - t0
         compiles = sum(
             1 for e in self.monitor.events[compiles_before:]
@@ -674,6 +807,7 @@ class InferenceEngine:
             "compiles": compiles,
             "packed": plan.packed,
             "fused": staged.fused,
+            "version": version,
         }
         return out, info
 

@@ -37,7 +37,16 @@ Routes:
   per-task request/error/over-SLO counters, per-(task, phase) latency
   histograms, queue depth / occupancy / cold-start gauges — the scrape
   surface the router and standard collectors consume. 404 when the
-  service was built without a tracer.
+  service was built without a tracer;
+* ``POST /swapz``      — hot-swap one task's params to a new model
+  version (docs/serving.md "Model registry & canary rollouts"). JSON
+  body: ``task``, ``checkpoint`` (path the replica can read),
+  ``version`` (the registry version name). The load runs on this
+  control thread off the dispatch path; the flip is atomic, so
+  in-flight batches finish on the old version. 200 with the swap info
+  (load_s + the compile split proving a same-geometry swap recompiled
+  nothing), 409 while another swap is in flight (loads cannot
+  overlap), 404 on an unknown task, 400 on a missing checkpoint.
 """
 
 from __future__ import annotations
@@ -46,6 +55,7 @@ import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from bert_pytorch_tpu.serve.batcher import BatcherFull
+from bert_pytorch_tpu.serve.engine import SwapBusy
 from bert_pytorch_tpu.serve.service import ServiceDraining, ServingService
 from bert_pytorch_tpu.serve.tracing import (TRACE_HEADER,
                                             TRACE_ID_RESPONSE_HEADER,
@@ -105,6 +115,11 @@ def _make_handler():
                 snap = service.telemetry.snapshot()
                 if service.capture is not None:
                     snap["profile"] = service.capture.status()
+                swap_stats = getattr(service.engine, "swap_stats", None)
+                if callable(swap_stats):
+                    # serving version + swap/torn counters (the rollout
+                    # controller and chaos harness scrape these).
+                    snap.update(swap_stats())
                 self._reply(200, snap)
             elif self.path == "/metricsz":
                 text = service.metrics_text()
@@ -133,6 +148,9 @@ def _make_handler():
                     if ctx else None)
             if self.path.rstrip("/") == "/profilez":
                 self._profilez(service, echo)
+                return
+            if self.path.rstrip("/") == "/swapz":
+                self._swapz(service, echo)
                 return
             if not self.path.startswith("/v1/"):
                 self._reply(404, {"error": f"no route {self.path}"}, echo)
@@ -168,6 +186,45 @@ def _make_handler():
                             echo)
             else:
                 self._reply(200, result, echo)
+
+        def _swapz(self, service, echo) -> None:
+            """Hot-swap control endpoint. The checkpoint load runs on
+            THIS thread (one per request — the dispatch plane never
+            blocks on it); 409 while another swap is in flight, the
+            same no-overlap discipline as /profilez."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if length > MAX_BODY_BYTES:
+                    self._reply(413, {"error": "payload too large"}, echo)
+                    return
+                body = json.loads(
+                    self.rfile.read(length).decode("utf-8") or "{}")
+                if not isinstance(body, dict):
+                    raise ValueError("body must be a JSON object")
+                missing = [k for k in ("task", "checkpoint", "version")
+                           if not body.get(k)]
+                if missing:
+                    raise ValueError(f"missing fields {missing}")
+            except ValueError as exc:
+                self._reply(400, {"error": f"bad swap request: {exc}"},
+                            echo)
+                return
+            try:
+                info = service.swap(str(body["task"]),
+                                    str(body["checkpoint"]),
+                                    str(body["version"]))
+            except SwapBusy as exc:
+                self._reply(409, {"error": str(exc)}, echo)
+            except ValueError as exc:
+                code = 404 if "unknown task" in str(exc) else 400
+                self._reply(code, {"error": str(exc)}, echo)
+            except FileNotFoundError as exc:
+                self._reply(400, {"error": str(exc)}, echo)
+            except Exception as exc:
+                self._reply(500, {"error": f"{type(exc).__name__}: {exc}"},
+                            echo)
+            else:
+                self._reply(200, dict(info, ok=True), echo)
 
         def _profilez(self, service, echo) -> None:
             """Arm an on-demand capture. 409 — not a second start — when

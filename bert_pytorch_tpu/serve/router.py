@@ -29,7 +29,16 @@ cannot tell one engine from a fleet. Per request it applies, in order:
   that has outlived the configured percentile of recent latencies fires
   ONE duplicate on the next-best replica and takes whichever answers
   first — the tail-at-scale hedge, budgeted (one hedge per request,
-  only past the percentile) so added load stays a few percent.
+  only past the percentile) so added load stays a few percent;
+* **canary traffic splitting** — with a split installed
+  (:meth:`Router.set_split`, driven by serve/rollout.py), a salted
+  deterministic hash of the request sequence sends ``share`` of the
+  task's traffic to replicas serving the canary version and the rest
+  away from them — a SOFT preference (availability beats cohort
+  purity; fallbacks are counted). Per-cohort outcome windows
+  (:meth:`Router.split_window`) are the rollout controller's SLO
+  evidence, and per-version routed counters export as
+  ``bert_router_version_requests``.
 
 Every ``window`` completed requests emit one schema-v1 ``router_window``
 record (ok/shed/error decomposition, retry/hedge/failover counters,
@@ -142,6 +151,23 @@ def _sample_hash(seq: int) -> float:
     return ((int(seq) * 2654435761) & 0xFFFFFFFF) / float(1 << 32)
 
 
+# Golden-ratio salt decorrelating the canary-cohort hash from the
+# trace-sampling hash of the SAME sequence number — without it the
+# canary cohort and the sampled-trace set would be the same requests
+# whenever the rates matched, and the canary's latency evidence would
+# carry the tracing overhead as a confound.
+_SPLIT_SALT = 0x9E3779B9
+
+
+def _split_hash(seq: int) -> float:
+    """Deterministic [0, 1) cohort hash for canary traffic splitting
+    (serve/rollout.py): the same request sequence number always lands
+    in the same cohort at a given share, and growing the share only
+    ADDS members (hash < share is monotone in share) — a request never
+    flaps between versions as the rollout advances."""
+    return _sample_hash(int(seq) ^ _SPLIT_SALT)
+
+
 def _transport_takes_headers(transport) -> bool:
     """Does the injected transport accept the trace-propagation
     ``headers`` kwarg? Tests and older harnesses inject 4-arg
@@ -185,6 +211,12 @@ class ReplicaState:
         self.inflight = 0           # router-local outstanding dispatches
         self.scrape_failures = 0
         self.requests = 0           # routed to this replica (run total)
+        # Serving model version the replica last reported (its
+        # bert_serve_serving_version label / healthz "version" field).
+        # None until a scrape carries one — canary preference treats an
+        # unknown version as NOT the canary (control traffic may land
+        # there; canary traffic will not).
+        self.version: Optional[str] = None
 
     def eligible(self) -> bool:
         return self.healthy and self.dispatch_alive and not self.draining
@@ -251,7 +283,18 @@ def default_scrape(url: str, timeout_s: float = 2.0) -> Optional[dict]:
             return None
         if resp.status == 200:
             gauges: Dict[str, float] = {}
+            version: Optional[str] = None
             for line in text.splitlines():
+                if line.startswith("bert_serve_serving_version{"):
+                    # Info-style gauge: the version rides the label, the
+                    # value is a constant 1 (serve/service.py).
+                    start = line.find('version="')
+                    if start >= 0:
+                        start += len('version="')
+                        end = line.find('"', start)
+                        if end > start:
+                            version = line[start:end]
+                    continue
                 if line.startswith("bert_serve_") and " " in line:
                     name, _, value = line.partition(" ")
                     try:
@@ -272,6 +315,8 @@ def default_scrape(url: str, timeout_s: float = 2.0) -> Optional[dict]:
                     # reads 0; its unfinished does not).
                     health["unfinished"] = int(
                         gauges["bert_serve_unfinished"])
+                if version is not None:
+                    health["version"] = version
                 return health
         # No tracer on the replica (404) or gauges missing: /healthz
         # carries the same liveness/drain/queue facts as JSON.
@@ -290,6 +335,10 @@ def default_scrape(url: str, timeout_s: float = 2.0) -> Optional[dict]:
         }
         if health.get("unfinished") is not None:
             result["unfinished"] = int(health["unfinished"])
+        if health.get("version"):
+            # Chaos replicas run without a tracer; /healthz carries the
+            # serving version so canary routing still works there.
+            result["version"] = str(health["version"])
         return result
     finally:
         conn.close()
@@ -363,6 +412,16 @@ class Router:
         self._latencies = collections.deque(maxlen=_SAMPLE_CAP)
         self._win = self._zero_window()
         self._run = self._zero_window()
+        # Canary traffic split (serve/rollout.py drives it): one split
+        # at a time, {task, version, share, fallbacks, canary, control}
+        # with a per-cohort outcome accumulator. Per-version routed
+        # counters live for the whole run (the
+        # bert_router_version_requests export). Both under _lock — the
+        # request threads book outcomes while the rollout controller
+        # reads/resets windows (concurrency registry,
+        # analysis/concurrency.py).
+        self._split: Optional[dict] = None
+        self._version_requests: Dict[str, int] = {}
         self._stop_event = threading.Event()
         self._scrape_thread: Optional[threading.Thread] = None
         # Router heartbeat: the same resumable liveness file the trainer
@@ -385,9 +444,15 @@ class Router:
                 "hedge_wasted_ms": 0.0,
                 "failovers": 0, "latency_ms": [], "failover_ms": []}
 
-    def _mint_trace(self) -> Tuple[str, bool]:
+    @staticmethod
+    def _zero_cohort() -> dict:
+        return {"requests": 0, "ok": 0, "sheds": 0, "errors": 0,
+                "latency_ms": []}
+
+    def _mint_trace(self) -> Tuple[str, bool, int]:
         """One fleet-unique trace id + head-sampling decision per client
-        request. The run token namespaces ids across router restarts
+        request (plus the raw sequence number — the canary cohort hash
+        reuses it). The run token namespaces ids across router restarts
         (serve/tracing.py discipline); the sequence hash keeps sampling
         deterministic for replayed bursts."""
         with self._lock:
@@ -395,7 +460,68 @@ class Router:
             self._trace_seq += 1
         sampled = (self.trace_sample_rate > 0.0
                    and _sample_hash(seq) < self.trace_sample_rate)
-        return f"rt-{self._trace_token}-{seq:x}", sampled
+        return f"rt-{self._trace_token}-{seq:x}", sampled, seq
+
+    # -- canary traffic split (serve/rollout.py) --------------------------
+
+    def set_split(self, task: str, version: str, share: float) -> None:
+        """Install or widen the canary split: ``share`` of ``task``
+        traffic (by deterministic request hash) PREFERS replicas serving
+        ``version``; the rest avoids them. One split at a time — a
+        second (task, version) must wait for :meth:`clear_split`."""
+        share = float(share)
+        if not 0.0 <= share <= 1.0:
+            raise ValueError(f"share must be in [0, 1], got {share}")
+        with self._lock:
+            if self._split is not None and (
+                    self._split["task"] != task
+                    or self._split["version"] != version):
+                raise RuntimeError(
+                    "a different split is already active "
+                    f"({self._split['task']}/{self._split['version']}); "
+                    "clear_split() first")
+            if self._split is None:
+                self._split = {
+                    "task": str(task), "version": str(version),
+                    "share": share, "fallbacks": 0,
+                    "canary": self._zero_cohort(),
+                    "control": self._zero_cohort(),
+                }
+            else:
+                self._split["share"] = share
+
+    def clear_split(self) -> None:
+        """Drop the canary split (rollout promoted or rolled back);
+        routing goes back to pure least-loaded."""
+        with self._lock:
+            self._split = None
+
+    def split_window(self, reset: bool = True) -> Optional[dict]:
+        """Per-cohort outcome window since the last reset — the rollout
+        controller's SLO evidence. None when no split is active."""
+        with self._lock:
+            if self._split is None:
+                return None
+            out = {"task": self._split["task"],
+                   "version": self._split["version"],
+                   "share": self._split["share"],
+                   "fallbacks": self._split["fallbacks"]}
+            for cohort in ("canary", "control"):
+                acc = self._split[cohort]
+                summary = {"requests": acc["requests"], "ok": acc["ok"],
+                           "errors": acc["errors"], "sheds": acc["sheds"]}
+                lat = sorted(acc["latency_ms"])
+                if lat:
+                    summary.update(
+                        latency_p50_ms=round(_pctl(lat, 0.50), 3),
+                        latency_p95_ms=round(_pctl(lat, 0.95), 3),
+                        latency_p99_ms=round(_pctl(lat, 0.99), 3))
+                out[cohort] = summary
+                if reset:
+                    self._split[cohort] = self._zero_cohort()
+            if reset:
+                self._split["fallbacks"] = 0
+            return out
 
     # -- health scraping --------------------------------------------------
 
@@ -473,15 +599,27 @@ class Router:
                 unfinished = health.get("unfinished")
                 rep.unfinished = (int(unfinished)
                                   if unfinished is not None else None)
+                if health.get("version"):
+                    rep.version = str(health["version"])
 
     # -- balancing / admission -------------------------------------------
 
-    def _admit(self, exclude: frozenset) -> ReplicaState:
+    def _admit(self, exclude: frozenset,
+               prefer_version: Optional[str] = None,
+               avoid_version: Optional[str] = None) -> ReplicaState:
         """Least-loaded eligible replica, or raise :class:`RouterShed`
         (brownout: every eligible replica saturated; outage: none
         eligible at all). Load is ``ReplicaState.load()`` — unfinished
         (pending + in-flight) when the replica exports it, else queue
-        depth — so a replica mid-batch no longer scrapes as idle."""
+        depth — so a replica mid-batch no longer scrapes as idle.
+
+        ``prefer_version`` / ``avoid_version`` are the canary split's
+        SOFT version preference: when the preferred sub-pool is empty or
+        fully saturated the request falls back to the whole candidate
+        set (counted into the split's ``fallbacks``) — availability
+        always beats cohort purity; a rollout that could strand traffic
+        behind a dead canary would turn every canary crash into a client
+        outage."""
         with self._lock:
             candidates = [rep for rep in self._replicas
                           if rep.eligible() and rep.url not in exclude]
@@ -494,11 +632,28 @@ class Router:
                     "every healthy replica is saturated "
                     f"(unfinished >= {self.brownout_queue_depth}); "
                     "brownout shed", self.shed_retry_after_s)
-            chosen = min(candidates,
+            pool = candidates
+            if prefer_version is not None or avoid_version is not None:
+                if prefer_version is not None:
+                    preferred = [rep for rep in candidates
+                                 if rep.version == prefer_version]
+                else:
+                    preferred = [rep for rep in candidates
+                                 if rep.version != avoid_version]
+                preferred = [rep for rep in preferred
+                             if rep.load() < self.brownout_queue_depth]
+                if preferred:
+                    pool = preferred
+                elif self._split is not None:
+                    self._split["fallbacks"] += 1
+            chosen = min(pool,
                          key=lambda r: (r.load() + r.inflight,
                                         r.inflight, r.index))
             chosen.inflight += 1
             chosen.requests += 1
+            self._version_requests[chosen.version or "unknown"] = \
+                self._version_requests.get(chosen.version or "unknown",
+                                           0) + 1
             return chosen
 
     def _release(self, rep: ReplicaState, failed: bool) -> None:
@@ -543,7 +698,25 @@ class Router:
         router-tier half of the stitched end-to-end tree
         (telemetry/collector.py)."""
         t0 = self._clock()
-        trace_id, sampled = self._mint_trace()
+        trace_id, sampled, seq = self._mint_trace()
+        # Canary cohort (serve/rollout.py): a salted hash of the SAME
+        # sequence number splits traffic deterministically — same
+        # request number, same cohort, and growing the share only adds
+        # members. Computed once here; the preference rides every
+        # admission and hedge pick for this request.
+        with self._lock:
+            split = (dict(self._split)
+                     if self._split is not None else None)
+        cohort: Optional[str] = None
+        prefer_version: Optional[str] = None
+        avoid_version: Optional[str] = None
+        if split is not None and split["task"] == task:
+            if _split_hash(seq) < split["share"]:
+                cohort = "canary"
+                prefer_version = split["version"]
+            else:
+                cohort = "control"
+                avoid_version = split["version"]
         deadline = t0 + self.deadline_s
         exclude: set = set()
         rounds = 0
@@ -561,7 +734,8 @@ class Router:
             self._observe(ok=ok, shed=shed, t0=t0, retries=failed_rounds,
                           hedges=hedges_fired, hedge_won=hedge_won,
                           failover=failover,
-                          hedge_wasted_ms=hedge_wasted_s * 1000.0)
+                          hedge_wasted_ms=hedge_wasted_s * 1000.0,
+                          cohort=cohort)
             if sampled:
                 self._emit_trace(
                     trace_id, task, status, t0, spans,
@@ -575,7 +749,9 @@ class Router:
         while True:
             t_admit = self._clock()
             try:
-                replica = self._admit(frozenset(exclude))
+                replica = self._admit(frozenset(exclude),
+                                      prefer_version=prefer_version,
+                                      avoid_version=avoid_version)
             except RouterShed as shed:
                 spans.append(self._span("admission", t0, t_admit))
                 return finish(503, {"error": str(shed)},
@@ -592,7 +768,9 @@ class Router:
                 self._dispatch_hedged(
                     replica, task, payload, remaining, exclude,
                     trace_id=trace_id, trace_sampled=sampled,
-                    attempt_base=attempt_base)
+                    attempt_base=attempt_base,
+                    prefer_version=prefer_version,
+                    avoid_version=avoid_version)
             attempt_base += len(attempts)
             hedges_fired += 1 if hedged else 0
             winner = None
@@ -693,7 +871,9 @@ class Router:
     def _dispatch_hedged(self, primary: ReplicaState, task: str,
                          payload: dict, timeout_s: float, exclude: set,
                          trace_id: str, trace_sampled: bool,
-                         attempt_base: int
+                         attempt_base: int,
+                         prefer_version: Optional[str] = None,
+                         avoid_version: Optional[str] = None
                          ) -> Tuple[Optional[int], dict, bool, bool, set,
                                     List[dict]]:
         """One dispatch round, possibly hedged: (status, body, hedged,
@@ -797,7 +977,9 @@ class Router:
                     # schema-invalid record on a healthy run.
                     hedge_tried = True
                     hedge_rep = self._pick_hedge(
-                        exclude | launched_urls)
+                        exclude | launched_urls,
+                        prefer_version=prefer_version,
+                        avoid_version=avoid_version)
                     if hedge_rep is not None:
                         hedged = True
                         launched_urls.add(hedge_rep.url)
@@ -831,17 +1013,39 @@ class Router:
             failed_urls.add(primary.url)
         return status, body, hedged, False, failed_urls, attempts
 
-    def _pick_hedge(self, exclude: set) -> Optional[ReplicaState]:
+    def _pick_hedge(self, exclude: set,
+                    prefer_version: Optional[str] = None,
+                    avoid_version: Optional[str] = None
+                    ) -> Optional[ReplicaState]:
         with self._lock:
             candidates = [rep for rep in self._replicas
                           if rep.eligible() and rep.url not in exclude]
             if not candidates:
                 return None
-            chosen = min(candidates,
+            # Same soft version preference as _admit: a canary request's
+            # hedge should race the SAME version (its latency evidence
+            # must not mix versions), but a no-target hedge falls back
+            # rather than not firing — tail rescue beats cohort purity.
+            pool = candidates
+            if prefer_version is not None or avoid_version is not None:
+                if prefer_version is not None:
+                    preferred = [rep for rep in candidates
+                                 if rep.version == prefer_version]
+                else:
+                    preferred = [rep for rep in candidates
+                                 if rep.version != avoid_version]
+                if preferred:
+                    pool = preferred
+                elif self._split is not None:
+                    self._split["fallbacks"] += 1
+            chosen = min(pool,
                          key=lambda r: (r.load() + r.inflight,
                                         r.inflight, r.index))
             chosen.inflight += 1
             chosen.requests += 1
+            self._version_requests[chosen.version or "unknown"] = \
+                self._version_requests.get(chosen.version or "unknown",
+                                           0) + 1
             return chosen
 
     # -- telemetry --------------------------------------------------------
@@ -849,9 +1053,24 @@ class Router:
     def _observe(self, ok: bool, shed: bool, t0: float, retries: int = 0,
                  hedges: int = 0, hedge_won: bool = False,
                  failover: bool = False,
-                 hedge_wasted_ms: float = 0.0) -> None:
+                 hedge_wasted_ms: float = 0.0,
+                 cohort: Optional[str] = None) -> None:
         latency_ms = (self._clock() - t0) * 1000.0
         with self._lock:
+            # Cohort booking rides the same acquisition as the window
+            # counters: the rollout controller's split_window() read can
+            # never see a request half-booked.
+            if cohort is not None and self._split is not None:
+                acc = self._split.get(cohort)
+                if acc is not None:
+                    acc["requests"] += 1
+                    if shed:
+                        acc["sheds"] += 1
+                    elif ok:
+                        acc["ok"] += 1
+                        acc["latency_ms"].append(latency_ms)
+                    else:
+                        acc["errors"] += 1
             for acc in (self._win, self._run):
                 acc["requests"] += 1
                 acc["retries"] += retries
@@ -933,7 +1152,20 @@ class Router:
                 "draining": rep.draining, "queue_depth": rep.queue_depth,
                 "unfinished": rep.unfinished,
                 "inflight": rep.inflight, "requests": rep.requests,
+                "version": rep.version,
             } for rep in self._replicas]
+            record["version_requests"] = dict(self._version_requests)
+            if self._split is not None:
+                record["split"] = {
+                    "task": self._split["task"],
+                    "version": self._split["version"],
+                    "share": self._split["share"],
+                    "fallbacks": self._split["fallbacks"],
+                    "canary_requests":
+                        self._split["canary"]["requests"],
+                    "control_requests":
+                        self._split["control"]["requests"],
+                }
         return record
 
     def metrics_text(self, prefix: str = "bert_router") -> str:
@@ -990,6 +1222,20 @@ class Router:
                 lines.append(
                     f'{name}{{replica="{i}",field="unfinished"}} '
                     f"{render(rep['unfinished'])}")
+        # Per-version routed counters (the rollout's traffic-shift
+        # evidence): rendered from the SAME snapshot as /statsz, so the
+        # two surfaces cannot drift. "unknown" = routed before the first
+        # scrape carried a version.
+        version_requests = snap.get("version_requests") or {}
+        if version_requests:
+            vname = f"{prefix}_version_requests"
+            lines.append(f"# HELP {vname} Requests routed per serving "
+                         "model version (run total).")
+            lines.append(f"# TYPE {vname} counter")
+            for version in sorted(version_requests):
+                lines.append(
+                    f'{vname}{{version="{version}"}} '
+                    f"{render(version_requests[version])}")
         return "\n".join(lines) + "\n"
 
     def healthy_count(self) -> int:

@@ -739,6 +739,26 @@ class ServingService:
         for thread in threads:
             thread.start()
 
+    # -- hot swap ----------------------------------------------------------
+
+    def serving_version(self) -> Optional[str]:
+        """The engine's serving model version (getattr: test fakes may
+        not carry the swap plumbing — None then, and the version fields
+        simply stay off the surfaces)."""
+        version = getattr(self.engine, "version", None)
+        return version() if callable(version) else None
+
+    def swap(self, task: str, checkpoint: str, version: str) -> dict:
+        """Hot-swap one task to ``checkpoint`` as ``version`` (the
+        /swapz control endpoint, docs/serving.md "Model registry &
+        canary rollouts"). Runs on the calling (HTTP control) thread —
+        the load happens off the dispatch path and only the atomic flip
+        touches state the executor reads; in-flight batches complete
+        against the old version. Raises engine.SwapBusy when a swap is
+        already in flight (HTTP 409)."""
+        return self.engine.swap_params(
+            task, checkpoint, version, emit=self.telemetry.emit)
+
     # -- health / drain ----------------------------------------------------
 
     @property
@@ -786,6 +806,13 @@ class ServingService:
             "queue_depth": self.batcher.depth(),
             "unfinished": self.batcher.unfinished(),
         }
+        version = self.serving_version()
+        if version is not None:
+            # The serving model version rides /healthz too: chaos
+            # replicas run without a tracer (no /metricsz), and the
+            # router's scrape fallback must still learn the version
+            # (serve/router.py default_scrape).
+            health["version"] = version
         if self.dispatch_mode == "pipelined":
             health["stages"] = {
                 t.name.replace("serve-", "", 1): t.is_alive()
@@ -964,4 +991,14 @@ class ServingService:
               "Engine AOT warmup wall time (serve_cold_start record).")
         gauge("warmup_compiles_cold", snap.get("warmup_compiles_cold"),
               "Real XLA compiles during warmup (0 = warm restart).")
+        version = self.serving_version()
+        if version is not None:
+            # Label-valued gauge (value is always 1; the label carries
+            # the version string) — the idiomatic Prometheus "info"
+            # metric, and what the router's scrape parses.
+            lines.append("# HELP bert_serve_serving_version The model "
+                         "version this replica is serving (label).")
+            lines.append("# TYPE bert_serve_serving_version gauge")
+            lines.append(
+                f'bert_serve_serving_version{{version="{version}"}} 1')
         return "\n".join(lines) + "\n"

@@ -46,6 +46,7 @@ never the supervisor itself.
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
 import signal
@@ -53,6 +54,7 @@ import subprocess
 import sys
 import threading
 import time
+import urllib.parse
 from typing import Callable, List, Optional, Sequence
 
 
@@ -536,6 +538,83 @@ class Supervisor:
                        for rc in rcs.values() if rc is not None)
         return {"rcs": rcs, "drain_killed": killed,
                 "all_graceful": graceful and killed == 0}
+
+    # -- hot-swap control (docs/serving.md "Model registry & canary
+    # rollouts") ----------------------------------------------------------
+
+    def swap_replica(self, index: int, task: str, checkpoint: str,
+                     version: str, timeout_s: float = 120.0) -> dict:
+        """Drive one replica's ``POST /swapz`` (serve/http.py): load the
+        checkpoint on the replica's control thread, flip its serving
+        params atomically. The supervisor resolves the checkpoint path
+        from the registry PARENT-SIDE — the replica never needs the
+        registry module, only a readable file. Returns the swap info
+        dict; raises RuntimeError on a non-200 answer (the caller —
+        rollout controller or chaos harness — decides whether that is
+        fatal). Every attempt emits fleet_event swap_requested and then
+        swap_ok (with the compile split) or swap_failed."""
+        with self._lock:
+            matches = [rep for rep in self._replicas
+                       if rep.spec.index == int(index)]
+        if not matches:
+            raise ValueError(f"no replica with index {index}")
+        rep = matches[0]
+        self._emit("swap_requested", rep, task=str(task),
+                   version=str(version))
+        body = json.dumps({"task": str(task),
+                           "checkpoint": str(checkpoint),
+                           "version": str(version)}).encode("utf-8")
+        parsed = urllib.parse.urlsplit(rep.spec.url)
+        conn = http.client.HTTPConnection(
+            parsed.hostname, parsed.port, timeout=max(1.0, timeout_s))
+        try:
+            try:
+                conn.request("POST", "/swapz", body=body,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read().decode("utf-8", "replace")
+                status = resp.status
+            except OSError as exc:
+                self._emit("swap_failed", rep, task=str(task),
+                           version=str(version),
+                           error=f"{type(exc).__name__}: {exc}")
+                raise RuntimeError(
+                    f"swap transport failure on replica {index}: "
+                    f"{exc}") from exc
+        finally:
+            conn.close()
+        try:
+            info = json.loads(data) if data else {}
+        except ValueError:
+            info = {"error": data[:200]}
+        if status != 200:
+            self._emit("swap_failed", rep, task=str(task),
+                       version=str(version), status=int(status),
+                       error=str(info.get("error", ""))[:200])
+            raise RuntimeError(
+                f"swap failed on replica {index} "
+                f"(status {status}): {info.get('error')}")
+        self._emit("swap_ok", rep, task=str(task), version=str(version),
+                   load_s=info.get("load_s"),
+                   compiles_cold=info.get("compiles_cold"),
+                   compiles_warm=info.get("compiles_warm"))
+        return info
+
+    def swap_all(self, task: str, checkpoint: str, version: str,
+                 timeout_s: float = 120.0,
+                 skip_indices: Sequence[int] = ()) -> List[dict]:
+        """Swap every replica SEQUENTIALLY (skipping ``skip_indices`` —
+        the canary replicas that already serve the version). Sequential
+        on purpose: with N-1 replicas still serving, one replica busy
+        loading costs capacity, never availability; swapping the fleet
+        at once would stack every load on the same window."""
+        skip = {int(i) for i in skip_indices}
+        with self._lock:
+            indices = [rep.spec.index for rep in self._replicas
+                       if rep.spec.index not in skip]
+        return [self.swap_replica(i, task, checkpoint, version,
+                                  timeout_s=timeout_s)
+                for i in indices]
 
     # -- introspection ----------------------------------------------------
 

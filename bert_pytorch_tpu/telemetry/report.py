@@ -118,6 +118,8 @@ def summarize_records(records, name: str = "") -> dict:
     router_traces = []
     trace_stitches = []
     fleet_events = []
+    registry_events = []
+    rollout_windows = []
     obs_scrapes = []
     obs_windows = []
     profile_windows = []
@@ -165,6 +167,10 @@ def summarize_records(records, name: str = "") -> dict:
             trace_stitches.append(rec)
         elif kind == "fleet_event":
             fleet_events.append(rec)
+        elif kind == "registry_event":
+            registry_events.append(rec)
+        elif kind == "rollout_window":
+            rollout_windows.append(rec)
         elif kind == "obs_scrape":
             obs_scrapes.append(rec)
         elif kind == "obs_fleet_window":
@@ -583,6 +589,50 @@ def summarize_records(records, name: str = "") -> dict:
             if rec.get("event") == "restart_scheduled" and rec.get("crash"))
         out["fleet_wedged_kills"] = by_event.get("wedged_kill", 0)
         out["fleet_gave_up"] = by_event.get("gave_up", 0)
+        out["fleet_swap_failures"] = by_event.get("swap_failed", 0)
+
+    # -- deployment plane (serve/registry.py, serve/rollout.py, docs/
+    # serving.md "Model registry & canary rollouts") ---------------------
+    # rollout_window records are the canary's per-window SLO evidence;
+    # the two counters behind the zero-tolerance gates are breaches
+    # (slo_ok false anywhere) and torn serves (a request observed a
+    # params flip mid-execution — structurally impossible under the
+    # engine's atomic swap, which is exactly why telemetry counts it).
+    if registry_events:
+        out["registry_events"] = len(registry_events)
+        by_ev: dict = {}
+        for rec in registry_events:
+            name = str(rec.get("event", "?"))
+            by_ev[name] = by_ev.get(name, 0) + 1
+        out["registry_event_kinds"] = dict(sorted(by_ev.items()))
+        out["registry_rollbacks"] = sum(
+            1 for rec in registry_events
+            if rec.get("event") == "state_change"
+            and rec.get("from_state") == "canary"
+            and rec.get("state") == "staged")
+    if rollout_windows:
+        out["rollout_windows"] = len(rollout_windows)
+        out["rollout_slo_breaches"] = sum(
+            1 for w in rollout_windows if w.get("slo_ok") is False)
+        out["rollout_rollbacks"] = sum(
+            1 for w in rollout_windows if w.get("action") == "rollback")
+        out["rollout_torn_serves"] = sum(
+            int(w.get("torn_serves", 0)) for w in rollout_windows)
+        out["rollout_max_share"] = max(
+            float(w.get("canary_share", 0.0)) for w in rollout_windows)
+        out["rollout_final_action"] = str(
+            rollout_windows[-1].get("action", "?"))
+        canary_reqs = sum(int(w.get("window_requests", 0))
+                          for w in rollout_windows)
+        out["rollout_canary_requests"] = canary_reqs
+        p95s = [float(w["latency_p95_ms"]) for w in rollout_windows
+                if w.get("latency_p95_ms") is not None]
+        if p95s:
+            out["rollout_canary_p95_ms"] = round(max(p95s), 3)
+        burns = [float(w["budget_burn"]) for w in rollout_windows
+                 if w.get("budget_burn") is not None]
+        if burns:
+            out["rollout_budget_burn"] = round(max(burns), 4)
 
     # -- fleet observatory section (telemetry/collector.py, docs/
     # observability.md) --------------------------------------------------
@@ -797,12 +847,20 @@ def compare(base: dict, new: dict, tolerances: Optional[dict] = None):
     # would wave through as "n/a" — while a single new orphan means a
     # span went missing between tiers, which is exactly the regression
     # the "orphan span share" gate exists to name.
+    # The deployment-plane pair rides here too: a canary window that
+    # breached its SLO (rollout_slo_breaches) or a single torn-model
+    # serve (rollout_torn_serves) is zero on any healthy rollout — the
+    # breach gate is what the auto-rollback E2E proves fires, and the
+    # torn gate is the atomic-swap invariant made falsifiable.
     for key, label in (("nonfinite_steps", "non-finite steps"),
                        ("divergence_warnings", "divergence warnings"),
                        ("serve_compiles_cold", "serve cold compiles"),
                        ("router_errors", "router client-visible errors"),
                        ("fleet_gave_up", "fleet replicas given up"),
-                       ("trace_orphans", "orphan span share")):
+                       ("trace_orphans", "orphan span share"),
+                       ("rollout_slo_breaches", "rollout canary SLO"),
+                       ("rollout_torn_serves",
+                        "rollout torn-model serves")):
         b, n = int(base.get(key, 0)), int(new.get(key, 0))
         if n > b:
             entry = {"metric": key, "label": label, "base": b, "new": n,
@@ -857,7 +915,13 @@ def format_summary(summary: dict) -> str:
              "trace_router_overhead_share", "trace_network_gap_share",
              "trace_replica_share",
              "fleet_events", "fleet_spawns", "fleet_crash_restarts",
-             "fleet_wedged_kills", "fleet_gave_up",
+             "fleet_wedged_kills", "fleet_gave_up", "fleet_swap_failures",
+             "registry_events", "registry_rollbacks",
+             "rollout_windows", "rollout_canary_requests",
+             "rollout_max_share", "rollout_canary_p95_ms",
+             "rollout_budget_burn", "rollout_slo_breaches",
+             "rollout_rollbacks", "rollout_torn_serves",
+             "rollout_final_action",
              "obs_scrapes", "obs_targets", "obs_scrape_failures",
              "fleet_windows", "fleet_targets", "fleet_healthy_min",
              "fleet_scrape_staleness_s", "fleet_worst_replica_p99_ms",
@@ -898,6 +962,11 @@ def format_summary(summary: dict) -> str:
         lines.append(f"  {'fleet_event_kinds':>22}: "
                      + ", ".join(f"{k}={v}" for k, v
                                  in summary["fleet_event_kinds"].items()))
+    if summary.get("registry_event_kinds"):
+        lines.append(f"  {'registry_event_kinds':>22}: "
+                     + ", ".join(
+                         f"{k}={v}" for k, v
+                         in summary["registry_event_kinds"].items()))
     if summary.get("fault_kinds"):
         lines.append(f"  {'fault_kinds':>22}: "
                      + ", ".join(summary["fault_kinds"]))

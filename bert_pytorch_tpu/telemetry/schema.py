@@ -187,6 +187,23 @@ KIND_REQUIRED_KEYS = {
     # "perf ledger drift" gate regresses the newest entry against the
     # rolling median of its leg's history
     "ledger_entry": ("leg", "config_digest", "metrics"),
+    # -- deployment plane (serve/registry.py, serve/rollout.py,
+    # docs/serving.md "Model registry & canary rollouts") ---------------
+    # one model-registry lifecycle event: a version published into the
+    # registry, or one state-machine transition between the lifecycle
+    # states below — transitions carry from_state, and a rollback
+    # (canary -> staged) must carry the SLO-breach reason that forced it
+    "registry_event": ("version", "event", "state"),
+    # one canary observation window (serve/rollout.py RolloutController):
+    # the canary cohort's ok/error decomposition and latency percentiles
+    # at one traffic share, the SLO verdict + error-budget burn the
+    # promotion gate read, the action taken (hold|advance|promote|
+    # rollback), and the torn-serve count the zero-tolerance
+    # "rollout torn-model serves" report gate regresses on
+    "rollout_window": (
+        "task", "version", "stage", "canary_share", "window_requests",
+        "ok", "errors", "slo_ok", "action", "torn_serves",
+    ),
 }
 
 # Target kinds the collector scrapes (telemetry/collector.py; mirrored
@@ -216,6 +233,26 @@ LEDGER_METRIC_DIRECTIONS = {
     "cold_start_s": "up",
     "padding_efficiency": "down",
 }
+
+# Model-registry version lifecycle (serve/registry.py; mirrored here so
+# the schema lint stays stdlib-only/jax-free like TRACE_PHASES). A
+# version enters the registry as ``staged``; only the edges below are
+# legal, and the canary -> staged edge (a rollback) must name its breach
+# reason — serve/registry.py imports THESE tuples, so the state machine
+# the registry enforces and the one the lint checks cannot drift.
+REGISTRY_STATES = ("staged", "canary", "live", "retired")
+REGISTRY_TRANSITIONS = (
+    ("staged", "canary"),    # rollout began (first traffic share)
+    ("canary", "live"),      # promoted after green observation windows
+    ("canary", "staged"),    # rolled back on SLO breach (reason required)
+    ("staged", "retired"),   # abandoned without ever taking traffic
+    ("live", "retired"),     # superseded by a promoted successor
+)
+
+# What a rollout_window decided (serve/rollout.py RolloutController):
+# hold at the current share, advance to the next stage, promote to live,
+# or roll back to the previous version.
+ROLLOUT_ACTIONS = ("hold", "advance", "promote", "rollback")
 
 # serve_trace span names (serve/tracing.py PHASES, mirrored here so the
 # schema module stays stdlib-only/jax-free — tools/check_telemetry_schema
@@ -320,6 +357,10 @@ def validate_record(rec) -> list:
                     _check_profile_fields(rec, errors)
                 if kind == "ledger_entry":
                     _check_ledger_fields(rec, errors)
+                if kind == "registry_event":
+                    _check_registry_event_fields(rec, errors)
+                if kind == "rollout_window":
+                    _check_rollout_window_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -1114,6 +1155,94 @@ def _check_ledger_fields(rec, errors) -> None:
                 f"got {nums[key]!r}")
 
 
+def _check_registry_event_fields(rec, errors) -> None:
+    """registry_event consistency (serve/registry.py): the version name
+    is the join key across registry/rollout/fleet records, the resulting
+    state must be a known lifecycle state, and a transition must be a
+    legal state-machine edge — a rollback additionally names WHY (the
+    breach reason is where the post-incident read starts)."""
+    for key in ("version", "event"):
+        v = rec.get(key)
+        if not isinstance(v, str) or not v:
+            errors.append(f"{key} must be a non-empty string, got {v!r}")
+    state = rec.get("state")
+    if state not in REGISTRY_STATES:
+        errors.append(
+            f"state must be one of {REGISTRY_STATES}, got {state!r}")
+    from_state = rec.get("from_state")
+    if from_state is not None:
+        if (from_state, state) not in REGISTRY_TRANSITIONS:
+            errors.append(
+                f"illegal registry transition {from_state!r} -> "
+                f"{state!r} (legal edges: {REGISTRY_TRANSITIONS})")
+        if (from_state, state) == ("canary", "staged"):
+            reason = rec.get("reason")
+            if not isinstance(reason, str) or not reason:
+                errors.append(
+                    "a rollback (canary -> staged) must carry a "
+                    f"non-empty 'reason', got {reason!r}")
+    elif rec.get("event") == "state_change":
+        errors.append("event 'state_change' requires from_state")
+    digest = rec.get("digest")
+    if digest is not None and (not isinstance(digest, str) or not digest):
+        errors.append(f"digest must be a non-empty string, got {digest!r}")
+
+
+def _check_rollout_window_fields(rec, errors) -> None:
+    """rollout_window consistency (serve/rollout.py): the canary share
+    is a traffic fraction, the cohort's ok/error split must fit inside
+    its window, percentiles are ordered, the action is one of the
+    controller's four decisions, and a rollback names its breach."""
+    for key in ("task", "version"):
+        v = rec.get(key)
+        if not isinstance(v, str) or not v:
+            errors.append(f"{key} must be a non-empty string, got {v!r}")
+    stage = rec.get("stage")
+    if not isinstance(stage, int) or isinstance(stage, bool) or stage < 0:
+        errors.append(
+            f"stage must be a non-negative integer, got {stage!r}")
+    share = rec.get("canary_share")
+    if not _is_number(share) or not 0 <= share <= 1:
+        errors.append(f"canary_share must be in [0, 1], got {share!r}")
+    counts = {}
+    for key in ("window_requests", "ok", "errors", "torn_serves"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{key} must be a non-negative integer, got {v!r}")
+        else:
+            counts[key] = v
+    if {"window_requests", "ok", "errors"} <= set(counts) and \
+            counts["ok"] + counts["errors"] > counts["window_requests"]:
+        errors.append(
+            "ok + errors exceeds window_requests "
+            f"({counts['ok']} + {counts['errors']} > "
+            f"{counts['window_requests']})")
+    if not isinstance(rec.get("slo_ok"), bool):
+        errors.append(
+            f"slo_ok must be a boolean, got {rec.get('slo_ok')!r}")
+    action = rec.get("action")
+    if action not in ROLLOUT_ACTIONS:
+        errors.append(
+            f"action must be one of {ROLLOUT_ACTIONS}, got {action!r}")
+    if action == "rollback":
+        reason = rec.get("reason")
+        if not isinstance(reason, str) or not reason:
+            errors.append(
+                "action 'rollback' must carry a non-empty 'reason', "
+                f"got {reason!r}")
+    vals = [rec.get(f"latency_{p}_ms") for p in ("p50", "p95", "p99")]
+    nums = [v for v in vals if _is_number(v)]
+    if len(nums) == 3 and not (nums[0] <= nums[1] <= nums[2]):
+        errors.append(
+            f"latency percentiles not ordered (p50 <= p95 <= p99): "
+            f"{nums}")
+    burn = rec.get("budget_burn")
+    if burn is not None and (not _is_number(burn) or burn < 0):
+        errors.append(
+            f"budget_burn must be a non-negative number, got {burn!r}")
+
+
 def _check_resume_fields(rec, errors) -> None:
     """Resume-record consistency: ``skipped`` is a list of objects each
     naming what was passed over and why (utils/checkpoint.py walk-back)."""
@@ -1176,10 +1305,46 @@ def _reject_constant(name):
 
 
 def validate_file(path: str) -> list:
-    """(line_number, error) pairs for a JSONL file; empty list = valid."""
+    """(line_number, error) pairs for a JSONL file; empty list = valid.
+
+    Beyond the per-line rules this applies the one CROSS-record lint the
+    stream carries: within one (task, version) rollout, ``canary_share``
+    may only advance (the controller holds or grows the cohort) until an
+    explicit ``rollback`` record resets the ramp — a share that shrinks
+    without a rollback means two controllers fought over the split,
+    which no single emitter produces."""
     errors = []
+    shares: dict = {}
     with open(path, "r", encoding="utf-8") as f:
         for lineno, line in enumerate(f, start=1):
-            for err in validate_line(line):
+            line_errors = validate_line(line)
+            for err in line_errors:
                 errors.append((lineno, err))
+            stripped = line.strip()
+            if line_errors or not stripped:
+                continue
+            rec = json.loads(stripped)
+            if isinstance(rec, dict) and "schema" in rec \
+                    and rec.get("kind") == "rollout_window":
+                for err in _check_rollout_sequence(rec, shares):
+                    errors.append((lineno, err))
     return errors
+
+
+def _check_rollout_sequence(rec, shares: dict) -> list:
+    """The cross-record monotone-share rule (see validate_file)."""
+    key = (rec.get("task"), rec.get("version"))
+    share = rec.get("canary_share")
+    if not _is_number(share):
+        return []
+    if rec.get("action") == "rollback":
+        shares.pop(key, None)  # a re-attempt starts the ramp over
+        return []
+    last = shares.get(key)
+    shares[key] = max(share, last) if last is not None else share
+    if last is not None and share < last:
+        return [
+            f"canary_share regressed without a rollback for task "
+            f"{rec.get('task')!r} version {rec.get('version')!r}: "
+            f"{share} < {last} (shares advance monotonically per stage)"]
+    return []

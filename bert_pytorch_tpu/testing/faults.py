@@ -40,6 +40,16 @@ The spec is a comma-separated list of points::
                      requests provably inside the admission window
                      (serve/service.py pipelined dispatch,
                      tools/chaos_serve.py)
+    swap_hold@N      hold a hot-swap OPEN on the Nth swap attempt,
+    swap_hold@NxS    between the new params finishing their load and
+                     the atomic flip (serve/engine.py swap_params): the
+                     fault check emits its injection record (the chaos
+                     harness's cue to SIGKILL the replica mid-swap)
+                     then sleeps S seconds (default 5) — so a kill
+                     lands with two complete param trees in memory and
+                     the flip not yet taken, proving in-flight batches
+                     only ever see the OLD consistent version
+                     (tools/chaos_serve.py swap phase)
 
 Everything is keyed on explicit step numbers / call counts — rerunning
 the same spec on the same data reproduces the same failure, which is
@@ -98,7 +108,8 @@ class FaultPlan:
             point = m.group("point")
             step = m.group("step")
             count = int(m.group("count") or 0)
-            if point in _STEP_POINTS or point in ("wedge", "admit_hold"):
+            if point in _STEP_POINTS or point in ("wedge", "admit_hold",
+                                                  "swap_hold"):
                 if step is None:
                     raise FaultSpecError(
                         f"fault point {point!r} needs @step (e.g. "
@@ -111,7 +122,7 @@ class FaultPlan:
                 raise FaultSpecError(
                     f"unknown fault point {point!r} (known: "
                     f"{', '.join(_STEP_POINTS)}, shard_error, wedge, "
-                    f"admit_hold)")
+                    f"admit_hold, swap_hold)")
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -218,6 +229,28 @@ class FaultPlan:
         if emit is not None:
             emit(self._record("injected_admit_hold", None,
                               batches_assembled=int(batches_assembled),
+                              hold_s=hold_s))
+        time.sleep(hold_s)
+
+    def serve_swap_check(self, swaps_attempted: int,
+                         emit: Optional[Callable] = None) -> None:
+        """Hold the calling (control) thread inside the swap window on
+        the armed ``swap_hold@N``-th swap attempt — AFTER the new params
+        finished loading, BEFORE the atomic flip (serve/engine.py
+        swap_params): emit the injection record FIRST (the chaos
+        harness's cue to SIGKILL this replica mid-swap), then sleep S
+        seconds (default 5 — a hold, not a wedge: an unkilled replica
+        resumes and completes the flip late). Fires at most once per
+        plan."""
+        cfg = self._points.get("swap_hold")
+        if (cfg is None or swaps_attempted < cfg["step"]
+                or "swap_hold" in self._fired):
+            return
+        self._fired.add("swap_hold")
+        hold_s = cfg["count"] or 5
+        if emit is not None:
+            emit(self._record("injected_swap_hold", None,
+                              swaps_attempted=int(swaps_attempted),
                               hold_s=hold_s))
         time.sleep(hold_s)
 

@@ -117,6 +117,19 @@ def parse_arguments(argv=None):
                              "without an output_dir")
     parser.add_argument("--compile_cache_dir", type=str, default="",
                         help="persistent XLA compile cache; empty disables")
+    parser.add_argument("--serving_version", type=str, default="v0",
+                        help="model version this replica starts on "
+                             "(serve/registry.py names; reported on "
+                             "/healthz, /statsz and the "
+                             "bert_serve_serving_version gauge — the "
+                             "router's canary split routes on it)")
+    parser.add_argument("--save_init_checkpoint", type=str, default="",
+                        help="write the first task's (possibly random-"
+                             "init) params as ckpt_0.msgpack + integrity "
+                             "manifest under this dir before serving — "
+                             "gives a jax-free parent (tools/"
+                             "chaos_serve.py) a real checkpoint to "
+                             "publish into a model registry")
     args = parser.parse_args(argv)
 
     with open(args.model_config_file) as f:
@@ -261,6 +274,7 @@ def build_service(args):
         epilogue_slots=args.epilogue_slots,
         autotune=args.autotune,
         autotune_cache=args.autotune_cache or None,
+        version=getattr(args, "serving_version", "v0"),
     )
     batcher = Batcher(
         max_batch_size=args.max_batch_size,
@@ -292,6 +306,19 @@ def main(args) -> int:
 
     logger.init(handlers=[logger.StreamHandler()])
     service, sink = build_service(args)
+    save_dir = getattr(args, "save_init_checkpoint", "")
+    if save_dir:
+        # Materialize the first task's params as a real, manifested
+        # checkpoint BEFORE serving: the jax-free chaos/rollout parent
+        # publishes this file into a model registry and swaps it back in
+        # as a new version (same geometry, so the swap compiles nothing).
+        from bert_pytorch_tpu.utils import checkpoint as ckpt_util
+
+        first_task = sorted(service.engine.tasks)[0]
+        ckpt_path = ckpt_util.save_checkpoint(
+            save_dir, 0,
+            {"model": service.engine.tasks[first_task].params, "epoch": 0})
+        logger.info(f"init checkpoint for task {first_task}: {ckpt_path}")
     if service.flight_recorder is not None:
         # Log lines tee into the flight-recorder ring too: a postmortem
         # carries the replica's last words, not just its last records.

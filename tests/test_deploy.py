@@ -1,0 +1,709 @@
+"""Deployment-plane unit tests (PR 19, docs/serving.md "Model registry
+& canary rollouts"): the versioned registry's state machine /
+torn-write discipline / digest verification (plus its jax-free CLI),
+the engine's atomic hot-swap, the router's deterministic request-hash
+canary split and per-version counters, the SLO-gated RolloutController
+against fake windows, the registry_event / rollout_window schema
+fixtures, and the zero-tolerance report gates.
+
+The end-to-end proof — a real 2-replica fleet rolling a published
+version 1% -> 50% -> 100% and auto-rolling a degraded one back — is
+``tools/chaos_serve.py --canary`` (tests/test_fleet_chaos.py, slow
+tier); the SIGKILL-mid-swap torn-model proof is ``--smoke`` phase D.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from bert_pytorch_tpu.serve.registry import (GEOMETRY_KEYS, ModelRegistry,
+                                             RegistryError,
+                                             geometry_from_config)
+from bert_pytorch_tpu.serve.rollout import RolloutController, RolloutError
+from bert_pytorch_tpu.serve.router import Router, _split_hash
+from bert_pytorch_tpu.telemetry import report, schema
+from bert_pytorch_tpu.utils.retry import RetryPolicy
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+
+
+def _ckpt(tmp_path, name="ckpt_0.msgpack", payload=b"model-bytes" * 64):
+    path = str(tmp_path / name)
+    with open(path, "wb") as f:
+        f.write(payload)
+    return path
+
+
+def _emitter():
+    records: list = []
+
+    def emit(rec):
+        records.append(dict(rec))
+
+    return records, emit
+
+
+def _lint_records(records, tmp_path, name):
+    """Stamp the sink envelope and run BOTH the per-record and the
+    cross-record (file) lint — what a real artifact stream faces."""
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        for i, rec in enumerate(records):
+            rec = dict(rec, schema=schema.SCHEMA_VERSION,
+                       ts=1754300000.0 + i)
+            assert schema.validate_record(rec) == [], rec
+            f.write(json.dumps(rec) + "\n")
+    assert schema.validate_file(path) == []
+
+
+# ---------------------------------------------------------------------------
+# serve/registry.py: publish, state machine, verification
+
+
+def test_registry_publish_digests_and_is_immutable(tmp_path):
+    records, emit = _emitter()
+    reg = ModelRegistry(str(tmp_path / "reg"), emit=emit)
+    ckpt = _ckpt(tmp_path)
+    manifest = reg.publish("v1", task="classify", checkpoint=ckpt,
+                           quantize="int8",
+                           geometry={"hidden_size": 32})
+    assert manifest["state"] == "staged"
+    assert manifest["sha256"] and manifest["size_bytes"] == \
+        os.path.getsize(ckpt)
+    assert manifest["quantize"] == "int8"
+    # Versions are immutable: republishing the name refuses.
+    with pytest.raises(RegistryError, match="already published"):
+        reg.publish("v1", task="classify", checkpoint=ckpt)
+    # A fresh instance reads the same manifest back off disk.
+    again = ModelRegistry(str(tmp_path / "reg"))
+    assert again.get("v1")["sha256"] == manifest["sha256"]
+    assert [m["version"] for m in again.list_versions()] == ["v1"]
+    assert records[0]["kind"] == "registry_event"
+    assert records[0]["event"] == "published"
+
+
+def test_registry_refuses_missing_checkpoint(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(RegistryError, match="checkpoint missing"):
+        reg.publish("v1", task="classify",
+                    checkpoint=str(tmp_path / "nope.msgpack"))
+
+
+def test_registry_state_machine_edges(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    ckpt = _ckpt(tmp_path)
+    reg.publish("v1", task="classify", checkpoint=ckpt)
+    # The only legal first move is staged -> canary (or retire).
+    with pytest.raises(RegistryError, match="illegal transition"):
+        reg.set_state("v1", "live")
+    reg.begin_canary("v1")
+    # A rollback must carry its breach reason.
+    with pytest.raises(RegistryError, match="requires a reason"):
+        reg.set_state("v1", "staged")
+    reg.rollback("v1", "canary p95 breach")
+    assert reg.get("v1")["state"] == "staged"
+    assert reg.get("v1")["history"][-1]["reason"] == "canary p95 breach"
+    # Re-canary and promote; a second promoted version retires the first.
+    reg.begin_canary("v1")
+    reg.promote("v1")
+    assert reg.live_version("classify")["version"] == "v1"
+    reg.publish("v2", task="classify", checkpoint=ckpt)
+    reg.begin_canary("v2")
+    reg.promote("v2")
+    assert reg.get("v1")["state"] == "retired"
+    assert reg.live_version("classify")["version"] == "v2"
+
+
+def test_registry_manifest_written_tmp_rename(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish("v1", task="classify", checkpoint=_ckpt(tmp_path))
+    entries = os.listdir(str(tmp_path / "reg" / "v1"))
+    # tmp+rename: the version dir holds exactly the manifest — no
+    # .tmp stragglers a torn writer could leave half-written.
+    assert entries == ["manifest.json"]
+
+
+def test_registry_verify_catches_tamper_and_size_change(tmp_path):
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    ckpt = _ckpt(tmp_path)
+    reg.publish("v1", task="classify", checkpoint=ckpt)
+    ok, detail = reg.verify("v1")
+    assert ok, detail
+    # Same size, different bytes: only the digest catches it.
+    size = os.path.getsize(ckpt)
+    with open(ckpt, "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"X")
+    ok, detail = reg.verify("v1")
+    assert not ok and "sha256 mismatch" in detail
+    with open(ckpt, "ab") as f:
+        f.write(b"tail")
+    ok, detail = reg.verify("v1")
+    assert not ok and "size mismatch" in detail
+    os.unlink(ckpt)
+    ok, detail = reg.verify("v1")
+    assert not ok and "missing" in detail
+
+
+def test_registry_geometry_drift(tmp_path):
+    config = {"hidden_size": 32, "num_hidden_layers": 2,
+              "num_attention_heads": 4, "intermediate_size": 64,
+              "vocab_size": 48, "max_position_embeddings": 64,
+              "hidden_act": "gelu"}
+    geometry = geometry_from_config(config)
+    assert set(geometry) == set(GEOMETRY_KEYS)
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish("v1", task="classify", checkpoint=_ckpt(tmp_path),
+                geometry=geometry)
+    ok, detail = reg.verify_geometry("v1", config)
+    assert ok and "matches" in detail
+    ok, detail = reg.verify_geometry("v1", dict(config, hidden_size=64))
+    assert not ok and "hidden_size" in detail
+    # A version published without geometry has nothing to check.
+    reg.publish("v2", task="classify", checkpoint=_ckpt(tmp_path))
+    ok, detail = reg.verify_geometry("v2", config)
+    assert ok and "no geometry" in detail
+
+
+def test_registry_lifecycle_events_are_schema_clean(tmp_path):
+    records, emit = _emitter()
+    reg = ModelRegistry(str(tmp_path / "reg"), emit=emit)
+    ckpt = _ckpt(tmp_path)
+    reg.publish("v1", task="classify", checkpoint=ckpt)
+    reg.begin_canary("v1")
+    reg.rollback("v1", "error budget burned")
+    reg.publish("v2", task="classify", checkpoint=ckpt)
+    reg.begin_canary("v2")
+    reg.promote("v2")
+    assert [r["event"] for r in records] == [
+        "published", "state_change", "state_change",
+        "published", "state_change", "state_change"]
+    _lint_records(records, tmp_path, "registry_events.jsonl")
+
+
+def test_registry_cli_full_lifecycle(tmp_path):
+    """The jax-free operator surface: publish with geometry, list,
+    verify, canary/promote/rollback — exit codes and the audit JSONL."""
+    ckpt = _ckpt(tmp_path)
+    config_path = str(tmp_path / "config.json")
+    with open(config_path, "w") as f:
+        json.dump({"hidden_size": 32, "num_hidden_layers": 2,
+                   "vocab_size": 48}, f)
+    root = str(tmp_path / "reg")
+    audit = str(tmp_path / "audit.jsonl")
+    tool = os.path.join(REPO_ROOT, "tools", "model_registry.py")
+
+    def cli(*argv):
+        return subprocess.run(
+            [sys.executable, tool, "--root", root,
+             "--telemetry_jsonl", audit, *argv],
+            capture_output=True, text=True, cwd=REPO_ROOT)
+
+    out = cli("publish", "v1", "--task", "classify",
+              "--checkpoint", ckpt, "--config", config_path)
+    assert out.returncode == 0 and "published v1" in out.stdout
+    out = cli("list")
+    assert out.returncode == 0
+    assert "v1" in out.stdout and "L2/H32" in out.stdout
+    out = cli("verify")
+    assert out.returncode == 0 and "v1: OK" in out.stdout
+    assert cli("canary", "v1").returncode == 0
+    assert cli("promote", "v1").returncode == 0
+    out = cli("promote", "v1")   # live -> live is not an edge
+    assert out.returncode == 1 and "illegal transition" in out.stderr
+    out = cli("publish", "v2", "--task", "classify", "--checkpoint", ckpt)
+    assert out.returncode == 0
+    assert cli("canary", "v2").returncode == 0
+    out = cli("rollback", "v2", "--reason", "p95 breach")
+    assert out.returncode == 0 and "p95 breach" in out.stdout
+    # Tampering fails verify with exit 1, scoped to the bad version.
+    with open(ckpt, "r+b") as f:
+        f.write(b"Z")
+    out = cli("verify", "v1")
+    assert out.returncode == 1 and "FAIL" in out.stdout
+    assert schema.validate_file(audit) == []
+
+
+def test_verify_checkpoint_registry_mode(tmp_path):
+    """tools/verify_checkpoint.py --registry sweeps every version of
+    every named root offline: exit 0 clean, 1 on a digest mismatch."""
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    ckpt = _ckpt(tmp_path)
+    reg.publish("v1", task="classify", checkpoint=ckpt)
+    tool = os.path.join(REPO_ROOT, "tools", "verify_checkpoint.py")
+    out = subprocess.run(
+        [sys.executable, tool, "--registry", str(tmp_path / "reg")],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "v1: verified" in out.stdout
+    with open(ckpt, "r+b") as f:
+        f.write(b"Z")
+    out = subprocess.run(
+        [sys.executable, tool, "--registry", str(tmp_path / "reg")],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert out.returncode == 1
+    assert "v1: corrupt" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# serve/engine.py: the atomic hot-swap
+
+
+@pytest.fixture(scope="module")
+def swap_engine():
+    """Tiny single-task engine. No warmup — these tests never run a
+    forward, so construction is just a CPU param init."""
+    import jax.numpy as jnp
+
+    from bert_pytorch_tpu.config import BertConfig
+    from bert_pytorch_tpu.data.tokenization import BertTokenizer
+    from bert_pytorch_tpu.serve import InferenceEngine
+    from bert_pytorch_tpu.tools.make_synthetic_data import (TRACE_WORDS,
+                                                            write_trace_vocab)
+
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="deploy_engine_")
+    vocab = 5 + len(TRACE_WORDS)
+    vocab += (8 - vocab % 8) % 8
+    config = BertConfig(
+        vocab_size=vocab, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    tokenizer = BertTokenizer(write_trace_vocab(os.path.join(
+        d, "vocab.txt")), do_lower_case=True)
+    return InferenceEngine(
+        config, tokenizer, tasks={"classify": {"labels": ["neg", "pos"]}},
+        buckets=(16,), max_batch_size=2, dtype=jnp.float32, seed=7,
+        version="v1")
+
+
+def test_swap_params_flips_version_and_params_atomically(
+        swap_engine, tmp_path):
+    import jax
+
+    from bert_pytorch_tpu.utils import checkpoint as ckpt_util
+
+    eng = swap_engine
+    assert eng.version() == "v1"
+    spec = eng.tasks["classify"]
+    old_leaf = jax.tree_util.tree_leaves(spec.params)[0]
+    nudged = jax.tree_util.tree_map(lambda x: x + 1.0, spec.params)
+    ckpt = ckpt_util.save_checkpoint(
+        str(tmp_path / "swap_ckpt"), 0, {"model": nudged, "epoch": 0})
+    epoch_before = eng._swap_epoch
+    info = eng.swap_params("classify", ckpt, "v2")
+    assert info["version"] == "v2" and info["from_version"] == "v1"
+    # Same geometry, stable forward names: the swap compiles NOTHING
+    # (the already-jitted forwards keep running against the new tree).
+    assert info["compiles"] == 0 and info["compiles_cold"] == 0
+    assert eng.version() == "v2"
+    assert eng._swap_epoch == epoch_before + 1
+    stats = eng.swap_stats()
+    assert stats["swaps"] >= 1 and stats["torn_serves"] == 0
+    new_leaf = jax.tree_util.tree_leaves(spec.params)[0]
+    assert float(abs((new_leaf - old_leaf) - 1.0).max()) < 1e-6
+
+
+def test_swap_params_rejects_bad_inputs(swap_engine, tmp_path):
+    from bert_pytorch_tpu.serve.engine import SwapBusy
+
+    eng = swap_engine
+    with pytest.raises(ValueError, match="unknown task"):
+        eng.swap_params("fill_mask", str(tmp_path / "x"), "v9")
+    with pytest.raises(FileNotFoundError):
+        eng.swap_params("classify", str(tmp_path / "missing.msgpack"),
+                        "v9")
+    # One swap in flight at a time: the second caller gets SwapBusy
+    # (serve/http.py maps it to 409; the supervisor retries later).
+    # The probe needs a real file — the existence check runs first.
+    busy_ckpt = _ckpt(tmp_path, "busy.msgpack")
+    with eng._swap_lock:
+        eng._swap_inflight = True
+    try:
+        with pytest.raises(SwapBusy):
+            eng.swap_params("classify", busy_ckpt, "v9")
+    finally:
+        with eng._swap_lock:
+            eng._swap_inflight = False
+
+
+# ---------------------------------------------------------------------------
+# serve/router.py: the deterministic canary split + per-version counters
+
+
+def test_split_hash_is_deterministic_and_nested():
+    first = [_split_hash(seq) for seq in range(512)]
+    assert first == [_split_hash(seq) for seq in range(512)]
+    assert all(0.0 <= h < 1.0 for h in first)
+    # Widening the share only ADDS members: the 1% cohort is a subset
+    # of the 50% cohort — a request never flaps out of the canary as
+    # the rollout advances.
+    tiny = {s for s in range(4096) if _split_hash(s) < 0.01}
+    half = {s for s in range(4096) if _split_hash(s) < 0.50}
+    assert tiny <= half
+    # And the share is honored to first order.
+    assert 0.35 < len(half) / 4096 < 0.65
+
+
+def _versioned_router(versions, events=None, **kwargs):
+    def transport(url, task, payload, timeout_s):
+        return 200, {"url": url}
+
+    def scrape(url):
+        return {"dispatch_alive": True, "draining": False,
+                "queue_depth": 0, "version": versions[url]}
+
+    kwargs.setdefault("retry_policy", RetryPolicy(
+        attempts=3, base_delay_s=0.0, jitter=0.0))
+    kwargs.setdefault("hedge_pctl", 0.0)
+    r = Router(sorted(versions), emit=events.append
+               if events is not None else None, transport=transport,
+               scrape=scrape, sleep=lambda s: None, **kwargs)
+    r.scrape_once()
+    return r
+
+
+def test_router_split_routes_cohort_to_canary_version():
+    r = _versioned_router({"http://a:1": "v1", "http://b:2": "v2"})
+    r.set_split("classify", "v2", 1.0)
+    for _ in range(8):
+        status, body, _ = r.handle("classify", {"text": "hi"})
+        assert status == 200 and body["url"] == "http://b:2"
+    window = r.split_window(reset=False)
+    assert window["canary"]["requests"] == 8
+    assert window["canary"]["ok"] == 8
+    assert window["control"]["requests"] == 0
+    snap = r.snapshot()
+    assert snap["version_requests"] == {"v2": 8}
+    r.stop()
+
+
+def test_router_split_share_matches_hash_prediction():
+    """The harness-side planner (tools/chaos_serve.py plan_burst) and
+    the router must agree on cohort membership seq by seq."""
+    r = _versioned_router({"http://a:1": "v1", "http://b:2": "v2"})
+    r.set_split("classify", "v2", 0.5)
+    n = 64
+    expected = sum(1 for seq in range(n) if _split_hash(seq) < 0.5)
+    for _ in range(n):
+        r.handle("classify", {"text": "hi"})
+    window = r.split_window(reset=True)
+    assert window["canary"]["requests"] == expected
+    assert window["control"]["requests"] == n - expected
+    # reset=True zeroed the accumulators but kept the split installed.
+    window = r.split_window(reset=True)
+    assert window["canary"]["requests"] == 0
+    r.clear_split()
+    assert r.split_window() is None
+    r.stop()
+
+
+def test_router_version_counters_match_metrics_export():
+    r = _versioned_router({"http://a:1": "v1", "http://b:2": "v2"})
+    r.set_split("classify", "v2", 0.5)
+    for _ in range(32):
+        r.handle("classify", {"text": "hi"})
+    snap = r.snapshot()
+    counts = snap["version_requests"]
+    assert sum(counts.values()) == 32 and set(counts) == {"v1", "v2"}
+    text = r.metrics_text()
+    for version, count in counts.items():
+        assert (f'bert_router_version_requests{{version="{version}"}} '
+                f"{count}") in text
+    r.stop()
+
+
+def test_router_rejects_overlapping_splits():
+    r = _versioned_router({"http://a:1": "v1", "http://b:2": "v2"})
+    r.set_split("classify", "v2", 0.01)
+    r.set_split("classify", "v2", 0.5)   # widening the SAME split is fine
+    with pytest.raises(RuntimeError, match="different split"):
+        r.set_split("classify", "v3", 0.01)
+    r.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve/rollout.py: the SLO-gated controller against fake windows
+
+
+class FakeSplitRouter:
+    """Records the split calls the controller makes; split_window
+    replays whatever the test staged."""
+
+    def __init__(self):
+        self.calls: list = []
+        self.window = None
+
+    def set_split(self, task, version, share):
+        self.calls.append(("set", task, version, share))
+
+    def clear_split(self):
+        self.calls.append(("clear",))
+
+    def split_window(self, reset=True):
+        return self.window
+
+
+def _window(requests, errors=0, p95=None, fallbacks=0):
+    canary = {"requests": requests, "ok": requests - errors,
+              "errors": errors, "sheds": 0}
+    if p95 is not None:
+        canary.update(latency_p50_ms=p95 / 2, latency_p95_ms=p95,
+                      latency_p99_ms=p95 * 1.2)
+    return {"task": "classify", "version": "v2", "share": 0.01,
+            "fallbacks": fallbacks, "canary": canary,
+            "control": {"requests": requests * 10, "ok": requests * 10,
+                        "errors": 0, "sheds": 0}}
+
+
+def _controller(tmp_path, records=None, **kwargs):
+    router = FakeSplitRouter()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish("v2", task="classify", checkpoint=_ckpt(tmp_path))
+    kwargs.setdefault("min_window_requests", 10)
+    kwargs.setdefault("green_windows_to_advance", 1)
+    ctrl = RolloutController(
+        router, reg, "classify", "v2",
+        emit=records.append if records is not None else None, **kwargs)
+    return ctrl, router, reg
+
+
+def test_rollout_advances_through_stages_and_promotes(tmp_path):
+    records: list = []
+    promoted = []
+    ctrl, router, reg = _controller(
+        tmp_path, records, stages=(0.01, 0.5, 1.0),
+        on_promote=lambda: promoted.append(True))
+    ctrl.start()
+    assert reg.get("v2")["state"] == "canary"
+    assert router.calls[-1] == ("set", "classify", "v2", 0.01)
+    actions = []
+    for _ in range(3):
+        actions.append(ctrl.observe(window=_window(12))["action"])
+    assert actions == ["advance", "advance", "promote"]
+    assert ctrl.status()["state"] == "promoted"
+    assert promoted == [True]
+    assert reg.get("v2")["state"] == "live"
+    # The split widened through every stage, then cleared on promote.
+    shares = [c[3] for c in router.calls if c[0] == "set"]
+    assert shares == [0.01, 0.5, 1.0]
+    assert router.calls[-1] == ("clear",)
+    # The emitted share is the share DURING each window (pre-advance):
+    # monotone per version, so the file-level cross-record lint passes.
+    assert [r["canary_share"] for r in records] == [0.01, 0.5, 1.0]
+    _lint_records(records, tmp_path, "rollout_happy.jsonl")
+
+
+def test_rollout_holds_on_thin_evidence(tmp_path):
+    ctrl, router, _ = _controller(tmp_path, min_window_requests=20)
+    ctrl.start()
+    rec = ctrl.observe(window=_window(3))
+    assert rec["action"] == "hold" and rec["slo_ok"] is True
+    assert ctrl.status()["state"] == "canary"
+    assert ctrl.status()["greens"] == 0
+
+
+def test_rollout_requires_consecutive_greens(tmp_path):
+    ctrl, _, _ = _controller(tmp_path, green_windows_to_advance=2)
+    ctrl.start()
+    assert ctrl.observe(window=_window(12))["action"] == "hold"
+    assert ctrl.observe(window=_window(12))["action"] == "advance"
+
+
+def test_rollout_error_budget_breach_rolls_back(tmp_path):
+    records: list = []
+    order: list = []
+    router = FakeSplitRouter()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    reg.publish("v2", task="classify", checkpoint=_ckpt(tmp_path))
+    ctrl = RolloutController(
+        router, reg, "classify", "v2", min_window_requests=10,
+        error_budget=0.01, emit=records.append,
+        on_rollback=lambda reason: order.append(
+            ("callback", reason, router.calls[-1])))
+    ctrl.start()
+    rec = ctrl.observe(window=_window(20, errors=5))
+    assert rec["action"] == "rollback" and rec["slo_ok"] is False
+    assert "error share" in rec["reason"]
+    assert ctrl.status()["state"] == "rolled_back"
+    assert reg.get("v2")["state"] == "staged"
+    assert reg.get("v2")["history"][-1]["reason"] == rec["reason"]
+    # Ordering: the split cleared BEFORE the fleet unwound — traffic
+    # snaps back to the old version before any replica re-swaps.
+    assert order == [("callback", rec["reason"], ("clear",))]
+    _lint_records(records, tmp_path, "rollout_breach.jsonl")
+
+
+def test_rollout_p95_gate(tmp_path):
+    ctrl, _, reg = _controller(tmp_path, slo_p95_ms=100.0)
+    ctrl.start()
+    assert ctrl.observe(window=_window(12, p95=50.0))["action"] == \
+        "advance"
+    ctrl2, _, _ = _controller(tmp_path / "b", slo_p95_ms=100.0)
+    ctrl2.start()
+    rec = ctrl2.observe(window=_window(12, p95=250.0))
+    assert rec["action"] == "rollback" and "p95" in rec["reason"]
+
+
+def test_rollout_torn_serve_rolls_back_even_on_thin_evidence(tmp_path):
+    ctrl, _, reg = _controller(tmp_path, min_window_requests=50,
+                               scrape_torn=lambda: 1)
+    ctrl.start()
+    # One request of evidence would normally hold — but a torn serve
+    # is the zero-tolerance structural breach; nothing excuses it.
+    rec = ctrl.observe(window=_window(1))
+    assert rec["action"] == "rollback"
+    assert "torn" in rec["reason"]
+    assert rec["torn_serves"] == 1
+    assert reg.get("v2")["state"] == "staged"
+
+
+def test_rollout_controller_is_single_use(tmp_path):
+    ctrl, _, _ = _controller(tmp_path)
+    ctrl.start()
+    with pytest.raises(RolloutError, match="single-use"):
+        ctrl.start()
+    ctrl.observe(window=_window(20, errors=20))
+    with pytest.raises(RolloutError, match="cannot observe"):
+        ctrl.observe(window=_window(20))
+
+
+def test_rollout_rejects_bad_stage_lists(tmp_path):
+    router = FakeSplitRouter()
+    reg = ModelRegistry(str(tmp_path / "reg"))
+    with pytest.raises(RolloutError, match="ascend"):
+        RolloutController(router, reg, "classify", "v2",
+                          stages=(0.5, 0.01, 1.0))
+    with pytest.raises(RolloutError, match="final stage"):
+        RolloutController(router, reg, "classify", "v2",
+                          stages=(0.01, 0.5))
+    with pytest.raises(RolloutError, match="shares"):
+        RolloutController(router, reg, "classify", "v2",
+                          stages=(0.0, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# the chaos harness's deterministic burst planner
+
+
+def _load_chaos_serve():
+    tools_dir = os.path.join(REPO_ROOT, "tools")
+    spec = importlib.util.spec_from_file_location(
+        "_deploy_chaos_serve", os.path.join(tools_dir, "chaos_serve.py"))
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, tools_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(tools_dir)
+    return module
+
+
+def test_plan_burst_fills_the_canary_window_exactly():
+    """plan_burst sizes a burst so the deterministic cohort hash yields
+    at least ``need`` canary requests from a known starting seq — the
+    1% stage of the --canary acceptance cannot stall on luck."""
+    chaos = _load_chaos_serve()
+    for share, need, start in ((0.01, 3, 0), (0.5, 5, 17), (1.0, 4, 3)):
+        n = chaos.plan_burst(share, need, start, minimum=2)
+        hits = sum(1 for seq in range(start, start + n)
+                   if _split_hash(seq) < share)
+        assert hits >= need
+        assert n >= 2
+
+
+# ---------------------------------------------------------------------------
+# schema fixtures + the zero-tolerance report gates
+
+
+def test_registry_schema_fixtures_lint():
+    good = os.path.join(HERE, "fixtures", "telemetry",
+                        "registry_good.jsonl")
+    bad = os.path.join(HERE, "fixtures", "telemetry",
+                       "registry_bad.jsonl")
+    assert schema.validate_file(good) == []
+    text = " | ".join(err for _, err in schema.validate_file(bad))
+    assert "version must be a non-empty string" in text
+    assert "state must be one of" in text
+    assert "illegal registry transition" in text
+    assert "must carry a non-empty 'reason'" in text
+    assert "'state_change' requires from_state" in text
+
+
+def test_rollout_schema_fixtures_lint():
+    good = os.path.join(HERE, "fixtures", "telemetry",
+                        "rollout_good.jsonl")
+    bad = os.path.join(HERE, "fixtures", "telemetry",
+                       "rollout_bad.jsonl")
+    assert schema.validate_file(good) == []
+    text = " | ".join(err for _, err in schema.validate_file(bad))
+    assert "canary_share must be in [0, 1]" in text
+    assert "ok + errors exceeds window_requests" in text
+    assert "action must be one of" in text
+    assert "action 'rollback' must carry a non-empty 'reason'" in text
+    assert "latency percentiles not ordered" in text
+    assert "torn_serves must be a non-negative integer" in text
+    assert "canary_share regressed without a rollback" in text
+    # And the jax-free repo tool agrees.
+    proc = subprocess.run(
+        [sys.executable, "tools/check_telemetry_schema.py", good, bad],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "rollout_good.jsonl: ok" in proc.stdout
+
+
+def _rollout_records(breaches=0, torn=0):
+    records = [
+        {"kind": "registry_event", "version": "v2", "event": "published",
+         "state": "staged"},
+        {"kind": "rollout_window", "task": "classify", "version": "v2",
+         "stage": 0, "canary_share": 0.01, "window_requests": 40,
+         "ok": 40, "errors": 0, "slo_ok": True, "action": "advance",
+         "torn_serves": 0},
+    ]
+    for _ in range(breaches):
+        records.append(
+            {"kind": "rollout_window", "task": "classify",
+             "version": "v2", "stage": 1, "canary_share": 0.5,
+             "window_requests": 40, "ok": 30, "errors": 10,
+             "slo_ok": False, "action": "rollback",
+             "reason": "error budget", "torn_serves": torn})
+    return records
+
+
+def test_report_summarizes_rollout_counters():
+    summary = report.summarize_records(_rollout_records(breaches=1,
+                                                        torn=2))
+    assert summary["registry_events"] == 1
+    assert summary["rollout_windows"] == 2
+    assert summary["rollout_slo_breaches"] == 1
+    assert summary["rollout_rollbacks"] == 1
+    assert summary["rollout_torn_serves"] == 2
+    assert summary["rollout_max_share"] == 0.5
+    assert summary["rollout_final_action"] == "rollback"
+
+
+def test_report_gate_fires_on_canary_breach_and_torn_serves():
+    clean = report.summarize_records(_rollout_records())
+    breached = report.summarize_records(_rollout_records(breaches=1))
+    torn = report.summarize_records(_rollout_records(breaches=1, torn=1))
+    regressions, _ = report.compare(clean, breached)
+    assert any(r["label"] == "rollout canary SLO" for r in regressions), \
+        regressions
+    regressions, _ = report.compare(clean, torn)
+    assert any(r["label"] == "rollout torn-model serves"
+               for r in regressions), regressions
+    # Zero-tolerance gates stay quiet when both sides are at zero.
+    regressions, _ = report.compare(clean, clean)
+    assert not any("rollout" in r["label"] for r in regressions), \
+        regressions

@@ -2,7 +2,11 @@
 --smoke) SIGKILLs a serving replica under a concurrent client burst,
 wedges another's dispatch thread (the failure only the supervisor's
 heartbeat watchdog can catch), and cuts a graceful drain short with a
-second kill — and no client ever sees it.
+second kill — and no client ever sees it. Since ISSUE 19 the smoke run
+also SIGKILLs a replica INSIDE an armed hot-swap window (phase D) to
+prove the torn-model count stays zero, and ``--canary`` drives the full
+deployment plane: registry publish, SLO-gated 1% -> 50% -> 100% canary
+rollout, and auto-rollback of a degraded version.
 
 Kept in its own module so the heavyweight subprocess gate (the
 supervisor spawns real ``run_server.py`` replicas; ~90s on a throttled
@@ -140,3 +144,45 @@ def test_chaos_serve_fleet_failover_acceptance():
         assert verdict[phase]["traced"] >= verdict[phase]["ok"], \
             verdict[phase]
     assert verdict["report_gate"] == {"doctored_rc": 1, "clean_rc": 0}
+    # Phase D (ISSUE 19): SIGKILL landed inside the armed swap_hold
+    # window — between checkpoint load and the atomic flip — and the
+    # fleet never served a torn model; the completed swap_all after the
+    # respawn hit the shared AOT cache (zero cold compiles).
+    d = verdict["phase_d"]
+    assert d["failures"] == 0 and d["swap_hold_observed"] is True, d
+    assert d["torn_serves"] == 0
+    assert d["swap_compiles_cold"] == 0
+
+
+@pytest.mark.slow  # ~15-40s: 2 real replicas + registry + full rollout
+def test_chaos_serve_canary_rollout_acceptance():
+    """ISSUE 19 acceptance (tools/chaos_serve.py --canary): a version
+    published from the fleet's own init checkpoint rolls out
+    1% -> 50% -> 100% behind the router's deterministic request-hash
+    split with zero client-visible failures and zero cold compiles on
+    every same-geometry swap; the per-version router counters export
+    consistently on /statsz and /metricsz; a degraded version breaches
+    its (unmeetable) p95 SLO on its FIRST full canary window and
+    auto-rolls back — and the breach artifact trips the zero-tolerance
+    "rollout canary SLO" report gate against the pre-breach baseline."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "chaos_serve.py"),
+         "--canary"],
+        capture_output=True, text=True, timeout=540,
+        cwd=os.path.join(REPO_ROOT, "tools"))
+    assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-2000:])
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    # The staircase ran to promotion, every window green.
+    windows = verdict["happy_windows"]
+    assert windows[-1]["action"] == "promote"
+    assert all(w["errors"] == 0 and w["slo_ok"] for w in windows)
+    shares = [w["canary_share"] for w in windows]
+    assert shares == sorted(shares) and shares[-1] == 1.0
+    # The degraded leg rolled back naming the breached SLO.
+    degraded = verdict["degraded_window"]
+    assert degraded["action"] == "rollback"
+    assert degraded["slo_ok"] is False and "p95" in degraded["reason"]
+    assert verdict["torn_serves"] == 0
+    assert verdict["version_requests"].get("v2", 0) > 0
+    assert verdict["report_gate"] == {"breach_rc": 1, "clean_rc": 0}

@@ -28,7 +28,20 @@ through the router while injecting, in sequence:
    the latency budget until the watchdog kills it;
 3. **kill during drain** — SIGTERM (graceful drain) followed by SIGKILL
    mid-drain. Requests the dying replica never answered are retried
-   elsewhere; the supervisor classifies the exit as a crash.
+   elsewhere; the supervisor classifies the exit as a crash;
+4. **SIGKILL mid-swap** (docs/serving.md "Model registry & canary
+   rollouts") — the fleet's own init checkpoint is published into a
+   model registry, a replica is armed with ``swap_hold@1`` (the fault
+   holds the hot-swap open between the new params finishing their load
+   and the atomic flip), and the harness SIGKILLs it inside that held
+   window under load. The kill must be invisible: zero client
+   failures, the respawned replica boots the baseline version (a
+   half-applied swap is structurally impossible — the flip either
+   happened or it did not), and ``torn_serves`` stays 0 everywhere.
+   Then the whole fleet converges onto the published version via the
+   supervisor's ``/swapz`` control calls with ZERO cold compiles — a
+   same-geometry swap reuses the already-jitted executables, proven by
+   the CompileMonitor's cache-counter events, never wall clock.
 
 Acceptance, asserted per phase and overall: ZERO client-visible
 failures (every request answers 2xx, except explicit brownout sheds —
@@ -62,6 +75,18 @@ Verdict is one JSON line on stdout; exit 0 = every assertion held.
 bursts, sized for a throttled tier-1 CPU box)::
 
     python tools/chaos_serve.py --smoke
+
+``--canary`` runs the deployment-plane E2E instead of the kill/wedge
+phases: a 2-replica fleet serving version v1, a new version published
+into the registry and rolled out 1% -> 50% -> 100% by a live
+:class:`RolloutController` (real router splits, real ``/swapz`` hot
+swaps, SLO verdicts from the canary cohort's own outcome windows, zero
+client-visible failures), followed by a deliberately DEGRADED version
+whose first canary window breaches its latency SLO and must
+auto-rollback — and the report gate is proven live: the artifact
+carrying the breach makes ``telemetry-report`` exit 1 naming "rollout
+canary SLO" against the pre-breach baseline, while the baseline
+self-diffs green.
 
 The parent is deliberately jax-free: supervisor/router/schema load by
 FILE PATH (tools/_bootstrap.py), so a hung accelerator runtime can hang
@@ -98,6 +123,10 @@ faults = load_by_path(
     "_fleet_faults", "bert_pytorch_tpu", "testing", "faults.py")
 synth = load_by_path(
     "_fleet_synth", "bert_pytorch_tpu", "tools", "make_synthetic_data.py")
+registry_mod = load_by_path(
+    "_fleet_registry", "bert_pytorch_tpu", "serve", "registry.py")
+rollout_mod = load_by_path(
+    "_fleet_rollout", "bert_pytorch_tpu", "serve", "rollout.py")
 
 # Tiny fp32 model over the trace vocabulary: the gate's evidence is
 # request outcomes and fleet/router records, not model quality — sized
@@ -228,6 +257,36 @@ def header(headers: dict, name: str):
     return None
 
 
+def get_json(url: str, path: str, timeout_s: float = 5.0) -> dict:
+    """GET an introspection endpoint (/statsz, /healthz) as JSON."""
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read()
+        check(resp.status == 200, f"GET {path} on {url} -> {resp.status}")
+        return json.loads(body)
+    finally:
+        conn.close()
+
+
+def get_text(url: str, path: str, timeout_s: float = 5.0) -> str:
+    """GET a text endpoint (/metricsz)."""
+    parsed = urllib.parse.urlsplit(url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=timeout_s)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+        check(resp.status == 200, f"GET {path} on {url} -> {resp.status}")
+        return body
+    finally:
+        conn.close()
+
+
 def run_burst(url: str, total: int, workers: int, timeout_s: float,
               outcomes: list, should_stop=None, mid=None) -> None:
     """Closed-loop burst: ``workers`` threads issue requests until
@@ -343,6 +402,347 @@ def lint(path: str) -> None:
     check(errors == [], f"schema lint failed for {path}: {errors[:3]}")
 
 
+# -- the canary-rollout scenario ---------------------------------------------
+
+def plan_burst(share: float, need: int, next_seq: int,
+               minimum: int = 12) -> int:
+    """Burst size whose canary-cohort membership yields at least
+    ``need`` canary requests starting at router seq ``next_seq``.
+
+    Cohort assignment is DETERMINISTIC — the router hashes its monotone
+    request seq (serve/router.py ``_split_hash``) — so the harness can
+    size each observation window exactly instead of waiting on luck for
+    a 1% cohort to fill it."""
+    n = 0
+    hits = 0
+    seq = next_seq
+    while hits < need or n < minimum:
+        if router_mod._split_hash(seq) < share:
+            hits += 1
+        n += 1
+        seq += 1
+        if n > 50000:
+            raise ChaosFailure(
+                f"no burst size under 50000 yields {need} canary "
+                f"requests at share {share}")
+    return n
+
+
+def run_canary(args) -> int:
+    """The deployment-plane E2E: registry publish -> canary swap ->
+    SLO-gated 1% -> 50% -> 100% rollout -> promote, then a degraded
+    version that must auto-rollback on its first full canary window —
+    with zero client-visible failures throughout and the "rollout
+    canary SLO" report gate proven to fire on the breach artifact."""
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_canary_")
+    os.makedirs(workdir, exist_ok=True)
+    cache_dir = os.path.join(workdir, "compile_cache")
+    vocab_path = synth.write_trace_vocab(os.path.join(workdir, "vocab.txt"))
+    config_path = os.path.join(workdir, "model.json")
+    with open(config_path, "w") as f:
+        json.dump(model_config(), f)
+
+    shared_args = [
+        "--model_config_file", config_path, "--vocab_file", vocab_path,
+        "--tasks", "classify", "--classify_labels", "neg,pos",
+        "--buckets", "16", "--max_batch_size", "4", "--max_wait_ms", "5",
+        "--dtype", "float32", "--compile_cache_dir", cache_dir,
+        "--trace_sample_rate", "0", "--telemetry_window", "16",
+        "--request_timeout_s", "10", "--serving_version", "v1",
+    ]
+    specs = []
+    for i in range(args.replicas):
+        out_dir = os.path.join(workdir, f"replica_{i}")
+        os.makedirs(out_dir, exist_ok=True)
+        extra_args = []
+        if i == 0:
+            extra_args = ["--save_init_checkpoint",
+                          os.path.join(workdir, "init_ckpt")]
+        port = free_port()
+        specs.append(supervisor_mod.ReplicaSpec(
+            index=i, port=port,
+            cmd=supervisor_mod.run_server_command(
+                port, out_dir, shared_args + extra_args),
+            heartbeat_file=os.path.join(out_dir, "heartbeat.json"),
+            env={}))
+
+    fleet_jsonl = os.path.join(workdir, "fleet_telemetry.jsonl")
+    sink = Sink(fleet_jsonl)
+    sup = supervisor_mod.Supervisor(
+        specs, emit=sink.write, spawn=make_spawn(workdir),
+        policy=supervisor_mod.RetryPolicy(
+            attempts=5, base_delay_s=0.4, max_delay_s=3.0,
+            full_jitter=True),
+        heartbeat_timeout_s=5.0,
+        startup_grace_s=args.warmup_timeout_s,
+        stable_reset_s=15.0, poll_interval_s=0.25, drain_grace_s=15.0)
+    router = router_mod.Router(
+        [s.url for s in specs], emit=sink.write, window=32,
+        scrape_interval_s=0.25,
+        deadline_s=args.router_deadline_s,
+        retry_policy=router_mod.RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            full_jitter=True),
+        hedge_pctl=0.95, hedge_min_ms=30.0, hedge_min_samples=24,
+        brownout_queue_depth=64, shed_retry_after_s=0.5,
+        trace_sample_rate=1.0)
+    router_server = router_mod.make_router_server(router, port=0)
+    router_url = "http://%s:%d" % router_server.server_address[:2]
+
+    t_start = time.monotonic()
+    verdict = {"metric": "chaos_serve_canary_rollout",
+               "workdir": workdir, "replicas": args.replicas,
+               "router_url": router_url}
+    canary_idx = args.replicas - 1
+
+    def next_seq() -> int:
+        # The router is in-process and quiescent between bursts, and
+        # _mint_trace hands out the CURRENT counter value before
+        # post-incrementing — so _trace_seq is exactly the next
+        # request's cohort-hash input.
+        return router._trace_seq
+
+    def scrape_torn() -> int:
+        total = 0
+        for s in specs:
+            try:
+                total += int(get_json(s.url, "/statsz")
+                             .get("torn_serves", 0))
+            except (OSError, ValueError, ChaosFailure):
+                pass
+        return total
+
+    def router_sees(idx: int, version: str) -> bool:
+        return any(r["url"].endswith(f":{specs[idx].port}")
+                   and r.get("version") == version and r["healthy"]
+                   for r in router.snapshot()["replica_states"])
+
+    def burst(n: int) -> dict:
+        outcomes: list = []
+        run_burst(router_url, n, args.burst_workers,
+                  args.client_timeout_s, outcomes)
+        summary = classify_outcomes(outcomes)
+        check(summary["failures"] == 0,
+              f"canary-mode burst saw client-visible failures: "
+              f"{summary}")
+        check_traced(outcomes, "canary burst")
+        return summary
+
+    try:
+        sup.start()
+        router.start()
+        threading.Thread(target=router_server.serve_forever,
+                         daemon=True).start()
+        wait_until(lambda: router.healthy_count() == args.replicas,
+                   args.warmup_timeout_s,
+                   f"all {args.replicas} replicas healthy")
+
+        # -- publish: the fleet's own init params become the registry's
+        # versions (same geometry — the zero-compile swap property is
+        # part of what this scenario proves).
+        reg = registry_mod.ModelRegistry(
+            os.path.join(workdir, "registry"), emit=sink.write)
+        ckpt_src = os.path.join(workdir, "init_ckpt", "ckpt_0.msgpack")
+        check(os.path.isfile(ckpt_src),
+              "replica 0 wrote no init checkpoint "
+              "(--save_init_checkpoint)")
+
+        def publish(version: str) -> str:
+            path = os.path.join(workdir, f"published_{version}.msgpack")
+            shutil.copyfile(ckpt_src, path)
+            reg.publish(version, task="classify", checkpoint=path,
+                        geometry=registry_mod.geometry_from_config(
+                            model_config()))
+            return path
+
+        publish("v1")
+        reg.begin_canary("v1")
+        reg.promote("v1")   # the audit trail starts at the booted truth
+        ckpt_v2 = publish("v2")
+
+        # -- happy path: v2 rolls 1% -> 50% -> 100% ---------------------
+        info = sup.swap_replica(canary_idx, "classify", ckpt_v2, "v2")
+        check(info.get("compiles_cold") == 0,
+              f"canary-replica swap recompiled: {info}")
+        wait_until(lambda: router_sees(canary_idx, "v2"), 15.0,
+                   "router scrape to learn the canary replica's version")
+
+        min_window = 3
+        promoted = {"swapped": False}
+
+        def on_promote() -> None:
+            infos = sup.swap_all("classify", ckpt_v2, "v2",
+                                 skip_indices=(canary_idx,))
+            for i in infos:
+                check(i.get("compiles_cold") == 0,
+                      f"promote-swap recompiled: {i}")
+            promoted["swapped"] = True
+
+        ctrl = rollout_mod.RolloutController(
+            router, reg, "classify", "v2",
+            stages=(0.01, 0.50, 1.0),
+            min_window_requests=min_window,
+            green_windows_to_advance=1,
+            error_budget=0.02,
+            emit=sink.write, on_promote=on_promote,
+            scrape_torn=scrape_torn)
+        ctrl.start()
+        windows = []
+        for _ in range(8):
+            status = ctrl.status()
+            if status["state"] != "canary":
+                break
+            burst(plan_burst(status["share"], min_window, next_seq()))
+            rec = ctrl.observe()
+            windows.append({k: rec.get(k) for k in (
+                "stage", "canary_share", "window_requests", "ok",
+                "errors", "slo_ok", "action")})
+            check(rec["action"] != "rollback",
+                  f"happy-path rollout rolled back: {rec}")
+        verdict["happy_windows"] = windows
+        check(ctrl.status()["state"] == "promoted",
+              f"rollout never promoted: {ctrl.status()} "
+              f"(windows: {windows})")
+        check(promoted["swapped"],
+              "promotion never swapped the rest of the fleet")
+        check(reg.get("v2")["state"] == "live",
+              f"v2 not live after promote: {reg.get('v2')['state']}")
+        check(reg.get("v1")["state"] == "retired",
+              f"promote did not retire v1: {reg.get('v1')['state']}")
+        for i in range(args.replicas):
+            st = get_json(specs[i].url, "/statsz")
+            check(st.get("version") == "v2",
+                  f"replica {i} did not converge onto v2: "
+                  f"{st.get('version')!r}")
+        check(router.split_window() is None,
+              "the split survived the promotion")
+
+        # -- per-version counters: /metricsz and /statsz must render
+        # the same snapshot (the no-drift contract).
+        snap = router.snapshot()
+        vreq = snap.get("version_requests") or {}
+        check(vreq.get("v2", 0) > 0,
+              f"router counted no v2 requests: {vreq}")
+        metrics = get_text(router_url, "/metricsz")
+        for version, count in sorted(vreq.items()):
+            line = (f'bert_router_version_requests'
+                    f'{{version="{version}"}} {count}')
+            check(line in metrics,
+                  f"/metricsz disagrees with the snapshot: missing "
+                  f"{line!r}")
+        stats = get_json(router_url, "/statsz")
+        check(stats.get("version_requests") == vreq,
+              f"/statsz version counters drifted from the snapshot: "
+              f"{stats.get('version_requests')} != {vreq}")
+        verdict["version_requests"] = vreq
+
+        # -- degraded leg: v3 must breach and auto-rollback -------------
+        ckpt_v3 = publish("v3")
+        sup.swap_replica(canary_idx, "classify", ckpt_v3, "v3")
+        wait_until(lambda: router_sees(canary_idx, "v3"), 15.0,
+                   "router scrape to learn the degraded version")
+        # The report gate's comparison point: everything up to (not
+        # including) the breach.
+        baseline_jsonl = os.path.join(
+            workdir, "fleet_telemetry.baseline.jsonl")
+        shutil.copyfile(fleet_jsonl, baseline_jsonl)
+
+        rolled = {"reason": None}
+
+        def on_rollback(reason: str) -> None:
+            rolled["reason"] = reason
+            sup.swap_replica(canary_idx, "classify", ckpt_v2, "v2")
+
+        ctrl2 = rollout_mod.RolloutController(
+            router, reg, "classify", "v3",
+            stages=(0.01, 0.50, 1.0),
+            min_window_requests=2, green_windows_to_advance=1,
+            # An unmeetable latency SLO stands in for a degraded model:
+            # the first full canary window MUST breach.
+            slo_p95_ms=0.001, error_budget=0.5,
+            emit=sink.write, on_rollback=on_rollback,
+            scrape_torn=scrape_torn)
+        ctrl2.start()
+        burst(plan_burst(0.01, 2, next_seq()))
+        rec = ctrl2.observe()
+        verdict["degraded_window"] = {k: rec.get(k) for k in (
+            "action", "slo_ok", "reason", "window_requests")}
+        check(rec["action"] == "rollback" and rec["slo_ok"] is False,
+              f"degraded canary did not roll back: {rec}")
+        check("p95" in (rec.get("reason") or ""),
+              f"rollback reason does not name the breached SLO: {rec}")
+        check(ctrl2.status()["state"] == "rolled_back",
+              f"controller not terminal after rollback: "
+              f"{ctrl2.status()}")
+        check(rolled["reason"], "on_rollback never fired")
+        check(reg.get("v3")["state"] == "staged",
+              f"v3 not rolled back to staged: {reg.get('v3')['state']}")
+        check(router.split_window() is None,
+              "the split survived the rollback")
+        wait_until(lambda: router_sees(canary_idx, "v2"), 15.0,
+                   "canary replica swapped back to v2 after rollback")
+        burst(12)   # the fleet still serves, on the old version
+        torn = scrape_torn()
+        check(torn == 0, f"torn-model serves recorded: {torn}")
+        verdict["torn_serves"] = torn
+
+        # -- teardown + artifacts ---------------------------------------
+        drain = sup.stop()
+        router_server.shutdown()
+        router.stop()
+        check(drain["drain_killed"] == 0,
+              f"a replica ignored the drain SIGTERM: {drain}")
+        sink.close()
+        lint(fleet_jsonl)
+        lint(baseline_jsonl)
+        for i in range(args.replicas):
+            lint(os.path.join(workdir, f"replica_{i}",
+                              "serve_telemetry.jsonl"))
+
+        # -- the report gate, proven live -------------------------------
+        # The artifact carrying the breach must trip "rollout canary
+        # SLO" against the pre-breach baseline; the baseline self-diffs
+        # green (the gate is proven to FIRE, not just to exist).
+        report_tool = os.path.join(REPO_ROOT, "tools",
+                                   "telemetry_report.py")
+        bad = subprocess.run(
+            [sys.executable, report_tool, fleet_jsonl, baseline_jsonl],
+            capture_output=True, text=True)
+        check(bad.returncode == 1
+              and "rollout canary SLO" in bad.stdout,
+              f"the canary breach did not trip the 'rollout canary "
+              f"SLO' gate (rc {bad.returncode}):\n{bad.stdout}")
+        clean = subprocess.run(
+            [sys.executable, report_tool, baseline_jsonl,
+             baseline_jsonl],
+            capture_output=True, text=True)
+        check(clean.returncode == 0,
+              f"pre-breach baseline failed its own self-diff (rc "
+              f"{clean.returncode}):\n{clean.stdout}")
+        verdict["report_gate"] = {"breach_rc": bad.returncode,
+                                  "clean_rc": clean.returncode}
+
+        verdict.update(ok=True,
+                       wall_s=round(time.monotonic() - t_start, 1))
+        print(json.dumps(verdict))
+        if not args.workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return 0
+    except (ChaosFailure, OSError, ValueError, KeyError,
+            RuntimeError) as exc:
+        verdict.update(ok=False, error=f"{type(exc).__name__}: {exc}")
+        try:
+            sup.stop()
+            router_server.shutdown()
+            router.stop()
+        except Exception:
+            pass
+        print(json.dumps(verdict))
+        print(f"chaos_serve --canary: FAILED — artifacts kept in "
+              f"{workdir}", file=sys.stderr)
+        return 1
+
+
 # -- the scenario ------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -352,12 +752,19 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="the one-command local gate: 2 replicas, "
                              "small bursts, tier-1-budget-sized")
+    parser.add_argument("--canary", action="store_true",
+                        help="run the deployment-plane E2E (registry "
+                             "publish + SLO-gated 1%%->50%%->100%% "
+                             "rollout + degraded-version auto-rollback) "
+                             "instead of the kill/wedge phases")
     parser.add_argument("--replicas", type=int, default=2)
     parser.add_argument("--burst_workers", type=int, default=4)
     parser.add_argument("--phase_a_requests", type=int, default=None,
                         help="burst size for the SIGKILL phase "
                              "(default 60; 50 under --smoke)")
     parser.add_argument("--phase_c_requests", type=int, default=30)
+    parser.add_argument("--phase_d_requests", type=int, default=24,
+                        help="burst size for the SIGKILL-mid-swap phase")
     parser.add_argument("--wedge_at", type=int, default=100,
                         help="requests the wedge replica serves before "
                              "its dispatch thread hangs (BERT_FAULTS "
@@ -381,6 +788,8 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     args.phase_a_requests = args.phase_a_requests or (
         50 if args.smoke else 60)
+    if args.canary:
+        return run_canary(args)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_serve_")
     os.makedirs(workdir, exist_ok=True)
@@ -405,22 +814,30 @@ def main(argv=None) -> int:
         "--buckets", "16", "--max_batch_size", "4", "--max_wait_ms", "5",
         "--dtype", "float32", "--compile_cache_dir", cache_dir,
         "--trace_sample_rate", "0", "--telemetry_window", "16",
-        "--request_timeout_s", "10",
+        "--request_timeout_s", "10", "--serving_version", "v1",
     ]
     specs = []
     for i in range(args.replicas):
         out_dir = os.path.join(workdir, f"replica_{i}")
         os.makedirs(out_dir, exist_ok=True)
         env = {}
+        extra_args = []
         if i == args.replicas - 1:
             env[faults.FAULTS_ENV] = f"wedge@{args.wedge_at}"
         elif i == 0:
             env[faults.FAULTS_ENV] = "admit_hold@2x6"
+        if i == 0:
+            # Replica 0 writes its freshly-initialized params as a real
+            # msgpack checkpoint before serving — the blob phase D
+            # publishes into the registry and swaps the fleet to (the
+            # jax-free parent can't produce one itself).
+            extra_args = ["--save_init_checkpoint",
+                          os.path.join(workdir, "init_ckpt")]
         port = free_port()
         specs.append(supervisor_mod.ReplicaSpec(
             index=i, port=port,
-            cmd=supervisor_mod.run_server_command(port, out_dir,
-                                                  shared_args),
+            cmd=supervisor_mod.run_server_command(
+                port, out_dir, shared_args + extra_args),
             heartbeat_file=os.path.join(out_dir, "heartbeat.json"),
             env=env))
 
@@ -626,6 +1043,153 @@ def main(argv=None) -> int:
                         and r.get("replica") == wedge_idx
                         for r in sink.records[-20:]),
             30.0, "supervisor to reap the drain-killed replica")
+        wait_until(lambda: healthy(wedge_idx), args.recover_timeout_s,
+                   "drain-killed replica respawned and healthy")
+
+        # -- phase D: SIGKILL mid-swap ----------------------------------
+        # The deployment-plane chaos proof (docs/serving.md "Model
+        # registry & canary rollouts"): publish the fleet's own init
+        # checkpoint as a new version, hold a hot-swap open on replica
+        # 0 (swap_hold@1 — new params loaded, flip not yet taken),
+        # SIGKILL inside the held window under load, then converge the
+        # whole fleet with zero cold compiles and zero torn serves.
+        reg = registry_mod.ModelRegistry(
+            os.path.join(workdir, "registry"), emit=sink.write)
+        ckpt_src = os.path.join(workdir, "init_ckpt", "ckpt_0.msgpack")
+        check(os.path.isfile(ckpt_src),
+              "replica 0 wrote no init checkpoint "
+              "(--save_init_checkpoint)")
+        # Published bytes must be immutable: every replica-0 respawn
+        # rewrites the init checkpoint, so the registry binds a private
+        # copy.
+        ckpt_pub = os.path.join(workdir, "published_v2.msgpack")
+        shutil.copyfile(ckpt_src, ckpt_pub)
+        reg.publish("v2-swap", task="classify", checkpoint=ckpt_pub,
+                    geometry=registry_mod.geometry_from_config(
+                        model_config()))
+        reg_ok, reg_detail = reg.verify("v2-swap")
+        check(reg_ok, f"published version failed verify: {reg_detail}")
+
+        # Faults arm at spawn: restart replica 0 with swap_hold armed.
+        specs[0].env[faults.FAULTS_ENV] = "swap_hold@1x6"
+        spawns_before = sink.count("spawn")
+        pid = state_of(0)["pid"]
+        check(pid, "replica 0 has no pid before the swap phase")
+        os.kill(pid, signal.SIGKILL)
+        wait_until(lambda: sink.count("spawn") > spawns_before
+                   and healthy(0),
+                   args.recover_timeout_s,
+                   "replica 0 respawned with swap_hold armed")
+
+        swap_attempt = {"resp": None, "exc": None}
+
+        def call_swapz() -> None:
+            try:
+                swap_attempt["resp"] = sup.swap_replica(
+                    0, "classify", ckpt_pub, "v2-swap", timeout_s=60.0)
+            except (RuntimeError, OSError) as exc:
+                swap_attempt["exc"] = f"{type(exc).__name__}: {exc}"
+
+        def swap_hold_recorded() -> bool:
+            try:
+                with open(replica0_jsonl) as f:
+                    return any('"injected_swap_hold"' in line
+                               for line in f)
+            except OSError:
+                return False
+
+        kill_d = {"hold_observed": False}
+        spawns_before_kill = sink.count("spawn")
+
+        def kill_mid_swap() -> None:
+            # Start the /swapz call (it loads the new params, then the
+            # armed fault emits its record and holds the window open),
+            # wait for the cue, and kill with BOTH param trees in
+            # memory and the flip not yet taken.
+            threading.Thread(target=call_swapz, daemon=True).start()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if swap_hold_recorded():
+                    kill_d["hold_observed"] = True
+                    break
+                time.sleep(0.2)
+            pid = state_of(0)["pid"]
+            if pid:
+                os.kill(pid, signal.SIGKILL)
+            # The respawn must come back unarmed: a second held swap
+            # would only slow the convergence assertions below.
+            specs[0].env.pop(faults.FAULTS_ENV, None)
+
+        outcomes_d: list = []
+        run_burst(router_url, args.phase_d_requests, args.burst_workers,
+                  args.client_timeout_s, outcomes_d,
+                  mid=(2, kill_mid_swap))
+        phase_d = classify_outcomes(outcomes_d)
+        phase_d["swap_hold_observed"] = kill_d["hold_observed"]
+        verdict["phase_d"] = phase_d
+        check(kill_d["hold_observed"],
+              "phase D: the swap_hold injection record never appeared — "
+              "the SIGKILL cannot be placed inside the swap window")
+        check(phase_d["failures"] == 0,
+              f"phase D (SIGKILL mid-swap): client-visible failures: "
+              f"{phase_d}")
+        check_traced(outcomes_d, "phase D")
+        wait_until(lambda: sink.count("spawn") > spawns_before_kill
+                   and healthy(0),
+                   args.recover_timeout_s,
+                   "mid-swap-killed replica respawned and healthy")
+        # The interrupted control call must surface as a failure, never
+        # a silent 200 for a swap that did not happen.
+        wait_until(lambda: swap_attempt["exc"] is not None
+                   or swap_attempt["resp"] is not None,
+                   30.0, "the interrupted /swapz call to fail")
+        check(swap_attempt["resp"] is None,
+              f"/swapz answered ok for a swap the SIGKILL interrupted: "
+              f"{swap_attempt}")
+        # A half-applied swap is structurally impossible: the respawned
+        # replica boots the configured baseline version, and nothing
+        # ever served torn params.
+        stats0 = get_json(specs[0].url, "/statsz")
+        check(stats0.get("version") == "v1",
+              f"replica respawned after a mid-swap SIGKILL must serve "
+              f"the baseline version v1, got {stats0.get('version')!r}")
+        check(int(stats0.get("torn_serves", 0)) == 0,
+              f"torn serves recorded on the killed replica: {stats0}")
+
+        # Converge: the supervisor swaps the whole fleet onto the
+        # published version — sequentially, zero cold compiles (same
+        # geometry hits the already-jitted executables; the cache
+        # counter events are the authority, never wall clock).
+        swap_infos = sup.swap_all("classify", ckpt_pub, "v2-swap",
+                                  timeout_s=120.0)
+        check(len(swap_infos) == args.replicas,
+              f"swap_all answered for {len(swap_infos)} of "
+              f"{args.replicas} replicas")
+        for info in swap_infos:
+            check(info.get("compiles_cold") == 0,
+                  f"same-geometry hot-swap recompiled: {info}")
+        torn_total = 0
+        for i in range(args.replicas):
+            st = get_json(specs[i].url, "/statsz")
+            check(st.get("version") == "v2-swap",
+                  f"replica {i} did not converge onto v2-swap: "
+                  f"{st.get('version')!r}")
+            torn_total += int(st.get("torn_serves", 0))
+        check(torn_total == 0,
+              f"torn-model serves after fleet convergence: {torn_total}")
+        phase_d["torn_serves"] = torn_total
+        phase_d["swap_compiles_cold"] = max(
+            i.get("compiles_cold", 0) for i in swap_infos)
+        phase_d["swap_load_s"] = max(
+            i.get("load_s", 0.0) for i in swap_infos)
+        # And the converged fleet still serves.
+        outcomes_d2: list = []
+        run_burst(router_url, 12, args.burst_workers,
+                  args.client_timeout_s, outcomes_d2)
+        post_swap = classify_outcomes(outcomes_d2)
+        check(post_swap["failures"] == 0,
+              f"post-swap burst saw failures: {post_swap}")
+        check_traced(outcomes_d2, "phase D post-swap")
 
         # -- teardown + fleet-level assertions --------------------------
         drain = sup.stop()
@@ -713,7 +1277,8 @@ def main(argv=None) -> int:
               f"router overhead + replica time): {bad_decomp[:2]}")
         # Every 2xx client outcome's echoed trace id names a stitch.
         ok_ids = {o["trace_id"]
-                  for o in outcomes_a + outcomes_b + outcomes_c
+                  for o in (outcomes_a + outcomes_b + outcomes_c
+                            + outcomes_d + outcomes_d2)
                   if o["status"] is not None and 200 <= o["status"] < 300}
         missing = ok_ids - set(stitch_ids)
         check(not missing,

@@ -62,9 +62,21 @@ captures folded together — the double-arm race the 409 guard
 prevents); a ``ledger_entry`` (telemetry/ledger.py, the longitudinal
 perf ledger) must name its leg and config digest and carry a non-empty
 metrics object of non-negative numbers with ordered percentiles and
-ratio metrics (mfu/padding_efficiency) in [0, 1]. The chaos harnesses
-(tools/chaos_run.py, tools/chaos_serve.py) lint their artifacts
-through this same module.
+ratio metrics (mfu/padding_efficiency) in [0, 1]. The deployment-plane
+kinds (docs/serving.md "Model registry & canary rollouts") carry
+theirs: a ``registry_event`` must name its version, a non-empty event,
+and a legal lifecycle state (staged/canary/live/retired), with
+``state_change`` events restricted to the registry's legal edges and
+every canary -> staged rollback carrying a ``reason``; a
+``rollout_window`` must carry a ``canary_share`` in (0, 1], a
+non-negative stage, an ok/errors pair bounded by ``window_requests``,
+an action from the rollout vocabulary (hold/advance/promote/rollback)
+— where a rollback names its ``reason`` — ordered latency percentiles
+when present, and a non-negative ``torn_serves``; and across records
+in one artifact, each (task, version) rollout's share sequence must be
+monotone non-decreasing unless a rollback resets it. The chaos
+harnesses (tools/chaos_run.py, tools/chaos_serve.py) lint their
+artifacts through this same module.
 
 Usage::
 

@@ -25,6 +25,16 @@ shard count). Shard files verify against their OWN sidecars and are
 chased from the index's manifest, so pointing this tool at the index
 covers the whole step.
 
+With ``--registry`` the named paths are model-registry roots
+(serve/registry.py, one manifest.json per version) instead of raw
+checkpoint trees: every version's checkpoint is re-hashed against its
+registry manifest digest, and with ``--config <model.json>`` each
+version's recorded geometry is diffed against the config — a version
+trained at a different shape than the fleet serves FAILs here instead
+of as a shape error at swap time on a live replica. Versions published
+without geometry are reported ``no_geometry`` (fails under
+``--strict`` only).
+
 Exit 0 = nothing corrupt (``--strict``: everything verified), 1 =
 corruption found (or unverified under ``--strict``), 2 = a named path is
 missing. Imports only the stdlib integrity module — no jax — so it runs
@@ -57,14 +67,72 @@ def expand(paths):
     return out
 
 
+def verify_registry(root: str, config: dict, strict: bool) -> int:
+    """Registry mode: re-hash every version in a serve/registry.py root
+    against its manifest digest, plus geometry drift vs ``config``."""
+    registry_mod = load_by_path(
+        "_ckpt_registry", "bert_pytorch_tpu", "serve", "registry.py")
+    reg = registry_mod.ModelRegistry(root)
+    versions = reg.list_versions()
+    if not versions:
+        print(f"verify_checkpoint: no registry versions under {root}")
+        return 2
+    failed = False
+    for manifest in versions:
+        version = manifest["version"]
+        ok, detail = reg.verify(version)
+        status = "verified" if ok else "corrupt"
+        print(f"{root}:{version}: {status} ({detail}) "
+              f"[state={manifest.get('state')} task={manifest.get('task')}]")
+        if not ok:
+            failed = True
+        if config is not None:
+            if not manifest.get("geometry"):
+                print(f"{root}:{version}: no_geometry "
+                      "(published without --config; nothing to diff)")
+                if strict:
+                    failed = True
+            else:
+                gok, gdetail = reg.verify_geometry(version, config)
+                if not gok:
+                    print(f"{root}:{version}: geometry DRIFT ({gdetail})")
+                    failed = True
+                else:
+                    print(f"{root}:{version}: geometry ok ({gdetail})")
+    return 1 if failed else 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="verify checkpoint integrity manifests")
     parser.add_argument("paths", nargs="+",
-                        help="checkpoint files or directories to scan")
+                        help="checkpoint files or directories to scan "
+                             "(--registry: registry roots)")
     parser.add_argument("--strict", action="store_true",
                         help="treat no_manifest (unverifiable) as failure")
+    parser.add_argument("--registry", action="store_true",
+                        help="paths are model-registry roots "
+                             "(serve/registry.py); verify every "
+                             "version's manifest digest")
+    parser.add_argument("--config", default="",
+                        help="model config JSON to diff each registry "
+                             "version's recorded geometry against "
+                             "(--registry only)")
     args = parser.parse_args(argv)
+
+    if args.registry:
+        import json
+        config = None
+        if args.config:
+            with open(args.config, "r", encoding="utf-8") as f:
+                config = json.load(f)
+        for root in args.paths:
+            if not os.path.isdir(root):
+                print(f"verify_checkpoint: {root}: no such registry root")
+                return 2
+        rcs = [verify_registry(root, config, args.strict)
+               for root in args.paths]
+        return max(rcs)
 
     for path in args.paths:
         if not os.path.exists(path):
