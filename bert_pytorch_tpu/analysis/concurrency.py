@@ -460,6 +460,12 @@ REGISTRY: Tuple[Entry, ...] = (
           why="per-version counters bumped by every admitting/hedging "
               "request thread while /metricsz and /statsz scrape "
               "threads snapshot them"),
+    Entry("bert_pytorch_tpu/serve/router.py", "_next_target_index",
+          cls="Router", kind="lock", locks=("_lock",),
+          why="monotone target-index mint bumped by add_target "
+              "(autoscaler thread) while the scrape thread and request "
+              "threads walk the table it indexes; a reused index would "
+              "alias two replicas' stats"),
 
     # -- serve/engine.py: the swappable params slot ------------------------
     # _swap_lock makes (spec.params, serving_version, _swap_epoch) one
@@ -499,6 +505,70 @@ REGISTRY: Tuple[Entry, ...] = (
           why="monitor thread reaps/restarts/kills replicas while "
               "start()/stop()/status() read and mutate the same table "
               "from control-plane threads"),
+    Entry("bert_pytorch_tpu/serve/supervisor.py", "_next_index",
+          cls="Supervisor", kind="lock", locks=("_lock",),
+          why="monotone replica-index mint bumped by add_replica "
+              "(autoscaler thread) while the monitor thread walks the "
+              "table; an index reuse would alias a drained replica's "
+              "heartbeat/postmortem baselines onto a fresh incarnation"),
+
+    # -- serve/autoscaler.py: control loop vs status readers ---------------
+    # The controller's decision state (evidence counters, cooldown
+    # bookkeeping, the membership chain tail) is mutated by the loop
+    # thread's tick() while status() snapshots it from the chaos
+    # harness / HTTP threads; the fleet adapter's pending-drain list is
+    # shared between tick() (reap) and whatever thread began the drain.
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_pending_drains",
+          cls="ElasticFleet", kind="lock", locks=("_lock",),
+          why="two-phase drains: begin_drain appends while tick()'s "
+              "reap_drained sweeps and draining() is read from status "
+              "threads"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_reds",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="consecutive-red evidence counter bumped/reset by tick() "
+              "while status() reads it"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_greens",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="consecutive-green evidence counter bumped/reset by "
+              "tick() while status() reads it"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_ticks",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="tick counter bumped by the loop thread, read by "
+              "status()"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_scale_ups",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="action counter bumped by tick(), read by status()"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_scale_downs",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="action counter bumped by tick(), read by status()"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_last_scale_at",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          allow=("_cooldown_remaining",),
+          why="cooldown anchor written on every scaling action, read by "
+              "the next tick's cooldown check (_cooldown_remaining runs "
+              "with _lock held — tick() only calls it inside the "
+              "decision block)"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_last_direction",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="thrash detection reads the previous action's direction "
+              "while tick() rewrites it"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_last_after",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="membership chain tail (exogenous-drift baseline) carried "
+              "between ticks, read by status()"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_last_emitted",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="hold-dedup key carried between ticks on the loop "
+              "thread; guarded because status readers share the lock"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_thrash",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="the structurally-impossible counter (zero-tolerance "
+              "gate): bumped by tick(), asserted on by the chaos "
+              "harness via status()"),
+    Entry("bert_pytorch_tpu/serve/autoscaler.py", "_last_error",
+          cls="AutoscalerController", kind="lock", locks=("_lock",),
+          why="loop-thread actuation/scrape errors surfaced to "
+              "status() readers"),
 
     # -- serve/rollout.py: observe loop vs status readers ------------------
     # One lock guards the whole stage state: observe() runs on a

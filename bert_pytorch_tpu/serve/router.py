@@ -409,6 +409,10 @@ class Router:
         self._lock = threading.Lock()
         self._replicas = [ReplicaState(url, i)
                           for i, url in enumerate(replica_urls)]
+        # Monotone target-index mint for add_target (under _lock): a
+        # removed slot's index stays retired, so per-target telemetry
+        # is never conflated across membership generations.
+        self._next_target_index = len(self._replicas)
         self._latencies = collections.deque(maxlen=_SAMPLE_CAP)
         self._win = self._zero_window()
         self._run = self._zero_window()
@@ -1245,6 +1249,53 @@ class Router:
     def replica_count(self) -> int:
         with self._lock:
             return len(self._replicas)
+
+    # -- elastic membership (serve/autoscaler.py, docs/serving.md
+    # "Elastic fleet") ----------------------------------------------------
+
+    def add_target(self, url: str) -> int:
+        """Add a replica URL to the routing table. The new target
+        enters UNHEALTHY (``ReplicaState``'s construction default) — no
+        request routes to it until its first clean scrape proves the
+        replica up, so a still-warming replica never absorbs traffic it
+        cannot answer yet. Returns the target's router-local index
+        (minted monotonically; never reused)."""
+        url = url.rstrip("/")
+        with self._lock:
+            if any(rep.url == url for rep in self._replicas):
+                raise ValueError(f"target already routed: {url}")
+            index = self._next_target_index
+            self._next_target_index += 1
+            self._replicas.append(ReplicaState(url, index))
+        return index
+
+    def remove_target(self, url: str) -> bool:
+        """Drop a replica URL from the routing table. The caller's
+        contract (serve/autoscaler.py) is to remove only AFTER the
+        supervisor confirms the drain — the replica answered its last
+        in-flight request — so removal never strands a dispatch. A
+        scrape probe already in flight writes back into the detached
+        ``ReplicaState`` (harmless: no request thread can reach it
+        through the table anymore). Refuses to empty the table (the
+        constructor's own invariant). Returns whether the URL was
+        routed at all."""
+        url = url.rstrip("/")
+        with self._lock:
+            keep = [rep for rep in self._replicas if rep.url != url]
+            if len(keep) == len(self._replicas):
+                return False
+            if not keep:
+                raise ValueError("refusing to remove the last target")
+            self._replicas = keep
+        return True
+
+    def split_active(self) -> bool:
+        """Whether a canary traffic split is live — one of the
+        autoscaler's hard scale-down holds (shrinking the fleet under
+        an active cohort split would skew the rollout's per-window
+        evidence mid-verdict)."""
+        with self._lock:
+            return self._split is not None
 
     def stop(self) -> None:
         """Stop the scrape thread, flush the partial window, and emit

@@ -50,6 +50,7 @@ import http.client
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -146,6 +147,71 @@ def run_server_command(port: int, output_dir: str,
              "--port", str(port), "--output_dir", output_dir])
 
 
+class ReplicaTemplate:
+    """Shared launch recipe for elastically spawned replicas
+    (docs/serving.md "Elastic fleet").
+
+    The engine/model flags — including the AOT compile-cache dir that
+    makes a new replica warm in seconds — are fixed ONCE; each
+    :meth:`make_spec` call mints only the per-replica pieces: a fresh
+    port (bind-to-zero unless the caller supplies one), an output dir
+    named after the replica index, and the heartbeat/postmortem files
+    the supervisor watches under it. ``Supervisor.add_replica`` and the
+    chaos harness both build argv from this one recipe instead of two
+    hand-rolled copies drifting apart.
+    """
+
+    def __init__(self, shared_args: Sequence[str], output_root: str,
+                 python: Optional[str] = None,
+                 script: Optional[str] = None,
+                 env: Optional[dict] = None,
+                 host: str = "127.0.0.1",
+                 dir_name: str = "replica_{index}",
+                 heartbeat_name: str = "heartbeat.json",
+                 postmortem_name: Optional[str] = None):
+        self.shared_args = list(shared_args)
+        self.output_root = output_root
+        self.python = python
+        self.script = script
+        self.env = dict(env) if env is not None else {}
+        self.host = host
+        self.dir_name = dir_name
+        self.heartbeat_name = heartbeat_name
+        self.postmortem_name = postmortem_name
+
+    @staticmethod
+    def alloc_port() -> int:
+        """A free local port, kernel-assigned (bind to 0)."""
+        s = socket.socket()
+        try:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+        finally:
+            s.close()
+
+    def make_spec(self, index: int, port: Optional[int] = None,
+                  extra_args: Sequence[str] = (),
+                  env: Optional[dict] = None) -> ReplicaSpec:
+        """One replica's spec from the shared recipe: fresh port, its
+        own output dir (created), heartbeat file under it."""
+        port = int(port) if port is not None else self.alloc_port()
+        out_dir = os.path.join(self.output_root,
+                               self.dir_name.format(index=int(index)))
+        os.makedirs(out_dir, exist_ok=True)
+        merged_env = dict(self.env)
+        if env:
+            merged_env.update(env)
+        return ReplicaSpec(
+            int(index), port,
+            run_server_command(port, out_dir,
+                               [*self.shared_args, *extra_args],
+                               python=self.python, script=self.script),
+            heartbeat_file=os.path.join(out_dir, self.heartbeat_name),
+            postmortem_file=(os.path.join(out_dir, self.postmortem_name)
+                             if self.postmortem_name else None),
+            env=merged_env, host=self.host)
+
+
 class _Replica:
     """Mutable runtime state for one supervised subprocess (internal;
     every field is read/written under ``Supervisor._lock``)."""
@@ -164,6 +230,10 @@ class _Replica:
         self.hb_counter: Optional[int] = None
         self.hb_advance_at = 0.0     # clock time the counter last moved
         self.probe_failures = 0
+        # Decommission flag (drain_replica): once set it NEVER clears —
+        # the exit is reaped WITHOUT respawn and the slot stays retired
+        # (its index is never reused; add_replica mints fresh ones).
+        self.draining = False
 
 
 class Supervisor:
@@ -219,6 +289,10 @@ class Supervisor:
         # callers read it (concurrency registry, analysis/concurrency.py).
         self._lock = threading.Lock()
         self._replicas = [_Replica(spec) for spec in specs]
+        # Monotone replica-index mint for add_replica: an index is
+        # NEVER reused, so every fleet_event/scale_event stream entry
+        # stays attributable to exactly one replica incarnation lineage.
+        self._next_index = max(spec.index for spec in specs) + 1
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # The supervisor's OWN liveness file (step = supervision ticks):
@@ -276,7 +350,7 @@ class Supervisor:
         now = self._clock()
         with self._lock:
             for rep in self._replicas:
-                if rep.proc is None:
+                if rep.proc is None and not rep.draining:
                     self._spawn_locked(rep, now)
         self._stop_event.clear()
         if monitor:
@@ -369,6 +443,13 @@ class Supervisor:
                            requests=rep.hb_counter)
                 self._kill_locked(rep)
                 self._harvest_postmortem_locked(rep, context="wedged")
+                if rep.draining:
+                    # A drain that wedged instead of exiting: the kill
+                    # completes the decommission, never a respawn.
+                    rep.state = STOPPED
+                    self._emit("drain_complete", rep, rc=rep.last_rc,
+                               graceful=False)
+                    return
                 self._schedule_restart_locked(rep, now, crash=True,
                                               reason="wedged")
                 return
@@ -385,6 +466,11 @@ class Supervisor:
                            failures=rep.probe_failures)
                 self._kill_locked(rep)
                 self._harvest_postmortem_locked(rep, context="probe")
+                if rep.draining:
+                    rep.state = STOPPED
+                    self._emit("drain_complete", rep, rc=rep.last_rc,
+                               graceful=False)
+                    return
                 self._schedule_restart_locked(rep, now, crash=True,
                                               reason="probe")
 
@@ -401,6 +487,15 @@ class Supervisor:
             # recorder flush (its last telemetry records and log lines)
             # into the fleet artifact before the slot is respawned.
             self._harvest_postmortem_locked(rep, context="exit")
+        if rep.draining:
+            # A scale-down drain (drain_replica): the ONE exit the
+            # supervisor's "N alive" contract does not replace. Reap,
+            # mark the slot retired, and tell the autoscaler the drain
+            # is confirmed — the router target is removed only now, so
+            # every in-flight request already got its answer.
+            rep.state = STOPPED
+            self._emit("drain_complete", rep, rc=rc, graceful=graceful)
+            return
         if self._stop_event.is_set():
             rep.state = STOPPED
             return
@@ -539,6 +634,72 @@ class Supervisor:
         return {"rcs": rcs, "drain_killed": killed,
                 "all_graceful": graceful and killed == 0}
 
+    # -- elastic membership (serve/autoscaler.py, docs/serving.md
+    # "Elastic fleet") ----------------------------------------------------
+
+    def add_replica(self, template: ReplicaTemplate,
+                    port: Optional[int] = None) -> ReplicaSpec:
+        """Grow the fleet by one: mint a spec from ``template`` under a
+        NEVER-REUSED replica index (fresh port + output dir + heartbeat
+        baseline per incarnation), spawn it, and return the spec. The
+        caller registers ``spec.url`` with the router, where the new
+        target enters UNHEALTHY until its first clean scrape — a
+        still-warming replica never absorbs traffic."""
+        now = self._clock()
+        with self._lock:
+            index = self._next_index
+            self._next_index += 1
+            spec = template.make_spec(index, port=port)
+            rep = _Replica(spec)
+            self._replicas.append(rep)
+            self._spawn_locked(rep, now)
+        return spec
+
+    def drain_replica(self, index: int) -> dict:
+        """Shrink the fleet by one: SIGTERM replica ``index`` and reap
+        its exit WITHOUT respawn — the one exit the supervisor's "N
+        alive" contract does not replace. The replica drains through
+        the same preemption contract :meth:`stop` uses (finish in-flight
+        work, exit rc 75); the monitor pass marks it STOPPED when the
+        exit lands. The caller removes the router target only after
+        :meth:`status` confirms the drain, so no request is stranded.
+        The slot stays decommissioned forever (``draining`` never
+        clears; the index is never reused)."""
+        with self._lock:
+            matches = [rep for rep in self._replicas
+                       if rep.spec.index == int(index)]
+            if not matches:
+                raise ValueError(f"no replica with index {index}")
+            rep = matches[0]
+            if rep.draining:
+                return {"replica": rep.spec.index, "state": rep.state}
+            rep.draining = True
+            self._emit("scale_drain", rep, state=rep.state)
+            if rep.proc is None:
+                # Nothing running (backoff slot / already exited):
+                # decommission directly — there is no drain to wait on.
+                rep.state = STOPPED
+                rep.restart_at = None
+                self._emit("drain_complete", rep, rc=rep.last_rc,
+                           graceful=True)
+                return {"replica": rep.spec.index, "state": rep.state}
+            try:
+                rep.proc.send_signal(signal.SIGTERM)
+            except Exception:
+                pass
+            return {"replica": rep.spec.index, "state": rep.state}
+
+    def active_count(self) -> int:
+        """Replicas that count as fleet capacity: not decommissioned
+        and not given up on. A slot mid-crash-restart (BACKOFF) still
+        counts — its respawn is already owed, and counting the respawn
+        as NEW capacity would double-book a SIGKILLed replica (exactly
+        the drift the autoscaler's membership chain lint forbids)."""
+        with self._lock:
+            return sum(1 for rep in self._replicas
+                       if not rep.draining
+                       and rep.state not in (STOPPED, FAILED))
+
     # -- hot-swap control (docs/serving.md "Model registry & canary
     # rollouts") ----------------------------------------------------------
 
@@ -632,6 +793,7 @@ class Supervisor:
                 "pid": getattr(rep.proc, "pid", None),
                 "last_rc": rep.last_rc,
                 "heartbeat_counter": rep.hb_counter,
+                "draining": rep.draining,
             } for rep in self._replicas]
 
     def replica_urls(self) -> List[str]:

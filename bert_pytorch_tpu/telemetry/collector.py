@@ -335,6 +335,11 @@ class Target:
         self.prev_sample: Optional[dict] = None
         self.prev_ok_at: Optional[float] = None
         self.failures = 0
+        # Set by FleetCollector.add_target: a dynamically joined target
+        # ages from its JOIN time, not from collector start — an
+        # autoscaled replica added ten minutes in must not be born ten
+        # minutes stale.
+        self.added_at: Optional[float] = None
 
 
 class JsonlTailer:
@@ -379,6 +384,60 @@ class JsonlTailer:
             if isinstance(rec, dict):
                 records.append(rec)
         return records
+
+
+class FleetMembership:
+    """Reconcile a collector's replica targets from a supervisor's
+    fleet-telemetry event stream (serve/supervisor.py).
+
+    The scrape set used to be static at launch, which breaks under an
+    elastic fleet (serve/autoscaler.py): a replica spawned mid-run never
+    joins the timeline, and a drained one counts as a stale scrape
+    failure forever. The supervisor's event stream is the membership
+    truth, so we read it instead of inventing a side-channel status
+    file: ``spawn`` announces a replica (join — idempotent by name, so
+    crash-respawns of a known replica are no-ops), ``drain_complete``
+    confirms a decommission and ``gave_up`` retires a crash-looping
+    replica (leave). Removal waits for ``drain_complete``, not the
+    ``scale_drain`` request — the same confirm-then-remove discipline
+    the router uses (docs/serving.md "Elastic fleet")."""
+
+    def __init__(self, collector: "FleetCollector", tailer: JsonlTailer,
+                 host: str = "127.0.0.1", prefix: str = "replica",
+                 timeout_s: float = 2.0,
+                 scrape: Optional[Callable[[str], Optional[dict]]] = None):
+        self._collector = collector
+        self._tailer = tailer
+        self._host = str(host)
+        self._prefix = str(prefix)
+        self._timeout_s = float(timeout_s)
+        self._scrape = scrape
+
+    def sync(self) -> dict:
+        """Drain the event stream once and apply joins/leaves. Returns
+        ``{"joined": [names], "left": [names]}`` for this pass."""
+        joined: List[str] = []
+        left: List[str] = []
+        for rec in self._tailer.poll():
+            if rec.get("kind") != "fleet_event":
+                continue
+            idx = rec.get("replica")
+            if idx is None:
+                continue
+            name = f"{self._prefix}-{idx}"
+            event = rec.get("event")
+            port = rec.get("port")
+            if event == "spawn" and port:
+                target = Target(name, "replica",
+                                f"http://{self._host}:{port}",
+                                scrape=self._scrape,
+                                timeout_s=self._timeout_s)
+                if self._collector.add_target(target):
+                    joined.append(name)
+            elif event in ("drain_complete", "gave_up"):
+                if self._collector.remove_target(name):
+                    left.append(name)
+        return {"joined": joined, "left": left}
 
 
 class FleetCollector:
@@ -429,6 +488,35 @@ class FleetCollector:
             if out_path else None
         self._stop_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    # -- dynamic membership -----------------------------------------------
+
+    def add_target(self, target: Target) -> bool:
+        """Join a target to the scrape set mid-run (elastic fleets: a
+        replica the autoscaler just spawned). Idempotent by name —
+        re-announcing an existing member is a no-op, so replaying a
+        supervisor event stream is safe. Returns True if added."""
+        with self._lock:
+            if any(t.name == target.name for t in self._targets):
+                return False
+            target.added_at = self._clock()
+            self._targets.append(target)
+            return True
+
+    def remove_target(self, name: str) -> bool:
+        """Retire a target from the scrape set (a drained replica is
+        decommissioned capacity, not a stale scrape failure — leaving it
+        in would poison max staleness forever). Returns True if a target
+        of that name was present."""
+        with self._lock:
+            kept = [t for t in self._targets if t.name != name]
+            removed = len(kept) != len(self._targets)
+            self._targets = kept
+            return removed
+
+    def target_names(self) -> List[str]:
+        with self._lock:
+            return [t.name for t in self._targets]
 
     # -- one pass ---------------------------------------------------------
 
@@ -485,9 +573,12 @@ class FleetCollector:
                     target.failures += 1
                     # Never-scraped targets age from collector start:
                     # a target that was never up is maximally stale,
-                    # not zero-stale.
+                    # not zero-stale. Dynamically joined targets age
+                    # from their join time instead.
                     anchor = (target.last_ok_at
                               if target.last_ok_at is not None
+                              else target.added_at
+                              if target.added_at is not None
                               else self._started_at)
                     staleness = now - anchor
                 rec = {

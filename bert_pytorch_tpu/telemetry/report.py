@@ -120,6 +120,7 @@ def summarize_records(records, name: str = "") -> dict:
     fleet_events = []
     registry_events = []
     rollout_windows = []
+    scale_events = []
     obs_scrapes = []
     obs_windows = []
     profile_windows = []
@@ -171,6 +172,8 @@ def summarize_records(records, name: str = "") -> dict:
             registry_events.append(rec)
         elif kind == "rollout_window":
             rollout_windows.append(rec)
+        elif kind == "scale_event":
+            scale_events.append(rec)
         elif kind == "obs_scrape":
             obs_scrapes.append(rec)
         elif kind == "obs_fleet_window":
@@ -634,6 +637,49 @@ def summarize_records(records, name: str = "") -> dict:
         if burns:
             out["rollout_budget_burn"] = round(max(burns), 4)
 
+    # -- elasticity plane section (serve/autoscaler.py, docs/serving.md
+    # "Elastic fleet") ---------------------------------------------------
+    # scale_event records are the autoscaler's decision stream. Two
+    # zero-tolerance gates read it: "autoscaler thrash" (a direction
+    # flip inside the cooldown window it is accountable to — the
+    # controller's shared last-scale timestamp makes this structurally
+    # impossible, so any occurrence is a control-loop bug) and "surge
+    # client-visible errors" (elasticity must never burn a client
+    # request; the controller's windows see every router error).
+    if scale_events:
+        out["scale_events"] = len(scale_events)
+        by_dec: dict = {}
+        for rec in scale_events:
+            name = str(rec.get("decision", "?"))
+            by_dec[name] = by_dec.get(name, 0) + 1
+        out["scale_decision_kinds"] = dict(sorted(by_dec.items()))
+        out["autoscaler_scale_ups"] = by_dec.get("scale_up", 0)
+        out["autoscaler_scale_downs"] = by_dec.get("scale_down", 0)
+        counts = [int(rec.get("replicas_after", 0))
+                  for rec in scale_events]
+        out["autoscaler_replicas_max"] = max(counts)
+        out["autoscaler_replicas_last"] = counts[-1]
+        thrash = 0
+        last_dir = None
+        for rec in scale_events:
+            decision = rec.get("decision")
+            if decision not in ("scale_up", "scale_down"):
+                continue
+            since = rec.get("since_last_scale_s")
+            cool = rec.get("cooldown_s")
+            if (last_dir is not None and decision != last_dir
+                    and since is not None and cool is not None
+                    and float(since) < float(cool)):
+                thrash += 1
+            last_dir = decision
+        out["autoscaler_thrash"] = thrash
+        out["surge_client_errors"] = sum(
+            int(rec.get("window_errors", 0) or 0)
+            for rec in scale_events)
+        out["surge_sheds"] = sum(
+            int(rec.get("window_sheds", 0) or 0)
+            for rec in scale_events)
+
     # -- fleet observatory section (telemetry/collector.py, docs/
     # observability.md) --------------------------------------------------
     # The collector's timeline carries per-target scrape samples and
@@ -852,6 +898,12 @@ def compare(base: dict, new: dict, tolerances: Optional[dict] = None):
     # serve (rollout_torn_serves) is zero on any healthy rollout — the
     # breach gate is what the auto-rollback E2E proves fires, and the
     # torn gate is the atomic-swap invariant made falsifiable.
+    # The elasticity-plane pair: a direction flip inside the cooldown
+    # window (autoscaler_thrash) is structurally impossible under the
+    # controller's shared last-scale timestamp, and a client-visible
+    # error during elastic capacity change (surge_client_errors) means
+    # scaling burned a request — both zero on any healthy surge, proven
+    # live by tools/chaos_serve.py --surge.
     for key, label in (("nonfinite_steps", "non-finite steps"),
                        ("divergence_warnings", "divergence warnings"),
                        ("serve_compiles_cold", "serve cold compiles"),
@@ -860,7 +912,10 @@ def compare(base: dict, new: dict, tolerances: Optional[dict] = None):
                        ("trace_orphans", "orphan span share"),
                        ("rollout_slo_breaches", "rollout canary SLO"),
                        ("rollout_torn_serves",
-                        "rollout torn-model serves")):
+                        "rollout torn-model serves"),
+                       ("autoscaler_thrash", "autoscaler thrash"),
+                       ("surge_client_errors",
+                        "surge client-visible errors")):
         b, n = int(base.get(key, 0)), int(new.get(key, 0))
         if n > b:
             entry = {"metric": key, "label": label, "base": b, "new": n,
@@ -922,6 +977,10 @@ def format_summary(summary: dict) -> str:
              "rollout_budget_burn", "rollout_slo_breaches",
              "rollout_rollbacks", "rollout_torn_serves",
              "rollout_final_action",
+             "scale_events", "autoscaler_scale_ups",
+             "autoscaler_scale_downs", "autoscaler_replicas_max",
+             "autoscaler_replicas_last", "autoscaler_thrash",
+             "surge_client_errors", "surge_sheds",
              "obs_scrapes", "obs_targets", "obs_scrape_failures",
              "fleet_windows", "fleet_targets", "fleet_healthy_min",
              "fleet_scrape_staleness_s", "fleet_worst_replica_p99_ms",

@@ -204,6 +204,18 @@ KIND_REQUIRED_KEYS = {
         "task", "version", "stage", "canary_share", "window_requests",
         "ok", "errors", "slo_ok", "action", "torn_serves",
     ),
+    # -- elasticity plane (serve/autoscaler.py, docs/serving.md
+    # "Elastic fleet") ---------------------------------------------------
+    # one autoscaler control-loop verdict: the decision (scale_up|
+    # scale_down|hold), the cooldown/hold reason, and the replica count
+    # before/after — ``exogenous`` stamps any membership drift since the
+    # previous event (a replica FAILed, an operator intervened) so the
+    # cross-record lint can reconstruct fleet membership from the event
+    # stream alone (see _check_scale_chain)
+    "scale_event": (
+        "decision", "reason", "replicas_before", "replicas_after",
+        "exogenous",
+    ),
 }
 
 # Target kinds the collector scrapes (telemetry/collector.py; mirrored
@@ -253,6 +265,11 @@ REGISTRY_TRANSITIONS = (
 # hold at the current share, advance to the next stage, promote to live,
 # or roll back to the previous version.
 ROLLOUT_ACTIONS = ("hold", "advance", "promote", "rollback")
+
+# What a scale_event decided (serve/autoscaler.py AutoscalerController;
+# the controller imports THIS tuple, so the runtime vocabulary and the
+# offline lint cannot drift — the ROLLOUT_ACTIONS pattern).
+SCALE_DECISIONS = ("scale_up", "scale_down", "hold")
 
 # serve_trace span names (serve/tracing.py PHASES, mirrored here so the
 # schema module stays stdlib-only/jax-free — tools/check_telemetry_schema
@@ -361,6 +378,8 @@ def validate_record(rec) -> list:
                     _check_registry_event_fields(rec, errors)
                 if kind == "rollout_window":
                     _check_rollout_window_fields(rec, errors)
+                if kind == "scale_event":
+                    _check_scale_event_fields(rec, errors)
     for key, value in rec.items():
         _check_finite(key, value, errors)
     return errors
@@ -1243,6 +1262,58 @@ def _check_rollout_window_fields(rec, errors) -> None:
             f"budget_burn must be a non-negative number, got {burn!r}")
 
 
+def _check_scale_event_fields(rec, errors) -> None:
+    """scale_event consistency (serve/autoscaler.py): the decision is
+    one of the controller's three verdicts, the before/after replica
+    counts move by exactly the decision's delta (a hold holds, a
+    scale_up adds ONE, a scale_down removes ONE), counts stay positive,
+    and the signal values that justified the verdict are sane."""
+    decision = rec.get("decision")
+    if decision not in SCALE_DECISIONS:
+        errors.append(
+            f"decision must be one of {SCALE_DECISIONS}, got "
+            f"{decision!r}")
+    reason = rec.get("reason")
+    if not isinstance(reason, str) or not reason:
+        errors.append(
+            f"reason must be a non-empty string, got {reason!r}")
+    counts = {}
+    for key in ("replicas_before", "replicas_after"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{key} must be a non-negative integer, got {v!r}")
+        else:
+            counts[key] = v
+    exo = rec.get("exogenous")
+    if not isinstance(exo, int) or isinstance(exo, bool):
+        errors.append(f"exogenous must be an integer, got {exo!r}")
+    if len(counts) == 2 and decision in SCALE_DECISIONS:
+        delta = {"scale_up": 1, "scale_down": -1, "hold": 0}[decision]
+        if counts["replicas_after"] != counts["replicas_before"] + delta:
+            errors.append(
+                f"decision {decision!r} must move replicas by {delta:+d} "
+                f"(got {counts['replicas_before']} -> "
+                f"{counts['replicas_after']})")
+    for key in ("window_requests", "window_errors", "window_sheds",
+                "reds", "greens", "healthy", "unfinished", "replica"):
+        v = rec.get(key)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            errors.append(
+                f"{key} must be a non-negative integer, got {v!r}")
+    for key in ("queue_wait_share", "budget_burn", "cooldown_s",
+                "since_last_scale_s"):
+        v = rec.get(key)
+        if v is not None and (not _is_number(v) or v < 0):
+            errors.append(
+                f"{key} must be a non-negative number, got {v!r}")
+    share = rec.get("queue_wait_share")
+    if _is_number(share) and share > 1:
+        errors.append(
+            f"queue_wait_share must be in [0, 1], got {share!r}")
+
+
 def _check_resume_fields(rec, errors) -> None:
     """Resume-record consistency: ``skipped`` is a list of objects each
     naming what was passed over and why (utils/checkpoint.py walk-back)."""
@@ -1312,9 +1383,18 @@ def validate_file(path: str) -> list:
     may only advance (the controller holds or grows the cohort) until an
     explicit ``rollback`` record resets the ramp — a share that shrinks
     without a rollback means two controllers fought over the split,
-    which no single emitter produces."""
+    which no single emitter produces.
+
+    ``scale_event`` streams carry a second cross-record lint: fleet
+    membership must be RECONSTRUCTIBLE from the event stream — each
+    event's ``replicas_before`` must equal the previous event's
+    ``replicas_after`` plus its declared ``exogenous`` drift. A count
+    that jumps without a declaration means the autoscaler lost track of
+    the fleet it manages (a SIGKILLed replica double-counted as
+    capacity, exactly the drift the surge chaos run forbids)."""
     errors = []
     shares: dict = {}
+    chain: dict = {}
     with open(path, "r", encoding="utf-8") as f:
         for lineno, line in enumerate(f, start=1):
             line_errors = validate_line(line)
@@ -1327,6 +1407,10 @@ def validate_file(path: str) -> list:
             if isinstance(rec, dict) and "schema" in rec \
                     and rec.get("kind") == "rollout_window":
                 for err in _check_rollout_sequence(rec, shares):
+                    errors.append((lineno, err))
+            if isinstance(rec, dict) and "schema" in rec \
+                    and rec.get("kind") == "scale_event":
+                for err in _check_scale_chain(rec, chain):
                     errors.append((lineno, err))
     return errors
 
@@ -1347,4 +1431,26 @@ def _check_rollout_sequence(rec, shares: dict) -> list:
             f"canary_share regressed without a rollback for task "
             f"{rec.get('task')!r} version {rec.get('version')!r}: "
             f"{share} < {last} (shares advance monotonically per stage)"]
+    return []
+
+
+def _check_scale_chain(rec, chain: dict) -> list:
+    """The cross-record membership-reconstruction rule (see
+    validate_file): replicas_before == previous replicas_after +
+    exogenous, per tag (one chain per autoscaler instance)."""
+    before = rec.get("replicas_before")
+    after = rec.get("replicas_after")
+    exo = rec.get("exogenous")
+    if not isinstance(before, int) or not isinstance(after, int) \
+            or not isinstance(exo, int):
+        return []  # field-level errors already reported per record
+    key = rec.get("tag")
+    last = chain.get(key)
+    chain[key] = after
+    if last is not None and before != last + exo:
+        return [
+            f"fleet membership not reconstructible: replicas_before="
+            f"{before} but previous replicas_after={last} with declared "
+            f"exogenous drift {exo:+d} (expected "
+            f"{last + exo})"]
     return []

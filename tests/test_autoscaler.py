@@ -1,0 +1,772 @@
+"""Elasticity-plane unit tests (PR 20, docs/serving.md "Elastic
+fleet"): the autoscaler control loop under a fake clock and scripted
+fleets/signals (evidence windows, both cooldowns, the replica band,
+every hard scale-down hold), dynamic supervisor/router membership with
+fake processes and transports, the scale_event schema fixtures + the
+membership chain lint, the two zero-tolerance report gates tripping by
+name, the collector's event-stream fleet membership, and the
+in-process fake-fleet surge pass that carries the surge invariants at
+tier-1 (PR 14 budget rule — the live subprocess proof is
+``tools/chaos_serve.py --surge``, tests/test_fleet_chaos.py)."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from bert_pytorch_tpu.serve.autoscaler import (HOLD, SCALE_DOWN, SCALE_UP,
+                                               AutoscalerController,
+                                               AutoscalerError,
+                                               ElasticFleet, RouterSignals)
+from bert_pytorch_tpu.serve.router import Router
+from bert_pytorch_tpu.serve.supervisor import (BACKOFF, RUNNING, STOPPED,
+                                               ReplicaTemplate, Supervisor)
+from bert_pytorch_tpu.telemetry import report, schema
+from bert_pytorch_tpu.telemetry.collector import (FleetCollector,
+                                                  FleetMembership,
+                                                  JsonlTailer, Target)
+from bert_pytorch_tpu.utils.preemption import EXIT_PREEMPTED
+from bert_pytorch_tpu.utils.retry import RetryPolicy
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+class FakeProc:
+    _pids = iter(range(6000, 7000))
+
+    def __init__(self):
+        self.pid = next(FakeProc._pids)
+        self.rc = None
+        self.signals = []
+
+    def poll(self):
+        return self.rc
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+    def send_signal(self, sig):
+        self.signals.append(sig)
+        self.rc = EXIT_PREEMPTED   # a well-behaved replica drains
+
+
+# ---------------------------------------------------------------------------
+# scripted collaborators for the controller units
+
+
+class ScriptedFleet:
+    """Minimal :class:`ElasticFleet` surface with scriptable status
+    rows — the controller's decisions are pure functions of what this
+    reports, so every branch is reachable without a process tree."""
+
+    def __init__(self, replicas: int = 1):
+        self.rows = [self._row(i) for i in range(replicas)]
+        self.split = False
+        self.pending_drain = False
+        self.scale_up_calls = 0
+        self.drain_calls = 0
+        self.scale_up_exc = None
+        self.refuse_drain = False
+
+    @staticmethod
+    def _row(i, state=RUNNING, draining=False):
+        return {"replica": i, "port": 9000 + i,
+                "url": f"http://127.0.0.1:{9000 + i}",
+                "state": state, "draining": draining}
+
+    def status(self):
+        return [dict(r) for r in self.rows]
+
+    def split_active(self):
+        return self.split
+
+    def draining(self):
+        return self.pending_drain or any(
+            r["draining"] and r["state"] != STOPPED for r in self.rows)
+
+    def scale_up(self):
+        if self.scale_up_exc is not None:
+            raise self.scale_up_exc
+        self.scale_up_calls += 1
+        i = max((r["replica"] for r in self.rows), default=-1) + 1
+        self.rows.append(self._row(i))
+        return {"replica": i, "url": self.rows[-1]["url"],
+                "port": 9000 + i}
+
+    def begin_drain(self):
+        self.drain_calls += 1
+        if self.refuse_drain:
+            return None
+        victims = [r for r in self.rows
+                   if not r["draining"] and r["state"] not in (STOPPED,)]
+        victim = max(victims, key=lambda r: r["replica"])
+        victim["draining"] = True
+        victim["state"] = STOPPED   # the fake drains instantly
+        return {"replica": victim["replica"], "url": victim["url"]}
+
+    def reap_drained(self):
+        return []
+
+
+RED = {"window_requests": 40, "window_errors": 0, "window_sheds": 9}
+GREEN = {"window_requests": 2, "window_errors": 0, "window_sheds": 0}
+# Hot reading over a thin window: a red trigger WITHOUT the traffic
+# evidence floor — neither red nor green, resets both streaks.
+NEUTRAL = {"window_requests": 2, "window_errors": 0, "window_sheds": 0,
+           "queue_wait_share": 0.9}
+
+
+def _controller(fleet, events=None, clock=None, **kw):
+    clock = clock or FakeClock()
+    sig = {"value": dict(GREEN)}
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("red_windows_to_scale_up", 2)
+    kw.setdefault("green_windows_to_scale_down", 2)
+    kw.setdefault("up_cooldown_s", 5.0)
+    kw.setdefault("down_cooldown_s", 20.0)
+    kw.setdefault("min_window_requests", 8)
+    ctrl = AutoscalerController(
+        fleet, lambda: dict(sig["value"]),
+        emit=events.append if events is not None else None,
+        clock=clock, **kw)
+
+    def tick_with(window):
+        sig["value"] = dict(window)
+        return ctrl.tick()
+
+    return ctrl, clock, tick_with
+
+
+# ---------------------------------------------------------------------------
+# controller: configuration validation
+
+
+def test_controller_validation_errors():
+    fleet = ScriptedFleet()
+    sig = dict
+    with pytest.raises(AutoscalerError, match="min_replicas"):
+        AutoscalerController(fleet, sig, min_replicas=3, max_replicas=2)
+    with pytest.raises(AutoscalerError, match="evidence windows"):
+        AutoscalerController(fleet, sig, red_windows_to_scale_up=0)
+    with pytest.raises(AutoscalerError, match="cooldowns"):
+        AutoscalerController(fleet, sig, up_cooldown_s=-1.0)
+    with pytest.raises(AutoscalerError, match="queue_wait_share"):
+        AutoscalerController(fleet, sig, queue_wait_share_low=0.5,
+                             queue_wait_share_high=0.25)
+
+
+# ---------------------------------------------------------------------------
+# controller: evidence windows
+
+
+def test_scale_up_needs_consecutive_red_windows():
+    fleet = ScriptedFleet(replicas=1)
+    events: list = []
+    ctrl, _, tick = _controller(fleet, events)
+    rec = tick(RED)
+    assert rec["decision"] == HOLD and fleet.scale_up_calls == 0
+    rec = tick(RED)
+    assert rec["decision"] == SCALE_UP
+    assert rec["reason"].startswith("red_windows:")
+    assert "sheds=9" in rec["reason"]
+    assert rec["replicas_before"] == 1 and rec["replicas_after"] == 2
+    assert rec["exogenous"] == 0 and rec["replica"] == 1
+    assert fleet.scale_up_calls == 1
+
+
+def test_red_streak_broken_by_neutral_window():
+    fleet = ScriptedFleet(replicas=1)
+    ctrl, _, tick = _controller(fleet)
+    tick(RED)
+    rec = tick(NEUTRAL)         # hot share over a thin window: noise
+    assert rec["decision"] == HOLD
+    assert ctrl.status()["reds"] == 0
+    tick(RED)
+    assert fleet.scale_up_calls == 0   # the streak restarted
+
+
+def test_red_evidence_floor_sheds_bypass_thin_window():
+    """min_window_requests gates hot readings — but an actual shed IS
+    the evidence, however thin the window."""
+    fleet = ScriptedFleet(replicas=1)
+    ctrl, _, tick = _controller(fleet)
+    thin_shed = {"window_requests": 1, "window_errors": 0,
+                 "window_sheds": 3}
+    tick(thin_shed)
+    rec = tick(thin_shed)
+    assert rec["decision"] == SCALE_UP
+    assert "sheds=3" in rec["reason"]
+
+
+# ---------------------------------------------------------------------------
+# controller: cooldowns
+
+
+def test_up_cooldown_blocks_back_to_back_growth():
+    fleet = ScriptedFleet(replicas=1)
+    ctrl, clock, tick = _controller(fleet)
+    tick(RED)
+    assert tick(RED)["decision"] == SCALE_UP
+    tick(RED)                              # streak restarted post-scale
+    rec = tick(RED)
+    assert rec["decision"] == HOLD and rec["reason"] == "hold:up_cooldown"
+    assert fleet.scale_up_calls == 1
+    clock.advance(5.1)
+    rec = tick(RED)
+    assert rec["decision"] == SCALE_UP and fleet.scale_up_calls == 2
+
+
+def test_down_cooldown_is_the_slower_direction():
+    fleet = ScriptedFleet(replicas=3)
+    ctrl, clock, tick = _controller(fleet)
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["decision"] == SCALE_DOWN
+    assert rec["replicas_before"] == 3 and rec["replicas_after"] == 2
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["reason"] == "hold:down_cooldown"
+    assert rec["cooldown_s"] == 20.0       # the cooldown it answers to
+    clock.advance(21.0)
+    rec = tick(GREEN)
+    assert rec["decision"] == SCALE_DOWN
+    assert fleet.drain_calls == 2
+    assert ctrl.status()["thrash"] == 0
+
+
+# ---------------------------------------------------------------------------
+# controller: the replica band + every hard scale-down hold
+
+
+def test_band_max_holds_growth():
+    fleet = ScriptedFleet(replicas=1)
+    ctrl, _, tick = _controller(fleet, max_replicas=1)
+    tick(RED)
+    rec = tick(RED)
+    assert rec["reason"] == "hold:band_max"
+    assert fleet.scale_up_calls == 0
+
+
+def test_band_min_holds_shrink():
+    fleet = ScriptedFleet(replicas=1)
+    ctrl, _, tick = _controller(fleet)
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["reason"] == "hold:band_min"
+    assert fleet.drain_calls == 0
+
+
+def test_hard_hold_canary_split():
+    fleet = ScriptedFleet(replicas=2)
+    fleet.split = True
+    ctrl, _, tick = _controller(fleet)
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["reason"] == "hold:canary_split"
+    assert fleet.drain_calls == 0
+
+
+def test_hard_hold_drain_in_flight():
+    fleet = ScriptedFleet(replicas=2)
+    fleet.pending_drain = True
+    ctrl, _, tick = _controller(fleet)
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["reason"] == "hold:draining"
+    assert fleet.drain_calls == 0
+
+
+def test_hard_hold_restarting_replica_is_not_spare_capacity():
+    fleet = ScriptedFleet(replicas=2)
+    fleet.rows[1]["state"] = BACKOFF   # SIGKILLed; respawn owed
+    ctrl, _, tick = _controller(fleet)
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["reason"] == "hold:restarting"
+    assert rec["replicas_before"] == 2   # ...and still counted as capacity
+    assert fleet.drain_calls == 0
+
+
+def test_hard_hold_min_healthy():
+    """Defense in depth: a replica active but not ready under some
+    FUTURE state would slip past the restarting hold — the healthy
+    floor still refuses to shrink below min_replicas healthy."""
+    fleet = ScriptedFleet(replicas=3)
+    fleet.rows[2]["state"] = "degraded"
+    ctrl, _, tick = _controller(fleet, min_replicas=2)
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["reason"] == "hold:min_healthy"
+    assert fleet.drain_calls == 0
+
+
+def test_scale_up_failure_is_a_named_hold():
+    fleet = ScriptedFleet(replicas=1)
+    fleet.scale_up_exc = RuntimeError("spawn blew up")
+    ctrl, _, tick = _controller(fleet)
+    tick(RED)
+    rec = tick(RED)
+    assert rec["decision"] == HOLD
+    assert rec["reason"] == "hold:scale_up_failed:RuntimeError"
+    assert "spawn blew up" in ctrl.status()["last_error"]
+
+
+def test_scale_down_without_candidate_is_a_named_hold():
+    fleet = ScriptedFleet(replicas=2)
+    fleet.refuse_drain = True
+    ctrl, _, tick = _controller(fleet)
+    tick(GREEN)
+    rec = tick(GREEN)
+    assert rec["decision"] == HOLD and rec["reason"] == "hold:no_candidate"
+
+
+# ---------------------------------------------------------------------------
+# controller: emission discipline
+
+
+def test_hold_dedup_and_reemission_on_change():
+    fleet = ScriptedFleet(replicas=1)
+    events: list = []
+    ctrl, _, tick = _controller(fleet, events)
+    for _ in range(4):
+        tick(GREEN)
+    # hold:evidence once, hold:band_min once — the repeats are dropped.
+    assert [e["reason"] for e in events] == ["hold:evidence",
+                                             "hold:band_min"]
+    fleet.rows.append(fleet._row(1))   # membership changed exogenously
+    tick(GREEN)
+    assert events[-1]["decision"] == SCALE_DOWN   # actions always emit
+
+
+def test_exogenous_drift_keeps_membership_chain_reconstructible(tmp_path):
+    fleet = ScriptedFleet(replicas=1)
+    events: list = []
+    ctrl, _, tick = _controller(fleet, events)
+    tick(RED)
+    tick(RED)                                      # scale_up: 1 -> 2
+    fleet.rows.pop()          # operator/gave-up drift outside the loop
+    tick(GREEN)
+    rec = events[-1]
+    assert rec["decision"] == HOLD
+    assert rec["replicas_before"] == 1 and rec["exogenous"] == -1
+    # The full emitted stream passes the cross-record chain lint.
+    path = tmp_path / "scale.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        for e in events:
+            f.write(json.dumps(dict(
+                e, schema=schema.SCHEMA_VERSION, ts=0.0)) + "\n")
+    assert schema.validate_file(str(path)) == []
+
+
+def test_controller_records_are_schema_clean():
+    fleet = ScriptedFleet(replicas=1)
+    events: list = []
+    ctrl, clock, tick = _controller(fleet, events)
+    tick(RED), tick(RED)
+    clock.advance(30.0)
+    tick(GREEN)
+    assert tick(GREEN)["decision"] == SCALE_DOWN
+    for e in events:
+        rec = dict(e, schema=schema.SCHEMA_VERSION, ts=0.0)
+        assert schema.validate_record(rec) == [], rec
+    assert ctrl.status()["thrash"] == 0
+    assert ctrl.status()["scale_ups"] == 1
+    assert ctrl.status()["scale_downs"] == 1
+
+
+def test_controller_loop_thread_start_stop():
+    fleet = ScriptedFleet(replicas=1)
+    ctrl, _, _ = _controller(fleet)
+    ctrl.start(interval_s=0.001)
+    with pytest.raises(AutoscalerError, match="already started"):
+        ctrl.start()
+    deadline = time.monotonic() + 5.0
+    while ctrl.status()["ticks"] < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    ctrl.stop()
+    st = ctrl.status()
+    assert st["ticks"] >= 3 and st["last_error"] is None
+
+
+# ---------------------------------------------------------------------------
+# ElasticFleet over a real Supervisor + Router (fake procs/transports)
+
+
+def _healthy_scrape(url):
+    return {"dispatch_alive": True, "draining": False, "queue_depth": 0}
+
+
+def _live_fleet(tmp_path, events=None):
+    clock = FakeClock()
+    procs = []
+
+    def spawn(spec):
+        procs.append(FakeProc())
+        return procs[-1]
+
+    template = ReplicaTemplate(["--task", "classify"], str(tmp_path),
+                               script="run_server.py")
+    specs = [template.make_spec(0, port=9001)]
+    sup = Supervisor(specs,
+                     emit=events.append if events is not None else None,
+                     spawn=spawn,
+                     policy=RetryPolicy(attempts=3, base_delay_s=1.0,
+                                        jitter=0.0),
+                     clock=clock, sleep=lambda s: None)
+    sup.start(monitor=False)
+    router = Router([specs[0].url], transport=lambda *a: (200, {}),
+                    scrape=_healthy_scrape, sleep=lambda s: None)
+    router.scrape_once()
+    fleet = ElasticFleet(sup, router, template)
+    return fleet, sup, router, procs, clock
+
+
+def test_elastic_fleet_scale_up_mints_fresh_identity(tmp_path):
+    fleet, sup, router, procs, _ = _live_fleet(tmp_path)
+    info = fleet.scale_up()
+    assert info["replica"] == 1 and info["port"] != 9001
+    assert len(procs) == 2 and router.replica_count() == 2
+    # The new target is unhealthy until its first clean scrape.
+    assert router.healthy_count() == 1
+    router.scrape_once()
+    assert router.healthy_count() == 2
+    # Fresh per-replica output dir from the template recipe.
+    assert os.path.isdir(os.path.join(str(tmp_path), "replica_1"))
+
+
+def test_elastic_fleet_drain_confirm_then_remove(tmp_path):
+    events: list = []
+    fleet, sup, router, procs, _ = _live_fleet(tmp_path, events)
+    fleet.scale_up()
+    router.scrape_once()
+    item = fleet.begin_drain()
+    assert item["replica"] == 1         # the elastic replica goes first
+    assert procs[1].signals == [15]     # SIGTERM drain
+    assert fleet.draining() is True
+    # The router keeps the target until the supervisor CONFIRMS.
+    assert fleet.reap_drained() == []
+    assert router.replica_count() == 2
+    sup.poll_once()                     # the rc-75 exit lands
+    st = [s for s in sup.status() if s["replica"] == 1][0]
+    assert st["state"] == STOPPED and st["last_rc"] == EXIT_PREEMPTED
+    done = fleet.reap_drained()
+    assert [d["replica"] for d in done] == [1]
+    assert router.replica_count() == 1
+    assert fleet.draining() is False
+    # Reaped WITHOUT respawn, and the index is never reused.
+    sup.poll_once()
+    assert len(procs) == 2
+    spec = sup.add_replica(ReplicaTemplate(
+        ["--task", "classify"], str(tmp_path), script="run_server.py"))
+    assert spec.index == 2
+    names = [e["event"] for e in events]
+    assert "scale_drain" in names
+    drain_done = [e for e in events if e["event"] == "drain_complete"][-1]
+    assert drain_done["rc"] == EXIT_PREEMPTED and drain_done["graceful"]
+
+
+def test_router_membership_under_live_traffic():
+    calls = []
+
+    def transport(url, task, payload, timeout_s):
+        calls.append(url)
+        return 200, {"ok": True}
+
+    # The seed replicas report deep queues; the elastic one is empty —
+    # once (and only once) a scrape proves it up, it takes the traffic.
+    def scrape(url):
+        return {"dispatch_alive": True, "draining": False,
+                "queue_depth": 0 if url == "http://c:3" else 5}
+
+    router = Router(["http://a:1", "http://b:2"], transport=transport,
+                    scrape=scrape, sleep=lambda s: None)
+    router.scrape_once()
+    router.add_target("http://c:3")
+    with pytest.raises(ValueError, match="already routed"):
+        router.add_target("http://c:3")
+    for _ in range(6):
+        assert router.handle("classify", {"text": "hi"})[0] == 200
+    assert "http://c:3" not in calls    # unhealthy until proven
+    router.scrape_once()
+    calls.clear()
+    for _ in range(9):
+        router.handle("classify", {"text": "hi"})
+    assert "http://c:3" in calls        # ...then absorbs traffic
+    assert router.remove_target("http://b:2") is True
+    assert router.remove_target("http://b:2") is False
+    calls.clear()
+    for _ in range(6):
+        assert router.handle("classify", {"text": "hi"})[0] == 200
+    assert "http://b:2" not in calls
+    router.remove_target("http://c:3")
+    with pytest.raises(ValueError, match="last target"):
+        router.remove_target("http://a:1")
+
+
+# ---------------------------------------------------------------------------
+# RouterSignals: per-tick windows from the router's run counters
+
+
+class _SnapRouter:
+    def __init__(self):
+        self.snap = {"requests": 0, "errors": 0, "sheds": 0,
+                     "replica_states": []}
+
+    def snapshot(self):
+        return dict(self.snap)
+
+
+def test_router_signals_are_window_deltas():
+    router = _SnapRouter()
+    signals = RouterSignals(router)
+    assert signals() == {"window_requests": 0, "window_errors": 0,
+                         "window_sheds": 0, "unfinished": 0}
+    router.snap.update(requests=10, errors=1, sheds=2, replica_states=[
+        {"url": "http://a:1", "unfinished": 3},
+        {"url": "http://b:2", "unfinished": 4}])
+    sig = signals()
+    assert sig["window_requests"] == 10 and sig["window_errors"] == 1
+    assert sig["window_sheds"] == 2 and sig["unfinished"] == 7
+    router.snap.update(requests=14)
+    sig = signals()
+    assert sig["window_requests"] == 4     # delta, not the running total
+    assert sig["window_errors"] == 0 and sig["window_sheds"] == 0
+
+
+def test_router_signals_probe_takes_worst_replica():
+    router = _SnapRouter()
+    router.snap["replica_states"] = [{"url": "http://a:1"},
+                                     {"url": "http://b:2"},
+                                     {"url": "http://c:3"}]
+
+    def probe(url):
+        if url == "http://a:1":
+            return {"phases": {"queue_wait_share": 0.1,
+                               "slo_budget_burn": 0.2}}
+        if url == "http://b:2":
+            return {"phases": {"queue_wait_share": 0.3,
+                               "slo_budget_burn": 1.2}}
+        raise OSError("replica c is warming")   # skipped, not fatal
+
+    sig = RouterSignals(router, probe=probe)()
+    assert sig["queue_wait_share"] == 0.3   # max over replicas
+    assert sig["budget_burn"] == 1.2
+
+
+# ---------------------------------------------------------------------------
+# scale_event schema fixtures + the membership chain lint
+
+
+def test_scale_schema_fixtures_lint():
+    good = os.path.join(HERE, "fixtures", "telemetry", "scale_good.jsonl")
+    bad = os.path.join(HERE, "fixtures", "telemetry", "scale_bad.jsonl")
+    assert schema.validate_file(good) == []
+    errors = schema.validate_file(bad)
+    text = " | ".join(err for _, err in errors)
+    assert "decision must be one of" in text
+    assert "reason must be a non-empty string" in text
+    assert "must move replicas by +1" in text
+    assert "replicas_before must be a non-negative integer" in text
+    assert "queue_wait_share must be in [0, 1]" in text
+    assert "exogenous must be an integer" in text
+    assert "fleet membership not reconstructible" in text
+    # And the repo tool (jax-free, file-path bootstrap) agrees.
+    proc = subprocess.run(
+        [sys.executable, "tools/check_telemetry_schema.py", good, bad],
+        capture_output=True, text=True, cwd=REPO_ROOT)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "scale_good.jsonl: ok" in proc.stdout
+    assert "scale_bad" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# telemetry-report: the elasticity section + both zero-tolerance gates
+
+
+def _scale_records(flip_inside_cooldown=False, window_errors=0):
+    records = [
+        {"kind": "scale_event", "tag": "autoscale",
+         "decision": "scale_up", "reason": "red_windows:sheds=5",
+         "replicas_before": 1, "replicas_after": 2, "exogenous": 0,
+         "window_requests": 40, "window_errors": window_errors,
+         "window_sheds": 5, "cooldown_s": 5.0},
+        {"kind": "scale_event", "tag": "autoscale",
+         "decision": "scale_down", "reason": "green_windows",
+         "replicas_before": 2, "replicas_after": 1, "exogenous": 0,
+         "window_requests": 4, "window_errors": 0, "window_sheds": 0,
+         "cooldown_s": 20.0, "since_last_scale_s": 25.0},
+    ]
+    if flip_inside_cooldown:
+        records.append(
+            {"kind": "scale_event", "tag": "autoscale",
+             "decision": "scale_up", "reason": "red_windows:sheds=2",
+             "replicas_before": 1, "replicas_after": 2, "exogenous": 0,
+             "window_requests": 30, "window_errors": 0,
+             "window_sheds": 2, "cooldown_s": 5.0,
+             "since_last_scale_s": 0.5})
+    return [dict(r, schema=schema.SCHEMA_VERSION, ts=0.0)
+            for r in records]
+
+
+def test_report_summarizes_scale_events():
+    summary = report.summarize_records(_scale_records())
+    assert summary["scale_events"] == 2
+    assert summary["autoscaler_scale_ups"] == 1
+    assert summary["autoscaler_scale_downs"] == 1
+    assert summary["autoscaler_replicas_max"] == 2
+    assert summary["autoscaler_replicas_last"] == 1
+    assert summary["autoscaler_thrash"] == 0
+    assert summary["surge_client_errors"] == 0
+    text = report.format_summary(summary)
+    assert "autoscaler_thrash" in text and "scale_events" in text
+
+
+def test_report_autoscaler_thrash_gate_trips_by_name():
+    base = report.summarize_records(_scale_records())
+    bad = report.summarize_records(
+        _scale_records(flip_inside_cooldown=True))
+    assert bad["autoscaler_thrash"] == 1
+    regressions, _ = report.compare(base, bad)
+    assert "autoscaler thrash" in [r["label"] for r in regressions]
+
+
+def test_report_surge_error_gate_trips_by_name():
+    base = report.summarize_records(_scale_records())
+    bad = report.summarize_records(_scale_records(window_errors=3))
+    regressions, _ = report.compare(base, bad)
+    assert "surge client-visible errors" in [r["label"]
+                                             for r in regressions]
+    # A clean self-diff stays clean.
+    assert report.compare(base, base)[0] == []
+
+
+# ---------------------------------------------------------------------------
+# collector: event-stream fleet membership (tools/obs_collect.py --fleet)
+
+
+def test_fleet_membership_follows_supervisor_events(tmp_path):
+    fleet_log = tmp_path / "fleet.jsonl"
+
+    def emit(event, replica, port):
+        with open(fleet_log, "a", encoding="utf-8") as f:
+            f.write(json.dumps({"kind": "fleet_event", "tag": "fleet",
+                                "event": event, "replica": replica,
+                                "port": port}) + "\n")
+
+    records: list = []
+    coll = FleetCollector([], emit=records.append)
+    mem = FleetMembership(coll, JsonlTailer(str(fleet_log), "fleet"),
+                          scrape=lambda url: {"healthy": True})
+    emit("spawn", 0, 8001)
+    emit("spawn", 1, 8002)
+    assert mem.sync() == {"joined": ["replica-0", "replica-1"],
+                          "left": []}
+    coll.collect_once()
+    scraped = [r["target"] for r in records if r["kind"] == "obs_scrape"]
+    assert scraped == ["replica-0", "replica-1"]
+    # A crash-respawn of a known replica is a no-op; the drain REQUEST
+    # alone removes nothing — confirmation does.
+    emit("spawn", 1, 8002)
+    emit("scale_drain", 1, 8002)
+    assert mem.sync() == {"joined": [], "left": []}
+    emit("drain_complete", 1, 8002)
+    assert mem.sync() == {"joined": [], "left": ["replica-1"]}
+    assert coll.target_names() == ["replica-0"]
+    coll.close()
+
+
+def test_dynamic_target_ages_from_join_not_collector_start():
+    clock = FakeClock()
+    records: list = []
+    coll = FleetCollector(
+        [Target("seed", "replica", "http://a:1",
+                scrape=lambda url: None)],
+        emit=records.append, clock=clock)
+    clock.advance(100.0)
+    coll.add_target(Target("late", "replica", "http://b:2",
+                           scrape=lambda url: None))
+    coll.collect_once()
+    by_name = {r["target"]: r for r in records
+               if r["kind"] == "obs_scrape"}
+    # The seed target was never up for 100s; the late joiner was only
+    # born this instant — staleness must say so.
+    assert by_name["seed"]["staleness_s"] == pytest.approx(100.0)
+    assert by_name["late"]["staleness_s"] == pytest.approx(0.0)
+    coll.close()
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 surge carrier (PR 14 budget rule): the full surge story on
+# an in-process fake fleet — warm scale-up, hysteresis under sustained
+# load, cooldown-gated scale-down, a reconstructible event stream, and
+# both gates green. The live subprocess version is `--surge` (slow).
+
+
+def test_in_process_surge_pass_carries_the_invariants(tmp_path):
+    fleet = ScriptedFleet(replicas=1)
+    events: list = []
+    ctrl, clock, tick = _controller(fleet, events, max_replicas=2,
+                                    green_windows_to_scale_down=3)
+    # Idle: holds at band_min, nothing thrashes.
+    for _ in range(4):
+        tick(GREEN)
+        clock.advance(1.0)
+    # Surge: brownout sheds force growth after the evidence windows.
+    tick(RED)
+    clock.advance(1.0)
+    assert tick(RED)["decision"] == SCALE_UP
+    # Sustained surge at the band edge holds, it does not oscillate.
+    for _ in range(3):
+        clock.advance(1.0)
+        rec = tick(RED)
+        assert rec["decision"] == HOLD
+    # Recovery: greens accumulate, the down cooldown gates the shrink.
+    clock.advance(30.0)
+    for _ in range(2):
+        tick(GREEN)
+        clock.advance(1.0)
+    rec = tick(GREEN)
+    assert rec["decision"] == SCALE_DOWN
+    assert rec["replicas_before"] == 2 and rec["replicas_after"] == 1
+
+    st = ctrl.status()
+    assert st["scale_ups"] == 1 and st["scale_downs"] == 1
+    assert st["thrash"] == 0
+    assert all(e["replicas_after"] <= 2 for e in events)
+    assert all(e["exogenous"] == 0 for e in events)
+
+    # The emitted stream is schema-clean (chain included) and both
+    # zero-tolerance gates stay green on a self-diff.
+    path = tmp_path / "surge_scale.jsonl"
+    with open(path, "w", encoding="utf-8") as f:
+        for e in events:
+            f.write(json.dumps(dict(
+                e, schema=schema.SCHEMA_VERSION, ts=0.0)) + "\n")
+    assert schema.validate_file(str(path)) == []
+    summary = report.summarize_records([
+        dict(e, schema=schema.SCHEMA_VERSION, ts=0.0) for e in events])
+    assert summary["autoscaler_thrash"] == 0
+    assert summary["surge_client_errors"] == 0
+    assert report.compare(summary, summary)[0] == []

@@ -154,6 +154,43 @@ def test_chaos_serve_fleet_failover_acceptance():
     assert d["swap_compiles_cold"] == 0
 
 
+@pytest.mark.slow  # ~40-120s: live burst + elastic replica subprocesses
+def test_chaos_serve_surge_elasticity_acceptance():
+    """ISSUE 20 acceptance (tools/chaos_serve.py --surge): a burst past
+    one replica's capacity makes the autoscaler scale up WARM (the
+    elastic replica boots from the shared AOT cache, compiles_cold==0),
+    sheds stop and p99 recovers at the same offered load; a SIGKILL
+    mid-surge is respawned capacity, never double-counted growth; the
+    load dropping to a trickle drains the elastic replica through the
+    SIGTERM -> rc-75 contract with zero stranded requests; and the
+    seeded-violation artifact trips BOTH zero-tolerance elasticity
+    gates by name while the real artifact self-diffs green."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "chaos_serve.py"),
+         "--surge"],
+        capture_output=True, text=True, timeout=540,
+        cwd=os.path.join(REPO_ROOT, "tools"))
+    assert proc.returncode == 0, (proc.stdout[-3000:], proc.stderr[-2000:])
+    verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert verdict["ok"] is True
+    # Zero client-visible failures in EVERY phase; the surge genuinely
+    # ramped past capacity (explicit sheds) and stopped shedding once
+    # capacity doubled.
+    for phase in ("phase_surge", "phase_post", "phase_trickle"):
+        assert verdict[phase]["failures"] == 0, verdict[phase]
+    assert verdict["phase_surge"]["sheds"] > 0
+    assert verdict["phase_post"]["sheds"] == 0
+    # Warm elasticity: the cache counter events are the authority.
+    assert verdict["elastic_compiles_cold"] == 0
+    assert verdict["p99_post_s"] < verdict["p99_surge_s"]
+    # Hysteresis held: one up, one down, zero thrash, and the event
+    # stream never books capacity past the band or unexplained drift.
+    assert verdict["controller"]["scale_ups"] == 1
+    assert verdict["controller"]["scale_downs"] == 1
+    assert verdict["controller"]["thrash"] == 0
+    assert verdict["report_gate"] == {"breach_rc": 1, "clean_rc": 0}
+
+
 @pytest.mark.slow  # ~15-40s: 2 real replicas + registry + full rollout
 def test_chaos_serve_canary_rollout_acceptance():
     """ISSUE 19 acceptance (tools/chaos_serve.py --canary): a version

@@ -88,6 +88,17 @@ carrying the breach makes ``telemetry-report`` exit 1 naming "rollout
 canary SLO" against the pre-breach baseline, while the baseline
 self-diffs green.
 
+``--surge`` runs the elasticity-plane E2E (docs/serving.md "Elastic
+fleet"): a 1-replica fleet behind a live :class:`AutoscalerController`,
+a closed-loop burst ramping past the replica's brownout ceiling ->
+warm scale-up (``compiles_cold == 0`` from the shared AOT cache) ->
+sheds stop and p99 recovers at the same offered load; a SIGKILL lands
+mid-surge and is absorbed as the SAME capacity (respawn, not growth);
+load drops -> green windows + the down cooldown drain the elastic
+replica through the SIGTERM -> rc-75 contract with zero stranded
+requests — and the "autoscaler thrash" / "surge client-visible errors"
+gates are proven to fire on a seeded artifact.
+
 The parent is deliberately jax-free: supervisor/router/schema load by
 FILE PATH (tools/_bootstrap.py), so a hung accelerator runtime can hang
 a REPLICA — which the watchdog kills — never the harness itself.
@@ -101,7 +112,6 @@ import json
 import os
 import shutil
 import signal
-import socket
 import subprocess
 import sys
 import tempfile
@@ -127,6 +137,8 @@ registry_mod = load_by_path(
     "_fleet_registry", "bert_pytorch_tpu", "serve", "registry.py")
 rollout_mod = load_by_path(
     "_fleet_rollout", "bert_pytorch_tpu", "serve", "rollout.py")
+autoscaler_mod = load_by_path(
+    "_fleet_autoscaler", "bert_pytorch_tpu", "serve", "autoscaler.py")
 
 # Tiny fp32 model over the trace vocabulary: the gate's evidence is
 # request outcomes and fleet/router records, not model quality — sized
@@ -159,14 +171,6 @@ class ChaosFailure(AssertionError):
 def check(cond, what):
     if not cond:
         raise ChaosFailure(what)
-
-
-def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 class Sink:
@@ -450,21 +454,14 @@ def run_canary(args) -> int:
         "--trace_sample_rate", "0", "--telemetry_window", "16",
         "--request_timeout_s", "10", "--serving_version", "v1",
     ]
+    template = supervisor_mod.ReplicaTemplate(shared_args, workdir)
     specs = []
     for i in range(args.replicas):
-        out_dir = os.path.join(workdir, f"replica_{i}")
-        os.makedirs(out_dir, exist_ok=True)
         extra_args = []
         if i == 0:
             extra_args = ["--save_init_checkpoint",
                           os.path.join(workdir, "init_ckpt")]
-        port = free_port()
-        specs.append(supervisor_mod.ReplicaSpec(
-            index=i, port=port,
-            cmd=supervisor_mod.run_server_command(
-                port, out_dir, shared_args + extra_args),
-            heartbeat_file=os.path.join(out_dir, "heartbeat.json"),
-            env={}))
+        specs.append(template.make_spec(i, extra_args=extra_args))
 
     fleet_jsonl = os.path.join(workdir, "fleet_telemetry.jsonl")
     sink = Sink(fleet_jsonl)
@@ -743,6 +740,358 @@ def run_canary(args) -> int:
         return 1
 
 
+# -- the surge (elastic capacity) scenario -----------------------------------
+
+def run_surge(args) -> int:
+    """The elasticity-plane E2E (docs/serving.md "Elastic fleet"): a
+    1-replica fleet behind the router, driven by a live
+    :class:`AutoscalerController`.
+
+    Sequence: a closed-loop burst ramps past the seed replica's
+    capacity (a deliberately LOW brownout ceiling makes "past capacity"
+    mean explicit sheds, deterministically, on any box) -> the
+    controller's red windows accumulate and it scales up -> the elastic
+    replica warms from the shared AOT cache (``compiles_cold == 0``,
+    cache counter events are the authority) -> sheds stop and p99
+    recovers at the SAME offered load. A SIGKILL lands mid-surge on the
+    seed replica: its respawn is the same capacity, never growth (the
+    membership chain lint would catch a double-count) and must not
+    block correctness. Load then drops to a trickle -> green windows +
+    the down cooldown -> scale-down drains the ELASTIC replica through
+    the SIGTERM -> rc-75 contract (reaped without respawn, router
+    target removed only after the supervisor confirms) with the trickle
+    still being answered — zero stranded requests. Zero client-visible
+    failures across every phase, and both elasticity report gates
+    ("autoscaler thrash", "surge client-visible errors") are proven to
+    FIRE on a seeded artifact while the real one self-diffs green.
+
+    The harness drives ``ctrl.tick()`` itself instead of ``start()`` —
+    phase boundaries stay deterministic, and every verdict lands in the
+    same sink the lint replays."""
+    workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_surge_")
+    os.makedirs(workdir, exist_ok=True)
+    cache_dir = os.path.join(workdir, "compile_cache")
+    vocab_path = synth.write_trace_vocab(os.path.join(workdir, "vocab.txt"))
+    config_path = os.path.join(workdir, "model.json")
+    with open(config_path, "w") as f:
+        json.dump(model_config(), f)
+
+    shared_args = [
+        "--model_config_file", config_path, "--vocab_file", vocab_path,
+        "--tasks", "classify", "--classify_labels", "neg,pos",
+        "--buckets", "16", "--max_batch_size", "2", "--max_wait_ms", "5",
+        "--dtype", "float32", "--compile_cache_dir", cache_dir,
+        "--trace_sample_rate", "0", "--telemetry_window", "16",
+        "--request_timeout_s", "10", "--serving_version", "v1",
+    ]
+    template = supervisor_mod.ReplicaTemplate(shared_args, workdir)
+    specs = [template.make_spec(0)]
+
+    fleet_jsonl = os.path.join(workdir, "fleet_telemetry.jsonl")
+    sink = Sink(fleet_jsonl)
+    sup = supervisor_mod.Supervisor(
+        specs, emit=sink.write, spawn=make_spawn(workdir),
+        policy=supervisor_mod.RetryPolicy(
+            attempts=5, base_delay_s=0.4, max_delay_s=3.0,
+            full_jitter=True),
+        heartbeat_timeout_s=5.0,
+        startup_grace_s=args.warmup_timeout_s,
+        stable_reset_s=15.0, poll_interval_s=0.25, drain_grace_s=15.0)
+    router = router_mod.Router(
+        [s.url for s in specs], emit=sink.write, window=32,
+        scrape_interval_s=0.2,
+        deadline_s=args.router_deadline_s,
+        retry_policy=router_mod.RetryPolicy(
+            attempts=3, base_delay_s=0.05, max_delay_s=0.5,
+            full_jitter=True),
+        # Hedging off (unreachable sample floor): hedges ADD load, and
+        # this scenario needs the offered load to be exactly what the
+        # burst issues so "past one replica's capacity" is the
+        # brownout ceiling, nothing else.
+        hedge_pctl=0.95, hedge_min_ms=30.0, hedge_min_samples=10**6,
+        brownout_queue_depth=args.surge_brownout_depth,
+        shed_retry_after_s=0.2,
+        trace_sample_rate=1.0)
+    router_server = router_mod.make_router_server(router, port=0)
+    router_url = "http://%s:%d" % router_server.server_address[:2]
+
+    # The control loop under test. Signals are the router's own
+    # windowed deltas (sheds/errors/requests + the scraped unfinished
+    # gauge); the /statsz phases probe (queue-wait share, budget burn)
+    # is a RUN-LEVEL rollup — cumulative, so a post-surge fleet would
+    # never read "idle" again — and is exercised by the fake-fleet
+    # units instead.
+    fleet = autoscaler_mod.ElasticFleet(sup, router, template)
+    signals = autoscaler_mod.RouterSignals(router)
+    ctrl = autoscaler_mod.AutoscalerController(
+        fleet, signals,
+        min_replicas=1, max_replicas=2,
+        red_windows_to_scale_up=2,
+        green_windows_to_scale_down=4,
+        up_cooldown_s=2.0, down_cooldown_s=args.surge_down_cooldown_s,
+        min_window_requests=4,
+        unfinished_high_per_replica=float(args.surge_brownout_depth),
+        unfinished_low_per_replica=2.0,
+        emit=sink.write)
+
+    t_start = time.monotonic()
+    verdict = {"metric": "chaos_serve_surge", "workdir": workdir,
+               "router_url": router_url}
+
+    def tick_until(pred, timeout_s: float, what: str,
+                   tick_s: float = 0.3) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            ctrl.tick()
+            if pred():
+                return
+            time.sleep(tick_s)
+        raise ChaosFailure(
+            f"timed out after {timeout_s:g}s waiting for {what} "
+            f"(controller: {ctrl.status()})")
+
+    def p99_ok_latency(outcomes: list):
+        oks = sorted(o["latency_s"] for o in outcomes
+                     if o["status"] is not None
+                     and 200 <= o["status"] < 300)
+        if not oks:
+            return None
+        return oks[min(len(oks) - 1, int(0.99 * len(oks)))]
+
+    try:
+        sup.start()
+        router.start()
+        threading.Thread(target=router_server.serve_forever,
+                         daemon=True).start()
+        wait_until(lambda: router.healthy_count() == 1,
+                   args.warmup_timeout_s, "the seed replica healthy")
+
+        # -- phase 1: surge past one replica's capacity -> scale up -----
+        surge_stop = {"flag": False}
+        outcomes_surge: list = []
+        burst_thread = threading.Thread(
+            target=run_burst,
+            args=(router_url, 10**9, args.surge_workers,
+                  args.client_timeout_s, outcomes_surge),
+            kwargs={"should_stop": lambda: surge_stop["flag"]},
+            daemon=True)
+        burst_thread.start()
+        tick_until(lambda: ctrl.status()["scale_ups"] >= 1,
+                   args.recover_timeout_s,
+                   "the controller to scale up under the surge")
+        tick_until(lambda: router.healthy_count() == 2,
+                   args.recover_timeout_s,
+                   "the elastic replica healthy behind the router")
+        elastic_idx = max(st["replica"] for st in sup.status())
+        check(elastic_idx >= 1,
+              f"scale-up minted no fresh replica index: {sup.status()}")
+
+        # The warm-elasticity acceptance: the elastic replica booted
+        # from the shared AOT cache with ZERO cold compiles — the cache
+        # counter events are the authority, never wall clock. This is
+        # the property that makes reactive scaling viable at all.
+        colds = cold_start_records(
+            os.path.join(workdir, f"replica_{elastic_idx}"))
+        check(colds, f"elastic replica {elastic_idx} emitted no "
+                     f"serve_cold_start record")
+        verdict["elastic_compiles_cold"] = colds[-1]["compiles_cold"]
+        check(colds[-1]["compiles_cold"] == 0,
+              f"elastic replica compiled cold: {colds[-1]}")
+
+        # -- phase 2: SIGKILL mid-surge — same capacity, never growth ---
+        seed_pid = sup.status()[0]["pid"]
+        check(seed_pid, "seed replica has no pid mid-surge")
+        os.kill(seed_pid, signal.SIGKILL)
+        tick_until(
+            lambda: sup.status()[0]["state"] == supervisor_mod.RUNNING
+            and router.healthy_count() == 2,
+            args.recover_timeout_s,
+            "the SIGKILLed seed replica respawned and healthy")
+        check(ctrl.status()["scale_downs"] == 0,
+              "the mid-surge SIGKILL triggered a scale-down: "
+              f"{ctrl.status()}")
+
+        surge_stop["flag"] = True
+        burst_thread.join(timeout=60.0)
+        check(not burst_thread.is_alive(), "surge burst never drained")
+        phase_surge = classify_outcomes(outcomes_surge)
+        verdict["phase_surge"] = phase_surge
+        check(phase_surge["failures"] == 0,
+              f"surge phase: client-visible failures: {phase_surge}")
+        check(phase_surge["sheds"] > 0,
+              "the surge never shed — the burst did not ramp past one "
+              "replica's capacity (lower --surge_brownout_depth or "
+              "raise --surge_workers)")
+        check_traced(outcomes_surge, "surge")
+        p99_surge = p99_ok_latency(outcomes_surge)
+
+        # -- phase 3: same offered load, doubled capacity ---------------
+        outcomes_post: list = []
+        post_thread = threading.Thread(
+            target=run_burst,
+            args=(router_url, args.surge_recovery_requests,
+                  args.surge_workers, args.client_timeout_s,
+                  outcomes_post),
+            daemon=True)
+        post_thread.start()
+        while post_thread.is_alive():
+            ctrl.tick()     # the loop keeps running; no thrash allowed
+            time.sleep(0.3)
+        post_thread.join()
+        phase_post = classify_outcomes(outcomes_post)
+        verdict["phase_post"] = phase_post
+        check(phase_post["failures"] == 0,
+              f"post-scale phase: client-visible failures: {phase_post}")
+        check(phase_post["sheds"] == 0,
+              f"sheds did not stop after the scale-up: {phase_post}")
+        check_traced(outcomes_post, "post-scale")
+        p99_post = p99_ok_latency(outcomes_post)
+        verdict["p99_surge_s"] = p99_surge
+        verdict["p99_post_s"] = p99_post
+        check(p99_surge is not None and p99_post is not None,
+              "no ok-latency percentile to compare")
+        check(p99_post < p99_surge,
+              f"p99 did not recover after the scale-up: "
+              f"{p99_post:.3f}s >= {p99_surge:.3f}s")
+
+        # -- phase 4: load drops -> graceful scale-down under traffic ---
+        trickle_stop = {"flag": False}
+        outcomes_trickle: list = []
+        trickle_thread = threading.Thread(
+            target=run_burst,
+            args=(router_url, 10**9, 1, args.client_timeout_s,
+                  outcomes_trickle),
+            kwargs={"should_stop": lambda: trickle_stop["flag"]},
+            daemon=True)
+        trickle_thread.start()
+        tick_until(lambda: ctrl.status()["scale_downs"] >= 1,
+                   args.recover_timeout_s,
+                   "green windows + down cooldown to trigger scale-down")
+        tick_until(lambda: router.replica_count() == 1,
+                   args.recover_timeout_s,
+                   "the drain to complete and the router target removed")
+        trickle_stop["flag"] = True
+        trickle_thread.join(timeout=60.0)
+        phase_trickle = classify_outcomes(outcomes_trickle)
+        verdict["phase_trickle"] = phase_trickle
+        check(phase_trickle["failures"] == 0,
+              f"scale-down stranded requests (client-visible failures "
+              f"during the drain): {phase_trickle}")
+        check_traced(outcomes_trickle, "trickle")
+
+        # The drain contract: the ELASTIC replica (highest index) exits
+        # EXIT_PREEMPTED on SIGTERM, is reaped WITHOUT respawn, and its
+        # slot stays retired.
+        drains = [r for r in sink.records
+                  if r.get("event") == "drain_complete"]
+        check(drains, "no drain_complete fleet_event recorded")
+        check(drains[-1].get("replica") == elastic_idx,
+              f"scale-down drained the wrong replica: {drains[-1]} "
+              f"(expected the elastic replica {elastic_idx})")
+        check(drains[-1].get("rc") == supervisor_mod.EXIT_PREEMPTED,
+              f"drained replica did not exit EXIT_PREEMPTED: "
+              f"{drains[-1]} (the run_server preemption contract)")
+        st = next(s for s in sup.status()
+                  if s["replica"] == elastic_idx)
+        check(st["state"] == supervisor_mod.STOPPED and st["draining"],
+              f"drained replica not reaped as a retired slot: {st}")
+
+        # -- the membership + hysteresis verdicts -----------------------
+        ctrl_status = ctrl.status()
+        verdict["controller"] = ctrl_status
+        check(ctrl_status["thrash"] == 0,
+              f"autoscaler thrash recorded: {ctrl_status}")
+        check(ctrl_status["scale_ups"] == 1
+              and ctrl_status["scale_downs"] == 1,
+              f"expected exactly one scale-up and one scale-down: "
+              f"{ctrl_status}")
+        scale_events = [r for r in sink.records
+                        if r.get("kind") == "scale_event"]
+        check(scale_events, "the controller emitted no scale_event")
+        check(max(int(r["replicas_after"]) for r in scale_events) <= 2,
+              "a scale_event reports capacity above the band — the "
+              f"SIGKILL respawn was double-counted: {scale_events}")
+        check(all(int(r.get("exogenous", 0)) == 0
+                  for r in scale_events),
+              "unexplained exogenous membership drift — the SIGKILL "
+              "respawn was double-counted as capacity change: "
+              f"{[r for r in scale_events if r.get('exogenous')]}")
+
+        # -- teardown + artifacts ---------------------------------------
+        drain = sup.stop()
+        router_server.shutdown()
+        router.stop()
+        check(drain["drain_killed"] == 0,
+              f"a replica ignored the drain SIGTERM: {drain}")
+        sink.close()
+        # validate_file replays the scale_event membership chain — the
+        # "reconstructible from the event stream" acceptance rides this
+        # lint, not just the in-memory asserts above.
+        lint(fleet_jsonl)
+        for idx in sorted({s["replica"] for s in sup.status()}):
+            lint(os.path.join(workdir, f"replica_{idx}",
+                              "serve_telemetry.jsonl"))
+
+        # -- both elasticity report gates, proven live ------------------
+        # A copy of the artifact seeded with one impossible record — a
+        # direction flip inside its cooldown window that also carries
+        # client-visible errors — must make telemetry-report exit 1
+        # naming BOTH gates, while the clean artifact self-diffs green.
+        breach_path = os.path.join(
+            workdir, "fleet_telemetry.breach.jsonl")
+        shutil.copyfile(fleet_jsonl, breach_path)
+        with open(breach_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps({
+                "schema": schema.SCHEMA_VERSION,
+                "ts": round(time.time(), 3),
+                "kind": "scale_event", "tag": "autoscale",
+                "decision": "scale_up",
+                "reason": "red_windows:sheds=3",
+                "replicas_before": 1, "replicas_after": 2,
+                "exogenous": 0, "healthy": 1, "reds": 2, "greens": 0,
+                "window_requests": 9, "window_errors": 3,
+                "window_sheds": 3,
+                "cooldown_s": 2.0, "since_last_scale_s": 0.1}) + "\n")
+        report_tool = os.path.join(REPO_ROOT, "tools",
+                                   "telemetry_report.py")
+        bad = subprocess.run(
+            [sys.executable, report_tool, breach_path, fleet_jsonl],
+            capture_output=True, text=True)
+        check(bad.returncode == 1
+              and "autoscaler thrash" in bad.stdout
+              and "surge client-visible errors" in bad.stdout,
+              f"the seeded violation did not trip both elasticity "
+              f"gates (rc {bad.returncode}):\n{bad.stdout}")
+        clean = subprocess.run(
+            [sys.executable, report_tool, fleet_jsonl, fleet_jsonl],
+            capture_output=True, text=True)
+        check(clean.returncode == 0,
+              f"clean surge artifact failed its own self-diff (rc "
+              f"{clean.returncode}):\n{clean.stdout}")
+        verdict["report_gate"] = {"breach_rc": bad.returncode,
+                                  "clean_rc": clean.returncode}
+
+        verdict.update(ok=True,
+                       wall_s=round(time.monotonic() - t_start, 1))
+        print(json.dumps(verdict))
+        if not args.workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+        return 0
+    except (ChaosFailure, OSError, ValueError, KeyError,
+            RuntimeError) as exc:
+        verdict.update(ok=False, error=f"{type(exc).__name__}: {exc}")
+        try:
+            sup.stop()
+            router_server.shutdown()
+            router.stop()
+        except Exception:
+            pass
+        print(json.dumps(verdict))
+        print(f"chaos_serve --surge: FAILED — artifacts kept in "
+              f"{workdir}", file=sys.stderr)
+        return 1
+
+
 # -- the scenario ------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -757,6 +1106,25 @@ def main(argv=None) -> int:
                              "publish + SLO-gated 1%%->50%%->100%% "
                              "rollout + degraded-version auto-rollback) "
                              "instead of the kill/wedge phases")
+    parser.add_argument("--surge", action="store_true",
+                        help="run the elasticity-plane E2E (autoscaler "
+                             "scale-up under a shedding surge, SIGKILL "
+                             "mid-surge, graceful rc-75 scale-down) "
+                             "instead of the kill/wedge phases")
+    parser.add_argument("--surge_workers", type=int, default=10,
+                        help="closed-loop client threads for the surge "
+                             "burst (must overwhelm ONE replica's "
+                             "brownout ceiling, not two)")
+    parser.add_argument("--surge_brownout_depth", type=int, default=6,
+                        help="router brownout queue ceiling per replica "
+                             "in surge mode — the definition of one "
+                             "replica's capacity")
+    parser.add_argument("--surge_recovery_requests", type=int, default=60,
+                        help="burst size for the post-scale-up recovery "
+                             "phase (same worker count as the surge)")
+    parser.add_argument("--surge_down_cooldown_s", type=float, default=6.0,
+                        help="the controller's scale-down cooldown in "
+                             "surge mode (the slow, cautious direction)")
     parser.add_argument("--replicas", type=int, default=2)
     parser.add_argument("--burst_workers", type=int, default=4)
     parser.add_argument("--phase_a_requests", type=int, default=None,
@@ -790,6 +1158,8 @@ def main(argv=None) -> int:
         50 if args.smoke else 60)
     if args.canary:
         return run_canary(args)
+    if args.surge:
+        return run_surge(args)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="chaos_serve_")
     os.makedirs(workdir, exist_ok=True)
@@ -816,10 +1186,9 @@ def main(argv=None) -> int:
         "--trace_sample_rate", "0", "--telemetry_window", "16",
         "--request_timeout_s", "10", "--serving_version", "v1",
     ]
+    template = supervisor_mod.ReplicaTemplate(shared_args, workdir)
     specs = []
     for i in range(args.replicas):
-        out_dir = os.path.join(workdir, f"replica_{i}")
-        os.makedirs(out_dir, exist_ok=True)
         env = {}
         extra_args = []
         if i == args.replicas - 1:
@@ -833,13 +1202,7 @@ def main(argv=None) -> int:
             # jax-free parent can't produce one itself).
             extra_args = ["--save_init_checkpoint",
                           os.path.join(workdir, "init_ckpt")]
-        port = free_port()
-        specs.append(supervisor_mod.ReplicaSpec(
-            index=i, port=port,
-            cmd=supervisor_mod.run_server_command(
-                port, out_dir, shared_args + extra_args),
-            heartbeat_file=os.path.join(out_dir, "heartbeat.json"),
-            env=env))
+        specs.append(template.make_spec(i, extra_args=extra_args, env=env))
 
     sink = Sink(os.path.join(workdir, "fleet_telemetry.jsonl"))
     sup = supervisor_mod.Supervisor(

@@ -74,9 +74,20 @@ an action from the rollout vocabulary (hold/advance/promote/rollback)
 — where a rollback names its ``reason`` — ordered latency percentiles
 when present, and a non-negative ``torn_serves``; and across records
 in one artifact, each (task, version) rollout's share sequence must be
-monotone non-decreasing unless a rollback resets it. The chaos
-harnesses (tools/chaos_run.py, tools/chaos_serve.py) lint their
-artifacts through this same module.
+monotone non-decreasing unless a rollback resets it. The
+elasticity-plane kind (``scale_event``, serve/autoscaler.py —
+docs/serving.md "Elastic fleet") carries its own: a decision from the
+scale vocabulary (scale_up/scale_down/hold), a non-empty ``reason``,
+non-negative integer ``replicas_before``/``replicas_after`` whose delta
+matches the decision (+1 for scale_up, -1 for scale_down, 0 for hold),
+an integer ``exogenous`` drift declaration, non-negative
+window/streak/health counters and signal shares (``queue_wait_share``
+in [0, 1]) when present — and across records per tag, the fleet's
+membership must be RECONSTRUCTIBLE from the stream: each event's
+``replicas_before`` must equal the previous event's ``replicas_after``
+plus its declared ``exogenous`` drift. The chaos harnesses
+(tools/chaos_run.py, tools/chaos_serve.py) lint their artifacts
+through this same module.
 
 Usage::
 

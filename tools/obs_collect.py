@@ -22,7 +22,12 @@ Usage::
         --out fleet_timeline.jsonl --interval_s 1 --duration_s 60
 
 ``--target`` is ``kind:name=url`` with kind in trainer/replica/router;
-``--tail`` is ``name=path``. Bounded by ``--duration_s`` or
+``--tail`` is ``name=path``. Scrape targets named with ``--target`` are
+static; under an elastic fleet (serve/autoscaler.py) add
+``--fleet fleet=out/fleet_telemetry.jsonl`` and membership follows the
+supervisor's own event stream instead — replicas spawned mid-run join
+the scrape set, drained ones leave it rather than counting as stale
+scrape failures forever. Bounded by ``--duration_s`` or
 ``--passes`` (whichever lands first; Ctrl-C stops cleanly either way).
 ``--trace <id>`` skips collecting entirely and prints the stitched
 span tree of one trace id out of an existing timeline (``--out`` names
@@ -94,6 +99,18 @@ def main(argv=None) -> int:
                         type=parse_tail, metavar="NAME=PATH",
                         help="JSONL sink to tail into the timeline; "
                              "repeatable")
+    parser.add_argument("--fleet", type=parse_tail, default=None,
+                        metavar="NAME=PATH",
+                        help="supervisor fleet-telemetry JSONL to read "
+                             "fleet MEMBERSHIP from: replicas the "
+                             "autoscaler spawns mid-run join the scrape "
+                             "set as NAME-<index> targets, drained or "
+                             "gave-up replicas leave it (instead of "
+                             "counting as stale scrape failures "
+                             "forever)")
+    parser.add_argument("--fleet_host", type=str, default="127.0.0.1",
+                        help="host the replicas announced by --fleet "
+                             "events are scraped at")
     parser.add_argument("--out", type=str, default="fleet_timeline.jsonl",
                         help="timeline output JSONL (appended)")
     parser.add_argument("--interval_s", type=float, default=1.0,
@@ -151,8 +168,8 @@ def main(argv=None) -> int:
         print(tree)
         return 0 if "not found" not in tree.splitlines()[0] else 1
 
-    if not args.target and not args.tail:
-        parser.error("need at least one --target or --tail")
+    if not args.target and not args.tail and not args.fleet:
+        parser.error("need at least one --target, --tail, or --fleet")
     targets = [collector_mod.Target(name, kind, url,
                                     timeout_s=args.scrape_timeout_s)
                for kind, name, url in args.target]
@@ -162,6 +179,17 @@ def main(argv=None) -> int:
         targets, tails=tails, out_path=args.out,
         interval_s=args.interval_s,
         slo_error_budget=args.slo_error_budget)
+    membership = None
+    if args.fleet:
+        # Membership rides the supervisor's OWN event stream (spawn /
+        # drain_complete / gave_up) on a dedicated tailer — independent
+        # offset from any --tail of the same file, which keeps tailing
+        # those records into the timeline too.
+        fleet_name, fleet_path = args.fleet
+        membership = collector_mod.FleetMembership(
+            coll, collector_mod.JsonlTailer(fleet_path, fleet_name),
+            host=args.fleet_host, prefix=fleet_name,
+            timeout_s=args.scrape_timeout_s)
     deadline = (time.monotonic() + args.duration_s
                 if args.duration_s > 0 else None)
     if args.profile:
@@ -177,6 +205,12 @@ def main(argv=None) -> int:
     done = 0
     try:
         while True:
+            if membership is not None:
+                delta = membership.sync()
+                for name in delta["joined"]:
+                    print(f"fleet: {name} joined the scrape set")
+                for name in delta["left"]:
+                    print(f"fleet: {name} left the scrape set")
             window = coll.collect_once()
             done += 1
             if window is not None:
